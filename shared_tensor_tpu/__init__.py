@@ -1,0 +1,23 @@
+"""shared-tensor-tpu: a TPU-native distributed shared tensor with
+high-performance approximate (1-bit error-feedback) updates for asynchronous
+data-parallel machine learning.
+
+TPU-first re-design of the capabilities of Hello1024/shared-tensor (a 477-line
+C / Lua-Torch7 system — see SURVEY.md): the codec runs as Pallas kernels on
+HBM, intra-pod sync rides ICI collectives over a GSPMD-sharded array, and the
+peer tier is a native C++ TCP transport with the same self-organizing
+binary-tree overlay and wire format.
+"""
+
+from .config import CodecConfig, Config, MeshConfig, ScalePolicy, TransportConfig
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Config",
+    "CodecConfig",
+    "TransportConfig",
+    "MeshConfig",
+    "ScalePolicy",
+    "__version__",
+]

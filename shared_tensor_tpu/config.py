@@ -1,0 +1,109 @@
+"""Typed configuration for shared-tensor-tpu.
+
+The reference has no config system at all — its total configuration surface is
+the three positional args of ``createOrFetch(host, port, tensor)`` plus
+hard-coded constants (reference src/sharedtensor.c:349-352, :323; SURVEY.md
+§5.6). This module realizes the survey's build note: a small typed config
+covering rendezvous, mesh axes, codec policy, pacing (the reference README's
+bandwidth-limit TODO), and fault timeouts (its disconnect-handling TODO).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+
+class ScalePolicy(enum.Enum):
+    """How the per-frame quantization scale is chosen from the residual.
+
+    POW2_RMS is the reference policy: ``2^floor(log2(rms(residual)))``
+    (reference src/sharedtensor.c:153-159). RMS skips the power-of-2 floor
+    (slightly faster convergence, loses the cheap-to-compare property);
+    ABS_MEAN uses mean(|r|) like signSGD-EF literature.
+    """
+
+    POW2_RMS = "pow2_rms"
+    RMS = "rms"
+    ABS_MEAN = "abs_mean"
+
+
+@dataclasses.dataclass(frozen=True)
+class CodecConfig:
+    """Approximate-delta codec configuration.
+
+    The reference codec is fixed: 1 sign bit per element, one global scale per
+    frame chosen by POW2_RMS, error feedback via a per-link residual
+    (reference src/sharedtensor.c:106-111, :145-177; SURVEY.md App. B). Those
+    are the defaults here. ``per_leaf_scale`` realizes the reference README's
+    "table sync" TODO (README.md:41): one scale per pytree leaf instead of one
+    for the whole flat buffer, fixing the 1000:1 mixed-magnitude degradation
+    measured in BASELINE.md.
+    """
+
+    scale_policy: ScalePolicy = ScalePolicy.POW2_RMS
+    per_leaf_scale: bool = True
+    #: Skip sending when scale == 0 (fixes reference quirk Q2, which sleeps 1s
+    #: but still transmits an idle frame). Wire-compat mode forces False.
+    suppress_zero_frames: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class TransportConfig:
+    """Host (DCN/TCP) transport configuration — the peer tier.
+
+    The reference transport is hand-rolled blocking TCP with no pacing,
+    backlog 5, and exit(-1) on any error (SURVEY.md §2.3, quirks Q8/Q10).
+    """
+
+    #: Max outgoing wire bytes/sec per link; 0 = unlimited. Realizes the
+    #: reference README.md:31 bandwidth-limiting TODO (token bucket in the
+    #: native transport).
+    bandwidth_cap_bytes_per_sec: int = 0
+    #: Listen backlog (reference uses 5; quirk Q10 — join storms get refused).
+    listen_backlog: int = 128
+    #: Seconds of link silence before a peer is declared dead and the link
+    #: torn down + re-grafted (fixes reference README.md:33 / quirk Q8 —
+    #: reference kills the whole process instead).
+    peer_timeout_sec: float = 30.0
+    #: Reconnect/rejoin attempts before giving up.
+    max_rejoin_attempts: int = 8
+    #: Speak the reference's exact wire format: raw host-endian float scale +
+    #: LSB-first bitmask frames, 'Y'/'N'+sockaddr join protocol
+    #: (SURVEY.md §2.3 wire spec). Enables interop A/B against C peers.
+    wire_compat: bool = False
+    #: Emit one idle frame per second when idle, like the reference (Q2).
+    #: Only meaningful (and forced on) in wire_compat mode.
+    idle_frames: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Pod-tier (intra-slice) configuration: how the shared array is laid out
+    across the local device mesh and which collective strategy syncs it."""
+
+    #: Mesh axis name over which the shared array is sharded.
+    shard_axis: str = "shard"
+    #: Mesh axis name over which data-parallel peers (devices acting as
+    #: independent workers) exchange compressed deltas.
+    peer_axis: str = "peer"
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Top-level config. ``rendezvous`` replaces the reference's
+    (host, port) positional pair; everything else is new surface the
+    reference hard-codes."""
+
+    rendezvous_host: str = "127.0.0.1"
+    rendezvous_port: int = 50000
+    codec: CodecConfig = dataclasses.field(default_factory=CodecConfig)
+    transport: TransportConfig = dataclasses.field(default_factory=TransportConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    #: Background sync frame pacing: target seconds between frames per link;
+    #: 0 = free-running (reference behavior: fill all bandwidth, README.md:31).
+    sync_interval_sec: float = 0.0
+
+
+DEFAULT = Config()

@@ -1,0 +1,19 @@
+"""Test environment: force an 8-device virtual CPU mesh.
+
+The reference's dev story is N peers on one machine (SURVEY.md §4.1); ours is
+the same plus N virtual devices in one process. Tests never need a real TPU —
+Pallas kernels run in interpret mode on CPU, and the sharded/collective path
+runs on the virtual device mesh. The identical tests pass unmodified on real
+TPU hardware.
+
+Must set env vars before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8"
+    ).strip()

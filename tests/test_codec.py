@@ -1,0 +1,266 @@
+"""Golden tests for the pure-JAX codec against an independent numpy replica of
+the reference arithmetic (reference src/sharedtensor.c:106-111, :145-177;
+restated in SURVEY.md Appendix B), plus the measured convergence invariants
+from BASELINE.md (residual RMS halves per frame on homogeneous data; exact
+fp32 convergence in ~28 frames for U(-1,1))."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from shared_tensor_tpu.config import ScalePolicy
+from shared_tensor_tpu.ops import (
+    Frame,
+    apply_frame,
+    apply_frame_many,
+    pack_bits,
+    pad_flat,
+    padded_len,
+    quantize,
+    unpack_bits,
+    wire_to_words,
+    words_to_wire,
+)
+
+
+# --- numpy replica of the reference codec (independent golden) -------------
+
+
+def ref_quantize(residual: np.ndarray, n: int):
+    """Sender half, reference arithmetic: scale = 2^floor(log2(rms)), bit set
+    (=> -scale) iff residual <= 0, error feedback into residual."""
+    r = residual.astype(np.float32).copy()
+    live = r[:n]
+    rms = np.sqrt(np.float64(np.sum(live.astype(np.float64) ** 2)) / n)
+    scale = np.float32(2.0 ** np.floor(np.log2(rms))) if rms > 0 else np.float32(0.0)
+    bits = np.zeros(len(r), dtype=np.int32)
+    if scale > 0:
+        for i in range(n):
+            if live[i] > 0:
+                live[i] -= scale
+            else:
+                bits[i] = 1
+                live[i] += scale
+        r[:n] = live
+    return scale, bits, r
+
+
+def ref_apply(values: np.ndarray, scale, bits, n: int):
+    out = values.astype(np.float32).copy()
+    for i in range(n):
+        out[i] += scale - bits[i] * 2 * scale
+    return out
+
+
+def ref_pack_bytes(bits: np.ndarray, n: int) -> bytes:
+    """Reference wire bitmask: bit i at byte[i/8], position i%8, LSB-first
+    (src/sharedtensor.c:171)."""
+    buf = bytearray((n + 7) // 8)
+    for i in range(n):
+        if bits[i]:
+            buf[i // 8] |= 1 << (i % 8)
+    return bytes(buf)
+
+
+# --- packing ----------------------------------------------------------------
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    bits = rng.integers(0, 2, size=2048).astype(np.int32)
+    words = pack_bits(jnp.asarray(bits))
+    assert words.dtype == jnp.uint32 and words.shape == (64,)
+    out = unpack_bits(words)
+    np.testing.assert_array_equal(np.asarray(out), bits)
+
+
+def test_wire_layout_matches_reference():
+    """Little-endian serialization of LSB-first uint32 words must be
+    byte-identical to the reference's uint8 bitmask."""
+    rng = np.random.default_rng(1)
+    for n in [1, 7, 8, 33, 1000, 1024]:
+        n_pad = padded_len(n)
+        bits = np.zeros(n_pad, dtype=np.int32)
+        bits[:n] = rng.integers(0, 2, size=n)
+        words = np.asarray(pack_bits(jnp.asarray(bits)))
+        assert words_to_wire(words, n) == ref_pack_bytes(bits, n)
+
+
+def test_wire_roundtrip():
+    rng = np.random.default_rng(2)
+    n = 777
+    n_pad = padded_len(n)
+    bits = np.zeros(n_pad, dtype=np.int32)
+    bits[:n] = rng.integers(0, 2, size=n)
+    words = np.asarray(pack_bits(jnp.asarray(bits)))
+    back = wire_to_words(words_to_wire(words, n), n_pad)
+    # bits below n must survive; padding bits are zero-filled
+    out = np.asarray(unpack_bits(jnp.asarray(back)))
+    np.testing.assert_array_equal(out[:n], bits[:n])
+
+
+# --- quantize golden --------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [5, 240, 1024, 5000])
+def test_quantize_matches_reference(n):
+    rng = np.random.default_rng(n)
+    n_pad = padded_len(n)
+    r = np.zeros(n_pad, dtype=np.float32)
+    r[:n] = rng.normal(size=n).astype(np.float32)
+
+    g_scale, g_bits, g_resid = ref_quantize(r, n)
+    frame, new_resid = quantize(jnp.asarray(r), n)
+
+    assert float(frame.scale) == pytest.approx(float(g_scale), rel=0, abs=0)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_bits(frame.words))[:n], g_bits[:n]
+    )
+    np.testing.assert_array_equal(np.asarray(new_resid), g_resid)
+    # padding invariant
+    assert not np.any(np.asarray(new_resid)[n:])
+
+
+def test_apply_matches_reference():
+    rng = np.random.default_rng(7)
+    n = 500
+    n_pad = padded_len(n)
+    r = np.zeros(n_pad, dtype=np.float32)
+    r[:n] = rng.normal(size=n).astype(np.float32)
+    v = np.zeros(n_pad, dtype=np.float32)
+    v[:n] = rng.normal(size=n).astype(np.float32)
+
+    frame, _ = quantize(jnp.asarray(r), n)
+    scale = float(frame.scale)
+    bits = np.asarray(unpack_bits(frame.words))
+    golden = ref_apply(v, scale, bits, n)
+    out = apply_frame(jnp.asarray(v), frame, n)
+    np.testing.assert_array_equal(np.asarray(out), golden)
+    assert not np.any(np.asarray(out)[n:])
+
+
+def test_zero_residual_is_idle():
+    n = 1024
+    r = jnp.zeros(n, dtype=jnp.float32)
+    frame, new_r = quantize(r, n)
+    assert float(frame.scale) == 0.0
+    np.testing.assert_array_equal(np.asarray(new_r), np.zeros(n))
+
+
+def test_zero_counts_as_negative():
+    """Quirk Q3 (kept deliberately): an exactly-converged element still gets a
+    sign bit (set => -scale) and oscillates within +/-scale."""
+    n = 1024
+    r = np.full(n, 1.0, dtype=np.float32)
+    r[0] = 0.0
+    frame, _ = quantize(jnp.asarray(r), n)
+    bits = np.asarray(unpack_bits(frame.words))
+    assert bits[0] == 1 and bits[1] == 0
+
+
+def test_scale_is_power_of_two():
+    rng = np.random.default_rng(3)
+    for seed in range(5):
+        r = rng.normal(size=1024).astype(np.float32) * 10.0**seed
+        frame, _ = quantize(jnp.asarray(r), 1024)
+        s = float(frame.scale)
+        assert s > 0 and np.log2(s) == np.floor(np.log2(s))
+
+
+# --- convergence invariants (BASELINE.md measured behavior) -----------------
+
+
+def test_residual_rms_halves_per_frame():
+    """Homogeneous U(-1,1): each frame carries ~1 bit/element; residual RMS
+    must shrink by ~half per frame (BASELINE.md convergence table)."""
+    rng = np.random.default_rng(11)
+    n = 4096
+    r = jnp.asarray(rng.uniform(-1, 1, size=n).astype(np.float32))
+    prev_rms = float(jnp.sqrt(jnp.mean(r * r)))
+    for _ in range(10):
+        frame, r = quantize(r, n)
+        rms = float(jnp.sqrt(jnp.mean(r * r)))
+        assert rms <= prev_rms * 0.65  # ~0.5 expected, generous bound
+        prev_rms = rms
+
+
+def test_exact_convergence_through_link():
+    """One-way link: receiver starts at 0, sender residual = target. After
+    ~30 frames the sender residual is exactly zero (BASELINE: 'exact fp32 by
+    frame ~28') and the receiver matches the target to within 1 ulp (receiver
+    accumulation ``v += s`` rounds independently of the sender's ``r -= s``,
+    so bit-exactness is only guaranteed for the residual)."""
+    rng = np.random.default_rng(12)
+    n = 2048
+    target = rng.uniform(-1, 1, size=n).astype(np.float32)
+    r = jnp.asarray(target)
+    v = jnp.zeros(n, dtype=jnp.float32)
+    for _ in range(40):
+        frame, r = quantize(r, n)
+        if float(frame.scale) == 0.0:
+            break
+        v = apply_frame(v, frame, n)
+    assert float(jnp.max(jnp.abs(r))) == 0.0
+    np.testing.assert_allclose(np.asarray(v), target, rtol=0, atol=1.5e-7)
+
+
+def test_per_frame_movement_bounded_by_scale():
+    """Every element moves by exactly +/-scale per frame — the documented
+    overshoot bound (reference README.md:24)."""
+    rng = np.random.default_rng(13)
+    n = 1024
+    v0 = jnp.zeros(n, dtype=jnp.float32)
+    r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    frame, _ = quantize(r, n)
+    v1 = apply_frame(v0, frame, n)
+    moves = np.abs(np.asarray(v1) - np.asarray(v0))
+    np.testing.assert_allclose(moves, float(frame.scale))
+
+
+def test_apply_frame_many_floods_all_arrays():
+    rng = np.random.default_rng(14)
+    n = 1024
+    arrays = tuple(
+        jnp.asarray(rng.normal(size=n).astype(np.float32)) for _ in range(3)
+    )
+    r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    frame, _ = quantize(r, n)
+    outs = apply_frame_many(arrays, frame, n)
+    for a, o in zip(arrays, outs):
+        expected = apply_frame(a, frame, n)
+        np.testing.assert_array_equal(np.asarray(o), np.asarray(expected))
+
+
+def test_pad_flat_roundtrip():
+    x = jnp.arange(240, dtype=jnp.float32).reshape(4, 5, 6, 2)
+    from shared_tensor_tpu.ops import unpad
+
+    flat = pad_flat(x)
+    assert flat.shape[0] == padded_len(240) and flat.shape[0] % 1024 == 0
+    np.testing.assert_array_equal(np.asarray(unpad(flat, x.shape)), np.asarray(x))
+
+
+def test_mixed_magnitude_degradation():
+    """The failure mode that motivates table sync (README.md:41, BASELINE:
+    1000:1 mix -> small half stuck at ~24% error): with ONE global scale the
+    small-magnitude half must still be far from converged after 48 frames."""
+    rng = np.random.default_rng(15)
+    n = 2048
+    target = np.concatenate(
+        [
+            rng.uniform(-1, 1, size=n // 2) * 1000.0,
+            rng.uniform(-1, 1, size=n // 2),
+        ]
+    ).astype(np.float32)
+    r = jnp.asarray(target)
+    v = jnp.zeros(n, dtype=jnp.float32)
+    for _ in range(48):
+        frame, r = quantize(r, n)
+        v = apply_frame(v, frame, n)
+    small_err = np.abs(np.asarray(v)[n // 2 :] - target[n // 2 :])
+    small_rel = np.mean(small_err / np.abs(target[n // 2 :]).clip(1e-6))
+    large_err = np.abs(np.asarray(v)[: n // 2] - target[: n // 2])
+    large_rel = np.mean(large_err / np.abs(target[: n // 2]).clip(1e-6))
+    assert large_rel < 0.01
+    assert small_rel > 0.05  # still poorly converged -> table sync needed
