@@ -72,7 +72,8 @@ def compute_scale(
     if policy == ScalePolicy.RMS:
         scale = rms
     elif policy == ScalePolicy.ABS_MEAN:
-        scale = jnp.sum(jnp.abs(residual), dtype=jnp.float32) / jnp.float32(n)
+        # Same amax normalization as rms: a raw f32 |r| sum can overflow.
+        scale = amax * (jnp.sum(jnp.abs(norm), dtype=jnp.float32) / jnp.float32(n))
     else:  # POW2_RMS
         # 2^floor(log2(rms)) computed exactly by clearing the f32 mantissa.
         # TPU log2/exp2 are approximate — a scale that is off by 1 ulp from a

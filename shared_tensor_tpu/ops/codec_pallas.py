@@ -1,0 +1,223 @@
+"""Pallas TPU kernels for the approximate-delta codec.
+
+The reference's hot path is 4-6 sequential CPU passes of n float ops per frame
+(quantize src/sharedtensor.c:153-174, apply :106-111 — measured codec-CPU-bound
+at 202 M elem/s, BASELINE.md). These kernels move that work onto the TPU VPU
+with the minimum number of HBM passes:
+
+- ``quantize``: one reduction pass for the scale (XLA — it is a dependency of
+  every element, so a second pass is inherent, exactly as in the reference),
+  then ONE fused pass that sign-quantizes, packs the bits into LSB-first
+  uint32 words, and applies the error feedback to the residual.
+- ``apply_frame_many``: ONE fused pass that unpacks the bits once and adds the
+  reconstructed +/-scale delta to K arrays (replica + other links' residuals —
+  the split-horizon flood), instead of K separate unpack+apply passes.
+
+Bit layout is identical to ops/codec.py (flat bit i -> word[i//32] bit i%32),
+so frames from either implementation interoperate; parity tests in
+tests/test_codec_pallas.py require bit-for-bit equality.
+
+Kernels run compiled on TPU and fall back to the interpreter on CPU (tests).
+
+Layout: flat padded length n_pad (multiple of 1024) viewed as (n_pad/128, 128)
+float32 rows; packed words viewed as (n_pad/128, 4) uint32 rows. Row r, word k
+covers flat bits 128*r + 32*k .. +31, so ``words2d.reshape(-1)`` is the flat
+word vector used by the wire layer.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (memory spaces)
+
+from ..config import ScalePolicy
+from .codec import Frame, compute_scale
+from .packing import LANES, BITS_PER_WORD
+
+WORDS_PER_ROW = LANES // BITS_PER_WORD  # 4
+#: Rows per grid step: 512 rows x 128 lanes x 4 B = 256 KiB per buffer in
+#: VMEM — small enough to leave room for the multi-array apply, large enough
+#: to amortize grid overhead.
+BLOCK_ROWS = 512
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _live_mask(block_rows: int, pid, n: int):
+    """live[i,j] = (flat index of element (i,j) in this block) < n."""
+    row = jax.lax.broadcasted_iota(jnp.int32, (block_rows, LANES), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (block_rows, LANES), 1)
+    flat = (pid * block_rows + row) * LANES + lane
+    return flat < n
+
+
+def _exact_pow2(e_i32):
+    """2^e as exact float32 via exponent-field construction (e in [0, 15]).
+    TPU exp2 is approximate and must never be used for codec bit math."""
+    return jax.lax.bitcast_convert_type((e_i32 + 127) << 23, jnp.float32)
+
+
+def _pack_rows(bits_i32):
+    """(rows, 128) 0/1 int32 -> (rows, 4) uint32, LSB-first per 32 lanes.
+
+    Mosaic supports neither unsigned reductions nor lane-splitting reshapes
+    ((rows,128)->(rows,4,32) fails "unsupported shape cast"), so the
+    lane-group reduction runs on the MXU instead: two (rows,128)x(128,4) dots
+    with constant weight matrices W_half[l, k] = [l//32 == k] * 2^(l%16),
+    one for the low 16 bits of each word and one for the high 16. Every value
+    stays <= 65535, so the f32 dot is exact; the halves are recombined with
+    integer shifts.
+    """
+    rows = bits_i32.shape[0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (LANES, WORDS_PER_ROW), 0)
+    word = jax.lax.broadcasted_iota(jnp.int32, (LANES, WORDS_PER_ROW), 1)
+    in_word = lane // BITS_PER_WORD == word
+    e = lane % BITS_PER_WORD  # bit position within the word, 0..31
+    w_lo = jnp.where(in_word & (e < 16), _exact_pow2(e % 16), 0.0)
+    w_hi = jnp.where(in_word & (e >= 16), _exact_pow2(e % 16), 0.0)
+    bits_f = bits_i32.astype(jnp.float32)
+    lo = jnp.dot(bits_f, w_lo, preferred_element_type=jnp.float32)
+    hi = jnp.dot(bits_f, w_hi, preferred_element_type=jnp.float32)
+    words_i32 = lo.astype(jnp.int32) | (hi.astype(jnp.int32) << 16)
+    return jax.lax.bitcast_convert_type(words_i32, jnp.uint32)
+
+
+def _unpack_rows(words_u32):
+    """(rows, 4) uint32 -> (rows, 128) 0/1 int32 (inverse of _pack_rows).
+
+    The lane replication (lane l <- word[l//32]) must stay in integer domain:
+    an MXU dot would round its f32 inputs to bf16 and corrupt word values
+    above 2^8. Each word column is lane-broadcast to its 32 lanes and the
+    four spans concatenated; bit extraction is then shift+mask in int32
+    (`& 1` discards arithmetic-shift sign extension).
+    """
+    rows = words_u32.shape[0]
+    words = jax.lax.bitcast_convert_type(words_u32, jnp.int32)
+    wrep = jnp.concatenate(
+        [
+            jnp.broadcast_to(words[:, k : k + 1], (rows, BITS_PER_WORD))
+            for k in range(WORDS_PER_ROW)
+        ],
+        axis=1,
+    )
+    shift = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1) % BITS_PER_WORD
+    return (wrep >> shift) & jnp.int32(1)
+
+
+# --- quantize --------------------------------------------------------------
+
+
+def _quantize_kernel(scale_ref, resid_ref, words_ref, new_resid_ref, *, n):
+    s = scale_ref[0, 0]
+    r = resid_ref[...]
+    live = _live_mask(r.shape[0], pl.program_id(0), n)
+    neg = r <= 0.0  # bit set => send -scale (zero counts as negative, Q3)
+    bits = jnp.logical_and(live, neg)
+    words_ref[...] = _pack_rows(bits.astype(jnp.int32))
+    sent = jnp.where(neg, -s, s)
+    # s == 0: idle frame, residual untouched; padding lanes are forced back
+    # to 0 either way (re-establishes the invariant even if the caller handed
+    # us a buffer with garbage past n — matches ops/codec.py exactly).
+    new_r = jnp.where(jnp.logical_and(live, s > 0.0), r - sent, jnp.where(live, r, 0.0))
+    new_resid_ref[...] = new_r
+
+
+@partial(jax.jit, static_argnames=("n", "policy"), donate_argnums=(0,))
+def quantize(
+    residual: jnp.ndarray,
+    n: int,
+    policy: ScalePolicy = ScalePolicy.POW2_RMS,
+) -> tuple[Frame, jnp.ndarray]:
+    """Drop-in replacement for ops.codec.quantize (bit-for-bit identical),
+    with the quantize/pack/error-feedback pass as a single fused kernel.
+
+    The residual argument is donated: on TPU the new residual reuses the old
+    one's HBM buffer (callers in the sync engine always replace it).
+    """
+    n_pad = residual.shape[0]
+    rows = n_pad // LANES
+    block = min(BLOCK_ROWS, rows)
+    scale = compute_scale(residual, n, policy)
+    words2d, new_resid = pl.pallas_call(
+        partial(_quantize_kernel, n=n),
+        grid=(pl.cdiv(rows, block),),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((block, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (block, WORDS_PER_ROW), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((block, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, WORDS_PER_ROW), jnp.uint32),
+            jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
+        ],
+        input_output_aliases={1: 1},  # new residual reuses the old buffer
+        interpret=_interpret(),
+    )(scale.reshape(1, 1), residual.reshape(rows, LANES))
+    return Frame(scale, words2d.reshape(-1)), new_resid.reshape(-1)
+
+
+# --- apply -----------------------------------------------------------------
+
+
+def _apply_kernel(scale_ref, words_ref, *refs, n, k):
+    s = scale_ref[0, 0]
+    bits = _unpack_rows(words_ref[...])
+    live = _live_mask(bits.shape[0], pl.program_id(0), n)
+    delta = s * (1.0 - 2.0 * bits.astype(jnp.float32))
+    in_refs, out_refs = refs[:k], refs[k:]
+    for i_ref, o_ref in zip(in_refs, out_refs):
+        # Padding lanes forced to 0, same as the golden apply_frame.
+        o_ref[...] = jnp.where(live, i_ref[...] + delta, 0.0)
+
+
+@partial(jax.jit, static_argnames=("n",), donate_argnums=(0,))
+def apply_frame_many(
+    arrays: tuple[jnp.ndarray, ...], frame: Frame, n: int
+) -> tuple[jnp.ndarray, ...]:
+    """Fused receive-side flood: unpack the frame once, add the +/-scale delta
+    to every array (replica + other links' residuals) in one HBM pass.
+    Bit-for-bit identical to ops.codec.apply_frame_many. Arrays are donated
+    (updated in place on TPU)."""
+    k = len(arrays)
+    n_pad = arrays[0].shape[0]
+    rows = n_pad // LANES
+    block = min(BLOCK_ROWS, rows)
+    blk = lambda i: (i, 0)
+    vspec = pl.BlockSpec((block, LANES), blk, memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        partial(_apply_kernel, n=n, k=k),
+        grid=(pl.cdiv(rows, block),),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (block, WORDS_PER_ROW), blk, memory_space=pltpu.VMEM
+            ),
+        ]
+        + [vspec] * k,
+        out_specs=[vspec] * k,
+        out_shape=[jax.ShapeDtypeStruct((rows, LANES), jnp.float32)] * k,
+        input_output_aliases={2 + i: i for i in range(k)},
+        interpret=_interpret(),
+    )(
+        frame.scale.reshape(1, 1),
+        frame.words.reshape(rows, WORDS_PER_ROW),
+        *[a.reshape(rows, LANES) for a in arrays],
+    )
+    return tuple(o.reshape(-1) for o in outs)
+
+
+@partial(jax.jit, static_argnames=("n",), donate_argnums=(0,))
+def apply_frame(values: jnp.ndarray, frame: Frame, n: int) -> jnp.ndarray:
+    """Single-array apply (see apply_frame_many)."""
+    return apply_frame_many((values,), frame, n)[0]

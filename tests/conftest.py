@@ -11,9 +11,21 @@ Must set env vars before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the ambient environment pins JAX_PLATFORMS to the
+# real TPU plugin; tests must run on the virtual CPU mesh regardless.
+# ST_TEST_PLATFORM overrides (e.g. ST_TEST_PLATFORM=axon pytest ... to run
+# the suite compiled on a real chip).
+_platform = os.environ.get("ST_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
         prev + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# A pytest plugin may have imported jax before this conftest ran, in which
+# case the env var alone is too late; the config update below still works as
+# long as no backend has been initialized yet (they init lazily).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
