@@ -1,0 +1,173 @@
+"""SharedTensor: the process-local replica + per-link codec state.
+
+This is the TPU-native equivalent of the reference's ``SharedTensor`` struct
+(reference src/sharedtensor.c:30-39: full replica ``values[]`` plus one
+residual buffer per tree link) and its update semantics (``addFromInternal``
+:334-344; flood-on-receive :124-127). Differences by design:
+
+- State is a pytree ("table") of tensors with per-leaf codec scales, not one
+  flat buffer — the reference README's "table sync" TODO (README.md:41) is
+  first-class here.
+- Links are dynamic: the reference hard-codes exactly 3 (up/left/right) and
+  pre-accumulates updates into *unconnected* slots so a late joiner can be
+  seeded (SURVEY.md §5.4). Here a new link's residual is explicitly seeded
+  with the current replica — the same state-transfer-through-the-codec
+  mechanism, made explicit — so any number of links works and a dropped peer
+  can re-graft anywhere (fixes reference quirk Q8 / README.md:33).
+- All array updates are functional JAX ops guarded by one mutex; the
+  reference's unsynchronized concurrent ``float +=`` races (quirk Q7, lost
+  updates) are gone by construction while the *approximate* semantics stay in
+  the codec.
+
+The object is deliberately transport-agnostic: the peer engine (comm/) calls
+``make_frame``/``receive_frame``; tests drive it in-process.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from .config import CodecConfig
+from .ops.table import (
+    TableFrame,
+    TableSpec,
+    accumulate_table,
+    apply_table_many,
+    flatten,
+    make_spec,
+    quantize_table,
+    unflatten,
+)
+
+
+class SharedTensor:
+    """Replica + per-link residuals for one shared table of tensors.
+
+    Reference API mapping (src/sharedtensor.c:455-465):
+      ``copyToTensor`` -> :meth:`read` (snapshot), ``addFromTensor`` ->
+      :meth:`add`, link fan-out -> :meth:`new_link`/:meth:`receive_frame`.
+    """
+
+    def __init__(
+        self,
+        template: Any,
+        codec: CodecConfig | None = None,
+        seed_values: bool = False,
+    ):
+        self.spec: TableSpec = make_spec(template)
+        self.codec = codec or CodecConfig()
+        self._lock = threading.Lock()
+        if seed_values:
+            self.values = flatten(template, self.spec)
+        else:
+            self.values = jnp.zeros(self.spec.total, jnp.float32)
+        self._links: dict[int, jnp.ndarray] = {}
+        # observability (SURVEY.md §5.5: the reference has none)
+        self.frames_out = 0
+        self.frames_in = 0
+        self.updates = 0
+
+    # -- links -------------------------------------------------------------
+
+    def new_link(self, link_id: int, seed: bool = True) -> None:
+        """Open a link. ``seed=True`` preloads the residual with the full
+        current replica, so the peer on the other end receives complete
+        state-to-date through normal codec frames — the reference's join /
+        state-transfer mechanism (src/sharedtensor.c:379-381 master seeding;
+        §5.4), generalized to any link at any time (rejoin support)."""
+        with self._lock:
+            if link_id in self._links:
+                raise ValueError(f"link {link_id} already exists")
+            if seed:
+                self._links[link_id] = self.values
+            else:
+                self._links[link_id] = jnp.zeros(self.spec.total, jnp.float32)
+
+    def drop_link(self, link_id: int) -> None:
+        """Close a link (peer died or left). Undelivered residual is
+        discarded — our replica already contains those updates; the departed
+        peer recovers them by re-grafting (its new parent seeds with the full
+        replica). The reference instead kills the whole process (quirk Q8)."""
+        with self._lock:
+            self._links.pop(link_id, None)
+
+    @property
+    def link_ids(self) -> tuple[int, ...]:
+        return tuple(self._links)
+
+    # -- user API ----------------------------------------------------------
+
+    def read(self) -> Any:
+        """Snapshot of the replica as the caller's pytree structure
+        (reference l_copyToTensor, src/sharedtensor.c:435-446)."""
+        return unflatten(self.values, self.spec)
+
+    def add(self, delta: Any) -> None:
+        """Merge an additive update: replica and every link residual receive
+        it (reference addFromInternal, src/sharedtensor.c:334-344)."""
+        update = flatten(delta, self.spec)
+        with self._lock:
+            ids = tuple(self._links)
+            arrays = (self.values, *(self._links[i] for i in ids))
+            out = accumulate_table(arrays, update, self.spec)
+            self.values = out[0]
+            for i, r in zip(ids, out[1:]):
+                self._links[i] = r
+            self.updates += 1
+
+    # -- sync engine hooks -------------------------------------------------
+
+    def make_frame(self, link_id: int) -> Optional[TableFrame]:
+        """Quantize this link's residual into a frame and apply error
+        feedback. Returns None when every leaf's scale is 0 and the codec
+        suppresses idle frames (fixing reference quirk Q2 — it transmits
+        1 zero-scale frame/s/link forever)."""
+        with self._lock:
+            resid = self._links.get(link_id)
+            if resid is None:
+                return None  # link dropped concurrently (peer death race)
+            frame, new_resid = quantize_table(
+                resid,
+                self.spec,
+                self.codec.scale_policy,
+                self.codec.per_leaf_scale,
+            )
+            if self.codec.suppress_zero_frames and not bool(
+                jnp.any(frame.scales > 0)
+            ):
+                return None
+            self._links[link_id] = new_resid
+            self.frames_out += 1
+            return frame
+
+    def receive_frame(self, link_id: int, frame: TableFrame) -> None:
+        """Apply an incoming frame to the replica and to every *other* link's
+        residual (split-horizon flood with per-hop re-quantization, reference
+        sync_in src/sharedtensor.c:124-127). ``link_id`` may be unknown
+        (already-dropped peer): the frame still applies to the replica."""
+        with self._lock:
+            others = tuple(i for i in self._links if i != link_id)
+            arrays = (self.values, *(self._links[i] for i in others))
+            out = apply_table_many(arrays, frame, self.spec)
+            self.values = out[0]
+            for i, r in zip(others, out[1:]):
+                self._links[i] = r
+            self.frames_in += 1
+
+    # -- introspection -----------------------------------------------------
+
+    def residual_rms(self, link_id: int) -> float:
+        with self._lock:
+            r = self._links.get(link_id)
+        if r is None:
+            return 0.0
+        return float(jnp.sqrt(jnp.sum(r * r) / self.spec.total_n))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"SharedTensor(leaves={self.spec.num_leaves}, n={self.spec.total_n}, "
+            f"links={list(self._links)}, out={self.frames_out}, in={self.frames_in})"
+        )
