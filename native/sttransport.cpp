@@ -1,0 +1,770 @@
+// sttransport: native host transport for shared-tensor-tpu.
+//
+// TPU-native re-design of the reference's communication layers (the 477-line
+// C module's L1 robust I/O, L3 link engines, L4 tree topology — see SURVEY.md
+// §1; reference src/sharedtensor.c:53-104, :113-189, :192-332). The codec
+// math itself lives on the TPU (Pallas kernels); this library owns only the
+// wire: the self-organizing binary-tree overlay, framed full-duplex streaming
+// per link, join/redirect membership, bandwidth pacing, liveness, and
+// metrics. Frames are opaque byte payloads to this layer.
+//
+// Deliberate fixes over the reference (SURVEY.md Appendix A):
+//  - any socket error tears down ONE link and emits an event instead of
+//    exit(-1) for the whole process (quirks Q8; README.md:33 TODO);
+//  - a dropped uplink re-joins through the rendezvous automatically;
+//  - outgoing bandwidth can be capped per link (token bucket; README.md:31);
+//  - configurable listen backlog (Q10), clean shutdown for connected nodes.
+//
+// Two wire modes:
+//  - native (default): length-prefixed frames [u32le len][payload]; len==0 is
+//    a keepalive. Join handshake: client sends "STT2" + u32le payload_hint;
+//    server replies 'Y' (accept) or 'N' + 16-byte IPv4 sockaddr redirect.
+//  - wire-compat: byte-exact reference protocol for interop with C peers
+//    (SURVEY.md §2.3): no hello, fixed-size frames [f32 scale][ceil(n/8) bit
+//    mask], join reply 'Y' / 'N'+sockaddr, idle links emit one zero-scale
+//    frame per second (reference quirk Q2 behavior, required for liveness).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+constexpr uint32_t kMaxPayload = 1u << 30;  // 1 GiB sanity cap
+constexpr char kMagic[4] = {'S', 'T', 'T', '2'};
+
+struct Config {
+  int32_t wire_compat = 0;
+  // compat mode: fixed frame payload size (4 + ceil(n/8)); native: 0.
+  int32_t compat_frame_bytes = 0;
+  int32_t listen_backlog = 128;
+  int64_t bandwidth_cap_bps = 0;   // outgoing payload bytes/sec per link
+  double peer_timeout_sec = 30.0;  // 0 = no liveness timeout
+  double keepalive_sec = 1.0;
+  int32_t max_children = 2;
+  int32_t queue_depth = 8;
+  int32_t max_rejoin_attempts = 8;
+  double rejoin_backoff_sec = 0.2;
+};
+
+struct Event {
+  int32_t kind;  // 1 = link up, 2 = link down, 3 = became master
+  int32_t link_id;
+  int32_t is_uplink;
+};
+
+// Bounded MPMC queue of byte buffers with close() wakeup.
+class FrameQueue {
+ public:
+  explicit FrameQueue(size_t cap) : cap_(cap) {}
+
+  bool push(std::vector<uint8_t>&& f, double timeout_sec) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!not_full_.wait_for(lk, secs(timeout_sec),
+                            [&] { return closed_ || q_.size() < cap_; }))
+      return false;
+    if (closed_) return false;
+    q_.push_back(std::move(f));
+    not_empty_.notify_one();
+    return true;
+  }
+
+  bool pop(std::vector<uint8_t>* out, double timeout_sec) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!not_empty_.wait_for(lk, secs(timeout_sec),
+                             [&] { return closed_ || !q_.empty(); }))
+      return false;
+    if (q_.empty()) return false;  // closed and drained
+    *out = std::move(q_.front());
+    q_.pop_front();
+    not_full_.notify_one();
+    return true;
+  }
+
+  size_t size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+ private:
+  static std::chrono::duration<double> secs(double s) {
+    return std::chrono::duration<double>(s);
+  }
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<std::vector<uint8_t>> q_;
+  size_t cap_;
+  bool closed_ = false;
+};
+
+// One full-duplex framed TCP link (the reference's synca/sync_in thread pair,
+// src/sharedtensor.c:113-189, minus the codec math which lives on-device).
+struct Link {
+  int32_t id = -1;
+  int fd = -1;
+  int32_t is_uplink = 0;
+  std::atomic<bool> alive{true};
+  // Two detached I/O threads own the link; the last one out closes the fd
+  // (closing it earlier could race a kernel fd-number reuse with the other
+  // thread's blocked read).
+  std::atomic<int> io_refs{2};
+  FrameQueue sendq, recvq;
+  // stats
+  std::atomic<uint64_t> bytes_out{0}, bytes_in{0}, frames_out{0}, frames_in{0};
+  // the peer address as observed by accept(); because children bind their
+  // listen socket to their uplink's local endpoint (the reference's
+  // addressing trick, src/sharedtensor.c:292-316), this doubles as the
+  // child's listen address for redirects.
+  sockaddr_in peer_addr{};
+
+  Link(size_t qdepth) : sendq(qdepth), recvq(qdepth) {}
+};
+
+struct Node;
+void link_sender_loop(Node* node, std::shared_ptr<Link> link);
+void link_receiver_loop(Node* node, std::shared_ptr<Link> link);
+void listener_loop(Node* node);
+void rejoin_loop(Node* node);
+
+struct Node {
+  Config cfg;
+  std::atomic<bool> closing{false};
+  std::atomic<int> active_threads{0};  // all detached; close() drains to 0
+  int listen_fd = -1;
+
+  std::mutex mu;  // guards links, child slots, next id
+  std::map<int32_t, std::shared_ptr<Link>> links;
+  std::shared_ptr<Link> child_slot[16];  // up to max_children (<=16)
+  int lrcounter = 0;
+  int32_t next_link_id = 1;
+  int32_t uplink_id = -1;
+
+  std::mutex ev_mu;
+  std::deque<Event> events;
+  std::condition_variable ev_cv;
+
+  sockaddr_in rendezvous{};
+  bool is_master = false;
+  std::string last_error;
+
+  void emit(int32_t kind, int32_t link_id, int32_t is_uplink) {
+    std::lock_guard<std::mutex> lk(ev_mu);
+    events.push_back({kind, link_id, is_uplink});
+    ev_cv.notify_all();
+  }
+};
+
+// ---- robust I/O (the reference's read_or_die/write_or_die, but returning
+// errors instead of exiting the process) --------------------------------
+
+bool read_full(int fd, uint8_t* buf, size_t count) {
+  while (count) {
+    ssize_t r = ::read(fd, buf, count);
+    if (r == 0) return false;  // peer closed
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;  // includes EAGAIN from SO_RCVTIMEO => liveness timeout
+    }
+    buf += r;
+    count -= r;
+  }
+  return true;
+}
+
+bool write_full(int fd, const uint8_t* buf, size_t count) {
+  while (count) {
+    ssize_t r = ::write(fd, buf, count);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    buf += r;
+    count -= r;
+  }
+  return true;
+}
+
+void set_common_sockopts(int fd) {
+  int yes = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &yes, sizeof yes);
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &yes, sizeof yes);
+}
+
+void set_recv_timeout(int fd, double sec) {
+  if (sec <= 0) return;
+  timeval tv;
+  tv.tv_sec = (time_t)sec;
+  tv.tv_usec = (suseconds_t)((sec - (double)tv.tv_sec) * 1e6);
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+}
+
+// ---- link lifecycle ------------------------------------------------------
+
+std::shared_ptr<Link> make_link(Node* node, int fd, int32_t is_uplink,
+                                const sockaddr_in* peer) {
+  auto link = std::make_shared<Link>((size_t)node->cfg.queue_depth);
+  {
+    std::lock_guard<std::mutex> lk(node->mu);
+    link->id = node->next_link_id++;
+    link->fd = fd;
+    link->is_uplink = is_uplink;
+    if (peer) link->peer_addr = *peer;
+    node->links[link->id] = link;
+    if (is_uplink) node->uplink_id = link->id;
+  }
+  set_recv_timeout(fd, node->cfg.peer_timeout_sec);
+  node->active_threads += 2;
+  std::thread(link_sender_loop, node, link).detach();
+  std::thread(link_receiver_loop, node, link).detach();
+  node->emit(1, link->id, is_uplink);
+  return link;
+}
+
+// Called at the end of each detached link-I/O thread.
+void link_io_exit(Node* node, const std::shared_ptr<Link>& link) {
+  if (--link->io_refs == 0) ::close(link->fd);
+  --node->active_threads;
+}
+
+// Tear down one link; the rest of the node keeps running (the fix for the
+// reference's exit(-1)-on-any-error model, src/sharedtensor.c:61-63).
+void kill_link(Node* node, std::shared_ptr<Link> link) {
+  bool was_alive = link->alive.exchange(false);
+  if (!was_alive) return;
+  ::shutdown(link->fd, SHUT_RDWR);
+  link->sendq.close();
+  link->recvq.close();
+  bool was_uplink = false;
+  {
+    std::lock_guard<std::mutex> lk(node->mu);
+    for (int i = 0; i < node->cfg.max_children; i++)
+      if (node->child_slot[i] == link) node->child_slot[i] = nullptr;
+    if (node->uplink_id == link->id) {
+      node->uplink_id = -1;
+      was_uplink = true;
+    }
+    node->links.erase(link->id);
+  }
+  node->emit(2, link->id, was_uplink ? 1 : 0);
+  // fd is closed by the last I/O thread to exit (link_io_exit); shutdown()
+  // above already unblocked both.
+}
+
+void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
+  // token bucket for the bandwidth cap (reference README.md:31 TODO)
+  double tokens = 0;
+  auto last = Clock::now();
+  const int64_t cap = node->cfg.bandwidth_cap_bps;
+
+  std::vector<uint8_t> frame;
+  while (link->alive && !node->closing) {
+    bool have = link->sendq.pop(&frame, node->cfg.keepalive_sec);
+    if (!link->alive || node->closing) break;
+    if (!have) {
+      // idle: emit liveness traffic. Native: zero-length keepalive frame.
+      // Compat: a zero-scale codec frame — the reference's own idle
+      // behavior (quirk Q2), which its peers expect.
+      if (node->cfg.wire_compat) {
+        frame.assign((size_t)node->cfg.compat_frame_bytes, 0);
+      } else {
+        frame.clear();
+      }
+    }
+    if (cap > 0 && !frame.empty()) {
+      auto now = Clock::now();
+      tokens += std::chrono::duration<double>(now - last).count() * (double)cap;
+      // burst allowance: 100ms worth, so the cap is honored even for the
+      // first frames after an idle period
+      if (tokens > 0.1 * (double)cap) tokens = 0.1 * (double)cap;
+      last = now;
+      if ((double)frame.size() > tokens) {
+        double wait = ((double)frame.size() - tokens) / (double)cap;
+        std::this_thread::sleep_for(std::chrono::duration<double>(wait));
+        tokens = 0;
+        last = Clock::now();  // the slept interval is spent, not re-credited
+      } else {
+        tokens -= (double)frame.size();
+      }
+    }
+    bool ok;
+    if (node->cfg.wire_compat) {
+      ok = write_full(link->fd, frame.data(), frame.size());
+    } else {
+      uint32_t len = (uint32_t)frame.size();
+      uint8_t hdr[4] = {(uint8_t)len, (uint8_t)(len >> 8), (uint8_t)(len >> 16),
+                        (uint8_t)(len >> 24)};
+      ok = write_full(link->fd, hdr, 4) &&
+           (frame.empty() || write_full(link->fd, frame.data(), frame.size()));
+    }
+    if (!ok) break;
+    if (have) {
+      link->frames_out++;
+    }
+    link->bytes_out += frame.size() + (node->cfg.wire_compat ? 0 : 4);
+  }
+  kill_link(node, link);
+  link_io_exit(node, link);
+}
+
+void link_receiver_loop(Node* node, std::shared_ptr<Link> link) {
+  while (link->alive && !node->closing) {
+    std::vector<uint8_t> frame;
+    if (node->cfg.wire_compat) {
+      frame.resize((size_t)node->cfg.compat_frame_bytes);
+      if (!read_full(link->fd, frame.data(), frame.size())) break;
+    } else {
+      uint8_t hdr[4];
+      if (!read_full(link->fd, hdr, 4)) break;
+      uint32_t len = (uint32_t)hdr[0] | ((uint32_t)hdr[1] << 8) |
+                     ((uint32_t)hdr[2] << 16) | ((uint32_t)hdr[3] << 24);
+      if (len > kMaxPayload) break;  // protocol violation
+      if (len == 0) continue;        // keepalive
+      frame.resize(len);
+      if (!read_full(link->fd, frame.data(), len)) break;
+    }
+    link->bytes_in += frame.size() + (node->cfg.wire_compat ? 0 : 4);
+    link->frames_in++;
+    // Block if Python is behind: TCP backpressure then paces the peer,
+    // exactly like the reference's blocking frame loop. Never drop: frames
+    // are cumulative deltas.
+    while (link->alive && !node->closing) {
+      if (link->recvq.push(std::move(frame), 0.5)) break;
+    }
+  }
+  kill_link(node, link);
+  link_io_exit(node, link);
+}
+
+// ---- topology: listener (reference do_listening, src/sharedtensor.c:
+// 192-242) ----------------------------------------------------------------
+
+void listener_loop(Node* node) {
+  while (!node->closing) {
+    sockaddr_in peer{};
+    socklen_t plen = sizeof peer;
+    int fd = ::accept(node->listen_fd, (sockaddr*)&peer, &plen);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      if (node->closing) break;
+      continue;
+    }
+    if (node->closing) {
+      ::close(fd);
+      break;
+    }
+    set_common_sockopts(fd);
+
+    if (!node->cfg.wire_compat) {
+      // native hello: magic + payload hint
+      uint8_t hello[8];
+      set_recv_timeout(fd, 5.0);
+      if (!read_full(fd, hello, 8) || memcmp(hello, kMagic, 4) != 0) {
+        ::close(fd);
+        continue;
+      }
+    }
+
+    // free child slot? accept. Otherwise redirect down the tree,
+    // alternating between children (reference :226-234).
+    int slot = -1;
+    std::shared_ptr<Link> redirect_to;
+    {
+      std::lock_guard<std::mutex> lk(node->mu);
+      for (int i = 0; i < node->cfg.max_children; i++) {
+        if (!node->child_slot[i]) {
+          slot = i;
+          break;
+        }
+      }
+      if (slot < 0) {
+        // pick an alternating live child for the redirect
+        for (int t = 0; t < node->cfg.max_children; t++) {
+          int i = (node->lrcounter++) % node->cfg.max_children;
+          if (node->child_slot[i]) {
+            redirect_to = node->child_slot[i];
+            break;
+          }
+        }
+      }
+    }
+    if (slot >= 0) {
+      uint8_t y = 'Y';
+      if (!write_full(fd, &y, 1)) {
+        ::close(fd);
+        continue;
+      }
+      auto link = make_link(node, fd, /*is_uplink=*/0, &peer);
+      std::lock_guard<std::mutex> lk(node->mu);
+      node->child_slot[slot] = link;
+    } else if (redirect_to) {
+      uint8_t n = 'N';
+      sockaddr_in addr = redirect_to->peer_addr;
+      write_full(fd, &n, 1);
+      write_full(fd, (const uint8_t*)&addr, sizeof addr);
+      ::close(fd);
+    } else {
+      ::close(fd);  // no children to redirect to and no slots (shutting down)
+    }
+  }
+  --node->active_threads;
+}
+
+// ---- topology: join walk (reference connect_to, src/sharedtensor.c:
+// 244-332) ----------------------------------------------------------------
+
+// Walk the tree from the rendezvous until someone accepts us (O(log N)
+// redirects). Returns connected fd + the local endpoint of that socket, or
+// -1 with *became_master=true when nobody answers at the rendezvous.
+int join_walk(Node* node, sockaddr_in target, bool allow_master,
+              bool* became_master, sockaddr_in* local_endpoint) {
+  *became_master = false;
+  for (int hops = 0; hops < 64; hops++) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    set_common_sockopts(fd);
+    if (::connect(fd, (sockaddr*)&target, sizeof target) < 0) {
+      ::close(fd);
+      if (hops == 0 && allow_master) {
+        // nobody home at the rendezvous: we are the master (the reference's
+        // master election, src/sharedtensor.c:271-277)
+        *became_master = true;
+        return -1;
+      }
+      return -1;
+    }
+    if (!node->cfg.wire_compat) {
+      uint8_t hello[8];
+      memcpy(hello, kMagic, 4);
+      uint32_t hint = (uint32_t)node->cfg.compat_frame_bytes;
+      memcpy(hello + 4, &hint, 4);
+      if (!write_full(fd, hello, 8)) {
+        ::close(fd);
+        return -1;
+      }
+    }
+    uint8_t reply;
+    set_recv_timeout(fd, 10.0);
+    if (!read_full(fd, &reply, 1)) {
+      ::close(fd);
+      return -1;
+    }
+    if (reply == 'Y') {
+      socklen_t len = sizeof *local_endpoint;
+      getsockname(fd, (sockaddr*)local_endpoint, &len);
+      set_recv_timeout(fd, node->cfg.peer_timeout_sec);
+      return fd;
+    }
+    if (reply != 'N') {
+      ::close(fd);
+      return -1;
+    }
+    sockaddr_in next{};
+    if (!read_full(fd, (uint8_t*)&next, sizeof next)) {
+      ::close(fd);
+      return -1;
+    }
+    ::close(fd);
+    target = next;
+  }
+  return -1;
+}
+
+// Uplink died: re-graft through the rendezvous (fixes reference quirk Q8 —
+// it exits instead). Children keep streaming throughout.
+void rejoin_loop(Node* node) {
+  while (!node->closing) {
+    {
+      std::unique_lock<std::mutex> lk(node->ev_mu);
+      node->ev_cv.wait_for(lk, std::chrono::milliseconds(200));
+    }
+    if (node->closing) break;
+    bool need;
+    {
+      std::lock_guard<std::mutex> lk(node->mu);
+      need = !node->is_master && node->uplink_id < 0;
+    }
+    if (!need) continue;
+    bool rejoined = false;
+    for (int attempt = 0;
+         attempt < node->cfg.max_rejoin_attempts && !node->closing; attempt++) {
+      std::this_thread::sleep_for(std::chrono::duration<double>(
+          node->cfg.rejoin_backoff_sec * (double)(1 << std::min(attempt, 6))));
+      bool became_master = false;
+      sockaddr_in local{};
+      int fd = join_walk(node, node->rendezvous, /*allow_master=*/false,
+                         &became_master, &local);
+      if (fd >= 0) {
+        make_link(node, fd, /*is_uplink=*/1, nullptr);
+        rejoined = true;
+        break;
+      }
+    }
+    if (!rejoined && !node->closing) {
+      node->emit(4, 0, 1);  // rejoin failed: Python decides what to do next
+    }
+  }
+  --node->active_threads;
+}
+
+}  // namespace
+
+// ---- C ABI ---------------------------------------------------------------
+
+extern "C" {
+
+typedef struct StNodeHandle StNodeHandle;
+
+struct StConfigC {
+  int32_t wire_compat;
+  int32_t compat_frame_bytes;
+  int32_t listen_backlog;
+  int64_t bandwidth_cap_bps;
+  double peer_timeout_sec;
+  double keepalive_sec;
+  int32_t max_children;
+  int32_t queue_depth;
+  int32_t max_rejoin_attempts;
+  double rejoin_backoff_sec;
+};
+
+struct StEventC {
+  int32_t kind;
+  int32_t link_id;
+  int32_t is_uplink;
+};
+
+struct StStatsC {
+  uint64_t bytes_out, bytes_in, frames_out, frames_in;
+  int32_t send_queue, recv_queue;
+};
+
+// Create a node and join the tree at host:port (or become master when nobody
+// answers). Returns NULL on error. is_master receives 1/0.
+void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
+                     int32_t* is_master) {
+  if (cfg_c->wire_compat && cfg_c->compat_frame_bytes < 5) {
+    return nullptr;  // compat frames are [f32 scale][>=1 bitmask byte]
+  }
+  auto* node = new Node();
+  Config& cfg = node->cfg;
+  cfg.wire_compat = cfg_c->wire_compat;
+  cfg.compat_frame_bytes = cfg_c->compat_frame_bytes;
+  cfg.listen_backlog = cfg_c->listen_backlog;
+  cfg.bandwidth_cap_bps = cfg_c->bandwidth_cap_bps;
+  cfg.peer_timeout_sec = cfg_c->peer_timeout_sec;
+  cfg.keepalive_sec = cfg_c->keepalive_sec;
+  cfg.max_children = std::min<int32_t>(cfg_c->max_children, 16);
+  cfg.queue_depth = cfg_c->queue_depth;
+  cfg.max_rejoin_attempts = cfg_c->max_rejoin_attempts;
+  cfg.rejoin_backoff_sec = cfg_c->rejoin_backoff_sec;
+
+  hostent* server = gethostbyname(host);
+  if (!server) {
+    node->last_error = "no such host";
+    delete node;
+    return nullptr;
+  }
+  sockaddr_in target{};
+  target.sin_family = AF_INET;
+  memcpy(&target.sin_addr.s_addr, server->h_addr, server->h_length);
+  target.sin_port = htons((uint16_t)port);
+  node->rendezvous = target;
+
+  bool became_master = false;
+  sockaddr_in listen_addr{};
+  int up_fd =
+      join_walk(node, target, /*allow_master=*/true, &became_master, &listen_addr);
+  if (up_fd < 0 && !became_master) {
+    delete node;
+    return nullptr;
+  }
+  node->is_master = became_master;
+  if (became_master) listen_addr = target;  // master owns the rendezvous addr
+
+  // Bind the listen socket to the same endpoint our parent observed (the
+  // reference's addressing trick) so redirects that hand out our accept()-
+  // observed address reach our listener.
+  node->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  set_common_sockopts(node->listen_fd);
+  if (::bind(node->listen_fd, (sockaddr*)&listen_addr, sizeof listen_addr) < 0 ||
+      ::listen(node->listen_fd, cfg.listen_backlog) < 0) {
+    ::close(node->listen_fd);
+    if (up_fd >= 0) ::close(up_fd);
+    delete node;
+    return nullptr;
+  }
+
+  node->active_threads += 2;
+  std::thread(listener_loop, node).detach();
+  std::thread(rejoin_loop, node).detach();
+  if (up_fd >= 0) make_link(node, up_fd, /*is_uplink=*/1, nullptr);
+  if (is_master) *is_master = became_master ? 1 : 0;
+  if (became_master) node->emit(3, 0, 0);
+  return node;
+}
+
+int32_t st_node_listen_port(void* h) {
+  auto* node = (Node*)h;
+  sockaddr_in addr{};
+  socklen_t len = sizeof addr;
+  if (getsockname(node->listen_fd, (sockaddr*)&addr, &len) < 0) return -1;
+  return (int32_t)ntohs(addr.sin_port);
+}
+
+// Enqueue a frame for a link. Returns 1 on success, 0 if the queue stayed
+// full for timeout_sec (backpressure — caller should retry), -1 dead link.
+int32_t st_node_send(void* h, int32_t link_id, const uint8_t* data,
+                     int32_t len, double timeout_sec) {
+  auto* node = (Node*)h;
+  std::shared_ptr<Link> link;
+  {
+    std::lock_guard<std::mutex> lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it == node->links.end()) return -1;
+    link = it->second;
+  }
+  if (!link->alive) return -1;
+  std::vector<uint8_t> frame(data, data + len);
+  return link->sendq.push(std::move(frame), timeout_sec) ? 1 : 0;
+}
+
+// Dequeue a received frame. Returns payload length (copied into buf up to
+// cap), 0 if none within timeout, -1 if the link is dead AND drained.
+int32_t st_node_recv(void* h, int32_t link_id, uint8_t* buf, int32_t cap,
+                     double timeout_sec) {
+  auto* node = (Node*)h;
+  std::shared_ptr<Link> link;
+  {
+    std::lock_guard<std::mutex> lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it == node->links.end()) return -1;
+    link = it->second;
+  }
+  std::vector<uint8_t> frame;
+  if (!link->recvq.pop(&frame, timeout_sec)) {
+    return link->alive ? 0 : -1;
+  }
+  int32_t n = (int32_t)std::min<size_t>(frame.size(), (size_t)cap);
+  memcpy(buf, frame.data(), (size_t)n);
+  return n;
+}
+
+int32_t st_node_poll_events(void* h, StEventC* out, int32_t cap,
+                            double timeout_sec) {
+  auto* node = (Node*)h;
+  std::unique_lock<std::mutex> lk(node->ev_mu);
+  if (node->events.empty() && timeout_sec > 0) {
+    node->ev_cv.wait_for(lk, std::chrono::duration<double>(timeout_sec));
+  }
+  int32_t n = 0;
+  while (n < cap && !node->events.empty()) {
+    Event e = node->events.front();
+    node->events.pop_front();
+    out[n].kind = e.kind;
+    out[n].link_id = e.link_id;
+    out[n].is_uplink = e.is_uplink;
+    n++;
+  }
+  return n;
+}
+
+int32_t st_node_links(void* h, int32_t* out, int32_t cap) {
+  auto* node = (Node*)h;
+  std::lock_guard<std::mutex> lk(node->mu);
+  int32_t n = 0;
+  for (auto& kv : node->links) {
+    if (n >= cap) break;
+    out[n++] = kv.first;
+  }
+  return n;
+}
+
+int32_t st_node_uplink(void* h) {
+  auto* node = (Node*)h;
+  std::lock_guard<std::mutex> lk(node->mu);
+  return node->uplink_id;
+}
+
+int32_t st_node_stats(void* h, int32_t link_id, StStatsC* out) {
+  auto* node = (Node*)h;
+  std::shared_ptr<Link> link;
+  {
+    std::lock_guard<std::mutex> lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it == node->links.end()) return -1;
+    link = it->second;
+  }
+  out->bytes_out = link->bytes_out;
+  out->bytes_in = link->bytes_in;
+  out->frames_out = link->frames_out;
+  out->frames_in = link->frames_in;
+  out->send_queue = (int32_t)link->sendq.size();
+  out->recv_queue = (int32_t)link->recvq.size();
+  return 0;
+}
+
+// Drop one link deliberately (tests / fault injection).
+int32_t st_node_drop_link(void* h, int32_t link_id) {
+  auto* node = (Node*)h;
+  std::shared_ptr<Link> link;
+  {
+    std::lock_guard<std::mutex> lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it == node->links.end()) return -1;
+    link = it->second;
+  }
+  kill_link(node, link);
+  return 0;
+}
+
+void st_node_close(void* h) {
+  auto* node = (Node*)h;
+  node->closing = true;
+  ::shutdown(node->listen_fd, SHUT_RDWR);
+  ::close(node->listen_fd);
+  std::vector<std::shared_ptr<Link>> links;
+  {
+    std::lock_guard<std::mutex> lk(node->mu);
+    for (auto& kv : node->links) links.push_back(kv.second);
+  }
+  for (auto& l : links) kill_link(node, l);
+  node->ev_cv.notify_all();
+  // All threads are detached; wait (bounded) for them to drain.
+  for (int i = 0; i < 1000 && node->active_threads > 0; i++) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  if (node->active_threads == 0) {
+    delete node;
+  }
+  // else: leak the node rather than free memory under a live thread —
+  // cannot happen unless a peer wedges a write for >10s during shutdown.
+}
+
+}  // extern "C"

@@ -1,0 +1,266 @@
+"""ctypes binding to the native C++ transport (native/sttransport.cpp).
+
+The native library owns the wire — TCP binary-tree overlay, framed streaming,
+pacing, liveness, rejoin — while frames stay opaque bytes at this layer. The
+peer engine (comm/peer.py) composes frames from device-side codec output.
+
+Builds the shared library on demand with `make -C native` (g++ is in the
+image; no pybind11 — plain C ABI).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import enum
+import pathlib
+import subprocess
+from typing import Optional
+
+from ..config import TransportConfig
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libsttransport.so"
+
+
+class _StConfigC(ctypes.Structure):
+    _fields_ = [
+        ("wire_compat", ctypes.c_int32),
+        ("compat_frame_bytes", ctypes.c_int32),
+        ("listen_backlog", ctypes.c_int32),
+        ("bandwidth_cap_bps", ctypes.c_int64),
+        ("peer_timeout_sec", ctypes.c_double),
+        ("keepalive_sec", ctypes.c_double),
+        ("max_children", ctypes.c_int32),
+        ("queue_depth", ctypes.c_int32),
+        ("max_rejoin_attempts", ctypes.c_int32),
+        ("rejoin_backoff_sec", ctypes.c_double),
+    ]
+
+
+class _StEventC(ctypes.Structure):
+    _fields_ = [
+        ("kind", ctypes.c_int32),
+        ("link_id", ctypes.c_int32),
+        ("is_uplink", ctypes.c_int32),
+    ]
+
+
+class _StStatsC(ctypes.Structure):
+    _fields_ = [
+        ("bytes_out", ctypes.c_uint64),
+        ("bytes_in", ctypes.c_uint64),
+        ("frames_out", ctypes.c_uint64),
+        ("frames_in", ctypes.c_uint64),
+        ("send_queue", ctypes.c_int32),
+        ("recv_queue", ctypes.c_int32),
+    ]
+
+
+class EventKind(enum.IntEnum):
+    LINK_UP = 1
+    LINK_DOWN = 2
+    BECAME_MASTER = 3
+    REJOIN_FAILED = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    kind: EventKind
+    link_id: int
+    is_uplink: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkStats:
+    bytes_out: int
+    bytes_in: int
+    frames_out: int
+    frames_in: int
+    send_queue: int
+    recv_queue: int
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build_native(force: bool = False) -> pathlib.Path:
+    """Compile native/libsttransport.so if needed."""
+    if force or not _LIB_PATH.exists():
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)] + (["-B"] if force else []),
+            check=True,
+            capture_output=True,
+        )
+    return _LIB_PATH
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    build_native()
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.st_node_create.restype = ctypes.c_void_p
+    lib.st_node_create.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.POINTER(_StConfigC),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.st_node_listen_port.restype = ctypes.c_int32
+    lib.st_node_listen_port.argtypes = [ctypes.c_void_p]
+    lib.st_node_send.restype = ctypes.c_int32
+    lib.st_node_send.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_char_p,
+        ctypes.c_int32,
+        ctypes.c_double,
+    ]
+    lib.st_node_recv.restype = ctypes.c_int32
+    lib.st_node_recv.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_double,
+    ]
+    lib.st_node_poll_events.restype = ctypes.c_int32
+    lib.st_node_poll_events.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(_StEventC),
+        ctypes.c_int32,
+        ctypes.c_double,
+    ]
+    lib.st_node_links.restype = ctypes.c_int32
+    lib.st_node_links.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+    ]
+    lib.st_node_uplink.restype = ctypes.c_int32
+    lib.st_node_uplink.argtypes = [ctypes.c_void_p]
+    lib.st_node_stats.restype = ctypes.c_int32
+    lib.st_node_stats.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.POINTER(_StStatsC),
+    ]
+    lib.st_node_drop_link.restype = ctypes.c_int32
+    lib.st_node_drop_link.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.st_node_close.restype = None
+    lib.st_node_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class TransportNode:
+    """One peer's transport endpoint: joins the tree at (host, port) or
+    becomes master when nobody answers — the reference's rendezvous semantics
+    (src/sharedtensor.c:271-277)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        config: TransportConfig | None = None,
+        frame_bytes: int = 0,
+        max_children: int = 2,
+        queue_depth: int = 8,
+        keepalive_sec: float = 1.0,
+    ):
+        cfg = config or TransportConfig()
+        self._lib = _load()
+        c = _StConfigC(
+            wire_compat=1 if cfg.wire_compat else 0,
+            compat_frame_bytes=frame_bytes,
+            listen_backlog=cfg.listen_backlog,
+            bandwidth_cap_bps=cfg.bandwidth_cap_bytes_per_sec,
+            peer_timeout_sec=cfg.peer_timeout_sec,
+            keepalive_sec=keepalive_sec,
+            max_children=max_children,
+            queue_depth=queue_depth,
+            max_rejoin_attempts=cfg.max_rejoin_attempts,
+            rejoin_backoff_sec=0.2,
+        )
+        is_master = ctypes.c_int32(0)
+        self._h = self._lib.st_node_create(
+            host.encode(), port, ctypes.byref(c), ctypes.byref(is_master)
+        )
+        if not self._h:
+            raise ConnectionError(
+                f"could not join or become master at {host}:{port}"
+            )
+        self.is_master = bool(is_master.value)
+        self._recv_buf = ctypes.create_string_buffer(max(frame_bytes, 1 << 20))
+
+    # -- wire ---------------------------------------------------------------
+
+    def send(self, link_id: int, payload: bytes, timeout: float = 1.0) -> bool:
+        """Enqueue a frame; False = backpressure (retry), raises on dead
+        link."""
+        r = self._lib.st_node_send(self._h, link_id, payload, len(payload), timeout)
+        if r < 0:
+            raise BrokenPipeError(f"link {link_id} is down")
+        return r == 1
+
+    def recv(self, link_id: int, timeout: float = 0.0) -> Optional[bytes]:
+        """Dequeue one received frame, or None. Raises when the link is dead
+        and fully drained."""
+        n = self._lib.st_node_recv(
+            self._h, link_id, self._recv_buf, len(self._recv_buf), timeout
+        )
+        if n < 0:
+            raise BrokenPipeError(f"link {link_id} is down")
+        if n == 0:
+            return None
+        return self._recv_buf.raw[:n]
+
+    # -- topology -----------------------------------------------------------
+
+    def poll_events(self, timeout: float = 0.0, cap: int = 16) -> list[Event]:
+        arr = (_StEventC * cap)()
+        n = self._lib.st_node_poll_events(self._h, arr, cap, timeout)
+        return [
+            Event(EventKind(arr[i].kind), arr[i].link_id, bool(arr[i].is_uplink))
+            for i in range(n)
+        ]
+
+    @property
+    def links(self) -> list[int]:
+        arr = (ctypes.c_int32 * 64)()
+        n = self._lib.st_node_links(self._h, arr, 64)
+        return [arr[i] for i in range(n)]
+
+    @property
+    def uplink(self) -> Optional[int]:
+        u = self._lib.st_node_uplink(self._h)
+        return None if u < 0 else u
+
+    @property
+    def listen_port(self) -> int:
+        return self._lib.st_node_listen_port(self._h)
+
+    def stats(self, link_id: int) -> Optional[LinkStats]:
+        s = _StStatsC()
+        if self._lib.st_node_stats(self._h, link_id, ctypes.byref(s)) < 0:
+            return None
+        return LinkStats(
+            s.bytes_out, s.bytes_in, s.frames_out, s.frames_in,
+            s.send_queue, s.recv_queue,
+        )
+
+    def drop_link(self, link_id: int) -> None:
+        self._lib.st_node_drop_link(self._h, link_id)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.st_node_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -135,13 +135,15 @@ class SharedTensor:
                 self.codec.scale_policy,
                 self.codec.per_leaf_scale,
             )
-            if self.codec.suppress_zero_frames and not bool(
-                jnp.any(frame.scales > 0)
-            ):
-                return None
+            # Storing unconditionally is safe: at scale 0 the new residual is
+            # identical to the old one.
             self._links[link_id] = new_resid
-            self.frames_out += 1
-            return frame
+        # The suppression predicate forces a device sync — evaluate it
+        # outside the lock so other links/users aren't serialized behind it.
+        if self.codec.suppress_zero_frames and not bool(jnp.any(frame.scales > 0)):
+            return None
+        self.frames_out += 1
+        return frame
 
     def receive_frame(self, link_id: int, frame: TableFrame) -> None:
         """Apply an incoming frame to the replica and to every *other* link's

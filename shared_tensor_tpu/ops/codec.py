@@ -38,6 +38,20 @@ from .packing import pack_bits, padded_len, unpack_bits
 from ..config import ScalePolicy
 
 
+def pow2_floor(x: jnp.ndarray) -> jnp.ndarray:
+    """2^floor(log2(x)) computed exactly by clearing the f32 mantissa.
+
+    TPU log2/exp2 are approximate — a scale that is off by 1 ulp from a power
+    of two breaks the codec's exact-convergence property (residual
+    subtraction no longer cancels), so transcendentals are not an option
+    here. Denormal input maps to 0 (idle frame), matching the reference's
+    behavior of grinding to scale==0. Shared by the scalar and table codecs,
+    which must match bit-for-bit.
+    """
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return jax.lax.bitcast_convert_type(bits & jnp.uint32(0x7F800000), jnp.float32)
+
+
 class Frame(NamedTuple):
     """One codec frame: everything that crosses the wire for one link-step.
 
@@ -75,16 +89,7 @@ def compute_scale(
         # Same amax normalization as rms: a raw f32 |r| sum can overflow.
         scale = amax * (jnp.sum(jnp.abs(norm), dtype=jnp.float32) / jnp.float32(n))
     else:  # POW2_RMS
-        # 2^floor(log2(rms)) computed exactly by clearing the f32 mantissa.
-        # TPU log2/exp2 are approximate — a scale that is off by 1 ulp from a
-        # power of two breaks the codec's exact-convergence property (residual
-        # subtraction no longer cancels), so transcendentals are not an option
-        # here. Denormal rms maps to 0 (idle frame), matching the reference's
-        # behavior of grinding to scale==0.
-        bits = jax.lax.bitcast_convert_type(rms, jnp.uint32)
-        scale = jax.lax.bitcast_convert_type(
-            bits & jnp.uint32(0x7F800000), jnp.float32
-        )
+        scale = pow2_floor(rms)
     # Non-finite rms (residual poisoned despite the accumulate() clamp) maps
     # to 0: the link idles instead of flooding NaN/inf to every replica.
     return jnp.where((rms > 0) & jnp.isfinite(rms), scale, jnp.float32(0.0))

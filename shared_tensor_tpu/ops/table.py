@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import ScalePolicy
+from .codec import pad_flat, pow2_floor
 from .packing import LANES, TILE, pack_bits, padded_len, unpack_bits
 
 
@@ -96,22 +97,23 @@ def make_spec(tree: Any) -> TableSpec:
 
 def flatten(tree: Any, spec: TableSpec) -> jnp.ndarray:
     """Pytree -> single padded flat float32 buffer (padding exactly 0)."""
-    leaves = jax.tree.leaves(tree)
-    if len(leaves) != spec.num_leaves:
+    leaves, treedef = jax.tree.flatten(tree)
+    if treedef != spec.treedef:
+        # the reference raises THError("Not the right size!") on mismatch
+        # (src/sharedtensor.c:335); a structural mismatch here would silently
+        # merge deltas into the wrong leaves and flood the corruption to
+        # every replica.
         raise ValueError(
-            f"tree has {len(leaves)} leaves, spec expects {spec.num_leaves}"
+            f"tree structure {treedef} does not match spec {spec.treedef}"
         )
     parts = []
     for i, (leaf, n, p) in enumerate(zip(leaves, spec.ns, spec.padded)):
         flat = jnp.ravel(jnp.asarray(leaf)).astype(jnp.float32)
         if flat.shape[0] != n:
-            # the reference raises THError("Not the right size!") here
-            # (src/sharedtensor.c:335); silent mis-flattening would corrupt
-            # every replica via the flood.
             raise ValueError(
                 f"leaf {i} has {flat.shape[0]} elements, spec expects {n}"
             )
-        parts.append(jnp.pad(flat, (0, p - n)))
+        parts.append(pad_flat(flat, p))
     return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
 
@@ -130,12 +132,6 @@ def _live_mask_flat(spec: TableSpec) -> np.ndarray:
     rows = spec.live_rowcount()
     lane = np.arange(LANES, dtype=np.int32)
     return (lane[None, :] < rows[:, None]).reshape(-1)
-
-
-def _pow2_floor(x: jnp.ndarray) -> jnp.ndarray:
-    """Exact 2^floor(log2(x)) by clearing the f32 mantissa (see codec.py)."""
-    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
-    return jax.lax.bitcast_convert_type(bits & jnp.uint32(0x7F800000), jnp.float32)
 
 
 def compute_scales(
@@ -163,7 +159,7 @@ def compute_scales(
         rms = amax * jnp.sqrt(
             jax.ops.segment_sum(ss_row, row_leaf, num_segments=k) / ns
         )
-        scales = _pow2_floor(rms) if policy == ScalePolicy.POW2_RMS else rms
+        scales = pow2_floor(rms) if policy == ScalePolicy.POW2_RMS else rms
     rms_pos = amax > 0
     return jnp.where(rms_pos & jnp.isfinite(scales), scales, 0.0)
 

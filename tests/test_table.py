@@ -148,3 +148,14 @@ def test_flatten_rejects_wrong_sizes():
         raise AssertionError("expected ValueError")
     except ValueError as e:
         assert "elements" in str(e)
+
+
+def test_flatten_rejects_wrong_structure():
+    t = _tree(seed=9)
+    spec = make_spec(t)
+    as_list = list(t.values())  # same leaf sizes, different structure
+    try:
+        flatten(as_list, spec)
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "structure" in str(e)

@@ -1,0 +1,219 @@
+"""Native transport tests: tree formation, framed streaming, redirects,
+fault handling — N nodes in one process on loopback, the reference's dev
+story (SURVEY.md §4.1). No JAX involved; frames are opaque bytes here."""
+
+import socket
+import time
+
+import pytest
+
+from shared_tensor_tpu.comm.transport import (
+    EventKind,
+    TransportNode,
+    build_native,
+)
+from shared_tensor_tpu.config import TransportConfig
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait(cond, timeout=5.0, step=0.01):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    build_native()
+
+
+def test_master_election_and_join():
+    port = _free_port()
+    cfg = TransportConfig(peer_timeout_sec=10.0)
+    with TransportNode("127.0.0.1", port, cfg) as master:
+        assert master.is_master
+        assert master.listen_port == port
+        with TransportNode("127.0.0.1", port, cfg) as joiner:
+            assert not joiner.is_master
+            assert _wait(lambda: joiner.uplink is not None)
+            assert _wait(lambda: len(master.links) == 1)
+            ev = master.poll_events(timeout=1.0)
+            assert any(e.kind == EventKind.LINK_UP for e in ev)
+
+
+def test_frame_roundtrip():
+    port = _free_port()
+    cfg = TransportConfig(peer_timeout_sec=10.0)
+    with TransportNode("127.0.0.1", port, cfg) as a, TransportNode(
+        "127.0.0.1", port, cfg
+    ) as b:
+        assert _wait(lambda: b.uplink is not None and len(a.links) == 1)
+        la = a.links[0]
+        lb = b.uplink
+        payload = b"\x01\x02\x03" * 100
+        assert a.send(la, payload)
+        got = None
+        for _ in range(100):
+            got = b.recv(lb, timeout=0.1)
+            if got:
+                break
+        assert got == payload
+        # reverse direction
+        assert b.send(lb, b"pong")
+        got = None
+        for _ in range(100):
+            got = a.recv(la, timeout=0.1)
+            if got:
+                break
+        assert got == b"pong"
+        st = a.stats(la)
+        assert st.frames_out >= 1 and st.frames_in >= 1
+
+
+def test_tree_redirect_third_joiner():
+    """Master has max_children=2; a third joiner must be redirected to a
+    child (the reference's alternating-redirect walk, src/sharedtensor.c:
+    226-234) and end up as that child's child."""
+    port = _free_port()
+    cfg = TransportConfig(peer_timeout_sec=10.0)
+    nodes = [TransportNode("127.0.0.1", port, cfg) for _ in range(4)]
+    try:
+        # everyone joined: every non-master has an uplink
+        assert _wait(
+            lambda: all(n.uplink is not None for n in nodes[1:]), timeout=10
+        )
+        # master has exactly 2 children; total child links across the tree = 3
+        assert _wait(
+            lambda: len(nodes[0].links) == 2
+            and sum(
+                len(n.links) - (0 if n.is_master else 1) for n in nodes
+            ) == 3,
+            timeout=10,
+        )
+    finally:
+        for n in nodes:
+            n.close()
+
+
+def test_link_down_event_and_survival():
+    """Killing a joiner must NOT kill the master (reference exits the whole
+    process on any socket error — quirk Q8, fixed here)."""
+    port = _free_port()
+    cfg = TransportConfig(peer_timeout_sec=10.0, max_rejoin_attempts=1)
+    master = TransportNode("127.0.0.1", port, cfg)
+    joiner = TransportNode("127.0.0.1", port, cfg)
+    try:
+        assert _wait(lambda: len(master.links) == 1)
+        master.poll_events(timeout=0.5)
+        joiner.close()  # peer dies
+        assert _wait(
+            lambda: any(
+                e.kind == EventKind.LINK_DOWN
+                for e in master.poll_events(timeout=0.2)
+            ),
+            timeout=10,
+        )
+        assert master.links == []
+        # master still accepts new joiners afterwards
+        j2 = TransportNode("127.0.0.1", port, cfg)
+        try:
+            assert _wait(lambda: len(master.links) == 1)
+        finally:
+            j2.close()
+    finally:
+        master.close()
+
+
+def test_wire_compat_frames():
+    """Wire-compat mode: fixed-size raw frames (f32 scale + bitmask), no
+    length prefix — byte-exact with the reference protocol (SURVEY.md §2.3)."""
+    port = _free_port()
+    n_elems = 240
+    frame_bytes = 4 + (n_elems + 7) // 8  # 34
+    cfg = TransportConfig(peer_timeout_sec=10.0, wire_compat=True)
+    with TransportNode(
+        "127.0.0.1", port, cfg, frame_bytes=frame_bytes
+    ) as a, TransportNode(
+        "127.0.0.1", port, cfg, frame_bytes=frame_bytes
+    ) as b:
+        assert _wait(lambda: b.uplink is not None and len(a.links) == 1)
+        import struct
+
+        payload = struct.pack("<f", 0.5) + bytes(range(30))
+        assert len(payload) == frame_bytes
+        assert a.send(a.links[0], payload)
+        got = None
+        for _ in range(100):
+            got = b.recv(b.uplink, timeout=0.1)
+            if got is not None and got != bytes(frame_bytes):  # skip keepalives
+                break
+        assert got == payload
+
+
+def test_wire_compat_raw_socket_interop():
+    """A plain socket speaking the reference's exact join+frame protocol can
+    talk to a native node in compat mode: connect, get 'Y', stream a frame."""
+    import struct
+
+    port = _free_port()
+    n_elems = 32
+    frame_bytes = 4 + 4
+    cfg = TransportConfig(peer_timeout_sec=10.0, wire_compat=True)
+    with TransportNode("127.0.0.1", port, cfg, frame_bytes=frame_bytes) as master:
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        try:
+            reply = s.recv(1)
+            assert reply == b"Y"  # accepted as first child
+            frame = struct.pack("<f", 0.25) + b"\xf0\x0f\xaa\x55"
+            s.sendall(frame)
+            assert _wait(lambda: len(master.links) == 1)
+            got = None
+            for _ in range(100):
+                got = master.recv(master.links[0], timeout=0.1)
+                if got is not None and got != bytes(frame_bytes):
+                    break
+            assert got == frame
+            # reference peers also RECEIVE frames continuously: at minimum the
+            # keepalive zero-frame arrives within ~2s (reference quirk Q2
+            # behavior is load-bearing for C peers' liveness)
+            s.settimeout(5)
+            data = b""
+            while len(data) < frame_bytes:
+                data += s.recv(frame_bytes - len(data))
+            assert len(data) == frame_bytes
+        finally:
+            s.close()
+
+
+def test_bandwidth_cap():
+    """Token-bucket pacing (reference README.md:31 TODO): with a 50 KB/s cap,
+    sending 100 KB takes >= ~1.5s."""
+    port = _free_port()
+    cfg = TransportConfig(peer_timeout_sec=10.0, bandwidth_cap_bytes_per_sec=50_000)
+    with TransportNode("127.0.0.1", port, cfg) as a, TransportNode(
+        "127.0.0.1", port, cfg
+    ) as b:
+        assert _wait(lambda: b.uplink is not None and len(a.links) == 1)
+        la, lb = a.links[0], b.uplink
+        payload = bytes(10_000)
+        t0 = time.time()
+        received = 0
+        sent = 0
+        while received < 10:
+            if sent < 10 and a.send(la, payload, timeout=0.05):
+                sent += 1
+            r = b.recv(lb, timeout=0.05)
+            if r is not None and len(r) == len(payload):
+                received += 1
+        elapsed = time.time() - t0
+        assert elapsed > 1.2, f"100KB at 50KB/s took only {elapsed:.2f}s"
