@@ -1,0 +1,424 @@
+"""The peer engine: a complete shared-tensor node.
+
+Composes the three lower layers into the reference's user-facing object
+(reference src/sharedtensor.c:347-465 — createOrFetch / copyToTensor /
+addFromTensor):
+
+  - :class:`~shared_tensor_tpu.core.SharedTensor` — replica + per-link
+    residuals + codec (device-side, functional JAX);
+  - :class:`~shared_tensor_tpu.comm.transport.TransportNode` — the native C++
+    TCP binary-tree overlay (host-side);
+  - :mod:`~shared_tensor_tpu.comm.wire` — typed message encoding between them.
+
+Where the reference runs 2 threads per link all doing O(n) float loops on the
+CPU (src/sharedtensor.c:113-189; measured codec-CPU-bound, SURVEY.md §6), this
+engine runs exactly two host threads per node — a sender and a receiver — that
+only move opaque bytes and dispatch device work; the O(n) math executes on the
+accelerator via the jitted table codec. Sends are event-driven (woken by
+``add()`` and by incoming frames) and quiesce when residuals hit exact zero —
+the reference instead burns 1 frame/s/link forever when idle (quirk Q2).
+
+Threading model: the receive thread is the only consumer of transport events
+(LINK_UP/LINK_DOWN) and the only writer of handshake state; the send thread
+only reads ``SharedTensor.link_ids`` (created exactly at handshake
+completion), so no lock beyond SharedTensor's own is needed.
+
+Join/rejoin semantics (native mode) are the SYNC handshake documented in
+wire.py. Wire-compat mode skips the handshake and speaks the reference's raw
+protocol for interop with C peers (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..core import SharedTensor
+from ..ops.table import make_spec
+from . import wire
+from .transport import EventKind, TransportNode
+
+log = logging.getLogger("shared_tensor_tpu.peer")
+
+
+class SpecMismatch(ConnectionError):
+    """Peer tried to sync a different table layout (the reference's
+    THError("Not the right size!"), src/sharedtensor.c:335, made explicit
+    at join time instead of corrupting the stream)."""
+
+
+class SharedTensorPeer:
+    """One node of the shared tensor: join the tree at (host, port) — or
+    become master if nobody answers — then stream codec frames forever.
+
+    The reference equivalent is ``sharedtensor.createOrFetch(host, port, t)``
+    (src/sharedtensor.c:347-391): master seeds the shared state from
+    ``template``; a joiner's ``template`` only defines the table *layout* and
+    its values are ignored, with real state streaming in from the tree.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        template: Any,
+        config: Config | None = None,
+    ):
+        self.config = config or Config()
+        codec = self.config.codec
+        tcfg = self.config.transport
+        spec = make_spec(template)
+        if tcfg.wire_compat:
+            if spec.num_leaves != 1:
+                raise ValueError(
+                    "wire-compat mode syncs one flat tensor per port "
+                    "(reference README.md:26); use native mode for tables"
+                )
+            frame_bytes = wire.compat_frame_bytes(spec.total_n)
+        else:
+            frame_bytes = wire.frame_wire_bytes(spec)
+        self.node = TransportNode(
+            host,
+            port,
+            tcfg,
+            frame_bytes=frame_bytes,
+            keepalive_sec=min(1.0, max(0.05, tcfg.peer_timeout_sec / 4)),
+        )
+        self.is_master = self.node.is_master
+        self.st = SharedTensor(template, codec, seed_values=self.is_master)
+        self._ready = threading.Event()
+        self._error: Optional[Exception] = None
+        if self.is_master:
+            self._ready.set()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        # parent-side handshake state: link_id -> snapshot being received
+        self._pending: dict[int, bytearray] = {}
+        # child-side re-graft accounting. Invariant: the snapshot we send a
+        # prospective parent is "state the tree already has from/for us" =
+        # replica - carried_residual, so the parent's diff seed never
+        # subtracts updates we still owe the tree. _sent_snapshot is kept
+        # until WELCOME so the uplink residual can be seeded with
+        # replica_now - sent_snapshot (= carry + everything added or flooded
+        # in during the handshake).
+        self._carry_residual: Optional[jnp.ndarray] = None
+        self._sent_snapshot: Optional[jnp.ndarray] = None
+        self._uplink: Optional[int] = None
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, daemon=True, name="st-recv"
+        )
+        self._send_thread = threading.Thread(
+            target=self._send_loop, daemon=True, name="st-send"
+        )
+        self._recv_thread.start()
+        self._send_thread.start()
+
+    # -- user API (the reference's three calls) -----------------------------
+
+    def read(self) -> Any:
+        """Snapshot of the shared state (reference copyToTensor)."""
+        return self.st.read()
+
+    def add(self, delta: Any) -> None:
+        """Merge an additive update into the shared state; it becomes visible
+        locally at once and streams to every peer asynchronously (reference
+        addFromTensor)."""
+        self.st.add(delta)
+        self._wake.set()
+
+    def wait_ready(self, timeout: float = 30.0) -> None:
+        """Block until joined and the state stream is flowing. Replaces the
+        reference's busy-wait-until-nonzero (quirk Q4: spins a core and hangs
+        forever on an all-zero tensor) with an explicit handshake."""
+        if not self._ready.wait(timeout):
+            if self._error is not None:
+                raise self._error
+            raise TimeoutError(f"not ready after {timeout}s")
+        if self._error is not None:
+            raise self._error
+
+    def drain(self, timeout: float = 60.0, tol: float = 0.0) -> bool:
+        """Block until every outgoing link residual is down to ``tol`` RMS and
+        the transport send queues are empty — i.e. all local updates have been
+        handed to our neighbors. Use before :meth:`close` to leave gracefully
+        (the reference has no flush concept at all; a leaving node takes its
+        undelivered residuals down with the whole process, quirk Q8)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline and not self._stop.is_set():
+            links = self.st.link_ids
+            if all(self.st.residual_rms(l) <= tol for l in links):
+                stats = [self.node.stats(l) for l in self.node.links]
+                if all(s is None or s.send_queue == 0 for s in stats):
+                    return True
+            time.sleep(0.05)
+        return False
+
+    def close(self) -> None:
+        """Leave the tree. Peers survive and re-graft (the reference prints an
+        apology and exit(-1)s the entire process instead — quirk Q8)."""
+        self._stop.set()
+        self._wake.set()
+        for t in (self._send_thread, self._recv_thread):
+            t.join(timeout=5.0)
+        self.node.close()
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    def metrics(self) -> dict:
+        """Observability the reference entirely lacks (SURVEY.md §5.5)."""
+        out = {
+            "frames_out": self.st.frames_out,
+            "frames_in": self.st.frames_in,
+            "updates": self.st.updates,
+            "links": {},
+        }
+        for link in self.node.links:
+            s = self.node.stats(link)
+            if s is not None:
+                out["links"][link] = {
+                    "bytes_out": s.bytes_out,
+                    "bytes_in": s.bytes_in,
+                    "frames_out": s.frames_out,
+                    "frames_in": s.frames_in,
+                    "residual_rms": self.st.residual_rms(link),
+                }
+        return out
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- send side -----------------------------------------------------------
+
+    def _send_loop(self) -> None:
+        compat = self.config.transport.wire_compat
+        interval = self.config.sync_interval_sec
+        while not self._stop.is_set():
+            sent_any = False
+            for link in self.st.link_ids:
+                frame = self.st.make_frame(link)
+                if frame is None:
+                    continue
+                payload = (
+                    wire.encode_compat_frame(frame, self.st.spec)
+                    if compat
+                    else wire.encode_frame(frame)
+                )
+                if self._send_blocking(link, payload):
+                    sent_any = True
+            if self._stop.is_set():
+                return
+            if interval > 0:
+                time.sleep(interval)
+            elif not sent_any:
+                # idle: wait for a local add() or an incoming frame to create
+                # new residual mass (event-driven wake, fixing quirk Q2)
+                self._wake.wait(0.05)
+                self._wake.clear()
+
+    def _send_blocking(self, link: int, payload: bytes) -> bool:
+        """Deliver one frame, riding out backpressure. On a dead link the
+        frame is dropped — its content is still in our replica, and the
+        re-graft handshake re-derives exactly the missing delta."""
+        while not self._stop.is_set():
+            try:
+                if self.node.send(link, payload, timeout=0.1):
+                    return True
+            except BrokenPipeError:
+                return False
+        return False
+
+    # -- receive side ---------------------------------------------------------
+
+    def _recv_loop(self) -> None:
+        compat = self.config.transport.wire_compat
+        while not self._stop.is_set():
+            busy = self._handle_events()
+            for link in list(self.node.links):
+                for _ in range(8):  # drain bursts without starving other links
+                    try:
+                        payload = self.node.recv(link, timeout=0.0)
+                    except BrokenPipeError:
+                        break
+                    if payload is None:
+                        break
+                    busy = True
+                    try:
+                        if compat:
+                            self._on_compat_frame(link, payload)
+                        else:
+                            self._on_message(link, payload)
+                    except Exception as e:  # a bad frame must not kill the node
+                        log.warning("dropping bad frame on link %d: %s", link, e)
+            if not busy:
+                time.sleep(0.002)
+
+    def _handle_events(self) -> bool:
+        evs = self.node.poll_events(timeout=0.0)
+        for ev in evs:
+            if ev.kind == EventKind.LINK_UP:
+                if ev.is_uplink:
+                    self._uplink = ev.link_id
+                    if self.config.transport.wire_compat:
+                        # reference protocol has no handshake: start streaming
+                        # into a zero residual at once
+                        self.st.new_link(ev.link_id, seed=False)
+                    else:
+                        self._start_join(ev.link_id)
+                else:
+                    if self.config.transport.wire_compat:
+                        # reference join: seed the child with the full replica
+                        # through the codec stream (src/sharedtensor.c:379-381)
+                        self.st.new_link(ev.link_id, seed=True)
+                    else:
+                        # native: wait for the child's SYNC snapshot before
+                        # opening the codec link
+                        self._pending[ev.link_id] = bytearray()
+            elif ev.kind == EventKind.LINK_DOWN:
+                self._pending.pop(ev.link_id, None)
+                resid = self.st.drop_link(ev.link_id)
+                if ev.is_uplink:
+                    # Keep undelivered upward updates for the re-grafted
+                    # uplink. If the parent died mid-handshake the codec link
+                    # never existed (resid None); everything we owe the tree
+                    # is then replica - sent_snapshot.
+                    if resid is not None:
+                        self._carry_residual = resid
+                    elif self._sent_snapshot is not None:
+                        self._carry_residual = (
+                            self.st.snapshot_flat() - self._sent_snapshot
+                        )
+                    self._sent_snapshot = None
+                    self._uplink = None
+            elif ev.kind == EventKind.BECAME_MASTER:
+                # our parent died and rejoin found nobody: we are the new root;
+                # whatever state we hold is now the authoritative seed
+                self._uplink = None
+                self.is_master = True
+                self._ready.set()
+            elif ev.kind == EventKind.REJOIN_FAILED:
+                self._error = ConnectionError(
+                    "uplink lost and rejoin failed; node is isolated"
+                )
+                self._ready.set()  # unblock wait_ready, which re-raises
+        return bool(evs)
+
+    # native-mode join handshake, child side
+    def _start_join(self, uplink: int) -> None:
+        snap = self.st.snapshot_flat()
+        if self._carry_residual is not None:
+            # exclude updates we still owe the tree, else the parent's diff
+            # seed would subtract them from us while our carried residual
+            # re-delivers them upward — a permanent divergence of exactly
+            # the carried amount
+            snap = snap - self._carry_residual
+            self._carry_residual = None
+        self._sent_snapshot = snap
+        self._send_blocking(uplink, wire.encode_sync(self.st.spec))
+        for chunk in wire.encode_snapshot_chunks(np.asarray(snap, dtype="<f4")):
+            if not self._send_blocking(uplink, chunk):
+                return  # uplink died mid-handshake; LINK_DOWN re-derives carry
+        # WELCOME (handled in _on_message) opens the codec link
+
+    def _on_message(self, link: int, payload: bytes) -> None:
+        kind = payload[0]
+        if kind == wire.DATA:
+            self.st.receive_frame(link, wire.decode_frame(payload, self.st.spec))
+            self._wake.set()  # flood refills other links' residuals
+        elif kind == wire.SYNC:
+            k, n, digest = wire.decode_sync(payload)
+            mine = self.st.spec
+            if digest != mine.layout_digest():
+                log.warning(
+                    "rejecting link %d: table layout differs "
+                    "(theirs: %d leaves / %d elems; ours: %d / %d)",
+                    link, k, n, mine.num_leaves, mine.total_n,
+                )
+                self._send_blocking(
+                    link,
+                    wire.encode_reject(
+                        f"table layout mismatch: yours ({k} leaves, {n} elems)"
+                        f" is not byte-compatible with ours"
+                        f" ({mine.num_leaves}, {mine.total_n})"
+                    ),
+                )
+                self.node.drop_link(link)
+                self._pending.pop(link, None)
+            else:
+                self._pending[link] = bytearray(self.st.spec.total * 4)
+        elif kind == wire.CHUNK:
+            buf = self._pending.get(link)
+            if buf is not None:
+                wire.decode_chunk_into(payload, buf)
+        elif kind == wire.DONE:
+            buf = self._pending.pop(link, None)
+            if buf is not None:
+                snap = jnp.asarray(np.frombuffer(bytes(buf), "<f4"))
+                self.st.new_link_diff(link, snap)
+                self._send_blocking(link, bytes([wire.WELCOME]))
+                self._wake.set()
+        elif kind == wire.WELCOME:
+            snap = self._sent_snapshot
+            self._sent_snapshot = None
+            if snap is not None:
+                # everything we hold that the snapshot didn't claim — the
+                # carried residual plus adds/floods during the handshake —
+                # is owed upward
+                self.st.new_link_diff(link, snap)
+            else:  # duplicate WELCOME; be tolerant
+                self.st.new_link(link, seed=False)
+            self._ready.set()
+            self._wake.set()
+        elif kind == wire.REJECT:
+            self._error = SpecMismatch(wire.decode_reject(payload))
+            self._ready.set()  # unblock wait_ready, which re-raises
+        else:
+            raise ValueError(f"unknown message kind {kind}")
+
+    def _on_compat_frame(self, link: int, payload: bytes) -> None:
+        frame = wire.decode_compat_frame(payload, self.st.spec)
+        if link == self._uplink and not self._ready.is_set():
+            # Readiness = the parent's stream is flowing. Counting zero-scale
+            # keepalives too fixes the reference's all-zero-tensor hang
+            # (quirk Q4): an idle parent still proves liveness within 1s.
+            self._ready.set()
+        if frame is None:
+            return  # reference idle keepalive (quirk Q2): no payload
+        self.st.receive_frame(link, frame)
+        self._wake.set()
+
+
+def create_or_fetch(
+    host: str,
+    port: int,
+    template: Any,
+    config: Config | None = None,
+    timeout: float = 30.0,
+) -> SharedTensorPeer:
+    """The reference's entry point (``sharedtensor.createOrFetch``,
+    src/sharedtensor.c:347): create the shared tensor at ``host:port`` if
+    nobody owns it yet (becoming master, seeded from ``template``), else join
+    the existing tree (``template`` supplies only the table layout).
+
+    Blocks until the node is ready — master immediately, joiner after the
+    state-transfer handshake.
+    """
+    peer = SharedTensorPeer(host, port, template, config)
+    try:
+        peer.wait_ready(timeout)
+    except BaseException:
+        peer.close()
+        raise
+    return peer

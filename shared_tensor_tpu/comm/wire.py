@@ -1,0 +1,167 @@
+"""Frame + handshake serialization for the peer tier.
+
+The reference wire protocol is one raw stream of ``[f32 scale][bitmask]``
+frames with no handshake at all — frame size is implied by out-of-band
+agreement on the tensor size (reference src/sharedtensor.c:121-122, :176-177;
+README.md:26 "one port per tensor"), and state transfer to a joiner happens
+implicitly through the normal codec stream (SURVEY.md §5.4).
+
+The native-mode protocol here keeps the codec-frame streaming but makes the
+implicit parts explicit, because they are exactly where the reference breaks
+(quirks Q4/Q5/Q8):
+
+- every message is typed (1 kind byte) inside the transport's length-prefixed
+  framing — no size ambiguity, no host-endianness on the wire (all little-
+  endian, explicit);
+- a joining link runs a SYNC handshake: the downstream node sends its current
+  replica snapshot (chunked), the upstream node seeds the link residual with
+  the *difference* (parent - child) and replies WELCOME. For a fresh joiner
+  the snapshot is all-zero, which degenerates to the reference's
+  seed-with-full-replica join; for a re-grafting peer that already has state
+  (reference can't do this at all — it exit(-1)s, quirk Q8) only the missing
+  delta streams, and the split-horizon flood then repairs its whole subtree;
+- spec mismatch is REJECTed explicitly (the reference THError()s on size
+  mismatch, src/sharedtensor.c:335, but only detects it after corrupting the
+  stream framing).
+
+Data frames carry per-leaf scales ("table sync", reference README.md:41) +
+the LSB-first packed sign bits produced by ops/packing.py.
+
+``encode_compat_frame``/``decode_compat_frame`` speak the reference's exact
+frame bytes for wire-compat interop with C peers (SURVEY.md §2.3 wire spec).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.table import TableFrame, TableSpec
+
+# message kinds (first payload byte, native mode)
+DATA = 0  # codec frame: scales + packed sign bits
+SYNC = 1  # child -> parent: join request header
+CHUNK = 2  # child -> parent: replica snapshot chunk
+DONE = 3  # child -> parent: snapshot complete
+WELCOME = 4  # parent -> child: accepted, streaming begins
+REJECT = 5  # parent -> child: spec mismatch, reason attached
+
+_SYNC_FMT = "<IQ16s"  # num_leaves, total_n, layout digest
+_CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
+
+#: Snapshot chunk payload cap. Big enough to amortize framing, small enough
+#: that queue-depth backpressure keeps memory bounded on huge tables.
+CHUNK_BYTES = 1 << 22
+
+
+def frame_wire_bytes(spec: TableSpec) -> int:
+    """Max payload size of any native-mode message for this spec."""
+    data = 1 + 4 * spec.num_leaves + 4 * (spec.total // 32)
+    chunk = 1 + struct.calcsize(_CHUNK_HDR) + CHUNK_BYTES
+    return max(data, chunk)
+
+
+def encode_frame(frame: TableFrame) -> bytes:
+    scales = np.asarray(frame.scales, dtype="<f4")
+    words = np.asarray(frame.words, dtype="<u4")
+    return b"\x00" + scales.tobytes() + words.tobytes()
+
+
+def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
+    k = spec.num_leaves
+    w = spec.total // 32
+    want = 1 + 4 * k + 4 * w
+    if len(payload) != want:
+        raise ValueError(
+            f"DATA frame is {len(payload)} bytes, spec wants {want} "
+            f"(k={k}, words={w}) — peer table layout mismatch"
+        )
+    scales = np.frombuffer(payload, "<f4", count=k, offset=1)
+    words = np.frombuffer(payload, "<u4", count=w, offset=1 + 4 * k)
+    return TableFrame(jnp.asarray(scales), jnp.asarray(words))
+
+
+def encode_sync(spec: TableSpec) -> bytes:
+    return bytes([SYNC]) + struct.pack(
+        _SYNC_FMT, spec.num_leaves, spec.total_n, spec.layout_digest()
+    )
+
+
+def decode_sync(payload: bytes) -> tuple[int, int, bytes]:
+    return struct.unpack_from(_SYNC_FMT, payload, 1)
+
+
+def encode_snapshot_chunks(flat: np.ndarray) -> Iterator[bytes]:
+    """Chunk a flat f32 replica snapshot into CHUNK messages + final DONE."""
+    raw = np.asarray(flat, dtype="<f4").tobytes()
+    for off in range(0, len(raw), CHUNK_BYTES):
+        yield (
+            bytes([CHUNK])
+            + struct.pack(_CHUNK_HDR, off)
+            + raw[off : off + CHUNK_BYTES]
+        )
+    yield bytes([DONE])
+
+
+def decode_chunk_into(payload: bytes, buf: bytearray) -> None:
+    (off,) = struct.unpack_from(_CHUNK_HDR, payload, 1)
+    body = payload[1 + struct.calcsize(_CHUNK_HDR) :]
+    if off + len(body) > len(buf):
+        raise ValueError(
+            f"snapshot chunk [{off}:{off + len(body)}] overruns "
+            f"{len(buf)}-byte snapshot buffer"
+        )
+    buf[off : off + len(body)] = body
+
+
+def encode_reject(reason: str) -> bytes:
+    return bytes([REJECT]) + reason.encode("utf-8", "replace")
+
+
+def decode_reject(payload: bytes) -> str:
+    return payload[1:].decode("utf-8", "replace")
+
+
+# -- wire-compat mode (reference frame format, single flat tensor) ----------
+
+
+def compat_frame_bytes(n: int) -> int:
+    """4-byte f32 scale + ceil(n/8)-byte LSB-first bitmask
+    (reference src/sharedtensor.c:121-122, :176-177)."""
+    return 4 + (n + 7) // 8
+
+
+def encode_compat_frame(frame: TableFrame, spec: TableSpec) -> bytes:
+    """Reference frame bytes. Requires a single-leaf spec (the reference
+    syncs exactly one flat tensor per port, README.md:26). Our u32 LSB-first
+    packing laid out little-endian is byte-identical to the reference's
+    ``data[i/8] |= 1 << (i%8)`` byte packing, so this is a slice, not a
+    re-pack."""
+    if spec.num_leaves != 1:
+        raise ValueError("wire-compat mode syncs a single tensor, not a table")
+    scale = float(np.asarray(frame.scales).reshape(-1)[0])
+    mask = np.asarray(frame.words, dtype="<u4").tobytes()
+    return struct.pack("<f", scale) + mask[: compat_frame_bytes(spec.total_n) - 4]
+
+
+def decode_compat_frame(payload: bytes, spec: TableSpec) -> Optional[TableFrame]:
+    """Reference frame bytes -> TableFrame. Returns None for a pure keepalive
+    (scale == 0: the reference sends one idle frame/s, quirk Q2 — it carries
+    no information, so we skip the device work)."""
+    if len(payload) != compat_frame_bytes(spec.total_n):
+        raise ValueError(
+            f"compat frame is {len(payload)} bytes, "
+            f"expected {compat_frame_bytes(spec.total_n)}"
+        )
+    (scale,) = struct.unpack_from("<f", payload, 0)
+    if scale == 0.0:
+        return None
+    nwords = spec.total // 32
+    raw = payload[4:].ljust(nwords * 4, b"\x00")
+    words = np.frombuffer(raw, "<u4", count=nwords)
+    return TableFrame(
+        jnp.full((1,), scale, jnp.float32), jnp.asarray(words)
+    )

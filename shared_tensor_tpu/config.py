@@ -45,7 +45,11 @@ class CodecConfig:
     scale_policy: ScalePolicy = ScalePolicy.POW2_RMS
     per_leaf_scale: bool = True
     #: Skip sending when scale == 0 (fixes reference quirk Q2, which sleeps 1s
-    #: but still transmits an idle frame). Wire-compat mode forces False.
+    #: but still transmits an idle frame). Safe in wire-compat mode too: the
+    #: native transport emits a zero-scale compat keepalive frame per
+    #: keepalive interval when a link is idle — the reference's own idle
+    #: behavior, which its peers' liveness expects — so the codec layer never
+    #: needs to synthesize idle frames itself.
     suppress_zero_frames: bool = True
 
 
@@ -71,11 +75,11 @@ class TransportConfig:
     max_rejoin_attempts: int = 8
     #: Speak the reference's exact wire format: raw host-endian float scale +
     #: LSB-first bitmask frames, 'Y'/'N'+sockaddr join protocol
-    #: (SURVEY.md §2.3 wire spec). Enables interop A/B against C peers.
+    #: (SURVEY.md §2.3 wire spec). Enables interop A/B against C peers. Idle
+    #: links emit one zero-scale keepalive frame per keepalive interval (the
+    #: reference's quirk-Q2 behavior, which C peers' liveness relies on) —
+    #: handled inside the native transport.
     wire_compat: bool = False
-    #: Emit one idle frame per second when idle, like the reference (Q2).
-    #: Only meaningful (and forced on) in wire_compat mode.
-    idle_frames: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -28,6 +28,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
+import jax
 import jax.numpy as jnp
 
 from .config import CodecConfig
@@ -72,27 +73,61 @@ class SharedTensor:
 
     # -- links -------------------------------------------------------------
 
-    def new_link(self, link_id: int, seed: bool = True) -> None:
+    def new_link(
+        self,
+        link_id: int,
+        seed: bool = True,
+        residual: Optional[jnp.ndarray] = None,
+    ) -> None:
         """Open a link. ``seed=True`` preloads the residual with the full
         current replica, so the peer on the other end receives complete
         state-to-date through normal codec frames — the reference's join /
         state-transfer mechanism (src/sharedtensor.c:379-381 master seeding;
-        §5.4), generalized to any link at any time (rejoin support)."""
+        §5.4), generalized to any link at any time (rejoin support).
+
+        ``residual`` overrides the seed with an explicit starting residual:
+        the peer engine uses this to carry a dead uplink's undelivered
+        residual onto the re-grafted uplink, so a node's pending updates
+        survive its parent's death instead of being lost."""
         with self._lock:
             if link_id in self._links:
                 raise ValueError(f"link {link_id} already exists")
-            if seed:
+            if residual is not None:
+                if residual.shape != (self.spec.total,):
+                    raise ValueError(
+                        f"residual shape {residual.shape} != ({self.spec.total},)"
+                    )
+                self._links[link_id] = jnp.asarray(residual, jnp.float32)
+            elif seed:
                 self._links[link_id] = self.values
             else:
                 self._links[link_id] = jnp.zeros(self.spec.total, jnp.float32)
 
-    def drop_link(self, link_id: int) -> None:
-        """Close a link (peer died or left). Undelivered residual is
-        discarded — our replica already contains those updates; the departed
-        peer recovers them by re-grafting (its new parent seeds with the full
-        replica). The reference instead kills the whole process (quirk Q8)."""
+    def new_link_diff(self, link_id: int, peer_snapshot: jnp.ndarray) -> None:
+        """Open a downstream link toward a peer whose replica currently equals
+        ``peer_snapshot``, seeding the residual with (our replica − theirs) —
+        the delta that, once streamed, converges them to our state. A fresh
+        joiner's snapshot is all-zero, making this exactly the reference's
+        seed-with-full-replica join (src/sharedtensor.c:379-381); a re-grafted
+        peer with live state receives only what it is missing (the reference
+        cannot re-graft at all, quirk Q8)."""
         with self._lock:
-            self._links.pop(link_id, None)
+            if link_id in self._links:
+                raise ValueError(f"link {link_id} already exists")
+            snap = jnp.asarray(peer_snapshot, jnp.float32)
+            if snap.shape != (self.spec.total,):
+                raise ValueError(
+                    f"snapshot shape {snap.shape} != ({self.spec.total},)"
+                )
+            self._links[link_id] = self.values - snap
+
+    def drop_link(self, link_id: int) -> Optional[jnp.ndarray]:
+        """Close a link (peer died or left); returns its undelivered residual
+        (or None if unknown). The peer engine re-seeds a replacement uplink
+        with it so pending updates survive re-grafting. The reference instead
+        kills the whole process on any link failure (quirk Q8)."""
+        with self._lock:
+            return self._links.pop(link_id, None)
 
     @property
     def link_ids(self) -> tuple[int, ...]:
@@ -104,6 +139,13 @@ class SharedTensor:
         """Snapshot of the replica as the caller's pytree structure
         (reference l_copyToTensor, src/sharedtensor.c:435-446)."""
         return unflatten(self.values, self.spec)
+
+    def snapshot_flat(self) -> jnp.ndarray:
+        """Atomic snapshot of the padded flat replica (handshake / checkpoint
+        use). Values arrays are replaced, never mutated, so the reference's
+        torn-read hazard (§5.2) cannot occur."""
+        with self._lock:
+            return self.values
 
     def add(self, delta: Any) -> None:
         """Merge an additive update: replica and every link residual receive
@@ -138,12 +180,15 @@ class SharedTensor:
             # Storing unconditionally is safe: at scale 0 the new residual is
             # identical to the old one.
             self._links[link_id] = new_resid
-        # The suppression predicate forces a device sync — evaluate it
-        # outside the lock so other links/users aren't serialized behind it.
-        if self.codec.suppress_zero_frames and not bool(jnp.any(frame.scales > 0)):
+        # One device->host transfer serves both the idle check and the wire
+        # encoding (the frame is bytes-bound anyway). Doing the idle check as
+        # its own jnp.any() would cost a second blocking sync per frame —
+        # measured 2-3 frames/s through a high-latency device tunnel.
+        scales, words = jax.device_get((frame.scales, frame.words))
+        if self.codec.suppress_zero_frames and not scales.any():
             return None
         self.frames_out += 1
-        return frame
+        return TableFrame(scales, words)
 
     def receive_frame(self, link_id: int, frame: TableFrame) -> None:
         """Apply an incoming frame to the replica and to every *other* link's

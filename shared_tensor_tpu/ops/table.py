@@ -65,6 +65,16 @@ class TableSpec:
     def total_n(self) -> int:
         return sum(self.ns)
 
+    def layout_digest(self) -> bytes:
+        """16-byte digest identifying the full table layout (tree structure,
+        leaf shapes, padding). Two specs with equal digests decode each
+        other's frames leaf-for-leaf; (num_leaves, total_n) alone cannot
+        distinguish e.g. {w:(8,128), b:(128,)} from {w:(128,), b:(8,128)}."""
+        import hashlib
+
+        desc = repr((str(self.treedef), self.shapes, self.ns, self.padded))
+        return hashlib.sha256(desc.encode()).digest()[:16]
+
     def row_leaf(self) -> np.ndarray:
         """int32[rows]: leaf index owning each 128-lane row."""
         return np.repeat(

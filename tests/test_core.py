@@ -143,6 +143,46 @@ def test_drop_link_and_regraft():
         np.testing.assert_allclose(np.asarray(got[k]), t[k] + 2.0, rtol=0, atol=1e-5)
 
 
+def test_regraft_carry_algebra():
+    """The peer handshake's re-graft accounting (comm/peer.py): a child that
+    lost its parent while holding an undelivered uplink residual X re-grafts
+    onto a new parent. The handshake sends snapshot = replica - X; the parent
+    diff-seeds its downlink with (parent - snapshot); at WELCOME the child
+    seeds its uplink with (replica_now - snapshot), covering X plus anything
+    added mid-handshake. Afterwards both converge to the union — nothing
+    lost, nothing double-counted."""
+    t = _tree(7)
+    parent = SharedTensor(t, seed_values=True)
+    child = SharedTensor(t, seed_values=True)  # had full state before orphaning
+
+    # child's updates that never reached its dead parent:
+    x = {k: np.full_like(v, 0.5) for k, v in t.items()}
+    child.new_link(9, seed=False)  # the (dead) old uplink
+    child.add(x)
+    carry = child.drop_link(9)  # what the dead link still owed upward
+
+    # --- handshake (mirrors SharedTensorPeer._start_join / WELCOME) ---
+    snap = child.snapshot_flat() - carry
+    # mid-handshake activity: child gets another local update
+    y = {k: np.full_like(v, -0.25) for k, v in t.items()}
+    child.add(y)
+    parent.new_link_diff(2, snap)  # parent side, at DONE
+    child.new_link_diff(2, snap)  # child side, at WELCOME: residual = X + Y
+
+    # parent also moved on while the child was orphaned
+    z = {k: np.full_like(v, 1.0) for k, v in t.items()}
+    parent.add(z)
+
+    _pump(parent, child, 2, 2)
+    want = {k: t[k] + 0.5 - 0.25 + 1.0 for k in t}
+    for st in (parent, child):
+        got = st.read()
+        for k in t:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), want[k], rtol=0, atol=1e-5
+            )
+
+
 def test_zero_template_no_hang():
     """All-zero shared tensor: reference quirk Q4 busy-waits forever; here
     links simply idle (no frames) and reads return zeros immediately."""

@@ -1,0 +1,263 @@
+"""End-to-end peer-engine tests: N full nodes in one process on loopback —
+the reference's entire dev story (SURVEY.md §4.1: multiple processes on
+localhost IS how shared-tensor is tested; here multiple nodes in one process).
+
+Covers BASELINE config 1 (the example.lua round-trip: createOrFetch +
+addFromTensor/copyToTensor, 2-node loopback), the eventual-consistency
+contract (reference README.md:24: after traffic quiesces every replica equals
+seed + sum of all updates), table sync, and fault handling the reference
+lacks (join-with-state, peer death without process death)."""
+
+import socket
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shared_tensor_tpu.comm.peer import SpecMismatch, create_or_fetch
+from shared_tensor_tpu.comm.transport import build_native
+from shared_tensor_tpu.config import CodecConfig, Config, TransportConfig
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    build_native()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+CFG = Config(transport=TransportConfig(peer_timeout_sec=10.0))
+
+
+def _wait_converged(peers, expect, tol=1e-6, timeout=30.0):
+    """Poll until every peer's replica equals ``expect`` within tol (the
+    codec converges *exactly* in finitely many frames for fp32 data —
+    BASELINE.md: ~28 frames for U(-1,1))."""
+    expect_leaves = jax.tree.leaves(expect)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        ok = True
+        for p in peers:
+            got = jax.tree.leaves(p.read())
+            if not all(
+                np.allclose(g, e, rtol=1e-4, atol=tol)
+                for g, e in zip(got, expect_leaves)
+            ):
+                ok = False
+                break
+        if ok:
+            return
+        time.sleep(0.05)
+    for i, p in enumerate(peers):
+        got = jax.tree.leaves(p.read())
+        for g, e in zip(got, expect_leaves):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(e), rtol=1e-4, atol=tol,
+                err_msg=f"peer {i} did not converge",
+            )
+
+
+def test_example_lua_roundtrip():
+    """BASELINE config 1: the reference's example.lua on loopback — master
+    seeds a 4x5x6x2 float32, a second node fetches it, both add deltas, both
+    converge to the common sum (reference example.lua:1-26)."""
+    port = _free_port()
+    seed = jnp.arange(1.0, 241.0, dtype=jnp.float32).reshape(4, 5, 6, 2)
+    with create_or_fetch("127.0.0.1", port, seed, CFG) as master:
+        assert master.is_master
+        np.testing.assert_array_equal(np.asarray(master.read()), np.asarray(seed))
+        with create_or_fetch(
+            "127.0.0.1", port, jnp.zeros_like(seed), CFG
+        ) as joiner:
+            assert not joiner.is_master
+            # joiner receives the seeded state through the codec stream
+            _wait_converged([joiner], seed)
+            # both sides add; everyone converges to seed + both deltas
+            d1 = jnp.full_like(seed, 1.0)
+            d2 = jnp.full_like(seed, 0.5)
+            master.add(d1)
+            joiner.add(d2)
+            _wait_converged([master, joiner], seed + d1 + d2)
+            m = master.metrics()
+            assert m["frames_out"] > 0 and m["frames_in"] > 0
+
+
+def test_four_peer_tree_consistency():
+    """4 peers (so one is redirected below the master's children): every
+    replica converges to seed + sum of every peer's update through split-
+    horizon flooding with per-hop re-quantization."""
+    port = _free_port()
+    seed = {"w": jnp.ones((16, 8), jnp.float32), "b": jnp.zeros((8,), jnp.float32)}
+    peers = [create_or_fetch("127.0.0.1", port, seed, CFG)]
+    try:
+        for _ in range(3):
+            peers.append(
+                create_or_fetch(
+                    "127.0.0.1", port, jax.tree.map(jnp.zeros_like, seed), CFG
+                )
+            )
+        _wait_converged(peers, seed)
+        rng = np.random.default_rng(0)
+        total = jax.tree.map(jnp.asarray, seed)
+        for i, p in enumerate(peers):
+            delta = {
+                "w": jnp.asarray(
+                    rng.normal(size=(16, 8)).astype(np.float32) * (i + 1)
+                ),
+                "b": jnp.asarray(rng.normal(size=(8,)).astype(np.float32)),
+            }
+            p.add(delta)
+            total = jax_tree_add(total, delta)
+        _wait_converged(peers, total, tol=1e-5)
+    finally:
+        for p in peers:
+            p.close()
+
+
+def jax_tree_add(a, b):
+    return jax.tree.map(lambda x, y: x + y, a, b)
+
+
+def test_mixed_magnitude_table_sync():
+    """The reference README's top TODO (README.md:41): a table with 1000:1
+    magnitude spread syncs accurately because each leaf gets its own scale
+    (single-scale degrades to ~0.15 bits/frame — BASELINE.md)."""
+    port = _free_port()
+    seed = {
+        "big": jnp.full((256,), 1000.0, jnp.float32),
+        "small": jnp.full((256,), 1.0, jnp.float32),
+    }
+    with create_or_fetch("127.0.0.1", port, seed, CFG) as master:
+        with create_or_fetch(
+            "127.0.0.1", port, jax.tree.map(jnp.zeros_like, seed), CFG
+        ) as joiner:
+            # converges exactly — with one global scale the small leaf would
+            # still be at ~24% error after 48 frames
+            _wait_converged([joiner], seed, timeout=20.0)
+
+
+def test_regraft_after_parent_death():
+    """A mid-tree node dies; its orphaned child re-grafts through the
+    rendezvous walk onto a surviving node, and updates made around the death
+    are neither lost nor double-counted (diff-seeded handshake + carried
+    residual — the reference exit(-1)s the whole tree instead, quirk Q8).
+
+    Topology: master M with children A and B (max_children=2), C redirected
+    under one of them. Killing C's parent forces a real re-graft."""
+    port = _free_port()
+    seed = jnp.ones((256,), jnp.float32)
+    cfg = Config(
+        transport=TransportConfig(peer_timeout_sec=5.0, max_rejoin_attempts=8)
+    )
+    m = create_or_fetch("127.0.0.1", port, seed, cfg)
+    peers = {"m": m}
+    try:
+        for name in ("a", "b", "c"):
+            peers[name] = create_or_fetch(
+                "127.0.0.1", port, jnp.zeros_like(seed), cfg
+            )
+        _wait_converged(list(peers.values()), seed)
+        # C is the one with an uplink to a non-master (it was redirected)
+        # — find C's parent: the non-master peer with a child link.
+        parent_name = next(
+            n for n, p in peers.items()
+            if not p.is_master and len(p.node.links) > 1
+        )
+        orphan_names = [
+            n for n, p in peers.items() if n not in ("m", parent_name)
+        ]
+        # updates in flight right around the parent's death
+        for p in peers.values():
+            p.add(jnp.full((256,), 0.25, jnp.float32))
+        peers.pop(parent_name).close()
+        survivors = list(peers.values())
+        # survivors (incl. the re-grafted orphans) converge to
+        # seed + every survivor's update + the dead peer's update (it was
+        # merged into its own replica and flooded before death — its close()
+        # drains nothing, but adds happened before close)
+        # The dead peer's 0.25 may or may not have propagated before close;
+        # accept either steady state by checking pairwise agreement + the
+        # floor of guaranteed updates.
+        deadline = time.time() + 40
+        while time.time() < deadline:
+            vals = [np.asarray(p.read()) for p in survivors]
+            spread = max(np.max(np.abs(v - vals[0])) for v in vals)
+            floor_ok = all(v.min() >= 1.0 + 3 * 0.25 - 1e-4 for v in vals)
+            if spread < 1e-4 and floor_ok:
+                break
+            time.sleep(0.1)
+        vals = [np.asarray(p.read()) for p in survivors]
+        spread = max(np.max(np.abs(v - vals[0])) for v in vals)
+        assert spread < 1e-4, f"survivor replicas diverged by {spread}"
+        assert all(v.min() >= 1.0 + 3 * 0.25 - 1e-4 for v in vals), (
+            "a survivor's own update was lost across the re-graft: "
+            + str([float(v.min()) for v in vals])
+        )
+    finally:
+        for p in peers.values():
+            p.close()
+
+
+def test_spec_mismatch_rejected():
+    """Joining with a different table layout must fail loudly at join time
+    (reference THError 'Not the right size!' src/sharedtensor.c:335 — but
+    only after corrupting the unframed stream)."""
+    port = _free_port()
+    with create_or_fetch(
+        "127.0.0.1", port, jnp.ones((64,), jnp.float32), CFG
+    ):
+        with pytest.raises((SpecMismatch, TimeoutError)):
+            p = create_or_fetch(
+                "127.0.0.1",
+                port,
+                jnp.ones((128,), jnp.float32),
+                CFG,
+                timeout=10.0,
+            )
+            p.close()
+
+
+def test_peer_death_survival_and_convergence():
+    """A peer dying must not kill the tree (reference quirk Q8: exit(-1)
+    everywhere), and the survivors keep syncing."""
+    port = _free_port()
+    seed = jnp.ones((128,), jnp.float32)
+    cfg = Config(
+        transport=TransportConfig(peer_timeout_sec=5.0, max_rejoin_attempts=8)
+    )
+    master = create_or_fetch("127.0.0.1", port, seed, cfg)
+    victim = create_or_fetch("127.0.0.1", port, jnp.zeros_like(seed), cfg)
+    survivor = create_or_fetch("127.0.0.1", port, jnp.zeros_like(seed), cfg)
+    try:
+        _wait_converged([victim, survivor], seed)
+        victim.close()
+        time.sleep(0.2)
+        master.add(jnp.full((128,), 2.0, jnp.float32))
+        _wait_converged([master, survivor], seed + 2.0, timeout=30.0)
+    finally:
+        master.close()
+        survivor.close()
+
+
+def test_idle_links_quiesce():
+    """After convergence, links go quiet (no residual mass left). The
+    reference instead emits one zero-scale frame per second per link forever
+    (quirk Q2)."""
+    port = _free_port()
+    seed = jnp.ones((64,), jnp.float32)
+    with create_or_fetch("127.0.0.1", port, seed, CFG) as a:
+        with create_or_fetch("127.0.0.1", port, jnp.zeros_like(seed), CFG) as b:
+            _wait_converged([b], seed)
+            time.sleep(0.5)
+            f0 = a.st.frames_out
+            time.sleep(1.0)
+            # allow a stray in-flight frame, but no steady 1/s drumbeat
+            assert a.st.frames_out - f0 <= 1
