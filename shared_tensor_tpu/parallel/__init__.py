@@ -1,0 +1,25 @@
+"""Pod tier: peer sync over ICI collectives on a device mesh (the north-star
+replacement for the reference's TCP tree — see parallel/ici.py)."""
+
+from .ici import (
+    PeerSyncState,
+    add_updates,
+    build_sync_step,
+    frame_ici_bytes,
+    init_state,
+    read_peer,
+    state_sharding,
+)
+from .mesh import make_mesh, rows_per_shard
+
+__all__ = [
+    "PeerSyncState",
+    "add_updates",
+    "build_sync_step",
+    "frame_ici_bytes",
+    "init_state",
+    "read_peer",
+    "state_sharding",
+    "make_mesh",
+    "rows_per_shard",
+]

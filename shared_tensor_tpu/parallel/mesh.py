@@ -1,0 +1,70 @@
+"""Device-mesh construction for the pod tier.
+
+The reference's scaling axis is peer count over a TCP tree (SURVEY.md §2.3);
+the TPU-native equivalent runs peers *inside* one process as devices on a
+`jax.sharding.Mesh` axis, exchanging compressed deltas over ICI instead of
+sockets (BASELINE.json north star). Two axes:
+
+- ``peer``: each device along this axis is an independent async-DP peer with
+  its own replica of the shared table (the reference's "node").
+- ``shard``: the flat table buffer is additionally sharded along this axis, so
+  tables far larger than one device's HBM still sync at ICI speed (the
+  reference crashes at ~60 Mi elements, quirk Q6; SURVEY.md §5.7).
+
+Tests run this on an 8-device virtual CPU mesh
+(``--xla_force_host_platform_device_count=8``); the same code runs unmodified
+on a real v5e-8 (SURVEY.md §4.2 tier 2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..config import MeshConfig
+
+
+def make_mesh(
+    n_peer: Optional[int] = None,
+    n_shard: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+    config: MeshConfig | None = None,
+) -> Mesh:
+    """A (peer, shard) mesh over ``n_peer * n_shard`` devices.
+
+    ``n_peer=None`` uses all remaining devices. On real hardware, pass devices
+    ordered so that the shard axis is innermost (contiguous ICI neighbors) —
+    scale reductions ride the shard axis every frame, while peer exchange is
+    one all-gather per frame.
+    """
+    cfg = config or MeshConfig()
+    devs = list(devices if devices is not None else jax.devices())
+    if n_peer is None:
+        n_peer = len(devs) // n_shard
+    need = n_peer * n_shard
+    if need > len(devs):
+        raise ValueError(
+            f"mesh ({n_peer} peers x {n_shard} shards) needs {need} devices, "
+            f"have {len(devs)}"
+        )
+    grid = np.array(devs[:need]).reshape(n_peer, n_shard)
+    return Mesh(grid, (cfg.peer_axis, cfg.shard_axis))
+
+
+def rows_per_shard(total: int, n_shard: int, lanes: int = 128) -> int:
+    """Rows of the (rows, 128) view each shard owns; validates divisibility.
+
+    ``total`` is always a multiple of 1024 (= 8 rows, ops/packing.py TILE), so
+    any power-of-two ``n_shard`` <= 8 divides evenly; larger shard counts may
+    need the caller to grow the table padding.
+    """
+    rows = total // lanes
+    if rows % n_shard:
+        raise ValueError(
+            f"{rows} rows not divisible by {n_shard} shards; "
+            f"pad the table to a multiple of {n_shard * lanes * 8} elements"
+        )
+    return rows // n_shard
