@@ -1,0 +1,113 @@
+"""Headline benchmark: approximate-delta sync bandwidth of the fused codec
+path on one chip, in equivalent applied-fp32-delta GB/s per link.
+
+Methodology (matches BASELINE.md's yardstick): the reference's 2-node
+loopback E2E sync at n = 1 Mi elements moves 1.01 GB/s of equivalent fp32
+deltas per link, and is codec-CPU-bound, not network-bound (SURVEY.md §6 —
+the wire carries only 0.03 GB/s; one core saturates on the quantize/apply
+loops, which is exactly the work reference README.md:47 wanted moved to an
+accelerator kernel). This bench therefore times that bottleneck work on the
+TPU: per frame, one full sender half (pow2-RMS scale + sign-quantize +
+bit-pack + error feedback, Pallas) plus one receiver half (unpack + apply,
+Pallas) on an n = 1 Mi buffer — the identical per-link per-frame math at
+identical approximation error (the codec is bit-for-bit the reference
+arithmetic; tests/test_codec*.py pin that). Frames are chained device-side
+via lax.scan and timed by the marginal-rate method (long chain minus short
+chain) so tunnel dispatch latency neither flatters nor masks the result;
+gaussian residuals keep a nonzero scale throughout, so every frame does the
+full (non-idle) codec work.
+
+Prints ONE JSON line: equivalent-delta GB/s and the ratio vs the 1.01 GB/s
+reference baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+N = 1 << 20  # 1 Mi elements — BASELINE.md's headline E2E config
+BASELINE_GBPS = 1.01
+
+
+def _bench(codec, codec_name: str) -> dict:
+    """Marginal-rate timing: through the axon tunnel, dispatch + completion
+    signaling costs ~0.1 s regardless of work, and ``block_until_ready`` can
+    return optimistically — so each measurement chains L frames device-side
+    in one program, forces TRUE completion by fetching a scalar that depends
+    on the final frame, and the per-frame time comes from the difference
+    between a long and a short chain (fixed overhead cancels)."""
+    from functools import partial
+
+    from shared_tensor_tpu.config import ScalePolicy
+
+    @partial(jax.jit, static_argnames=("length",), donate_argnums=(0, 1))
+    def group(resid, values, length):
+        def body(carry, _):
+            r, v = carry
+            frame, r = codec.quantize(r, N, ScalePolicy.POW2_RMS)
+            v = codec.apply_frame(v, frame, N)
+            return (r, v), frame.scale
+
+        (r, v), scales = jax.lax.scan(body, (resid, values), None, length=length)
+        # The fetched scalar depends on both chains (r via scales, v
+        # directly), so neither half can be dead-code-eliminated and the
+        # fetch waits for the whole program.
+        return r, v, scales[-1] + v[0]
+
+    def timed(length: int) -> float:
+        best = float("inf")
+        for rep in range(3):
+            r = jax.random.normal(jax.random.key(rep), (N,), jnp.float32)
+            v = jnp.zeros((N,), jnp.float32)
+            jax.block_until_ready((r, v))
+            t0 = time.perf_counter()
+            _, _, probe = group(r, v, length)
+            float(probe)  # forces completion through the tunnel
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    short, long_ = 16, 144
+    timed(short)  # warmup/compile both lengths
+    timed(long_)
+    t_frame = (timed(long_) - timed(short)) / (long_ - short)
+
+    fps = 1.0 / t_frame
+    equiv_gbps = fps * N * 4 / 1e9
+    return {
+        "metric": "sync_bandwidth_equiv_fp32_per_link",
+        "value": round(equiv_gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(equiv_gbps / BASELINE_GBPS, 2),
+        "detail": {
+            "n_elements": N,
+            "frames_per_s": round(fps, 1),
+            "backend": jax.default_backend(),
+            "codec": codec_name,
+            "wire_gbps": round(fps * (N / 8 + 4) / 1e9, 4),
+        },
+    }
+
+
+def main() -> None:
+    import sys
+    import traceback
+
+    try:
+        from shared_tensor_tpu.ops import codec_pallas as codec
+        result = _bench(codec, "pallas")
+    except Exception:  # Pallas path unavailable: pure-JAX/XLA fallback.
+        # Loud + recorded in the JSON (detail.codec) so a fallback can never
+        # masquerade as a Pallas result.
+        traceback.print_exc(file=sys.stderr)
+        print("bench: Pallas codec failed, falling back to XLA codec", file=sys.stderr)
+        from shared_tensor_tpu.ops import codec
+        result = _bench(codec, "xla-fallback")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

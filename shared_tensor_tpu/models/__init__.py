@@ -1,0 +1,15 @@
+"""Workload models for the shared-tensor training story (BASELINE configs
+2 and 4). The reference is model-agnostic parameter sync (SURVEY.md §5.7);
+these models exist because its README names them as the intended workloads
+(char-rnn, reference README.md:37) and benchmark arms (ResNet async-DP)."""
+
+from .char_rnn import CharRNNConfig, forward, init_params, loss_fn, make_batches, sample
+
+__all__ = [
+    "CharRNNConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "sample",
+    "make_batches",
+]

@@ -1,0 +1,231 @@
+"""char-rnn: the flagship workload model (BASELINE config 2).
+
+The reference lists "More complete examples, including char-rnn" as an
+unrealized TODO (reference README.md:37); its intended workload is
+asynchronous data-parallel SGD where each worker trains a local model and
+merges parameter deltas through the shared tensor (reference README.md:13-19,
+example.lua:14-26). This module supplies that model, TPU-first:
+
+- A multi-layer LSTM over byte-level tokens, the classic Karpathy char-rnn
+  architecture, written as pure functions on an explicit parameter pytree —
+  the pytree is exactly what the shared-tensor table syncs (ops/table.py).
+- All matmuls run in bfloat16 with float32 accumulation
+  (``preferred_element_type``) so they land on the MXU; gate math, cell state
+  and parameters stay float32 on the VPU.
+- Time recurrence is a single ``lax.scan`` per layer (compiler-friendly: one
+  traced step, static shapes), with the input projection for ALL timesteps
+  hoisted out of the scan as one large [T*B, E] x [E, 4H] matmul — inside the
+  scan only the [B, H] x [H, 4H] recurrent matmul remains. Dimensions default
+  to multiples of 128 to match MXU/VPU tiling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class CharRNNConfig:
+    """Defaults are the flagship size (Karpathy's char-rnn default is a
+    2-layer LSTM with 128 hidden units; we default larger and MXU-aligned)."""
+
+    vocab: int = 256  # byte-level: any text works with no tokenizer
+    embed: int = 256
+    hidden: int = 512
+    layers: int = 2
+
+    @property
+    def param_count(self) -> int:
+        n = self.vocab * self.embed
+        d = self.embed
+        for _ in range(self.layers):
+            n += (d + self.hidden + 1) * 4 * self.hidden
+            d = self.hidden
+        n += (self.hidden + 1) * self.vocab
+        return n
+
+
+def init_params(key: jax.Array, cfg: CharRNNConfig) -> Any:
+    """Parameter pytree. Scaled-normal init; forget-gate bias starts at 1 so
+    gradients flow through time from step one (standard LSTM practice)."""
+    ks = jax.random.split(key, 2 + cfg.layers)
+    params: dict[str, Any] = {
+        "embed": jax.random.normal(ks[0], (cfg.vocab, cfg.embed), jnp.float32)
+        * 0.08,
+    }
+    lstm = []
+    d = cfg.embed
+    for li in range(cfg.layers):
+        kx, kh = jax.random.split(ks[1 + li])
+        # Gate order along the 4H axis: [input, forget, cell(g), output].
+        b = jnp.zeros((4 * cfg.hidden,), jnp.float32)
+        b = b.at[cfg.hidden : 2 * cfg.hidden].set(1.0)
+        lstm.append(
+            {
+                "wx": jax.random.normal(kx, (d, 4 * cfg.hidden), jnp.float32)
+                * (1.0 / jnp.sqrt(d)),
+                "wh": jax.random.normal(kh, (cfg.hidden, 4 * cfg.hidden), jnp.float32)
+                * (1.0 / jnp.sqrt(cfg.hidden)),
+                "b": b,
+            }
+        )
+        d = cfg.hidden
+    params["lstm"] = lstm
+    params["proj"] = {
+        "w": jax.random.normal(ks[-1], (cfg.hidden, cfg.vocab), jnp.float32)
+        * (1.0 / jnp.sqrt(cfg.hidden)),
+        "b": jnp.zeros((cfg.vocab,), jnp.float32),
+    }
+    return params
+
+
+def _mm(a: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """bf16 x bf16 -> f32 matmul (MXU path)."""
+    return jax.lax.dot(
+        a.astype(jnp.bfloat16),
+        w.astype(jnp.bfloat16),
+        precision=None,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _cell(
+    layer: dict, h: jnp.ndarray, c: jnp.ndarray, gx_t: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One LSTM cell update given the input half of the gate pre-activation
+    ``gx_t`` = x @ wx + b (shared by training and sampling paths)."""
+    gates = gx_t + _mm(h, layer["wh"])
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+def _lstm_layer(layer: dict, xs: jnp.ndarray, hidden: int) -> jnp.ndarray:
+    """Run one LSTM layer over xs: f32[T, B, D] -> f32[T, B, H].
+
+    The input half of the gate pre-activation (xs @ wx + b) has no recurrent
+    dependency, so it is computed for every timestep in one big MXU matmul;
+    the scan body carries only (h, c) and the [B,H]x[H,4H] matmul.
+    """
+    t, b_sz, d = xs.shape
+    gx = _mm(xs.reshape(t * b_sz, d), layer["wx"]).reshape(t, b_sz, 4 * hidden)
+    gx = gx + layer["b"]
+
+    def step(carry, gx_t):
+        h, c = _cell(layer, *carry, gx_t)
+        return (h, c), h
+
+    h0 = jnp.zeros((b_sz, hidden), jnp.float32)
+    (_, _), hs = jax.lax.scan(step, (h0, h0), gx)
+    return hs
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward(params: Any, tokens: jnp.ndarray, cfg: CharRNNConfig) -> jnp.ndarray:
+    """Logits for next-token prediction: int32[B, T] -> f32[B, T, vocab]."""
+    # mode="clip": out-of-vocab ids clamp instead of producing NaN embeddings
+    # (jnp.take's default fill mode poisons the whole table via the flood
+    # otherwise — the Q9 class of failure).
+    x = jnp.take(params["embed"], tokens, axis=0, mode="clip")  # [B, T, E]
+    xs = jnp.swapaxes(x, 0, 1)  # [T, B, E] for scan
+    for layer in params["lstm"]:
+        xs = _lstm_layer(layer, xs, cfg.hidden)
+    logits = _mm(
+        xs.reshape(-1, cfg.hidden), params["proj"]["w"]
+    ) + params["proj"]["b"]
+    t, b_sz = xs.shape[0], xs.shape[1]
+    return jnp.swapaxes(logits.reshape(t, b_sz, cfg.vocab), 0, 1)
+
+
+def loss_fn(params: Any, batch: tuple[jnp.ndarray, jnp.ndarray], cfg: CharRNNConfig) -> jnp.ndarray:
+    """Mean next-char cross-entropy. ``batch`` = (inputs, targets), both
+    int32[B, T]."""
+    inputs, targets = batch
+    logits = forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+@partial(jax.jit, static_argnames=("cfg", "length"))
+def sample(
+    params: Any,
+    key: jax.Array,
+    prompt: jnp.ndarray,
+    cfg: CharRNNConfig,
+    length: int = 256,
+    temperature: float = 1.0,
+) -> jnp.ndarray:
+    """Autoregressive sampling: int32[P] prompt -> int32[length] continuation.
+
+    Single-token steps keep (h, c) per layer in carry; the whole generation is
+    one ``lax.scan`` (no Python loop, one compile).
+    """
+
+    def step_token(state, tok):
+        # Batch-of-1 shapes so the exact training cell (_cell) is reused.
+        hs, cs = state
+        x = params["embed"][tok][None, :]
+        new_h, new_c = [], []
+        for li, layer in enumerate(params["lstm"]):
+            gx = _mm(x, layer["wx"]) + layer["b"]
+            h, c = _cell(layer, hs[li], cs[li], gx)
+            new_h.append(h)
+            new_c.append(c)
+            x = h
+        logits = (_mm(x, params["proj"]["w"]) + params["proj"]["b"])[0]
+        return (tuple(new_h), tuple(new_c)), logits
+
+    zeros = tuple(
+        jnp.zeros((1, cfg.hidden), jnp.float32) for _ in range(cfg.layers)
+    )
+    state = (zeros, zeros)
+
+    state, logits = jax.lax.scan(step_token, state, prompt)
+    last_logits = logits[-1]
+
+    def gen(carry, k):
+        state, logits = carry
+        tok = jax.random.categorical(k, logits / temperature)
+        state, logits = step_token(state, tok)
+        return (state, logits), tok
+
+    keys = jax.random.split(key, length)
+    _, toks = jax.lax.scan(gen, (state, last_logits), keys)
+    return toks
+
+
+def make_batches(
+    text: bytes,
+    batch: int,
+    seq: int,
+    key: jax.Array,
+    n_peer: int | None = None,
+    vocab: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Random (inputs, targets) windows from byte text. With ``n_peer``,
+    returns [n_peer, batch, seq] so each pod peer trains on its own slice —
+    the reference's N-workers-on-one-corpus story (example.lua:6-12).
+    ``vocab`` folds bytes into a smaller id space (tests / tiny models)."""
+    if len(text) < seq + 2:
+        raise ValueError(
+            f"text has {len(text)} bytes; need at least seq+2 = {seq + 2}"
+        )
+    data = jnp.frombuffer(text, dtype=jnp.uint8).astype(jnp.int32)
+    if vocab is not None:
+        data = data % vocab
+    count = (n_peer or 1) * batch
+    starts = jax.random.randint(key, (count,), 0, data.shape[0] - seq - 1)
+    idx = starts[:, None] + jnp.arange(seq)[None, :]
+    x = data[idx]
+    y = data[idx + 1]
+    if n_peer is not None:
+        x = x.reshape(n_peer, batch, seq)
+        y = y.reshape(n_peer, batch, seq)
+    return x, y

@@ -104,17 +104,22 @@ def read_peer(state: PeerSyncState, spec: TableSpec, peer: int):
     return unflatten(state.values[peer], spec)
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def add_updates(state: PeerSyncState, updates: jax.Array) -> PeerSyncState:
+def add_updates_raw(state: PeerSyncState, updates: jax.Array) -> PeerSyncState:
     """Each peer merges its own additive update (``updates[p]`` for peer p):
     replica and residual both receive it, so it is visible locally at once and
     queued for the group (reference addFromInternal, src/sharedtensor.c:
-    334-344). Sanitized like ops.table.accumulate_table (quirk Q9 fix)."""
+    334-344). Sanitized like ops.table.accumulate_table (quirk Q9 fix).
+
+    Un-jitted so callers (train/async_sgd.py) can fuse it into a larger
+    step; use :func:`add_updates` standalone."""
     u = jnp.nan_to_num(updates.astype(jnp.float32), nan=0.0, posinf=3.0e38, neginf=-3.0e38)
     return PeerSyncState(
         jnp.clip(state.values + u, -3.0e38, 3.0e38),
         jnp.clip(state.residual + u, -3.0e38, 3.0e38),
     )
+
+
+add_updates = jax.jit(add_updates_raw, donate_argnums=(0,))
 
 
 # --- the fused sync step ----------------------------------------------------
@@ -171,6 +176,7 @@ def build_sync_step(
     per_leaf: bool = True,
     compressed: bool = True,
     config: MeshConfig | None = None,
+    jit_compile: bool = True,
 ):
     """Compile one fused pod sync step: ``state -> (state', scales)``.
 
@@ -266,11 +272,14 @@ def build_sync_step(
         out_specs=(spec_vr, spec_vr, P(peer_ax, None)),
     )
 
-    @partial(jax.jit, donate_argnums=(0,))
     def sync_step(state: PeerSyncState) -> Tuple[PeerSyncState, jax.Array]:
         v, r, scales = sharded(state.values, state.residual)
         return PeerSyncState(v, r), scales
 
+    if jit_compile:
+        return jax.jit(sync_step, donate_argnums=(0,))
+    # Raw (traceable) form for embedding into a larger jitted step
+    # (train/async_sgd.py fuses grads + add_updates + sync into one program).
     return sync_step
 
 

@@ -1,0 +1,7 @@
+"""Training tier: async data-parallel SGD over the pod's compressed sync
+(the reference's intended workload, README.md:13-19, made a first-class
+subsystem)."""
+
+from .async_sgd import PodTrainer, build_train_step
+
+__all__ = ["PodTrainer", "build_train_step"]

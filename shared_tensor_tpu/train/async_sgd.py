@@ -1,0 +1,170 @@
+"""Async data-parallel SGD over the pod tier: the reference's training story,
+fused into one XLA program per step.
+
+The reference's workload is N workers each looping {copyToTensor; compute a
+local update; addFromTensor} while peer updates stream in asynchronously
+(reference README.md:13-19, example.lua:14-26). On a pod, each device along
+the ``peer`` mesh axis is one such worker; a training step is
+
+  1. every peer computes grads of its own replica on its own batch
+     (``jax.vmap`` over the peer axis — GSPMD keeps each peer's compute on
+     its own device, zero cross-device traffic);
+  2. ``add_updates``: the scaled update lands in the peer's replica (visible
+     immediately, like ``addFromTensor``) and its outgoing residual;
+  3. the fused compressed sync step (parallel/ici.py): 1-bit quantize +
+     all-gather over ICI + split-horizon apply.
+
+One ``jax.jit`` covers all three, so XLA overlaps the codec/collective with
+backward-pass compute where the schedule allows. Compute never blocks on
+host round-trips — the async-semantics contract (reference README.md:24)
+holds step-to-step: a peer's update is visible locally at once and reaches
+others compressed, with bounded +/-scale overshoot.
+
+``sync_every > 1`` trades freshness for bandwidth exactly like the
+reference's natural backpressure pacing (its TCP link simply falls behind and
+residuals accumulate, reference src/sharedtensor.c:176-177): local steps
+accumulate into the residual and one compressed frame carries their sum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import CodecConfig, MeshConfig, ScalePolicy
+from ..ops.table import TableSpec, flatten, make_spec, unflatten
+from ..parallel.ici import (
+    PeerSyncState,
+    add_updates,
+    add_updates_raw,
+    build_sync_step,
+    init_state,
+    read_peer,
+)
+
+
+def build_train_step(
+    mesh: Mesh,
+    spec: TableSpec,
+    loss_fn: Callable[[Any, Any], jnp.ndarray],
+    policy: ScalePolicy = ScalePolicy.POW2_RMS,
+    per_leaf: bool = True,
+    compressed: bool = True,
+    sync: bool = True,
+    config: MeshConfig | None = None,
+):
+    """Compile ``(state, batch, lr) -> (state', per-peer loss, scales)``.
+
+    ``loss_fn(params, batch_item) -> scalar`` sees the caller's parameter
+    pytree; ``batch`` carries a leading peer axis on every leaf. ``lr`` is a
+    traced scalar so schedules don't retrigger compilation. ``sync=False``
+    builds the no-communication arm (pure local SGD — the isolation baseline
+    for convergence comparisons)."""
+    cfg = config or MeshConfig()
+    sync_raw = (
+        build_sync_step(
+            mesh,
+            spec,
+            policy=policy,
+            per_leaf=per_leaf,
+            compressed=compressed,
+            config=cfg,
+            jit_compile=False,
+        )
+        if sync
+        else None
+    )
+    k = spec.num_leaves if per_leaf else 1
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def per_peer(values_row: jnp.ndarray, batch_item):
+        params = unflatten(values_row, spec)
+        loss, grads = grad_fn(params, batch_item)
+        return loss, flatten(grads, spec)
+
+    def _step(state: PeerSyncState, batch, lr):
+        losses, g = jax.vmap(per_peer)(state.values, batch)
+        state = add_updates_raw(state, -lr * g)
+        if sync_raw is not None:
+            state, scales = sync_raw(state)
+        else:
+            scales = jnp.zeros((state.values.shape[0], k), jnp.float32)
+        return state, losses, scales
+
+    return jax.jit(_step, donate_argnums=(0,))
+
+
+@dataclasses.dataclass
+class PodTrainer:
+    """Convenience wrapper owning the sharded state + compiled step.
+
+    ``create_or_fetch`` for the pod tier: construct with a parameter template
+    and every peer starts from that seed, replicas kept eventually-consistent
+    by the compressed sync (the in-pod analog of comm/peer.py's
+    ``create_or_fetch`` — SURVEY.md §2.2 row 1)."""
+
+    mesh: Mesh
+    template: Any
+    loss_fn: Callable[[Any, Any], jnp.ndarray]
+    codec: CodecConfig = dataclasses.field(default_factory=CodecConfig)
+    mesh_config: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    compressed: bool = True
+    sync: bool = True
+
+    def __post_init__(self):
+        self.spec: TableSpec = make_spec(self.template)
+        self.state: PeerSyncState = init_state(
+            self.mesh, self.spec, self.template, self.mesh_config
+        )
+        self.n_peer: int = self.mesh.shape[self.mesh_config.peer_axis]
+        self._step = build_train_step(
+            self.mesh,
+            self.spec,
+            self.loss_fn,
+            policy=self.codec.scale_policy,
+            per_leaf=self.codec.per_leaf_scale,
+            compressed=self.compressed,
+            sync=self.sync,
+            config=self.mesh_config,
+        )
+        self.steps = 0
+
+    def shard_batch(self, batch: Any) -> Any:
+        """Pin a [n_peer, ...] batch pytree to the peer axis so each peer's
+        slice lives on its own devices before the step runs."""
+        ax = self.mesh_config.peer_axis
+
+        def put(x):
+            sh = NamedSharding(self.mesh, P(ax, *([None] * (x.ndim - 1))))
+            return jax.device_put(x, sh)
+
+        return jax.tree.map(put, batch)
+
+    def step(self, batch: Any, lr: float = 1e-2):
+        """One fused train+sync step. Returns (per-peer losses f32[n_peer],
+        per-peer-leaf scales); state advances in place."""
+        self.state, losses, scales = self._step(
+            self.state, batch, jnp.float32(lr)
+        )
+        self.steps += 1
+        return losses, scales
+
+    def read(self, peer: int = 0) -> Any:
+        """Peer ``peer``'s current replica as the template pytree (reference
+        copyToTensor, src/sharedtensor.c:435-446)."""
+        return read_peer(self.state, self.spec, peer)
+
+    def add(self, updates: jax.Array) -> None:
+        """Out-of-band additive update, [n_peer, spec.total] flat (reference
+        addFromTensor outside the training loop)."""
+        self.state = add_updates(self.state, updates)
+
+    def replica_spread(self) -> float:
+        """Max abs deviation of any replica from the peer mean — the
+        eventual-consistency observable (0 when fully converged/synced)."""
+        v = self.state.values
+        return float(jnp.max(jnp.abs(v - jnp.mean(v, axis=0, keepdims=True))))

@@ -1,0 +1,75 @@
+"""char-rnn model tests (SURVEY.md §4.2 tier 1 style: hermetic, CPU).
+
+The model is the flagship workload (BASELINE config 2; reference
+README.md:37's unrealized char-rnn TODO)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from shared_tensor_tpu.models import char_rnn as m
+
+TINY = m.CharRNNConfig(vocab=64, embed=16, hidden=32, layers=2)
+
+
+def test_forward_shape_and_finite():
+    params = m.init_params(jax.random.key(0), TINY)
+    tokens = jax.random.randint(jax.random.key(1), (3, 7), 0, TINY.vocab)
+    logits = m.forward(params, tokens, TINY)
+    assert logits.shape == (3, 7, TINY.vocab)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_param_count_matches_pytree():
+    params = m.init_params(jax.random.key(0), TINY)
+    n = sum(x.size for x in jax.tree.leaves(params))
+    assert n == TINY.param_count
+
+
+def test_initial_loss_near_uniform():
+    """Untrained model should be close to -log(1/vocab) on random data."""
+    params = m.init_params(jax.random.key(0), TINY)
+    x = jax.random.randint(jax.random.key(1), (4, 16), 0, TINY.vocab)
+    y = jax.random.randint(jax.random.key(2), (4, 16), 0, TINY.vocab)
+    loss = m.loss_fn(params, (x, y), TINY)
+    assert abs(float(loss) - jnp.log(TINY.vocab)) < 0.5
+
+
+def test_sgd_learns_repeating_pattern():
+    """A few plain SGD steps must cut the loss on a trivially predictable
+    stream — guards the whole backward path."""
+    cfg = TINY
+    params = m.init_params(jax.random.key(0), cfg)
+    text = bytes(range(8)) * 200
+    x, y = m.make_batches(text, batch=8, seq=16, key=jax.random.key(3))
+
+    grad = jax.jit(jax.grad(lambda p: m.loss_fn(p, (x, y), cfg)))
+    loss0 = float(m.loss_fn(params, (x, y), cfg))
+    for _ in range(100):
+        g = grad(params)
+        params = jax.tree.map(lambda p, gg: p - 0.5 * gg, params, g)
+    loss1 = float(m.loss_fn(params, (x, y), cfg))
+    assert loss1 < loss0 * 0.5, (loss0, loss1)
+
+
+def test_sample_shape_dtype_and_range():
+    params = m.init_params(jax.random.key(0), TINY)
+    prompt = jnp.asarray([1, 2, 3], jnp.int32)
+    out = m.sample(params, jax.random.key(1), prompt, TINY, length=11)
+    assert out.shape == (11,)
+    assert out.dtype in (jnp.int32, jnp.int64)
+    assert bool(jnp.all((out >= 0) & (out < TINY.vocab)))
+
+
+def test_make_batches_targets_shifted():
+    text = bytes(range(256)) * 4
+    x, y = m.make_batches(text, batch=4, seq=8, key=jax.random.key(0))
+    assert x.shape == (4, 8) and y.shape == (4, 8)
+    # y is x shifted by one within the byte ramp (mod 256 at wrap)
+    assert bool(jnp.all((y - x) % 256 == 1))
+
+
+def test_make_batches_peer_axis():
+    text = b"hello world " * 100
+    x, y = m.make_batches(text, batch=2, seq=4, key=jax.random.key(0), n_peer=3)
+    assert x.shape == (3, 2, 4) and y.shape == (3, 2, 4)
