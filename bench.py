@@ -12,10 +12,9 @@ bit-pack + error feedback, Pallas) plus one receiver half (unpack + apply,
 Pallas) on an n = 1 Mi buffer — the identical per-link per-frame math at
 identical approximation error (the codec is bit-for-bit the reference
 arithmetic; tests/test_codec*.py pin that). Frames are chained device-side
-via lax.scan and timed by the marginal-rate method (long chain minus short
-chain) so tunnel dispatch latency neither flatters nor masks the result;
-gaussian residuals keep a nonzero scale throughout, so every frame does the
-full (non-idle) codec work.
+via lax.scan into multi-second runs so tunnel dispatch latency is a small
+bias that only understates the result; gaussian residuals keep a nonzero
+scale throughout, so every frame does the full (non-idle) codec work.
 
 Prints ONE JSON line: equivalent-delta GB/s and the ratio vs the 1.01 GB/s
 reference baseline.
@@ -34,47 +33,12 @@ BASELINE_GBPS = 1.01
 
 
 def _bench(codec, codec_name: str) -> dict:
-    """Marginal-rate timing: through the axon tunnel, dispatch + completion
-    signaling costs ~0.1 s regardless of work, and ``block_until_ready`` can
-    return optimistically — so each measurement chains L frames device-side
-    in one program, forces TRUE completion by fetching a scalar that depends
-    on the final frame, and the per-frame time comes from the difference
-    between a long and a short chain (fixed overhead cancels)."""
-    from functools import partial
-
+    """Long-chain device-side timing (utils/timing.py): thousands of frames
+    per dispatch, so tunnel latency is a small conservative bias."""
     from shared_tensor_tpu.config import ScalePolicy
+    from shared_tensor_tpu.utils.timing import codec_frame_time
 
-    @partial(jax.jit, static_argnames=("length",), donate_argnums=(0, 1))
-    def group(resid, values, length):
-        def body(carry, _):
-            r, v = carry
-            frame, r = codec.quantize(r, N, ScalePolicy.POW2_RMS)
-            v = codec.apply_frame(v, frame, N)
-            return (r, v), frame.scale
-
-        (r, v), scales = jax.lax.scan(body, (resid, values), None, length=length)
-        # The fetched scalar depends on both chains (r via scales, v
-        # directly), so neither half can be dead-code-eliminated and the
-        # fetch waits for the whole program.
-        return r, v, scales[-1] + v[0]
-
-    def timed(length: int) -> float:
-        best = float("inf")
-        for rep in range(3):
-            r = jax.random.normal(jax.random.key(rep), (N,), jnp.float32)
-            v = jnp.zeros((N,), jnp.float32)
-            jax.block_until_ready((r, v))
-            t0 = time.perf_counter()
-            _, _, probe = group(r, v, length)
-            float(probe)  # forces completion through the tunnel
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    short, long_ = 16, 144
-    timed(short)  # warmup/compile both lengths
-    timed(long_)
-    t_frame = (timed(long_) - timed(short)) / (long_ - short)
-
+    t_frame = codec_frame_time(codec, N, ScalePolicy.POW2_RMS)
     fps = 1.0 / t_frame
     equiv_gbps = fps * N * 4 / 1e9
     return {

@@ -1,0 +1,50 @@
+"""The reference's example.lua, 1:1 program shape (BASELINE config 1).
+
+Run once to become master at 127.0.0.1:50000; run more copies (same command,
+other terminals) to join the tree. Every process adds 1s each second and
+prints its replica — watch the values converge across processes as updates
+flood through (reference example.lua:1-26, README.md:8-19).
+
+Usage:  python examples/example.py [host] [port] [--steps N]
+
+Tip: run with JAX_PLATFORMS=cpu for multi-process demos on one machine; the
+single TPU chip can only be claimed by one process at a time.
+"""
+
+import argparse
+import time
+
+import jax.numpy as jnp
+
+from shared_tensor_tpu.comm.peer import create_or_fetch
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("host", nargs="?", default="127.0.0.1")
+    ap.add_argument("port", nargs="?", type=int, default=50000)
+    ap.add_argument("--steps", type=int, default=0, help="0 = run forever")
+    args = ap.parse_args()
+
+    # torch.range(1,4):float()  (example.lua:4)
+    x = jnp.arange(1.0, 5.0, dtype=jnp.float32)
+
+    with create_or_fetch(args.host, args.port, x) as a:
+        step = 0
+        while args.steps == 0 or step < args.steps:
+            x = a.read()  # a:copyToTensor(x)
+
+            # do something computationally intensive with x
+            results = jnp.ones_like(x)
+
+            # Add our updates into a, which will be asynchronously
+            # propagated to all other connected programs.
+            a.add(results)  # a:addFromTensor(results)
+
+            print(x)
+            time.sleep(1)  # just so you can see what's going on
+            step += 1
+
+
+if __name__ == "__main__":
+    main()

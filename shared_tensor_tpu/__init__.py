@@ -10,8 +10,19 @@ binary-tree overlay and wire format.
 """
 
 from .config import CodecConfig, Config, MeshConfig, ScalePolicy, TransportConfig
+from .core import SharedTensor
 
 __version__ = "0.1.0"
+
+
+def create_or_fetch(host, port, template, config=None, timeout=30.0):
+    """The reference entry point (sharedtensor.createOrFetch) — see
+    comm/peer.py. Imported lazily so codec-only users don't pay for the
+    native transport build."""
+    from .comm.peer import create_or_fetch as _cof
+
+    return _cof(host, port, template, config, timeout)
+
 
 __all__ = [
     "Config",
@@ -19,5 +30,7 @@ __all__ = [
     "TransportConfig",
     "MeshConfig",
     "ScalePolicy",
+    "SharedTensor",
+    "create_or_fetch",
     "__version__",
 ]

@@ -1,0 +1,63 @@
+"""Reference-named API shim (the north star's "JAX shim").
+
+The reference's public API is exactly three Lua calls
+(reference src/sharedtensor.c:455-465, README.md:6-19):
+
+    a = sharedtensor.createOrFetch(host, port, tensor)
+    a:copyToTensor(t)
+    a:addFromTensor(t)
+
+This module exposes the same names with the same program shape, so a user
+porting a Torch7/Lua script (example.lua, char-rnn) renames nothing. The
+objects underneath are the real framework (comm/peer.py over the native
+transport); tensors are jax arrays or pytrees of them.
+
+`copyToTensor` returns the snapshot instead of filling a caller buffer —
+jax arrays are immutable, so the out-parameter idiom has no meaning here.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .comm.peer import SharedTensorPeer, create_or_fetch
+from .config import Config
+
+
+class _CompatHandle:
+    """The reference's userdata object: three methods, nothing else."""
+
+    def __init__(self, peer: SharedTensorPeer):
+        self._peer = peer
+
+    def copyToTensor(self) -> Any:  # noqa: N802 (reference-exact name)
+        """Snapshot of the replica (reference l_copyToTensor,
+        src/sharedtensor.c:435-446)."""
+        return self._peer.read()
+
+    def addFromTensor(self, delta: Any) -> None:  # noqa: N802
+        """Async additive merge (reference l_addFromTensor,
+        src/sharedtensor.c:448-453)."""
+        self._peer.add(delta)
+
+    def close(self) -> None:
+        """Clean departure — the capability the reference lacks (its __gc
+        exits the whole process on a connected tensor, quirk Q8)."""
+        self._peer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def createOrFetch(  # noqa: N802 (reference-exact name)
+    host: str, port: int, tensor: Any, config: Config | None = None
+) -> _CompatHandle:
+    """Create the shared tensor at host:port (becoming master, seeded from
+    ``tensor``) or join the existing tree (reference l_createOrFetch,
+    src/sharedtensor.c:347-391). Blocks until ready, like the reference's
+    joiner wait — but via an explicit handshake, not a busy-wait on nonzero
+    values (quirk Q4 fixed; an all-zero tensor joins fine)."""
+    return _CompatHandle(create_or_fetch(host, port, tensor, config))

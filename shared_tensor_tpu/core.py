@@ -131,7 +131,16 @@ class SharedTensor:
 
     @property
     def link_ids(self) -> tuple[int, ...]:
-        return tuple(self._links)
+        with self._lock:
+            return tuple(self._links)
+
+    def snapshot_all(self) -> tuple[jnp.ndarray, dict[int, jnp.ndarray]]:
+        """Consistent point-in-time view of (replica, {link: residual}) under
+        ONE lock acquisition — the checkpoint primitive. Separate
+        snapshot_flat + per-link reads would let a concurrent frame land
+        between them, tearing the error-feedback invariant on restore."""
+        with self._lock:
+            return self.values, dict(self._links)
 
     # -- user API ----------------------------------------------------------
 

@@ -3,13 +3,27 @@
 these models exist because its README names them as the intended workloads
 (char-rnn, reference README.md:37) and benchmark arms (ResNet async-DP)."""
 
-from .char_rnn import CharRNNConfig, forward, init_params, loss_fn, make_batches, sample
+from . import char_rnn, resnet
+from .char_rnn import (
+    CharRNNConfig,
+    encode_corpus,
+    forward,
+    init_params,
+    loss_fn,
+    make_batches,
+    sample,
+)
+from .resnet import ResNetConfig
 
 __all__ = [
+    "char_rnn",
+    "resnet",
     "CharRNNConfig",
+    "ResNetConfig",
     "init_params",
     "forward",
     "loss_fn",
     "sample",
     "make_batches",
+    "encode_corpus",
 ]

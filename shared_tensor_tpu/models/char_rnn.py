@@ -201,25 +201,35 @@ def sample(
     return toks
 
 
+def encode_corpus(text: bytes, vocab: int | None = None) -> jnp.ndarray:
+    """One-time byte-text -> int32 device array conversion. Convert the
+    corpus ONCE and pass the array to make_batches in the training loop —
+    re-uploading a multi-MB corpus every step would dominate step time.
+    ``vocab`` folds bytes into a smaller id space (tests / tiny models)."""
+    data = jnp.frombuffer(text, dtype=jnp.uint8).astype(jnp.int32)
+    if vocab is not None:
+        data = data % vocab
+    return data
+
+
 def make_batches(
-    text: bytes,
+    text: bytes | jnp.ndarray,
     batch: int,
     seq: int,
     key: jax.Array,
     n_peer: int | None = None,
     vocab: int | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Random (inputs, targets) windows from byte text. With ``n_peer``,
+    """Random (inputs, targets) windows from a byte corpus. With ``n_peer``,
     returns [n_peer, batch, seq] so each pod peer trains on its own slice —
     the reference's N-workers-on-one-corpus story (example.lua:6-12).
-    ``vocab`` folds bytes into a smaller id space (tests / tiny models)."""
+    ``text`` may be raw bytes (converted on the fly; fine for tests) or the
+    device array from :func:`encode_corpus` (training loops)."""
     if len(text) < seq + 2:
         raise ValueError(
-            f"text has {len(text)} bytes; need at least seq+2 = {seq + 2}"
+            f"corpus has {len(text)} tokens; need at least seq+2 = {seq + 2}"
         )
-    data = jnp.frombuffer(text, dtype=jnp.uint8).astype(jnp.int32)
-    if vocab is not None:
-        data = data % vocab
+    data = encode_corpus(text, vocab) if isinstance(text, bytes) else text
     count = (n_peer or 1) * batch
     starts = jax.random.randint(key, (count,), 0, data.shape[0] - seq - 1)
     idx = starts[:, None] + jnp.arange(seq)[None, :]

@@ -1,0 +1,142 @@
+"""ResNet-18: the async-DP benchmark arm model (BASELINE config 4:
+"ResNet-18 data-parallel async SGD, 8 peers, compressed-delta vs exact
+allreduce").
+
+The reference is model-agnostic parameter sync (SURVEY.md §5.7) — this model
+exists purely as the convergence-comparison workload. TPU-first choices:
+
+- convs in bfloat16 with float32 accumulation (``preferred_element_type``) so
+  they tile onto the MXU; NHWC layout (TPU-native).
+- BatchNorm uses current-batch statistics only (training mode): the
+  normalization is a pure function of (params, batch), so the whole model
+  stays functional and every learnable tensor lives in the shared table. No
+  running-stat mutable state to special-case in the sync.
+- The default geometry is the CIFAR variant (3x3 stem, no maxpool) so tests
+  and benches run on 32x32 inputs; ``stem_stride``/``stem_pool`` give the
+  ImageNet stem.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stages: tuple[int, ...] = (2, 2, 2, 2)  # ResNet-18: two basic blocks/stage
+    width: int = 64
+    classes: int = 10
+    stem_kernel: int = 3
+    stem_stride: int = 1
+    stem_pool: bool = False  # True for the ImageNet 7x7/s2 + maxpool stem
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * jnp.sqrt(
+        2.0 / fan_in
+    )
+
+
+def init_params(key: jax.Array, cfg: ResNetConfig) -> Any:
+    keys = iter(jax.random.split(key, 4 + sum(cfg.stages) * 3))
+    w = cfg.width
+    params: dict[str, Any] = {
+        "stem": {
+            "conv": _conv_init(next(keys), cfg.stem_kernel, cfg.stem_kernel, 3, w),
+            "scale": jnp.ones((w,), jnp.float32),
+            "bias": jnp.zeros((w,), jnp.float32),
+        }
+    }
+    blocks = []
+    cin = w
+    for si, depth in enumerate(cfg.stages):
+        cout = w * (2**si)
+        for bi in range(depth):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            blk = {
+                "conv1": _conv_init(next(keys), 3, 3, cin, cout),
+                "scale1": jnp.ones((cout,), jnp.float32),
+                "bias1": jnp.zeros((cout,), jnp.float32),
+                "conv2": _conv_init(next(keys), 3, 3, cout, cout),
+                # zero-init the residual branch's last norm scale: each block
+                # starts as identity (standard trick, stabilizes early async
+                # training where peers see each other's noisy deltas)
+                "scale2": jnp.zeros((cout,), jnp.float32),
+                "bias2": jnp.zeros((cout,), jnp.float32),
+            }
+            if stride != 1 or cin != cout:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout)
+            blocks.append(blk)
+            cin = cout
+    params["blocks"] = blocks
+    params["head"] = {
+        "w": jax.random.normal(next(keys), (cin, cfg.classes), jnp.float32)
+        * (1.0 / jnp.sqrt(cin)),
+        "b": jnp.zeros((cfg.classes,), jnp.float32),
+    }
+    return params
+
+
+def _conv(x, w, stride=1):
+    # Both operands bf16 (MXU path; XLA accumulates bf16 convs in f32
+    # internally), cast back to f32 for the norm/activation VPU math.
+    # preferred_element_type=f32 would be cleaner but its conv transpose
+    # (gradient) rule rejects the mixed-dtype cotangent.
+    out = jax.lax.conv_general_dilated(
+        x.astype(jnp.bfloat16),
+        w.astype(jnp.bfloat16),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out.astype(jnp.float32)
+
+
+def _bn(x, scale, bias):
+    """Batch statistics over (N, H, W) — training-mode BatchNorm as a pure
+    function; f32 throughout (VPU)."""
+    mean = jnp.mean(x, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(x, axis=(0, 1, 2), keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward(params: Any, images: jnp.ndarray, cfg: ResNetConfig) -> jnp.ndarray:
+    """f32[N, H, W, 3] -> logits f32[N, classes]."""
+    x = _conv(images, params["stem"]["conv"], cfg.stem_stride)
+    x = jax.nn.relu(_bn(x, params["stem"]["scale"], params["stem"]["bias"]))
+    if cfg.stem_pool:
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+        )
+    bi = 0
+    for si, depth in enumerate(cfg.stages):
+        for b in range(depth):
+            blk = params["blocks"][bi]
+            stride = 2 if (si > 0 and b == 0) else 1
+            y = jax.nn.relu(_bn(_conv(x, blk["conv1"], stride), blk["scale1"], blk["bias1"]))
+            y = _bn(_conv(y, blk["conv2"]), blk["scale2"], blk["bias2"])
+            sc = _conv(x, blk["proj"], stride) if "proj" in blk else x
+            x = jax.nn.relu(sc + y)
+            bi += 1
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    return jax.lax.dot(
+        x.astype(jnp.bfloat16),
+        params["head"]["w"].astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ) + params["head"]["b"]
+
+
+def loss_fn(params: Any, batch: tuple[jnp.ndarray, jnp.ndarray], cfg: ResNetConfig) -> jnp.ndarray:
+    """Mean softmax cross-entropy; ``batch`` = (images f32[N,H,W,3], labels
+    int32[N])."""
+    images, labels = batch
+    logits = forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

@@ -1,0 +1,7 @@
+"""Auxiliary subsystems the reference lacks (SURVEY.md §5): checkpoint/
+resume lives here; observability counters live on the objects they observe
+(SharedTensor counters, peer.metrics(), per-frame scales from sync steps)."""
+
+from . import checkpoint
+
+__all__ = ["checkpoint"]

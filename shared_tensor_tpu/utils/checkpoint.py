@@ -1,0 +1,140 @@
+"""Checkpoint / resume for the shared tensor (SURVEY.md §5.4).
+
+The reference has NO persistence — kill the tree and the tensor is gone
+(reference src/sharedtensor.c has no file I/O at all); its only
+state-recovery mechanism is streaming full state to a late joiner through
+the codec (src/sharedtensor.c:379-381). This module adds the missing half:
+
+- checkpoint = the replica values + every link/peer residual, written
+  atomically (tmp + rename) as a single .npz;
+- resume has two modes:
+  1. restore-in-place (this module): reload values/residuals and continue;
+  2. rejoin-as-peer (comm/peer.py): start fresh and receive state through
+     the codec stream — the reference's own join mechanism, which this
+     checkpoint complements rather than replaces.
+
+Plain .npz keeps the format inspectable and dependency-free; the sharded pod
+state round-trips through host memory and is re-placed onto the mesh
+sharding on load (tables that fit one host; beyond that, shard-parallel
+checkpointing is an orbax integration point).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import TYPE_CHECKING
+
+import jax
+import numpy as np
+
+from ..core import SharedTensor
+from ..ops.table import TableSpec
+
+if TYPE_CHECKING:  # avoid importing the mesh tier for peer-only users
+    from jax.sharding import Mesh
+
+    from ..config import MeshConfig
+    from ..parallel.ici import PeerSyncState
+
+_FORMAT = 1
+
+
+def _atomic_savez(path: str, **arrays) -> None:
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def save_shared(st: SharedTensor, path: str) -> None:
+    """Snapshot a peer-tier SharedTensor: replica + every link residual,
+    taken atomically via ``snapshot_all`` (one lock acquisition) so a
+    concurrent frame cannot tear the error-feedback invariant."""
+    values, links = st.snapshot_all()
+    arrays = {
+        "values": np.asarray(values),
+        "layout": np.frombuffer(st.spec.layout_digest(), dtype=np.uint8),
+    }
+    for lid, r in links.items():
+        arrays[f"link_{lid}"] = np.asarray(r)
+    arrays["meta"] = np.frombuffer(
+        json.dumps({"format": _FORMAT, "links": list(links)}).encode(),
+        dtype=np.uint8,
+    )
+    _atomic_savez(path, **arrays)
+
+
+def load_shared(st: SharedTensor, path: str) -> None:
+    """Restore into an existing (layout-compatible) SharedTensor. Residuals
+    are restored for links that exist in the file; links opened after the
+    checkpoint keep their current residuals."""
+    with np.load(path) as z:
+        digest = z["layout"].tobytes()
+        if digest != st.spec.layout_digest():
+            raise ValueError(
+                "checkpoint layout does not match this SharedTensor's table "
+                "layout (different tree structure/shapes)"
+            )
+        meta = json.loads(z["meta"].tobytes().decode())
+        values = z["values"]
+        links = {
+            lid: z[f"link_{lid}"]
+            for lid in meta.get("links", [])
+            if f"link_{lid}" in z
+        }
+    import jax.numpy as jnp
+
+    with st._lock:
+        st.values = jnp.asarray(values)
+        for lid, r in links.items():
+            if lid in st._links:
+                st._links[lid] = jnp.asarray(r)
+
+
+def save_pod(state: "PeerSyncState", spec: TableSpec, path: str) -> None:
+    """Snapshot the pod tier's sharded state (all peers' replicas +
+    residuals) through host memory."""
+    values, residual = jax.device_get((state.values, state.residual))
+    _atomic_savez(
+        path,
+        values=values,
+        residual=residual,
+        layout=np.frombuffer(spec.layout_digest(), dtype=np.uint8),
+        meta=np.frombuffer(
+            json.dumps({"format": _FORMAT}).encode(), dtype=np.uint8
+        ),
+    )
+
+
+def load_pod(
+    path: str,
+    mesh: "Mesh",
+    spec: TableSpec,
+    config: "MeshConfig | None" = None,
+) -> "PeerSyncState":
+    """Rebuild a PeerSyncState on ``mesh`` from a checkpoint. The peer count
+    must match the mesh's peer axis (re-sharding across a different peer
+    count is a join/leave operation, not a restore)."""
+    from ..parallel.ici import PeerSyncState, state_sharding
+
+    with np.load(path) as z:
+        if z["layout"].tobytes() != spec.layout_digest():
+            raise ValueError("checkpoint layout does not match the table spec")
+        values, residual = z["values"], z["residual"]
+    sh = state_sharding(mesh, config)
+    n_peer = mesh.shape[sh.spec[0]]
+    if values.shape[0] != n_peer:
+        raise ValueError(
+            f"checkpoint has {values.shape[0]} peers, mesh has {n_peer}"
+        )
+    return PeerSyncState(
+        jax.device_put(values, sh), jax.device_put(residual, sh)
+    )

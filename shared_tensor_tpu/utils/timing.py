@@ -1,0 +1,81 @@
+"""Device-side timing for codec work (shared by bench.py and
+benchmarks/pareto.py).
+
+Through the axon tunnel, dispatch + completion signaling costs a variable
+~0.1 s regardless of work, and ``block_until_ready`` can return
+optimistically — so each measurement chains L codec frames device-side in
+ONE program, forces TRUE completion by fetching a scalar that depends on the
+final frame of both the residual and values chains, and sizes L so the chain
+runs for seconds: the overhead becomes a small bias that only UNDERSTATES
+the reported rate. (A long-minus-short marginal estimate would cancel the
+overhead exactly, but the tunnel's jitter is comparable to the overhead
+itself and can even drive the difference negative.)"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def codec_frame_time(
+    codec,
+    n: int,
+    policy,
+    make_residual: Callable[[int], jnp.ndarray] | None = None,
+    target_seconds: float = 3.0,
+    reps: int = 2,
+) -> float:
+    """Seconds per fused codec roundtrip frame (sender quantize + receiver
+    apply) at table size ``n``. ``make_residual(seed)`` supplies the starting
+    residual (default: standard normal — nonzero scale throughout, so every
+    frame does the full non-idle work)."""
+    if make_residual is None:
+        make_residual = lambda seed: jax.random.normal(
+            jax.random.key(seed), (n,), jnp.float32
+        )
+
+    @partial(jax.jit, static_argnames=("length",), donate_argnums=(0, 1))
+    def group(resid, values, length):
+        def body(carry, _):
+            r, v = carry
+            frame, r = codec.quantize(r, n, policy)
+            v = codec.apply_frame(v, frame, n)
+            return (r, v), ()
+
+        (r, v), _ = jax.lax.scan(body, (resid, values), None, length=length)
+        # The fetched scalar depends on both chains (each frame's error
+        # feedback feeds r, each apply feeds v), so neither half can be
+        # dead-code-eliminated and the fetch waits for the whole program.
+        return r, v, r[0] + v[0]
+
+    def timed(length: int) -> float:
+        best = float("inf")
+        for rep in range(reps):
+            r = make_residual(rep)
+            v = jnp.zeros((n,), jnp.float32)
+            jax.block_until_ready((r, v))
+            t0 = time.perf_counter()
+            _, _, probe = group(r, v, length)
+            float(probe)  # forces completion through the tunnel
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # Pilot at a fixed short length (compile 1), then ONE long bucketed
+    # length (compile 2) sized so device work dominates the tunnel overhead;
+    # the pilot's per-frame time over-counts overhead, so the chosen bucket
+    # errs long (harmless). Scan length is static — every distinct length
+    # costs a fresh (slow, remote) compile, hence buckets, not doubling.
+    pilot = 512
+    timed(pilot)  # warmup/compile
+    est = max(timed(pilot) / pilot, 1e-9)
+    want = target_seconds / est
+    length = pilot
+    while length < want and length < 1_000_000:
+        length *= 8
+    if length == pilot:
+        return est
+    return timed(length) / length

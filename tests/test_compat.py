@@ -1,0 +1,49 @@
+"""Reference-named shim tests: the example.lua program shape, verbatim names
+(BASELINE config 1 through the compat surface)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shared_tensor_tpu import compat
+from tests.test_peer import _free_port  # reuse the loopback port helper
+
+
+def test_example_lua_program_shape():
+    x = jnp.arange(1.0, 5.0, dtype=jnp.float32)  # torch.range(1,4):float()
+    port = _free_port()
+    with compat.createOrFetch("127.0.0.1", port, x) as a:
+        got = a.copyToTensor()
+        np.testing.assert_allclose(np.asarray(got), [1, 2, 3, 4])
+        a.addFromTensor(jnp.ones_like(x))
+        np.testing.assert_allclose(np.asarray(a.copyToTensor()), [2, 3, 4, 5])
+
+
+def test_two_process_semantics_in_one_process():
+    """Master + joiner through the compat names; joiner receives state and
+    both see each other's adds (example.lua's multi-terminal story)."""
+    x = jnp.arange(1.0, 5.0, dtype=jnp.float32)
+    port = _free_port()
+    with compat.createOrFetch("127.0.0.1", port, x) as master:
+        with compat.createOrFetch("127.0.0.1", port, jnp.zeros_like(x)) as joiner:
+            # joiner got the master's state through the codec stream
+            deadline = 50
+            for _ in range(deadline):
+                if np.allclose(np.asarray(joiner.copyToTensor()), [1, 2, 3, 4], atol=1e-6):
+                    break
+                import time
+
+                time.sleep(0.1)
+            np.testing.assert_allclose(
+                np.asarray(joiner.copyToTensor()), [1, 2, 3, 4], atol=1e-6
+            )
+            joiner.addFromTensor(jnp.ones_like(x))
+            import time
+
+            for _ in range(deadline):
+                if np.allclose(np.asarray(master.copyToTensor()), [2, 3, 4, 5], atol=1e-6):
+                    break
+                time.sleep(0.1)
+            np.testing.assert_allclose(
+                np.asarray(master.copyToTensor()), [2, 3, 4, 5], atol=1e-6
+            )
