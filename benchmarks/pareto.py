@@ -2,8 +2,8 @@
 sync-bandwidth Pareto.
 
 For each table size, measures (a) the fused codec roundtrip rate on the chip
-(marginal-rate timing, see bench.py) giving equivalent-fp32-delta GB/s per
-link at 1 bit/element/frame wire cost, and (b) the measured residual-RMS
+(long-chain device-side timing, utils/timing.py) giving equivalent-fp32-delta
+GB/s per link at 1 bit/element/frame wire cost, and (b) the measured residual-RMS
 decay per frame on uniform data — the matched-approximation-error yardstick
 (the reference halves residual RMS each frame on homogeneous data,
 BASELINE.md convergence table; the codec here is bit-identical, and this

@@ -247,7 +247,13 @@ class SharedTensorPeer:
         while not self._stop.is_set():
             busy = self._handle_events()
             for link in list(self.node.links):
-                for _ in range(8):  # drain bursts without starving other links
+                # Consecutive DATA frames batch into ONE device apply
+                # (core.receive_frames): without this, per-frame dispatch on
+                # a busy device falls behind a fast sender and the RX queue
+                # backs up by hundreds of frames. Control messages flush the
+                # batch first so relative order is preserved.
+                batch: list = []
+                for _ in range(256):  # bounded so other links aren't starved
                     try:
                         payload = self.node.recv(link, timeout=0.0)
                     except BrokenPipeError:
@@ -257,13 +263,45 @@ class SharedTensorPeer:
                     busy = True
                     try:
                         if compat:
-                            self._on_compat_frame(link, payload)
-                        else:
-                            self._on_message(link, payload)
+                            frame = self._decode_compat(link, payload)
+                            if frame is not None:
+                                batch.append(frame)
+                            continue
+                        if payload[0] == wire.DATA:
+                            batch.append(wire.decode_frame(payload, self.st.spec))
+                            continue
                     except Exception as e:  # a bad frame must not kill the node
                         log.warning("dropping bad frame on link %d: %s", link, e)
+                        continue
+                    # control message: flush queued frames first (order), and
+                    # never let a flush failure swallow the control message —
+                    # a dropped WELCOME/DONE would hang the join handshake
+                    self._flush_frames(link, batch)
+                    batch = []
+                    try:
+                        self._on_message(link, payload)
+                    except Exception as e:
+                        log.warning("dropping bad message on link %d: %s", link, e)
+                self._flush_frames(link, batch)
             if not busy:
                 time.sleep(0.002)
+
+    def _flush_frames(self, link: int, batch: list) -> None:
+        if not batch:
+            return
+        try:
+            self.st.receive_frames(link, batch)
+        except Exception:
+            # Fall back to per-frame apply so one bad frame costs only
+            # itself, not up to 255 good ones (received deltas are never
+            # resent — the sender's error feedback already cleared them, so
+            # a discarded good frame would silently diverge the replicas).
+            for f in batch:
+                try:
+                    self.st.receive_frame(link, f)
+                except Exception as e:
+                    log.warning("dropping bad frame on link %d: %s", link, e)
+        self._wake.set()  # flood refills other links' residuals
 
     def _handle_events(self) -> bool:
         evs = self.node.poll_events(timeout=0.0)
@@ -387,17 +425,16 @@ class SharedTensorPeer:
         else:
             raise ValueError(f"unknown message kind {kind}")
 
-    def _on_compat_frame(self, link: int, payload: bytes) -> None:
+    def _decode_compat(self, link: int, payload: bytes):
+        """Decode one reference-wire frame; returns a TableFrame to batch, or
+        None for idle keepalives (which still count for readiness)."""
         frame = wire.decode_compat_frame(payload, self.st.spec)
         if link == self._uplink and not self._ready.is_set():
             # Readiness = the parent's stream is flowing. Counting zero-scale
             # keepalives too fixes the reference's all-zero-tensor hang
             # (quirk Q4): an idle parent still proves liveness within 1s.
             self._ready.set()
-        if frame is None:
-            return  # reference idle keepalive (quirk Q2): no payload
-        self.st.receive_frame(link, frame)
-        self._wake.set()
+        return frame  # None = reference idle keepalive (quirk Q2)
 
 
 def create_or_fetch(

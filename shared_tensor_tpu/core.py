@@ -32,10 +32,13 @@ import jax
 import jax.numpy as jnp
 
 from .config import CodecConfig
+import numpy as np
+
 from .ops.table import (
     TableFrame,
     TableSpec,
     accumulate_table,
+    apply_table_batch,
     apply_table_many,
     flatten,
     make_spec,
@@ -212,6 +215,36 @@ class SharedTensor:
             for i, r in zip(others, out[1:]):
                 self._links[i] = r
             self.frames_in += 1
+
+    def receive_frames(self, link_id: int, frames: list[TableFrame]) -> None:
+        """Batched :meth:`receive_frame`: apply K queued frames from one link
+        in a single device dispatch (their summed delta — codec deltas are
+        pure adds and commute). K is padded with zero-scale no-op frames to
+        the next power of two so jit specializes on O(log K) shapes. This is
+        the receive path's defense against dispatch-overhead backlog: a
+        sender can emit frames faster than a busy device can absorb
+        one-dispatch-per-frame (see ops/table.py apply_table_batch)."""
+        if not frames:
+            return
+        if len(frames) == 1:
+            return self.receive_frame(link_id, frames[0])
+        k = 1
+        while k < len(frames):
+            k *= 2
+        scales = np.zeros((k, self.spec.num_leaves), np.float32)
+        words = np.zeros((k, self.spec.total // 32), np.uint32)
+        for i, f in enumerate(frames):
+            scales[i] = np.asarray(f.scales)
+            words[i] = np.asarray(f.words)
+        stacked = TableFrame(jnp.asarray(scales), jnp.asarray(words))
+        with self._lock:
+            others = tuple(i for i in self._links if i != link_id)
+            arrays = (self.values, *(self._links[i] for i in others))
+            out = apply_table_batch(arrays, stacked, self.spec)
+            self.values = out[0]
+            for i, r in zip(others, out[1:]):
+                self._links[i] = r
+            self.frames_in += len(frames)
 
     # -- introspection -----------------------------------------------------
 

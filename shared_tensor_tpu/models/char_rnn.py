@@ -224,12 +224,17 @@ def make_batches(
     returns [n_peer, batch, seq] so each pod peer trains on its own slice —
     the reference's N-workers-on-one-corpus story (example.lua:6-12).
     ``text`` may be raw bytes (converted on the fly; fine for tests) or the
-    device array from :func:`encode_corpus` (training loops)."""
+    device array from :func:`encode_corpus` (training loops). ``vocab`` folds
+    ids on the gathered windows, so it works for both input kinds."""
     if len(text) < seq + 2:
         raise ValueError(
             f"corpus has {len(text)} tokens; need at least seq+2 = {seq + 2}"
         )
-    data = encode_corpus(text, vocab) if isinstance(text, bytes) else text
+    data = encode_corpus(text) if isinstance(text, bytes) else text
+    if vocab is not None:
+        # Fold AFTER gathering (below) would also work, but folding the ids
+        # here keeps y's shifted-by-one relation to x exact under the fold.
+        data = data % vocab
     count = (n_peer or 1) * batch
     starts = jax.random.randint(key, (count,), 0, data.shape[0] - seq - 1)
     idx = starts[:, None] + jnp.arange(seq)[None, :]

@@ -238,6 +238,31 @@ def apply_table(values: jnp.ndarray, frame: TableFrame, spec: TableSpec) -> jnp.
 
 
 @partial(jax.jit, static_argnames=("spec",))
+def apply_table_batch(
+    arrays: tuple[jnp.ndarray, ...], frames: TableFrame, spec: TableSpec
+) -> tuple[jnp.ndarray, ...]:
+    """Apply a STACK of K frames (scales f32[K, L], words u32[K, W]) in one
+    dispatch: the summed delta of all K frames lands in one pass.
+
+    Equivalent to applying the frames sequentially — codec deltas are pure
+    adds, so they commute — but one device round-trip instead of K. This is
+    what keeps the receive path ahead of a fast sender: per-frame dispatch
+    overhead on a busy device was measured to back the RX queue up by
+    hundreds of frames (train/hierarchical.py's two-pod run). Zero-scale
+    padding frames contribute exactly nothing, so callers can pad a partial
+    batch up to a bucketed K to bound jit specializations."""
+    k = frames.scales.shape[0]
+    bits = unpack_bits(frames.words.reshape(-1)).reshape(k, -1, LANES)
+    row_leaf = jnp.asarray(spec.row_leaf())
+    s_row = frames.scales[:, row_leaf][:, :, None]  # [K, rows, 1]
+    live = jnp.asarray(_live_mask_flat(spec)).reshape(-1, LANES)
+    delta = jnp.sum(s_row * (1.0 - 2.0 * bits.astype(jnp.float32)), axis=0)
+    flat_delta = jnp.where(live, delta, 0.0).reshape(-1)
+    live_flat = live.reshape(-1)
+    return tuple(jnp.where(live_flat, a + flat_delta, 0.0) for a in arrays)
+
+
+@partial(jax.jit, static_argnames=("spec",))
 def accumulate_table(
     arrays: tuple[jnp.ndarray, ...], update: jnp.ndarray, spec: TableSpec
 ) -> tuple[jnp.ndarray, ...]:

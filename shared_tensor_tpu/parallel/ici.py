@@ -122,6 +122,24 @@ def add_updates_raw(state: PeerSyncState, updates: jax.Array) -> PeerSyncState:
 add_updates = jax.jit(add_updates_raw, donate_argnums=(0,))
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def apply_external(state: PeerSyncState, delta: jax.Array) -> PeerSyncState:
+    """Apply a delta that arrived from OUTSIDE the pod (the DCN/TCP peer
+    tier) to every pod peer's replica — values only, residuals untouched.
+
+    This is split-horizon at the pod boundary (reference sync_in never
+    re-floods a frame back toward the link it came from,
+    src/sharedtensor.c:124-127): every pod peer receives the external delta
+    directly here, so queueing it into intra-pod residuals would deliver it
+    twice. ``delta`` is flat [spec.total], broadcast over peers."""
+    d = jnp.nan_to_num(
+        delta.astype(jnp.float32), nan=0.0, posinf=3.0e38, neginf=-3.0e38
+    )
+    return PeerSyncState(
+        jnp.clip(state.values + d[None, :], -3.0e38, 3.0e38), state.residual
+    )
+
+
 # --- the fused sync step ----------------------------------------------------
 
 

@@ -3,5 +3,6 @@
 subsystem)."""
 
 from .async_sgd import PodTrainer, build_train_step
+from .hierarchical import HierarchicalTrainer
 
-__all__ = ["PodTrainer", "build_train_step"]
+__all__ = ["PodTrainer", "build_train_step", "HierarchicalTrainer"]

@@ -56,14 +56,24 @@ def build_train_step(
     compressed: bool = True,
     sync: bool = True,
     config: MeshConfig | None = None,
+    optimizer=None,
 ):
-    """Compile ``(state, batch, lr) -> (state', per-peer loss, scales)``.
+    """Compile ``(state, opt_state, batch, lr) -> (state', opt_state',
+    per-peer loss, scales)``.
 
     ``loss_fn(params, batch_item) -> scalar`` sees the caller's parameter
     pytree; ``batch`` carries a leading peer axis on every leaf. ``lr`` is a
     traced scalar so schedules don't retrigger compilation. ``sync=False``
     builds the no-communication arm (pure local SGD — the isolation baseline
-    for convergence comparisons)."""
+    for convergence comparisons).
+
+    ``optimizer`` is any optax GradientTransformation, applied per peer to
+    the FLAT gradient vector (each peer keeps its own momentum/Adam state;
+    ``lr`` is then ignored — the transform owns the step size). The transform
+    must be elementwise (momentum/adam/rmsprop/...), since it sees the padded
+    flat buffer, not the parameter tree. Its additive updates flow through
+    the same path as plain SGD deltas: visible locally at once, compressed
+    toward the group."""
     cfg = config or MeshConfig()
     sync_raw = (
         build_sync_step(
@@ -86,16 +96,22 @@ def build_train_step(
         loss, grads = grad_fn(params, batch_item)
         return loss, flatten(grads, spec)
 
-    def _step(state: PeerSyncState, batch, lr):
+    def _step(state: PeerSyncState, opt_state, batch, lr):
         losses, g = jax.vmap(per_peer)(state.values, batch)
-        state = add_updates_raw(state, -lr * g)
+        if optimizer is None:
+            updates = -lr * g
+        else:
+            updates, opt_state = jax.vmap(optimizer.update)(
+                g, opt_state, state.values
+            )
+        state = add_updates_raw(state, updates)
         if sync_raw is not None:
             state, scales = sync_raw(state)
         else:
             scales = jnp.zeros((state.values.shape[0], k), jnp.float32)
-        return state, losses, scales
+        return state, opt_state, losses, scales
 
-    return jax.jit(_step, donate_argnums=(0,))
+    return jax.jit(_step, donate_argnums=(0,) if optimizer is None else (0, 1))
 
 
 @dataclasses.dataclass
@@ -114,6 +130,7 @@ class PodTrainer:
     mesh_config: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     compressed: bool = True
     sync: bool = True
+    optimizer: Any = None  # optax GradientTransformation (see build_train_step)
 
     def __post_init__(self):
         self.spec: TableSpec = make_spec(self.template)
@@ -121,6 +138,11 @@ class PodTrainer:
             self.mesh, self.spec, self.template, self.mesh_config
         )
         self.n_peer: int = self.mesh.shape[self.mesh_config.peer_axis]
+        self.opt_state = (
+            None
+            if self.optimizer is None
+            else jax.vmap(self.optimizer.init)(self.state.values)
+        )
         self._step = build_train_step(
             self.mesh,
             self.spec,
@@ -130,6 +152,7 @@ class PodTrainer:
             compressed=self.compressed,
             sync=self.sync,
             config=self.mesh_config,
+            optimizer=self.optimizer,
         )
         self.steps = 0
 
@@ -146,9 +169,10 @@ class PodTrainer:
 
     def step(self, batch: Any, lr: float = 1e-2):
         """One fused train+sync step. Returns (per-peer losses f32[n_peer],
-        per-peer-leaf scales); state advances in place."""
-        self.state, losses, scales = self._step(
-            self.state, batch, jnp.float32(lr)
+        per-peer-leaf scales); state advances in place. With an optax
+        ``optimizer``, ``lr`` is ignored (the transform owns the step size)."""
+        self.state, self.opt_state, losses, scales = self._step(
+            self.state, self.opt_state, batch, jnp.float32(lr)
         )
         self.steps += 1
         return losses, scales

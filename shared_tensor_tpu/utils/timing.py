@@ -64,18 +64,19 @@ def codec_frame_time(
             best = min(best, time.perf_counter() - t0)
         return best
 
-    # Pilot at a fixed short length (compile 1), then ONE long bucketed
-    # length (compile 2) sized so device work dominates the tunnel overhead;
-    # the pilot's per-frame time over-counts overhead, so the chosen bucket
-    # errs long (harmless). Scan length is static — every distinct length
-    # costs a fresh (slow, remote) compile, hence buckets, not doubling.
-    pilot = 512
-    timed(pilot)  # warmup/compile
-    est = max(timed(pilot) / pilot, 1e-9)
-    want = target_seconds / est
-    length = pilot
-    while length < want and length < 1_000_000:
-        length *= 8
-    if length == pilot:
-        return est
-    return timed(length) / length
+    # Grow the chain until the measured run itself is target-length: a pilot
+    # estimate alone UNDERSHOOTS (its per-frame time over-counts the fixed
+    # overhead, so the projected length lands short and the long run would
+    # still be overhead-dominated). Each distinct length is a fresh (slow,
+    # remote) compile, so lengths move in x8 buckets — the loop converges in
+    # 1-3 extra measurements.
+    length = 512
+    timed(length)  # warmup/compile
+    t = timed(length)
+    while t < target_seconds and length < 1_000_000:
+        est = max(t / length, 1e-9)
+        want = target_seconds / est
+        while length < want and length < 1_000_000:
+            length *= 8
+        t = timed(length)
+    return t / length

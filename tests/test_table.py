@@ -159,3 +159,77 @@ def test_flatten_rejects_wrong_structure():
         raise AssertionError("expected ValueError")
     except ValueError as e:
         assert "structure" in str(e)
+
+
+def test_apply_table_batch_matches_sequential():
+    """Batched K-frame apply (one dispatch) must equal K sequential applies
+    — and zero-scale padding frames must be exact no-ops."""
+    import jax
+
+    from shared_tensor_tpu.config import ScalePolicy
+    from shared_tensor_tpu.ops.table import TableFrame, apply_table_batch
+
+    tpl = {
+        "a": jax.random.normal(jax.random.key(0), (37,)),
+        "b": jax.random.normal(jax.random.key(1), (5, 9)) * 100.0,
+    }
+    spec = make_spec(tpl)
+    frames = []
+    resid = flatten(tpl, spec)  # live-masked by construction
+    for _ in range(5):
+        f, resid = quantize_table(resid, spec, ScalePolicy.POW2_RMS, True)
+        frames.append(f)
+
+    values0 = flatten({"a": jnp.zeros((37,)), "b": jnp.zeros((5, 9))}, spec)
+    seq = values0
+    for f in frames:
+        seq = apply_table(seq, f, spec)
+
+    # pad with 3 zero-scale no-op frames to k=8
+    k = 8
+    scales = np.zeros((k, spec.num_leaves), np.float32)
+    words = np.zeros((k, spec.total // 32), np.uint32)
+    for i, f in enumerate(frames):
+        scales[i] = np.asarray(f.scales)
+        words[i] = np.asarray(f.words)
+    words[6] = 0xFFFFFFFF  # garbage bits under zero scale must not matter
+    stacked = TableFrame(jnp.asarray(scales), jnp.asarray(words))
+    (batched,) = apply_table_batch((values0,), stacked, spec)
+    np.testing.assert_allclose(np.asarray(batched), np.asarray(seq), rtol=1e-6, atol=1e-6)
+
+
+def test_receive_frames_batch_floods_other_links():
+    """core.receive_frames applies the summed delta to the replica AND other
+    links' residuals (split horizon), identically to one-at-a-time."""
+    import numpy as np
+
+    from shared_tensor_tpu.config import ScalePolicy
+    from shared_tensor_tpu.core import SharedTensor
+    from shared_tensor_tpu.ops.table import quantize_table
+
+    tpl = {"w": jnp.zeros((64,), jnp.float32)}
+    sender = SharedTensor(tpl)
+    sender.new_link(1, seed=False)
+    sender.add({"w": jnp.linspace(-1, 1, 64)})
+
+    frames = [sender.make_frame(1) for _ in range(4)]
+    frames = [f for f in frames if f is not None]
+
+    a = SharedTensor(tpl)
+    a.new_link(1, seed=False)
+    a.new_link(2, seed=False)
+    b = SharedTensor(tpl)
+    b.new_link(1, seed=False)
+    b.new_link(2, seed=False)
+
+    for f in frames:
+        a.receive_frame(1, f)
+    b.receive_frames(1, frames)
+
+    np.testing.assert_allclose(
+        np.asarray(a.snapshot_flat()), np.asarray(b.snapshot_flat()), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(a._links[2]), np.asarray(b._links[2]), atol=1e-6
+    )
+    assert b.frames_in == len(frames)

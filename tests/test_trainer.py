@@ -121,3 +121,20 @@ def test_no_sync_arm_diverges_replicas():
         batch = tr.shard_batch(_batches(jax.random.key(i), 4))
         tr.step(batch, lr=0.3)
     assert tr.replica_spread() > 1e-4
+
+
+def test_optax_optimizer_trains():
+    """optax momentum per peer: loss decreases and per-peer optimizer state
+    is carried across steps."""
+    import optax
+
+    tr = _trainer(n_peer=4, optimizer=optax.sgd(0.3, momentum=0.9))
+    first = last = None
+    for i in range(40):
+        batch = tr.shard_batch(_batches(jax.random.key(i), 4))
+        losses, _ = tr.step(batch)
+        mean = float(jnp.mean(losses))
+        first = mean if first is None else first
+        last = mean
+    assert last < first * 0.8, (first, last)
+    assert tr.opt_state is not None
