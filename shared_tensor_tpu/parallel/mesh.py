@@ -54,6 +54,39 @@ def make_mesh(
     return Mesh(grid, (cfg.peer_axis, cfg.shard_axis))
 
 
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Initialize a MULTI-HOST pod: every host process calls this, then
+    builds the same mesh with :func:`make_mesh` over ``jax.devices()`` (the
+    global device list). XLA then routes the sync step's collectives over
+    ICI within a slice and DCN between hosts automatically — one pod can
+    span hosts with no code change in the sync path.
+
+    This is the GSPMD tier of the multi-host story; the alternative tier is
+    one HierarchicalTrainer per host pod bridged over the TCP tree
+    (train/hierarchical.py), which tolerates asynchrony between hosts the
+    way the reference's cross-machine peers do (README.md:26). Use this one
+    when hosts are tightly coupled (same pod/DCN domain), the hierarchical
+    tier when they are not.
+
+    Arguments default to the standard JAX env vars (cluster auto-detection).
+    Returns this process's index. No-ops safely if already initialized."""
+    import jax.distributed
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        pass  # already initialized (idempotent use in notebooks/tests)
+    return jax.process_index()
+
+
 def rows_per_shard(total: int, n_shard: int, lanes: int = 128) -> int:
     """Rows of the (rows, 128) view each shard owns; validates divisibility.
 
