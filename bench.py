@@ -12,66 +12,291 @@ bit-pack + error feedback, Pallas) plus one receiver half (unpack + apply,
 Pallas) on an n = 1 Mi buffer — the identical per-link per-frame math at
 identical approximation error (the codec is bit-for-bit the reference
 arithmetic; tests/test_codec*.py pin that). Frames are chained device-side
-via lax.scan into multi-second runs so tunnel dispatch latency is a small
-bias that only understates the result; gaussian residuals keep a nonzero
-scale throughout, so every frame does the full (non-idle) codec work.
+via lax.fori_loop into multi-second runs so tunnel dispatch latency is a
+small bias that only understates the result; gaussian residuals keep a
+nonzero scale throughout, so every frame does the full (non-idle) codec work.
 
-Prints ONE JSON line: equivalent-delta GB/s and the ratio vs the 1.01 GB/s
-reference baseline.
+Robustness contract (round-1 postmortem, VERDICT.md): this process NEVER
+imports jax itself. Every measurement runs in a watchdogged subprocess with
+a hard timeout, under a total wall-clock budget (ST_BENCH_BUDGET_S, default
+420 s); a wedged TPU tunnel (observed: jax.devices() hanging forever) can
+kill an arm but not the bench. Arm ladder: real chip + Pallas (the headline;
+retried with backoff if the chip is claimed/wedged) -> real chip + XLA codec
+(only if the backend came up but Mosaic failed) -> CPU + XLA (degraded,
+labeled). Exactly ONE JSON line is always printed, recording which arms ran
+and how each ended (detail.attempts / detail.chip_state).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
 
 N = 1 << 20  # 1 Mi elements — BASELINE.md's headline E2E config
 BASELINE_GBPS = 1.01
+BUDGET_S = float(os.environ.get("ST_BENCH_BUDGET_S", "420"))
+CPU_RESERVE_S = 100.0  # budget held back for the CPU fallback arm
+_T0 = time.monotonic()
+_PRINTED = False
 
 
-def _bench(codec, codec_name: str) -> dict:
-    """Long-chain device-side timing (utils/timing.py): thousands of frames
-    per dispatch, so tunnel latency is a small conservative bias."""
-    from shared_tensor_tpu.config import ScalePolicy
-    from shared_tensor_tpu.utils.timing import codec_frame_time
+def _remaining() -> float:
+    return BUDGET_S - (time.monotonic() - _T0)
 
-    t_frame = codec_frame_time(codec, N, ScalePolicy.POW2_RMS)
-    fps = 1.0 / t_frame
-    equiv_gbps = fps * N * 4 / 1e9
+
+def _emit(result: dict) -> None:
+    global _PRINTED
+    if not _PRINTED:
+        _PRINTED = True
+        print(json.dumps(result), flush=True)
+
+
+def _error_result(attempts, reason: str) -> dict:
     return {
         "metric": "sync_bandwidth_equiv_fp32_per_link",
-        "value": round(equiv_gbps, 3),
+        "value": 0.0,
         "unit": "GB/s",
-        "vs_baseline": round(equiv_gbps / BASELINE_GBPS, 2),
-        "detail": {
-            "n_elements": N,
-            "frames_per_s": round(fps, 1),
-            "backend": jax.default_backend(),
-            "codec": codec_name,
-            "wire_gbps": round(fps * (N / 8 + 4) / 1e9, 4),
-        },
+        "vs_baseline": 0.0,
+        "detail": {"error": reason, "attempts": attempts},
     }
 
 
-def main() -> None:
-    import sys
-    import traceback
+# ---------------------------------------------------------------- worker ----
 
-    try:
-        from shared_tensor_tpu.ops import codec_pallas as codec
-        result = _bench(codec, "pallas")
-    except Exception:  # Pallas path unavailable: pure-JAX/XLA fallback.
-        # Loud + recorded in the JSON (detail.codec) so a fallback can never
-        # masquerade as a Pallas result.
-        traceback.print_exc(file=sys.stderr)
-        print("bench: Pallas codec failed, falling back to XLA codec", file=sys.stderr)
+
+def _worker(codec_name: str) -> None:
+    """Runs in a subprocess: init backend, announce it, measure, print JSON."""
+    import jax
+
+    # The ambient TPU-plugin site hook overrides the JAX_PLATFORMS env var
+    # (observed: JAX_PLATFORMS=cpu still hangs in tunnel init); the config
+    # update after import is the only reliable way to force a platform —
+    # same mechanism tests/conftest.py uses.
+    force = os.environ.get("ST_FORCE_PLATFORM")
+    if force:
+        jax.config.update("jax_platforms", force)
+
+    # Parent watches for this marker: it distinguishes "backend init hung or
+    # failed" (retry chip with backoff / skip to CPU) from "backend fine but
+    # the codec/measurement failed" (fall back to the XLA codec on-chip).
+    # The third token classifies the backend as tpu/other using the ONE
+    # source of truth for plugin-name knowledge (codec_pallas._interpret —
+    # the supervisor itself must stay jax-free and cannot classify).
+    from shared_tensor_tpu.ops import codec_pallas as _cp
+
+    kind = "other" if _cp._interpret() else "tpu"
+    print(
+        f"ST_BACKEND_UP {jax.default_backend()} {kind}",
+        file=sys.stderr,
+        flush=True,
+    )
+
+    if codec_name == "pallas":
+        codec = _cp
+
+        if codec._interpret():
+            # Interpret-mode Pallas is orders of magnitude slower than the
+            # XLA codec and would masquerade as a kernel number — fail fast
+            # so the supervisor falls through to the honest arm.
+            raise RuntimeError(
+                "pallas arm needs a TPU backend; "
+                f"got {jax.default_backend()} (would run interpret mode)"
+            )
+    else:
         from shared_tensor_tpu.ops import codec
-        result = _bench(codec, "xla-fallback")
-    print(json.dumps(result))
+
+    from shared_tensor_tpu.config import ScalePolicy
+    from shared_tensor_tpu.utils.timing import codec_frame_time
+
+    budget = float(os.environ.get("ST_TIMING_BUDGET_S", "120"))
+    t_frame = codec_frame_time(
+        codec, N, ScalePolicy.POW2_RMS, target_seconds=3.0, budget_s=budget
+    )
+    fps = 1.0 / t_frame
+    equiv_gbps = fps * N * 4 / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "sync_bandwidth_equiv_fp32_per_link",
+                "value": round(equiv_gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(equiv_gbps / BASELINE_GBPS, 2),
+                "detail": {
+                    "n_elements": N,
+                    "frames_per_s": round(fps, 1),
+                    "backend": jax.default_backend(),
+                    "codec": codec_name,
+                    "wire_gbps": round(fps * (N / 8 + 4) / 1e9, 4),
+                },
+            }
+        ),
+        flush=True,
+    )
+
+
+# ------------------------------------------------------------ supervisor ----
+
+
+def _run_arm(platform: str | None, codec_name: str, timeout_s: float):
+    """One watchdogged measurement subprocess.
+
+    Returns (parsed_json_or_None, backend: (name, is_tpu) | None,
+    outcome: str, stderr_tail: str). ``backend`` comes from the worker's
+    ``ST_BACKEND_UP <name> <tpu|other>`` marker (None = backend never
+    initialized). ``platform=None`` keeps the ambient JAX_PLATFORMS (the
+    real chip under the driver); "cpu" forces the CPU fallback.
+    """
+    env = dict(os.environ)
+    if platform is not None:
+        env["JAX_PLATFORMS"] = platform
+        env["ST_FORCE_PLATFORM"] = platform
+    # Leave headroom inside the subprocess for backend init + the one compile.
+    env["ST_TIMING_BUDGET_S"] = str(max(20.0, timeout_s - 90.0))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", codec_name],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        stdout, stderr = proc.stdout, proc.stderr
+        timed_out = False
+    except subprocess.TimeoutExpired as e:
+        stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        stderr = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        timed_out = True
+
+    backend = None
+    for line in stderr.splitlines():
+        if line.startswith("ST_BACKEND_UP"):
+            parts = line.split()
+            backend = (
+                parts[1] if len(parts) > 1 else "unknown",
+                len(parts) > 2 and parts[2] == "tpu",
+            )
+            break
+    backend_up = backend is not None
+    parsed = None
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    if parsed is not None:
+        outcome = "ok"
+    elif timed_out:
+        outcome = "timeout-backend-init" if not backend_up else "timeout-measuring"
+    elif not backend_up:
+        outcome = "backend-init-failed"
+    else:
+        outcome = "measurement-failed"
+    return parsed, backend, outcome, stderr[-2000:]
+
+
+def main() -> None:
+    attempts: list[dict] = []
+    best: dict | None = None
+    chip_state = "not-tried"
+
+    def note(platform, codec, outcome, err_tail=""):
+        entry = {
+            "platform": platform or "ambient",
+            "codec": codec,
+            "outcome": outcome,
+        }
+        if outcome != "ok" and err_tail:
+            # Keep the root cause (Mosaic rejection, init error) in the
+            # artifact — an outcome string alone is undebuggable.
+            entry["stderr_tail"] = err_tail[-500:]
+        attempts.append(entry)
+
+    # On SIGTERM/SIGINT (driver timeout), still emit whatever we know.
+    def _sig(signum, frame):
+        _emit(_error_result(attempts, f"signal {signum} before any arm finished"))
+        os._exit(1)
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+
+    # Phase A: the real chip (ambient platform). Retry with backoff if the
+    # chip is claimed/wedged (VERDICT.md next-round item 2); never burn the
+    # CPU reserve.
+    def _tpu_like(backend) -> bool:
+        return backend is not None and backend[1]
+
+    tries = 0
+    while best is None and tries < 3:
+        budget_left = _remaining() - CPU_RESERVE_S
+        if budget_left < 75:
+            break
+        parsed, backend, outcome, err = _run_arm(None, "pallas", min(budget_left, 270.0))
+        note(None, "pallas", outcome, err)
+        if _tpu_like(backend):
+            chip_state = "up"
+        elif chip_state == "not-tried":
+            chip_state = "wedged-or-unavailable"
+        if parsed is not None:
+            best = parsed
+            break
+        if backend is not None:
+            # Backend is fine; the Pallas path itself failed (e.g. Mosaic
+            # rejection). Do NOT re-enter Pallas — try the XLA codec on the
+            # same backend. If the ambient backend resolved to CPU (no TPU
+            # plugin registered at all), the result must carry the degraded
+            # label — it is NOT an on-chip number.
+            budget_left = _remaining() - CPU_RESERVE_S
+            if budget_left >= 75:
+                parsed, backend, outcome, err = _run_arm(
+                    None, "xla", min(budget_left, 270.0)
+                )
+                note(None, "xla", outcome, err)
+                if parsed is not None:
+                    best = parsed
+                    if not _tpu_like(backend):
+                        best["detail"]["degraded"] = (
+                            "ambient backend resolved to "
+                            f"{backend[0]} (no TPU)"
+                        )
+            break
+        tries += 1
+        backoff = min(20.0 * tries, max(0.0, _remaining() - CPU_RESERVE_S - 75))
+        if backoff > 0:
+            time.sleep(backoff)
+
+    # Phase B: CPU fallback — a degraded but real number beats no number.
+    if best is None and _remaining() > 30:
+        parsed, _, outcome, err = _run_arm("cpu", "xla", max(30.0, _remaining() - 10))
+        note("cpu", "xla", outcome, err)
+        if parsed is not None:
+            best = parsed
+            best["detail"]["degraded"] = "cpu-fallback (real chip unavailable)"
+
+    if best is None:
+        best = _error_result(attempts, "no arm completed within budget")
+    best.setdefault("detail", {})
+    best["detail"]["attempts"] = attempts
+    best["detail"]["chip_state"] = chip_state
+    _emit(best)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        _worker(sys.argv[2])
+    else:
+        try:
+            main()
+        except Exception as e:  # the one-JSON-line contract holds no matter what
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            _emit(_error_result([], f"supervisor crashed: {type(e).__name__}: {e}"))
+            sys.exit(1)

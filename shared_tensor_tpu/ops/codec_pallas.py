@@ -46,7 +46,21 @@ BLOCK_ROWS = 512
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    """Compiled on any TPU-like backend, interpreter elsewhere (CPU tests).
+
+    The tunneled TPU plugin registers platform name "axon", not "tpu" —
+    matching on backend name alone would silently interpret on the real chip
+    (round-1 bench postmortem), so also accept any device whose device_kind
+    says TPU.
+    """
+    backend = jax.default_backend()
+    if backend in ("tpu", "axon"):
+        return False
+    try:
+        kind = getattr(jax.devices()[0], "device_kind", "")
+    except Exception:
+        kind = ""
+    return "tpu" not in kind.lower()
 
 
 def _live_mask(block_rows: int, pid, n: int):

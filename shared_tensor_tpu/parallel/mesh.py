@@ -76,14 +76,16 @@ def init_multihost(
     Returns this process's index. No-ops safely if already initialized."""
     import jax.distributed
 
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except RuntimeError:
-        pass  # already initialized (idempotent use in notebooks/tests)
+    if jax.distributed.is_initialized():
+        return jax.process_index()  # idempotent use in notebooks/tests
+    # Any RuntimeError here (bad coordinator address, mismatched
+    # num_processes/process_id) propagates: swallowing it would let a broken
+    # multi-host launch proceed as a confusing single-process mesh.
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
     return jax.process_index()
 
 

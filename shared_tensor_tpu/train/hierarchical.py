@@ -74,8 +74,17 @@ class HierarchicalTrainer:
 
         peer = create_or_fetch(host, port, template, peer_config, timeout)
         try:
-            pod = PodTrainer(mesh, peer.read(), loss_fn, **pod_kwargs)
-            return cls(pod, peer, sync_every)
+            # ONE snapshot seeds both the pod and the bridge bookkeeping.
+            # Codec frames keep streaming into peer.st after create_or_fetch
+            # returns (a joiner returns at WELCOME, mid state-transfer); any
+            # frame applied between "seed the pod" and "record what the pod
+            # has seen" would be counted as seen but never applied — a
+            # permanent silent divergence (ADVICE.md round-1 high finding).
+            snap = peer.st.snapshot_flat()
+            pod = PodTrainer(
+                mesh, unflatten(snap, peer.st.spec), loss_fn, **pod_kwargs
+            )
+            return cls(pod, peer, sync_every, _peer_seen=snap)
         except BaseException:
             peer.close()
             raise
@@ -85,6 +94,7 @@ class HierarchicalTrainer:
         pod: PodTrainer,
         peer: SharedTensorPeer,
         sync_every: int = 1,
+        _peer_seen: jnp.ndarray | None = None,
     ):
         if peer.st.spec.layout_digest() != pod.spec.layout_digest():
             raise ValueError("pod table layout != peer table layout")
@@ -93,12 +103,22 @@ class HierarchicalTrainer:
         self.sync_every = max(1, int(sync_every))
         # What the pod has already incorporated of the peer-tier replica,
         # and what the peer tier already has of the pod's progress.
-        self._peer_seen = peer.st.snapshot_flat()
+        # ``_peer_seen`` must be the exact snapshot the pod was seeded from
+        # (create() passes it); deriving it from the pod itself keeps the
+        # invariant for manual wiring too — a fresh peer.st.snapshot_flat()
+        # here would silently absorb frames applied since the pod seed.
+        self._peer_seen = (
+            _peer_seen if _peer_seen is not None else self._pod_mean_of(pod)
+        )
         self._pod_pushed = self._pod_mean()
         self.exchanges = 0
 
+    @staticmethod
+    def _pod_mean_of(pod: PodTrainer) -> jnp.ndarray:
+        return jnp.mean(pod.state.values, axis=0)
+
     def _pod_mean(self) -> jnp.ndarray:
-        return jnp.mean(self.pod.state.values, axis=0)
+        return self._pod_mean_of(self.pod)
 
     def step(self, batch: Any, lr: float = 1e-2):
         losses, scales = self.pod.step(batch, lr)

@@ -20,6 +20,7 @@ from __future__ import annotations
 import contextlib
 import math
 import time
+from collections import deque
 from typing import Iterable, Iterator
 
 import jax
@@ -46,14 +47,17 @@ class RateMeter:
 
     def __init__(self, window_sec: float = 10.0):
         self.window = window_sec
-        self._samples: list[tuple[float, dict[str, float]]] = []
+        self._samples: deque[tuple[float, dict[str, float]]] = deque()
 
     def update(self, **counters: float) -> None:
         now = time.monotonic()
         self._samples.append((now, dict(counters)))
         cutoff = now - self.window
-        while len(self._samples) > 2 and self._samples[1][0] >= cutoff:
-            self._samples.pop(0)
+        # Evict while the SECOND-oldest sample is already at/past the window
+        # edge — keeping exactly one sample at or before it, so rates() spans
+        # the full window rather than just the last update interval.
+        while len(self._samples) > 2 and self._samples[1][0] <= cutoff:
+            self._samples.popleft()
 
     def rates(self) -> dict[str, float]:
         if len(self._samples) < 2:

@@ -9,7 +9,14 @@ final frame of both the residual and values chains, and sizes L so the chain
 runs for seconds: the overhead becomes a small bias that only UNDERSTATES
 the reported rate. (A long-minus-short marginal estimate would cancel the
 overhead exactly, but the tunnel's jitter is comparable to the overhead
-itself and can even drive the difference negative.)"""
+itself and can even drive the difference negative.)
+
+The chain length is a *dynamic* ``lax.fori_loop`` trip count, so every
+length reuses ONE compiled program — round 1's version used ``lax.scan``
+with a static length and paid a fresh (slow, remote) compile per length
+step, which is how the bench burned its whole budget compiling and timed
+out with nothing emitted (VERDICT.md "What's weak" #1).
+"""
 
 from __future__ import annotations
 
@@ -28,25 +35,29 @@ def codec_frame_time(
     make_residual: Callable[[int], jnp.ndarray] | None = None,
     target_seconds: float = 3.0,
     reps: int = 2,
+    budget_s: float | None = None,
 ) -> float:
     """Seconds per fused codec roundtrip frame (sender quantize + receiver
     apply) at table size ``n``. ``make_residual(seed)`` supplies the starting
     residual (default: standard normal — nonzero scale throughout, so every
-    frame does the full non-idle work)."""
+    frame does the full non-idle work). ``budget_s`` is a hard wall-clock
+    budget for the whole measurement including compile: the best estimate so
+    far is returned when it trips (never raises for budget reasons)."""
+    deadline = None if budget_s is None else time.monotonic() + budget_s
     if make_residual is None:
         make_residual = lambda seed: jax.random.normal(
             jax.random.key(seed), (n,), jnp.float32
         )
 
-    @partial(jax.jit, static_argnames=("length",), donate_argnums=(0, 1))
+    @partial(jax.jit, donate_argnums=(0, 1))
     def group(resid, values, length):
-        def body(carry, _):
+        def body(_, carry):
             r, v = carry
             frame, r = codec.quantize(r, n, policy)
             v = codec.apply_frame(v, frame, n)
-            return (r, v), ()
+            return (r, v)
 
-        (r, v), _ = jax.lax.scan(body, (resid, values), None, length=length)
+        r, v = jax.lax.fori_loop(0, length, body, (resid, values))
         # The fetched scalar depends on both chains (each frame's error
         # feedback feeds r, each apply feeds v), so neither half can be
         # dead-code-eliminated and the fetch waits for the whole program.
@@ -59,24 +70,26 @@ def codec_frame_time(
             v = jnp.zeros((n,), jnp.float32)
             jax.block_until_ready((r, v))
             t0 = time.perf_counter()
-            _, _, probe = group(r, v, length)
+            _, _, probe = group(r, v, jnp.int32(length))
             float(probe)  # forces completion through the tunnel
             best = min(best, time.perf_counter() - t0)
+            if deadline is not None and time.monotonic() > deadline:
+                break
         return best
 
     # Grow the chain until the measured run itself is target-length: a pilot
     # estimate alone UNDERSHOOTS (its per-frame time over-counts the fixed
     # overhead, so the projected length lands short and the long run would
-    # still be overhead-dominated). Each distinct length is a fresh (slow,
-    # remote) compile, so lengths move in x8 buckets — the loop converges in
-    # 1-3 extra measurements.
-    length = 512
-    timed(length)  # warmup/compile
+    # still be overhead-dominated). Dynamic trip count = no recompiles, so
+    # growth can jump straight to the projected length.
+    length = 256
+    timed(length)  # warmup/compile (the one compile)
     t = timed(length)
-    while t < target_seconds and length < 1_000_000:
+    max_length = 4_000_000
+    while t < target_seconds and length < max_length:
+        if deadline is not None and time.monotonic() > deadline:
+            break
         est = max(t / length, 1e-9)
-        want = target_seconds / est
-        while length < want and length < 1_000_000:
-            length *= 8
+        length = min(max_length, max(length * 2, int(target_seconds / est)))
         t = timed(length)
     return t / length

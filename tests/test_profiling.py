@@ -4,6 +4,7 @@ import time
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from shared_tensor_tpu.config import ScalePolicy
 from shared_tensor_tpu.ops import codec
@@ -40,7 +41,27 @@ def test_rate_meter():
     m.update(frames=50, bytes=5000)
     r = m.rates()
     assert r["frames"] > 100  # ~1000/s
-    assert r["bytes"] / r["frames"] == 100.0
+    assert r["bytes"] / r["frames"] == pytest.approx(100.0)
+
+
+def test_rate_meter_window_spans_more_than_last_interval():
+    """Eviction keeps one sample at/just before the window edge: with many
+    rapid updates inside the window, rates() must span the whole window, not
+    just the final update interval (ADVICE.md round-1 finding)."""
+    m = RateMeter(window_sec=60.0)
+    for i in range(50):
+        m.update(frames=i)
+    assert len(m._samples) == 50  # nothing evicted within the window
+    m2 = RateMeter(window_sec=0.01)
+    m2.update(frames=0)
+    time.sleep(0.02)
+    for i in range(1, 5):
+        m2.update(frames=i)
+    # The stale sample is RETAINED as the one at/before the window edge
+    # (eviction only pops while the second-oldest is past the cutoff), so
+    # all 5 survive here; the old inverted condition would leave exactly 2.
+    assert len(m2._samples) == 5
+    assert m2._samples[0][0] <= time.monotonic() - m2.window
 
 
 def test_trace_writes_profile(tmp_path):
