@@ -42,6 +42,7 @@ BUDGET_S = float(os.environ.get("ST_BENCH_BUDGET_S", "420"))
 CPU_RESERVE_S = 100.0  # budget held back for the CPU fallback arm
 _T0 = time.monotonic()
 _PRINTED = False
+_ACTIVE_WORKER: "subprocess.Popen | None" = None
 
 
 def _remaining() -> float:
@@ -150,27 +151,32 @@ def _run_arm(platform: str | None, codec_name: str, timeout_s: float):
     initialized). ``platform=None`` keeps the ambient JAX_PLATFORMS (the
     real chip under the driver); "cpu" forces the CPU fallback.
     """
+    global _ACTIVE_WORKER
     env = dict(os.environ)
     if platform is not None:
         env["JAX_PLATFORMS"] = platform
         env["ST_FORCE_PLATFORM"] = platform
     # Leave headroom inside the subprocess for backend init + the one compile.
     env["ST_TIMING_BUDGET_S"] = str(max(20.0, timeout_s - 90.0))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker", codec_name],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    _ACTIVE_WORKER = proc  # so the SIGTERM handler can reap it (no orphans)
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--worker", codec_name],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        stdout, stderr = proc.stdout, proc.stderr
+        stdout, stderr = proc.communicate(timeout=timeout_s)
         timed_out = False
-    except subprocess.TimeoutExpired as e:
-        stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
-        stderr = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        stdout, stderr = proc.communicate()
+        stdout, stderr = stdout or "", stderr or ""
         timed_out = True
+    finally:
+        _ACTIVE_WORKER = None
 
     backend = None
     for line in stderr.splitlines():
@@ -219,8 +225,16 @@ def main() -> None:
             entry["stderr_tail"] = err_tail[-500:]
         attempts.append(entry)
 
-    # On SIGTERM/SIGINT (driver timeout), still emit whatever we know.
+    # On SIGTERM/SIGINT (driver timeout), still emit whatever we know — and
+    # kill the in-flight worker first: an orphaned jax subprocess hung in
+    # tunnel init would keep the TPU grant claimed for the NEXT run (the
+    # exact wedge this bench exists to survive).
     def _sig(signum, frame):
+        if _ACTIVE_WORKER is not None:
+            try:
+                _ACTIVE_WORKER.kill()
+            except OSError:
+                pass
         _emit(_error_result(attempts, f"signal {signum} before any arm finished"))
         os._exit(1)
 

@@ -1,0 +1,229 @@
+/* stc_harness — a standalone C peer speaking the reference wire protocol.
+ *
+ * Purpose (VERDICT.md round-1 item 5): prove byte-level interop of the
+ * framework's wire-compat mode against a real compiled-C counterpart, not a
+ * Python mock. This file is written fresh from the protocol/codec SPEC
+ * (SURVEY.md §2.3 + Appendix B, citing reference src/sharedtensor.c for the
+ * behavior it must match); it is NOT a copy of the reference implementation
+ * (different structure: single uplink leaf peer, mutex'd state, bounded
+ * runtime, heap buffers, clean shutdown).
+ *
+ * Protocol (reference src/sharedtensor.c:121-122, :176-177, :281-300):
+ *   join:   connect; read 1 byte; 'Y' => stream on this socket;
+ *           'N' => 16-byte raw sockaddr_in redirect, retry there.
+ *   frames: [4-byte little-endian f32 scale][ceil(n/8) bytes bitmask],
+ *           bit i at byte[i/8], position i%8 (LSB-first);
+ *           set bit = -scale, clear = +scale.
+ *   codec:  scale = 2^floor(log2(RMS(residual))) (0 => idle frame, 1/s);
+ *           sender: b_i = (r_i <= 0); r_i -= (1-2*b_i)*scale  (error
+ *           feedback); receiver: values_i += (1-2*b_i)*scale.
+ *
+ * Usage: stc_harness <host> <port> <n> <seconds> <add>
+ *   Joins the tree at host:port for a tensor of n floats, immediately
+ *   contributes `add` to every element (the reference addFromTensor
+ *   semantics: values += add, residual += add), streams full-duplex for
+ *   `seconds`, then prints the final replica (one float per line, %.9g) on
+ *   stdout and exits 0. Any protocol error exits nonzero with a message.
+ */
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <math.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+typedef struct {
+    int fd;
+    int n;
+    int mask_bytes;
+    float *values;   /* replica */
+    float *resid;    /* uplink residual (error feedback) */
+    pthread_mutex_t mu;
+    volatile int stop;
+} Peer;
+
+static int read_full(int fd, void *buf, size_t len) {
+    char *p = buf;
+    while (len > 0) {
+        ssize_t r = read(fd, p, len);
+        if (r < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        if (r == 0) return -1; /* EOF */
+        p += r;
+        len -= (size_t)r;
+    }
+    return 0;
+}
+
+static int write_full(int fd, const void *buf, size_t len) {
+    const char *p = buf;
+    while (len > 0) {
+        ssize_t r = write(fd, p, len);
+        if (r < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        p += r;
+        len -= (size_t)r;
+    }
+    return 0;
+}
+
+/* Join walk: connect, follow 'N' redirects until a 'Y' (bounded depth). */
+static int join_tree(const char *host, int port) {
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof addr);
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+        fprintf(stderr, "stc_harness: bad host %s\n", host);
+        return -1;
+    }
+    for (int depth = 0; depth < 64; depth++) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        if (fd < 0) return -1;
+        if (connect(fd, (struct sockaddr *)&addr, sizeof addr) != 0) {
+            perror("stc_harness: connect");
+            close(fd);
+            return -1;
+        }
+        char reply;
+        if (read_full(fd, &reply, 1) != 0) {
+            close(fd);
+            return -1;
+        }
+        if (reply == 'Y') return fd;
+        if (reply != 'N') {
+            fprintf(stderr, "stc_harness: bad join reply 0x%02x\n", reply);
+            close(fd);
+            return -1;
+        }
+        /* raw sockaddr_in redirect (x86-layout, reference :229-231) */
+        if (read_full(fd, &addr, sizeof addr) != 0) {
+            close(fd);
+            return -1;
+        }
+        close(fd);
+    }
+    fprintf(stderr, "stc_harness: redirect loop\n");
+    return -1;
+}
+
+static void *sender(void *arg) {
+    Peer *pe = arg;
+    unsigned char *frame = malloc(4 + (size_t)pe->mask_bytes);
+    if (!frame) return NULL;
+    while (!pe->stop) {
+        pthread_mutex_lock(&pe->mu);
+        double ss = 0.0;
+        for (int i = 0; i < pe->n; i++)
+            ss += (double)pe->resid[i] * pe->resid[i];
+        float rms = (float)sqrt(ss / pe->n);
+        float scale = rms > 0.0f ? exp2f(floorf(log2f(rms))) : 0.0f;
+        memset(frame + 4, 0, (size_t)pe->mask_bytes);
+        for (int i = 0; i < pe->n; i++) {
+            if (pe->resid[i] <= 0.0f) { /* send -scale; zero counts negative */
+                frame[4 + i / 8] |= (unsigned char)(1u << (i % 8));
+                pe->resid[i] += scale;
+            } else {
+                pe->resid[i] -= scale;
+            }
+        }
+        pthread_mutex_unlock(&pe->mu);
+        memcpy(frame, &scale, 4); /* little-endian f32 on the wire */
+        if (scale == 0.0f)
+            sleep(1); /* idle keepalive frame, 1/s (quirk Q2 semantics) */
+        if (write_full(pe->fd, frame, 4 + (size_t)pe->mask_bytes) != 0)
+            break;
+    }
+    free(frame);
+    return NULL;
+}
+
+static void *receiver(void *arg) {
+    Peer *pe = arg;
+    unsigned char *frame = malloc(4 + (size_t)pe->mask_bytes);
+    if (!frame) return NULL;
+    while (!pe->stop) {
+        if (read_full(pe->fd, frame, 4 + (size_t)pe->mask_bytes) != 0) break;
+        float scale;
+        memcpy(&scale, frame, 4);
+        if (scale == 0.0f) continue;
+        pthread_mutex_lock(&pe->mu);
+        for (int i = 0; i < pe->n; i++) {
+            int bit = (frame[4 + i / 8] >> (i % 8)) & 1;
+            pe->values[i] += bit ? -scale : scale;
+        }
+        pthread_mutex_unlock(&pe->mu);
+    }
+    free(frame);
+    return NULL;
+}
+
+int main(int argc, char **argv) {
+    if (argc != 6) {
+        fprintf(stderr, "usage: %s host port n seconds add\n", argv[0]);
+        return 2;
+    }
+    /* write() on a peer-closed socket must return EPIPE, not kill us
+     * mid-shutdown before the final replica dump. */
+    signal(SIGPIPE, SIG_IGN);
+
+    const char *host = argv[1];
+    int port = atoi(argv[2]);
+    int n = atoi(argv[3]);
+    double seconds = atof(argv[4]);
+    float add = (float)atof(argv[5]);
+    if (n <= 0 || port <= 0) {
+        fprintf(stderr, "stc_harness: bad n/port\n");
+        return 2;
+    }
+
+    Peer pe;
+    memset(&pe, 0, sizeof pe);
+    pe.n = n;
+    pe.mask_bytes = (n + 7) / 8;
+    pe.values = calloc((size_t)n, sizeof(float));
+    pe.resid = calloc((size_t)n, sizeof(float));
+    pthread_mutex_init(&pe.mu, NULL);
+    if (!pe.values || !pe.resid) return 1;
+
+    pe.fd = join_tree(host, port);
+    if (pe.fd < 0) return 1;
+
+    /* addFromTensor semantics: visible locally at once, queued for the
+     * uplink (reference :334-344). */
+    for (int i = 0; i < n; i++) {
+        pe.values[i] += add;
+        pe.resid[i] += add;
+    }
+
+    pthread_t ts, tr;
+    if (pthread_create(&tr, NULL, receiver, &pe) != 0) return 1;
+    if (pthread_create(&ts, NULL, sender, &pe) != 0) return 1;
+
+    struct timespec dur;
+    dur.tv_sec = (time_t)seconds;
+    dur.tv_nsec = (long)((seconds - (double)dur.tv_sec) * 1e9);
+    nanosleep(&dur, NULL);
+
+    pe.stop = 1;
+    shutdown(pe.fd, SHUT_RDWR); /* unblocks both threads */
+    pthread_join(ts, NULL);
+    pthread_join(tr, NULL);
+    close(pe.fd);
+
+    for (int i = 0; i < n; i++)
+        printf("%.9g\n", (double)pe.values[i]);
+    return 0;
+}

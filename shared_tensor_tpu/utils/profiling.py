@@ -60,9 +60,31 @@ class RateMeter:
             self._samples.popleft()
 
     def rates(self) -> dict[str, float]:
+        """Per-second rates over (at most) the trailing window.
+
+        The oldest retained sample can be far older than the window (it is
+        kept as the at-or-before-the-edge anchor; after an idle gap it may
+        predate the edge by the whole gap). Using its raw timestamp would
+        dilute the rate over the gap, so the counter value AT the window
+        edge is linearly interpolated between the two samples bracketing it
+        and the rate taken from there.
+        """
         if len(self._samples) < 2:
             return {}
-        (t0, c0), (t1, c1) = self._samples[0], self._samples[-1]
+        t1, c1 = self._samples[-1]
+        cutoff = t1 - self.window
+        t0, c0 = self._samples[0]
+        if t0 < cutoff:
+            i = 1
+            while i < len(self._samples) - 1 and self._samples[i][0] < cutoff:
+                i += 1
+            (ta, ca), (tb, cb) = self._samples[i - 1], self._samples[i]
+            w = min(1.0, (cutoff - ta) / max(tb - ta, 1e-9))
+            c0 = {
+                k: ca.get(k, 0.0) + (cb.get(k, 0.0) - ca.get(k, 0.0)) * w
+                for k in cb
+            }
+            t0 = min(cutoff, tb)
         dt = max(t1 - t0, 1e-9)
         return {k: (c1.get(k, 0.0) - c0.get(k, 0.0)) / dt for k in c1}
 

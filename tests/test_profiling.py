@@ -64,6 +64,22 @@ def test_rate_meter_window_spans_more_than_last_interval():
     assert m2._samples[0][0] <= time.monotonic() - m2.window
 
 
+def test_rate_meter_idle_gap_does_not_dilute():
+    """After an idle gap longer than the window, rates() must reflect the
+    recent window (counters interpolated at the window edge), not average
+    the burst over the whole gap."""
+    m = RateMeter(window_sec=0.05)
+    m.update(frames=0)
+    time.sleep(0.5)  # idle gap 10x the window
+    m.update(frames=100)
+    time.sleep(0.01)
+    m.update(frames=200)
+    r = m.rates()
+    # Diluted-over-the-gap would be ~ (200-0)/0.51 ~ 390/s; the window
+    # estimate is >= (200 - interp@edge)/window ~ 2000/s.
+    assert r["frames"] > 1500, r
+
+
 def test_trace_writes_profile(tmp_path):
     with trace(str(tmp_path)):
         jnp.sum(jnp.ones((128, 128))).block_until_ready()

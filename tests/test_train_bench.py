@@ -1,0 +1,36 @@
+"""Smoke test for benchmarks/train_bench.py (VERDICT.md round-1 item 4):
+the artifact must always be one parseable JSON line with all three arms."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_train_bench_emits_all_arms():
+    env = dict(os.environ)
+    env["ST_TRAIN_BENCH_BUDGET_S"] = "120"
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "train_bench.py"),
+            "--platform", "cpu", "--peers", "2", "--tiny",
+            "--batch", "2", "--seq", "32",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "train_step_bench"
+    assert set(out["arms"]) == {"sync_off", "compressed", "exact"}
+    for name, arm in out["arms"].items():
+        assert "error" not in arm, (name, arm)
+        assert arm["tokens_per_s"] > 0
+    assert out["arms"]["compressed"].get("sync_overhead_pct") is not None
