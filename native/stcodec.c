@@ -1,0 +1,166 @@
+/* stcodec: native host-tier codec hot loops.
+ *
+ * The reference's entire codec is ~30 lines of C inside its link threads
+ * (reference src/sharedtensor.c:106-111 receiver, :153-174 sender), measured
+ * at 202 M elem/s on one core (BASELINE.md) — the system's bottleneck. Our
+ * host tier's numpy implementation (ops/codec_np.py) costs ~8 memory passes
+ * per frame where the C loop needs ~2 fused ones; this library provides
+ * those fused loops for CPU peers. The TPU tier is ops/codec_pallas.py; the
+ * numpy tier remains the always-available fallback and the semantic
+ * reference for these functions (bit-identical given the same scales).
+ *
+ * Table layout (ops/table.py): one flat f32 buffer; leaf i occupies
+ * [off[i], off[i]+padded[i]) with ns[i] live elements at the front, padding
+ * exactly 0. Bits are LSB-first: flat bit j -> word[j/32] bit j%32
+ * (ops/packing.py wire contract; byte-identical to the reference's
+ * data[i/8] |= 1 << (i%8)).
+ *
+ * Plain C ABI for ctypes (no pybind11 in this image). Single-threaded by
+ * design: one link engine per thread, like the reference.
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+#define EXPORT __attribute__((visibility("default")))
+
+/* Sender half for one leaf: sign-quantize + pack + error feedback, one fused
+ * pass. bit = (r <= 0) — zero counts as negative (reference quirk Q3, kept:
+ * converged elements oscillate within +/-scale). With s == 0 the leaf idles:
+ * bits still record signs (matching the XLA/numpy tiers bit-for-bit) but the
+ * residual is untouched. */
+static void quantize_leaf(float *r, int64_t n, int64_t padded, float s,
+                          uint32_t *words) {
+  int64_t nw = padded / 32;
+  int64_t j = 0;
+  for (int64_t w = 0; w < nw; w++) {
+    uint32_t bits = 0;
+    int64_t base = w * 32;
+    int64_t lim = n - base;
+    if (lim > 32) lim = 32;
+    if (s > 0.0f) {
+      for (int64_t b = 0; b < lim; b++) {
+        float v = r[base + b];
+        uint32_t neg = v <= 0.0f;
+        bits |= neg << b;
+        r[base + b] = v - (neg ? -s : s);
+      }
+    } else {
+      for (int64_t b = 0; b < lim; b++) {
+        bits |= (uint32_t)(r[base + b] <= 0.0f) << b;
+      }
+    }
+    words[w] = bits;
+  }
+  (void)j;
+}
+
+/* Per-leaf reduction partials for the scale policies, one fused pass per
+ * leaf: max|r|, sum(r^2), sum(|r|). Double accumulators make the raw sums
+ * overflow-safe by construction (f32 max squared ~1.2e77 << DBL_MAX), where
+ * the f32 tiers need the amax-normalization trick (quirk Q9 discussion in
+ * ops/codec.compute_scale). The Python caller finishes the policy math. */
+EXPORT void stc_scale_partials(const float *r, const int64_t *off,
+                               const int64_t *ns, int64_t n_leaves,
+                               double *out_amax, double *out_ss,
+                               double *out_sabs) {
+  for (int64_t i = 0; i < n_leaves; i++) {
+    const float *p = r + off[i];
+    int64_t n = ns[i];
+    /* 4-way unrolled accumulators: breaks the serial FP dependency chain so
+     * the adds pipeline (a single double accumulator costs ~4 cycles/elem) */
+    double amax[4] = {0, 0, 0, 0}, ss[4] = {0, 0, 0, 0}, sabs[4] = {0, 0, 0, 0};
+    int64_t j = 0;
+    for (; j + 4 <= n; j += 4) {
+      for (int u = 0; u < 4; u++) {
+        double v = p[j + u];
+        double a = v < 0 ? -v : v;
+        if (a > amax[u]) amax[u] = a;
+        ss[u] += v * v;
+        sabs[u] += a;
+      }
+    }
+    for (; j < n; j++) {
+      double v = p[j];
+      double a = v < 0 ? -v : v;
+      if (a > amax[0]) amax[0] = a;
+      ss[0] += v * v;
+      sabs[0] += a;
+    }
+    double am = amax[0];
+    for (int u = 1; u < 4; u++)
+      if (amax[u] > am) am = amax[u];
+    out_amax[i] = am;
+    out_ss[i] = ss[0] + ss[1] + ss[2] + ss[3];
+    out_sabs[i] = sabs[0] + sabs[1] + sabs[2] + sabs[3];
+  }
+}
+
+EXPORT void stc_quantize(float *r, const int64_t *off, const int64_t *ns,
+                         const int64_t *padded, int64_t n_leaves,
+                         const float *scales, uint32_t *words) {
+  for (int64_t i = 0; i < n_leaves; i++) {
+    quantize_leaf(r + off[i], ns[i], padded[i], scales[i], words + off[i] / 32);
+  }
+}
+
+/* Receiver half: accumulate K frames' deltas into delta[total]
+ * (delta += s * (1 - 2*bit), reference src/sharedtensor.c:109), then the
+ * caller adds delta to each target array. Splitting accumulate/apply keeps
+ * the per-array work to one add pass regardless of K. */
+EXPORT void stc_accumulate_delta(float *delta, const int64_t *off,
+                                 const int64_t *ns, const int64_t *padded_unused,
+                                 int64_t n_leaves, const float *scales,
+                                 const uint32_t *words) {
+  (void)padded_unused;
+  for (int64_t i = 0; i < n_leaves; i++) {
+    float s = scales[i];
+    if (s == 0.0f) continue;
+    const uint32_t *w = words + off[i] / 32;
+    float *d = delta + off[i];
+    int64_t n = ns[i];
+    int64_t full = n / 32; /* whole words: branch-free, vectorizable */
+    for (int64_t k = 0; k < full; k++) {
+      uint32_t bits = w[k];
+      float *dd = d + k * 32;
+      float signs[32];
+      /* +/-s differ only in the IEEE sign bit: splice the codec bit in */
+      for (int b = 0; b < 32; b++) {
+        union { float f; uint32_t u; } u;
+        u.f = s;
+        u.u |= ((bits >> b) & 1u) << 31;
+        signs[b] = u.f;
+      }
+      for (int b = 0; b < 32; b++) dd[b] += signs[b];
+    }
+    if (n % 32) {
+      uint32_t bits = w[full];
+      int64_t base = full * 32;
+      for (int64_t b = 0; b < n - base; b++) {
+        d[base + b] += ((bits >> b) & 1u) ? -s : s;
+      }
+    }
+  }
+}
+
+/* values[i] += delta[i] for one target array (live lanes only — padding in
+ * both is 0 by invariant, so a full-width add preserves it). */
+EXPORT void stc_add_inplace(float *values, const float *delta, int64_t total) {
+  for (int64_t i = 0; i < total; i++) values[i] += delta[i];
+}
+
+/* Local additive update, sanitized (quirk Q9 fix — one NaN in the reference
+ * poisons every replica through the flood): u is pre-masked by the caller;
+ * NaN -> 0, +/-inf and sums clamped to +/-3e38. */
+EXPORT void stc_accumulate_update(float *a, const float *u, int64_t total) {
+  for (int64_t i = 0; i < total; i++) {
+    float x = u[i];
+    if (x != x) x = 0.0f; /* NaN */
+    if (x > 3.0e38f) x = 3.0e38f;
+    if (x < -3.0e38f) x = -3.0e38f;
+    float s = a[i] + x;
+    if (s > 3.0e38f) s = 3.0e38f;
+    if (s < -3.0e38f) s = -3.0e38f;
+    a[i] = s;
+  }
+}

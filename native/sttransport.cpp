@@ -211,6 +211,8 @@ bool write_full(int fd, const uint8_t* buf, size_t count) {
   return true;
 }
 
+inline bool listen_fd_ok(int fd) { return fd >= 0; }
+
 void set_common_sockopts(int fd) {
   int yes = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &yes, sizeof yes);
@@ -597,29 +599,55 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
   target.sin_port = htons((uint16_t)port);
   node->rendezvous = target;
 
+  // Join-or-become-master, with retry. Two races both end in a failed
+  // first pass and both resolve by retrying as a joiner (the reference
+  // inherits the same race and just dies, src/sharedtensor.c:271-277,314):
+  //  - A and B start together; both find the rendezvous empty, both elect
+  //    themselves master; one loses the bind (EADDRINUSE) — the loser must
+  //    re-walk, and will now connect to the winner.
+  //  - A joins while B (the would-be master) is between its failed connect
+  //    and its listen(): A's walk fails outright; a short backoff later the
+  //    master is listening.
   bool became_master = false;
-  sockaddr_in listen_addr{};
-  int up_fd =
-      join_walk(node, target, /*allow_master=*/true, &became_master, &listen_addr);
-  if (up_fd < 0 && !became_master) {
-    delete node;
-    return nullptr;
-  }
-  node->is_master = became_master;
-  if (became_master) listen_addr = target;  // master owns the rendezvous addr
+  int up_fd = -1;
+  int listen_fd = -1;
+  for (int attempt = 0; attempt < 50 && !listen_fd_ok(listen_fd); attempt++) {
+    if (attempt > 0) {
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(10 * std::min(attempt, 10)));
+    }
+    became_master = false;
+    sockaddr_in listen_addr{};
+    up_fd = join_walk(node, target, /*allow_master=*/true, &became_master,
+                      &listen_addr);
+    if (up_fd < 0 && !became_master) continue;  // tree settling; retry
+    if (became_master) listen_addr = target;  // master owns the rendezvous addr
 
-  // Bind the listen socket to the same endpoint our parent observed (the
-  // reference's addressing trick) so redirects that hand out our accept()-
-  // observed address reach our listener.
-  node->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  set_common_sockopts(node->listen_fd);
-  if (::bind(node->listen_fd, (sockaddr*)&listen_addr, sizeof listen_addr) < 0 ||
-      ::listen(node->listen_fd, cfg.listen_backlog) < 0) {
-    ::close(node->listen_fd);
+    // Bind the listen socket to the same endpoint our parent observed (the
+    // reference's addressing trick) so redirects that hand out our accept()-
+    // observed address reach our listener.
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    set_common_sockopts(listen_fd);
+    if (::bind(listen_fd, (sockaddr*)&listen_addr, sizeof listen_addr) < 0 ||
+        ::listen(listen_fd, cfg.listen_backlog) < 0) {
+      // lost the master election (or our observed endpoint got reused):
+      // close everything and re-walk as a joiner
+      ::close(listen_fd);
+      listen_fd = -1;
+      if (up_fd >= 0) {
+        ::close(up_fd);
+        up_fd = -1;
+      }
+      continue;
+    }
+  }
+  if (!listen_fd_ok(listen_fd)) {
     if (up_fd >= 0) ::close(up_fd);
     delete node;
     return nullptr;
   }
+  node->is_master = became_master;
+  node->listen_fd = listen_fd;
 
   node->active_threads += 2;
   std::thread(listener_loop, node).detach();

@@ -33,6 +33,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import Any, Optional
 
 import jax.numpy as jnp
@@ -110,6 +111,14 @@ class SharedTensorPeer:
         self._carry_residual: Optional[jnp.ndarray] = None
         self._sent_snapshot: Optional[jnp.ndarray] = None
         self._uplink: Optional[int] = None
+        # delivery accounting (see _send_loop): sent-but-unacked frame seqs
+        # per link (send thread appends, recv thread pops on wire.ACK), and
+        # cumulative RX/ACK counters per link
+        self._ack_mu = threading.Lock()
+        self._unacked: dict[int, list[int]] = {}
+        self._acked: dict[int, int] = {}
+        self._rx_count: dict[int, int] = {}
+        self._ack_sent: dict[int, int] = {}  # highest ACK actually delivered
         self._recv_thread = threading.Thread(
             target=self._recv_loop, daemon=True, name="st-recv"
         )
@@ -144,17 +153,24 @@ class SharedTensorPeer:
             raise self._error
 
     def drain(self, timeout: float = 60.0, tol: float = 0.0) -> bool:
-        """Block until every outgoing link residual is down to ``tol`` RMS and
-        the transport send queues are empty — i.e. all local updates have been
-        handed to our neighbors. Use before :meth:`close` to leave gracefully
-        (the reference has no flush concept at all; a leaving node takes its
-        undelivered residuals down with the whole process, quirk Q8)."""
+        """Block until every outgoing link residual is down to ``tol`` RMS,
+        the transport send queues are empty, AND every sent frame has been
+        acknowledged by its receiver — i.e. all local updates now live in our
+        neighbors' replicas (they apply + flood atomically on receive). After
+        a successful drain, close() loses nothing. Use before :meth:`close`
+        to leave gracefully (the reference has no flush concept at all; a
+        leaving node takes its undelivered residuals down with the whole
+        process, quirk Q8). A crash without drain instead falls under the
+        bounded-loss arm of the delivery contract (core.SharedTensor)."""
         deadline = time.time() + timeout
         while time.time() < deadline and not self._stop.is_set():
             links = self.st.link_ids
             if all(self.st.residual_rms(l) <= tol for l in links):
                 stats = [self.node.stats(l) for l in self.node.links]
-                if all(s is None or s.send_queue == 0 for s in stats):
+                if (
+                    all(s is None or s.send_queue == 0 for s in stats)
+                    and self.st.inflight_total() == 0
+                ):
                     return True
             time.sleep(0.05)
         return False
@@ -205,19 +221,91 @@ class SharedTensorPeer:
     def _send_loop(self) -> None:
         compat = self.config.transport.wire_compat
         interval = self.config.sync_interval_sec
+        # Pipelined frame production (round-2 verdict Weak #2): up to
+        # ``send_pipeline_depth`` dispatched-but-unfetched frames per link,
+        # each with its device->host copy started asynchronously at dispatch
+        # time. Quantizes chain on device, their transfers overlap each other
+        # and the host's encode+socket work, so on a high-latency device link
+        # the frame rate is bandwidth-bound, not round-trip-bound.
+        # Error-feedback ordering is safe: the residual update happens at
+        # dispatch time under SharedTensor's lock.
+        #
+        # Delivery accounting: a sent frame stays in SharedTensor's in-flight
+        # ledger until the RECEIVER acknowledges it (wire.ACK, handled in
+        # _on_message) — enqueue into the native send queue is NOT delivery
+        # (a link can die with queued frames, and their error feedback would
+        # be silently lost; measured as the regraft divergence flake). In
+        # wire-compat mode the reference protocol has no ACK, so delivery
+        # degrades to ack-on-enqueue (the C peer loses everything on death
+        # anyway, quirk Q8).
+        # numpy host tier: quantize is synchronous host work — pipelining
+        # just hoards the SharedTensor lock; depth only pays on device tiers
+        # where dispatch/transfer are async.
+        depth = 1 if self.st._np else max(1, int(self.config.send_pipeline_depth))
+        pipe: dict[int, deque] = {}
+        hot: set[int] = set()  # links whose last finished frame carried data
         while not self._stop.is_set():
             sent_any = False
-            for link in self.st.link_ids:
-                frame = self.st.make_frame(link)
+            links = self.st.link_ids
+            for stale in [l for l in pipe if l not in links]:
+                del pipe[stale]  # LINK_DOWN already rolled their ledger back
+                hot.discard(stale)
+            for link in links:
+                q = pipe.setdefault(link, deque())
+                # top up: a cold (idle) link risks one speculative frame per
+                # wake tick; a hot link keeps the full pipeline busy
+                target = depth if link in hot else 1
+                while len(q) < target:
+                    df = self.st.begin_frame(link)
+                    if df is None:
+                        break  # link dropped concurrently
+                    for arr in df[1]:
+                        try:
+                            arr.copy_to_host_async()
+                        except AttributeError:
+                            pass  # non-jax array (already host-side)
+                    q.append(df)
+                if not q:
+                    continue
+                seq, df = q.popleft()
+                frame = self.st.finish_frame(df)
+                while frame is None:
+                    # Idle frame (a no-op: scale 0 left the residual
+                    # untouched): ack it and drain the remaining speculative
+                    # frames — they must be FINISHED, not dropped (an add()
+                    # may have raced the dispatches, making a later one
+                    # non-idle, and its error feedback is already applied;
+                    # dropping it would lose that delta forever).
+                    self.st.ack_frame(link, seq)
+                    hot.discard(link)
+                    if not q:
+                        break
+                    seq, df = q.popleft()
+                    frame = self.st.finish_frame(df)
                 if frame is None:
                     continue
+                hot.add(link)
                 payload = (
                     wire.encode_compat_frame(frame, self.st.spec)
                     if compat
                     else wire.encode_frame(frame)
                 )
+                if not compat:
+                    # register BEFORE sending: the receiver's ACK must never
+                    # race ahead of the ledger entry it acknowledges
+                    with self._ack_mu:
+                        self._unacked.setdefault(link, []).append(seq)
                 if self._send_blocking(link, payload):
+                    if compat:
+                        self.st.ack_frame(link, seq)  # no ACK in the protocol
                     sent_any = True
+                else:
+                    # link died with this frame (and possibly speculative
+                    # successors) undelivered: roll their error feedback back
+                    # so drop_link/carry sees the full owed residual
+                    pipe.pop(link, None)
+                    hot.discard(link)
+                    self.st.nack_frame(link)
             if self._stop.is_set():
                 return
             if interval > 0:
@@ -283,6 +371,7 @@ class SharedTensorPeer:
                     except Exception as e:
                         log.warning("dropping bad message on link %d: %s", link, e)
                 self._flush_frames(link, batch)
+                self._flush_acks(link)  # retry any backpressure-dropped ACK
             if not busy:
                 time.sleep(0.002)
 
@@ -301,7 +390,31 @@ class SharedTensorPeer:
                     self.st.receive_frame(link, f)
                 except Exception as e:
                     log.warning("dropping bad frame on link %d: %s", link, e)
+        self._ack_received(link, len(batch))
         self._wake.set()  # flood refills other links' residuals
+
+    def _ack_received(self, link: int, n: int) -> None:
+        """Tell the sender its frames arrived (drives its in-flight ledger;
+        see _send_loop). Cumulative, and RETRIED: an ACK dropped to send-queue
+        backpressure is only healed by a later one if more DATA arrives — the
+        final ACK of a burst would otherwise be lost forever, leaving the
+        sender's ledger undrained (drain() spinning, rollback re-delivering
+        delivered frames on link death)."""
+        if self.config.transport.wire_compat or n <= 0:
+            return
+        count = self._rx_count.get(link, 0) + n
+        self._rx_count[link] = count
+        self._flush_acks(link)
+
+    def _flush_acks(self, link: int) -> None:
+        count = self._rx_count.get(link, 0)
+        if count <= self._ack_sent.get(link, 0):
+            return
+        try:
+            if self.node.send(link, wire.encode_ack(count), timeout=0.0):
+                self._ack_sent[link] = count
+        except BrokenPipeError:
+            self._ack_sent[link] = count  # link dead; nothing left to ack
 
     def _handle_events(self) -> bool:
         evs = self.node.poll_events(timeout=0.0)
@@ -326,6 +439,11 @@ class SharedTensorPeer:
                         self._pending[ev.link_id] = bytearray()
             elif ev.kind == EventKind.LINK_DOWN:
                 self._pending.pop(ev.link_id, None)
+                with self._ack_mu:
+                    self._unacked.pop(ev.link_id, None)
+                    self._acked.pop(ev.link_id, None)
+                    self._rx_count.pop(ev.link_id, None)
+                    self._ack_sent.pop(ev.link_id, None)
                 resid = self.st.drop_link(ev.link_id)
                 if ev.is_uplink:
                     # Keep undelivered upward updates for the re-grafted
@@ -374,7 +492,17 @@ class SharedTensorPeer:
         kind = payload[0]
         if kind == wire.DATA:
             self.st.receive_frame(link, wire.decode_frame(payload, self.st.spec))
+            self._ack_received(link, 1)
             self._wake.set()  # flood refills other links' residuals
+        elif kind == wire.ACK:
+            count = wire.decode_ack(payload)
+            with self._ack_mu:
+                done = count - self._acked.get(link, 0)
+                self._acked[link] = count
+                seqs = self._unacked.get(link, [])
+                acked, self._unacked[link] = seqs[:done], seqs[done:]
+            for seq in acked:
+                self.st.ack_frame(link, seq)
         elif kind == wire.SYNC:
             k, n, digest = wire.decode_sync(payload)
             mine = self.st.spec

@@ -48,6 +48,7 @@ CHUNK = 2  # child -> parent: replica snapshot chunk
 DONE = 3  # child -> parent: snapshot complete
 WELCOME = 4  # parent -> child: accepted, streaming begins
 REJECT = 5  # parent -> child: spec mismatch, reason attached
+ACK = 6  # cumulative count of DATA frames received on this link
 
 _SYNC_FMT = "<IQ16s"  # num_leaves, total_n, layout digest
 _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
@@ -115,6 +116,24 @@ def decode_chunk_into(payload: bytes, buf: bytearray) -> None:
             f"{len(buf)}-byte snapshot buffer"
         )
     buf[off : off + len(body)] = body
+
+
+def encode_ack(count: int) -> bytes:
+    """Receiver -> sender: cumulative DATA frames received on this link.
+
+    Delivery acknowledgement drives the sender's in-flight ledger
+    (core.SharedTensor): a frame's error feedback is only forgotten once the
+    peer confirms receipt, so a link death rolls back exactly the undelivered
+    tail into the carry residual (at-least-once delivery — see
+    core.begin_frame). The reference has no delivery concept at all: its
+    sender's residual update IS the send (src/sharedtensor.c:166-177), and
+    any socket error kills the process anyway (quirk Q8)."""
+    return bytes([ACK]) + struct.pack("<Q", count)
+
+
+def decode_ack(payload: bytes) -> int:
+    (count,) = struct.unpack_from("<Q", payload, 1)
+    return count
 
 
 def encode_reject(reason: str) -> bytes:

@@ -108,6 +108,13 @@ class Config:
     #: Background sync frame pacing: target seconds between frames per link;
     #: 0 = free-running (reference behavior: fill all bandwidth, README.md:31).
     sync_interval_sec: float = 0.0
+    #: Outstanding quantized-but-unsent frames per link in the sender
+    #: pipeline. Each is dispatched on device and its device->host copy
+    #: started asynchronously before older frames finish sending, so frame
+    #: transfers overlap compute AND each other — on a high-latency
+    #: device link (PCIe queue, TPU tunnel) throughput is bounded by
+    #: bandwidth instead of round-trip latency. 1 = plain double buffering.
+    send_pipeline_depth: int = 8
 
 
 DEFAULT = Config()

@@ -27,6 +27,7 @@ word vector used by the wire layer.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -61,6 +62,19 @@ def _interpret() -> bool:
     except Exception:
         kind = ""
     return "tpu" not in kind.lower()
+
+
+def use_pallas() -> bool:
+    """Should the production codec paths (ops/table.py, parallel/ici.py) run
+    these kernels? Default: yes exactly when they would compile (real TPU);
+    on CPU the pure-XLA codec is faster than the Pallas interpreter.
+    ``ST_CODEC=pallas|xla`` overrides (tests use it to pin either tier)."""
+    mode = os.environ.get("ST_CODEC", "auto").lower()
+    if mode == "pallas":
+        return True
+    if mode == "xla":
+        return False
+    return not _interpret()
 
 
 def _live_mask(block_rows: int, pid, n: int):
@@ -235,3 +249,123 @@ def apply_frame_many(
 def apply_frame(values: jnp.ndarray, frame: Frame, n: int) -> jnp.ndarray:
     """Single-array apply (see apply_frame_many)."""
     return apply_frame_many((values,), frame, n)[0]
+
+
+# --- row-granular primitives (the table tier) -------------------------------
+#
+# The table codec (ops/table.py) runs the same sign/error-feedback rule with a
+# DIFFERENT scale per leaf — per-leaf padding is row-aligned, so at kernel
+# granularity that is simply "a scale per (1,128) row" plus "live lanes per
+# row". These two primitives are the fused production tier for it (round-2
+# verdict: the scalar kernels above were proven on chip but only the pure-XLA
+# path shipped; these are what ops/table.py and parallel/ici.py now call).
+# They are deliberately UN-jitted: table.py wraps them in its own jit, and
+# parallel/ici.py embeds them inside a shard_map'd step.
+
+
+def _quantize_rows_kernel(s_ref, cnt_ref, resid_ref, words_ref, new_resid_ref):
+    s = s_ref[...]  # (block, 1) per-row scale
+    c = cnt_ref[...]  # (block, 1) live lanes per row (0..128)
+    r = resid_ref[...]  # (block, LANES)
+    lane = jax.lax.broadcasted_iota(jnp.int32, r.shape, 1)
+    live = lane < c
+    neg = r <= 0.0  # bit set => send -scale (zero counts as negative, Q3)
+    bits = jnp.logical_and(live, neg)
+    words_ref[...] = _pack_rows(bits.astype(jnp.int32))
+    sent = jnp.where(neg, -s, s)
+    # rows whose leaf idles at scale 0 keep their residual; padding lanes are
+    # forced back to 0 (the ops/table.py invariant, bit-for-bit)
+    new_resid_ref[...] = jnp.where(
+        jnp.logical_and(live, s > 0.0), r - sent, jnp.where(live, r, 0.0)
+    )
+
+
+def quantize_rows(
+    s_row: jnp.ndarray, rowcount: jnp.ndarray, residual: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused sender pass with per-row scales: sign-quantize + LSB-first pack +
+    error feedback in ONE pass over HBM.
+
+    ``s_row`` f32[rows] (leaf scale broadcast to its rows), ``rowcount``
+    i32[rows] (live lanes per row), ``residual`` f32[rows*128] flat.
+    Returns (words u32[rows*4] flat, new_residual flat). Traceable — callers
+    jit. Bit-for-bit equal to the ops/table.py XLA path.
+    """
+    rows = residual.shape[0] // LANES
+    block = min(BLOCK_ROWS, rows)
+    row_spec = lambda w: pl.BlockSpec((block, w), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    words2d, new_resid = pl.pallas_call(
+        _quantize_rows_kernel,
+        grid=(pl.cdiv(rows, block),),
+        in_specs=[row_spec(1), row_spec(1), row_spec(LANES)],
+        out_specs=[row_spec(WORDS_PER_ROW), row_spec(LANES)],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, WORDS_PER_ROW), jnp.uint32),
+            jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
+        ],
+        input_output_aliases={2: 1},
+        interpret=_interpret(),
+    )(
+        s_row.reshape(rows, 1),
+        rowcount.reshape(rows, 1).astype(jnp.int32),
+        residual.reshape(rows, LANES),
+    )
+    return words2d.reshape(-1), new_resid.reshape(-1)
+
+
+def _apply_rows_kernel(s_ref, cnt_ref, words_ref, *refs, k_frames, n_arrays):
+    c = cnt_ref[...]  # (block, 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (c.shape[0], LANES), 1)
+    live = lane < c
+    delta = jnp.zeros((c.shape[0], LANES), jnp.float32)
+    for kf in range(k_frames):
+        w = words_ref[:, kf * WORDS_PER_ROW : (kf + 1) * WORDS_PER_ROW]
+        bits = _unpack_rows(w)
+        s = s_ref[:, kf : kf + 1]  # (block, 1)
+        delta = delta + s * (1.0 - 2.0 * bits.astype(jnp.float32))
+    delta = jnp.where(live, delta, 0.0)
+    in_refs, out_refs = refs[:n_arrays], refs[n_arrays:]
+    for i_ref, o_ref in zip(in_refs, out_refs):
+        o_ref[...] = jnp.where(live, i_ref[...] + delta, 0.0)
+
+
+def apply_rows_batch(
+    s_rows: jnp.ndarray,
+    rowcount: jnp.ndarray,
+    words2d: jnp.ndarray,
+    arrays: tuple[jnp.ndarray, ...],
+) -> tuple[jnp.ndarray, ...]:
+    """Fused receive pass for K frames x N target arrays, per-row scales: the
+    frames are unpacked ONCE, their +/-scale deltas summed (codec deltas are
+    pure adds — they commute, ops/table.py apply_table_batch rationale), and
+    the sum applied to every array in one HBM pass.
+
+    ``s_rows`` f32[rows, K] — per-frame, per-row scales (a frame's column is 0
+    where it contributes nothing: idle leaves, split-horizon self-masking in
+    parallel/ici.py); ``words2d`` u32[rows, K*4] — frame k's packed bits for
+    row r at [r, 4k:4k+4]; ``arrays`` flat f32[rows*128] each.
+    """
+    rows = arrays[0].shape[0] // LANES
+    k = s_rows.shape[1]
+    n_arr = len(arrays)
+    # Cap the words block at ~2 MiB of VMEM so large K still fits alongside
+    # the target arrays (block stays a whole number of 8-row tiles).
+    block = min(BLOCK_ROWS, rows, max(8, (2 << 20) // (k * WORDS_PER_ROW * 4) // 8 * 8))
+    row_spec = lambda w: pl.BlockSpec((block, w), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    vspec = row_spec(LANES)
+    outs = pl.pallas_call(
+        partial(_apply_rows_kernel, k_frames=k, n_arrays=n_arr),
+        grid=(pl.cdiv(rows, block),),
+        in_specs=[row_spec(k), row_spec(1), row_spec(k * WORDS_PER_ROW)]
+        + [vspec] * n_arr,
+        out_specs=[vspec] * n_arr,
+        out_shape=[jax.ShapeDtypeStruct((rows, LANES), jnp.float32)] * n_arr,
+        input_output_aliases={3 + i: i for i in range(n_arr)},
+        interpret=_interpret(),
+    )(
+        s_rows,
+        rowcount.reshape(rows, 1).astype(jnp.int32),
+        words2d,
+        *[a.reshape(rows, LANES) for a in arrays],
+    )
+    return tuple(o.reshape(-1) for o in outs)

@@ -174,38 +174,58 @@ def compute_scales(
     return jnp.where(rms_pos & jnp.isfinite(scales), scales, 0.0)
 
 
-@partial(jax.jit, static_argnames=("spec", "policy", "per_leaf"))
-def quantize_table(
+def _table_scales(
     residual: jnp.ndarray,
     spec: TableSpec,
-    policy: ScalePolicy = ScalePolicy.POW2_RMS,
-    per_leaf: bool = True,
-) -> tuple[TableFrame, jnp.ndarray]:
-    """Sender step over a table: one pass, per-leaf scales.
-
-    Per-leaf semantics are identical to codec.quantize: bit set iff r <= 0,
-    residual moves by -+scale of its own leaf, leaves with scale 0 idle.
-
-    ``per_leaf=False`` computes ONE scale over the whole table (the
-    reference's behavior — a frame then carries a single global scale, which
-    wire-compat interop with C peers requires); the returned TableFrame still
-    holds k copies of it so the apply path is uniform.
-    """
+    policy: ScalePolicy,
+    per_leaf: bool,
+) -> jnp.ndarray:
+    """Per-leaf scales; ``per_leaf=False`` computes ONE scale over the whole
+    table (the reference's behavior, src/sharedtensor.c:153-159 — wire-compat
+    interop with C peers requires it) replicated to every leaf so the apply
+    path is uniform."""
     if per_leaf:
-        scales = compute_scales(residual, spec, policy)
-    else:
-        one_spec = dataclasses.replace(
-            spec,
-            shapes=((spec.total_n,),),
-            ns=(spec.total_n,),
-            padded=(spec.total,),
-        )
-        # NOTE: valid because padding lanes are 0 by invariant; the single-
-        # leaf view only changes which elements each scale aggregates over.
-        s = compute_scales(residual, one_spec, policy)[0]
-        scales = jnp.full((spec.num_leaves,), s, jnp.float32)
-    rows = residual.reshape(-1, LANES)
+        return compute_scales(residual, spec, policy)
+    one_spec = dataclasses.replace(
+        spec,
+        shapes=((spec.total_n,),),
+        ns=(spec.total_n,),
+        padded=(spec.total,),
+    )
+    # NOTE: valid because padding lanes are 0 by invariant; the single-
+    # leaf view only changes which elements each scale aggregates over.
+    s = compute_scales(residual, one_spec, policy)[0]
+    return jnp.full((spec.num_leaves,), s, jnp.float32)
+
+
+def _resolve_impl(impl: str) -> str:
+    """'auto' -> the Pallas kernels exactly when they would compile (TPU);
+    pure XLA elsewhere (CPU tests/peers). See codec_pallas.use_pallas."""
+    if impl != "auto":
+        return impl
+    from . import codec_pallas
+
+    return "pallas" if codec_pallas.use_pallas() else "xla"
+
+
+@partial(jax.jit, static_argnames=("spec", "policy", "per_leaf", "impl"))
+def _quantize_table(
+    residual: jnp.ndarray,
+    spec: TableSpec,
+    policy: ScalePolicy,
+    per_leaf: bool,
+    impl: str,
+) -> tuple[TableFrame, jnp.ndarray]:
+    scales = _table_scales(residual, spec, policy, per_leaf)
     row_leaf = jnp.asarray(spec.row_leaf())
+    if impl == "pallas":
+        from . import codec_pallas
+
+        words, new_flat = codec_pallas.quantize_rows(
+            scales[row_leaf], jnp.asarray(spec.live_rowcount()), residual
+        )
+        return TableFrame(scales, words), new_flat
+    rows = residual.reshape(-1, LANES)
     s_row = scales[row_leaf][:, None]  # (rows, 1)
     live = jnp.asarray(_live_mask_flat(spec)).reshape(-1, LANES)
     neg = rows <= 0
@@ -218,14 +238,57 @@ def quantize_table(
     )
 
 
-@partial(jax.jit, static_argnames=("spec",))
-def apply_table_many(
-    arrays: tuple[jnp.ndarray, ...], frame: TableFrame, spec: TableSpec
-) -> tuple[jnp.ndarray, ...]:
-    """Receiver step over a table applied to several arrays (replica + other
-    links' residuals — the flood), one pass."""
-    bits = unpack_bits(frame.words).reshape(-1, LANES)
+def quantize_table(
+    residual: jnp.ndarray,
+    spec: TableSpec,
+    policy: ScalePolicy = ScalePolicy.POW2_RMS,
+    per_leaf: bool = True,
+    impl: str = "auto",
+) -> tuple[TableFrame, jnp.ndarray]:
+    """Sender step over a table: one pass, per-leaf scales.
+
+    Per-leaf semantics are identical to codec.quantize: bit set iff r <= 0,
+    residual moves by -+scale of its own leaf, leaves with scale 0 idle.
+
+    On TPU the sign/pack/error-feedback pass runs as the fused Pallas kernel
+    (codec_pallas.quantize_rows) — the production tier; the XLA path is the
+    golden reference and the CPU fallback. ``impl`` pins either ("xla" /
+    "pallas") for parity tests."""
+    return _quantize_table(residual, spec, policy, per_leaf, _resolve_impl(impl))
+
+
+def _batch_layout(frames: TableFrame, spec: TableSpec):
+    """(scales [K,L], words [K,W]) -> the row-major layout the Pallas batch
+    kernel consumes: s_rows f32[rows, K], words2d u32[rows, K*4] (frame k's
+    words for row r at [r, 4k:4k+4])."""
+    k = frames.scales.shape[0]
+    rows = spec.total // LANES
     row_leaf = jnp.asarray(spec.row_leaf())
+    s_rows = frames.scales[:, row_leaf].T  # (rows, K)
+    words2d = (
+        frames.words.reshape(k, rows, LANES // 32)
+        .transpose(1, 0, 2)
+        .reshape(rows, k * (LANES // 32))
+    )
+    return s_rows, words2d
+
+
+@partial(jax.jit, static_argnames=("spec", "impl"))
+def _apply_table_many(
+    arrays: tuple[jnp.ndarray, ...], frame: TableFrame, spec: TableSpec, impl: str
+) -> tuple[jnp.ndarray, ...]:
+    row_leaf = jnp.asarray(spec.row_leaf())
+    if impl == "pallas":
+        from . import codec_pallas
+
+        rows = spec.total // LANES
+        return codec_pallas.apply_rows_batch(
+            frame.scales[row_leaf].reshape(rows, 1),
+            jnp.asarray(spec.live_rowcount()),
+            frame.words.reshape(rows, LANES // 32),
+            arrays,
+        )
+    bits = unpack_bits(frame.words).reshape(-1, LANES)
     s_row = frame.scales[row_leaf][:, None]
     live = jnp.asarray(_live_mask_flat(spec)).reshape(-1, LANES)
     delta = jnp.where(live, s_row * (1.0 - 2.0 * bits.astype(jnp.float32)), 0.0)
@@ -233,13 +296,48 @@ def apply_table_many(
     return tuple(jnp.where(live.reshape(-1), a + flat_delta, 0.0) for a in arrays)
 
 
+def apply_table_many(
+    arrays: tuple[jnp.ndarray, ...],
+    frame: TableFrame,
+    spec: TableSpec,
+    impl: str = "auto",
+) -> tuple[jnp.ndarray, ...]:
+    """Receiver step over a table applied to several arrays (replica + other
+    links' residuals — the flood), one fused pass (Pallas on TPU)."""
+    return _apply_table_many(arrays, frame, spec, _resolve_impl(impl))
+
+
 def apply_table(values: jnp.ndarray, frame: TableFrame, spec: TableSpec) -> jnp.ndarray:
     return apply_table_many((values,), frame, spec)[0]
 
 
-@partial(jax.jit, static_argnames=("spec",))
+@partial(jax.jit, static_argnames=("spec", "impl"))
+def _apply_table_batch(
+    arrays: tuple[jnp.ndarray, ...], frames: TableFrame, spec: TableSpec, impl: str
+) -> tuple[jnp.ndarray, ...]:
+    if impl == "pallas":
+        from . import codec_pallas
+
+        s_rows, words2d = _batch_layout(frames, spec)
+        return codec_pallas.apply_rows_batch(
+            s_rows, jnp.asarray(spec.live_rowcount()), words2d, arrays
+        )
+    k = frames.scales.shape[0]
+    bits = unpack_bits(frames.words.reshape(-1)).reshape(k, -1, LANES)
+    row_leaf = jnp.asarray(spec.row_leaf())
+    s_row = frames.scales[:, row_leaf][:, :, None]  # [K, rows, 1]
+    live = jnp.asarray(_live_mask_flat(spec)).reshape(-1, LANES)
+    delta = jnp.sum(s_row * (1.0 - 2.0 * bits.astype(jnp.float32)), axis=0)
+    flat_delta = jnp.where(live, delta, 0.0).reshape(-1)
+    live_flat = live.reshape(-1)
+    return tuple(jnp.where(live_flat, a + flat_delta, 0.0) for a in arrays)
+
+
 def apply_table_batch(
-    arrays: tuple[jnp.ndarray, ...], frames: TableFrame, spec: TableSpec
+    arrays: tuple[jnp.ndarray, ...],
+    frames: TableFrame,
+    spec: TableSpec,
+    impl: str = "auto",
 ) -> tuple[jnp.ndarray, ...]:
     """Apply a STACK of K frames (scales f32[K, L], words u32[K, W]) in one
     dispatch: the summed delta of all K frames lands in one pass.
@@ -250,16 +348,11 @@ def apply_table_batch(
     overhead on a busy device was measured to back the RX queue up by
     hundreds of frames (train/hierarchical.py's two-pod run). Zero-scale
     padding frames contribute exactly nothing, so callers can pad a partial
-    batch up to a bucketed K to bound jit specializations."""
-    k = frames.scales.shape[0]
-    bits = unpack_bits(frames.words.reshape(-1)).reshape(k, -1, LANES)
-    row_leaf = jnp.asarray(spec.row_leaf())
-    s_row = frames.scales[:, row_leaf][:, :, None]  # [K, rows, 1]
-    live = jnp.asarray(_live_mask_flat(spec)).reshape(-1, LANES)
-    delta = jnp.sum(s_row * (1.0 - 2.0 * bits.astype(jnp.float32)), axis=0)
-    flat_delta = jnp.where(live, delta, 0.0).reshape(-1)
-    live_flat = live.reshape(-1)
-    return tuple(jnp.where(live_flat, a + flat_delta, 0.0) for a in arrays)
+    batch up to a bucketed K to bound jit specializations.
+
+    On TPU the unpack/sum/apply runs as ONE fused Pallas pass
+    (codec_pallas.apply_rows_batch) instead of K XLA unpack passes."""
+    return _apply_table_batch(arrays, frames, spec, _resolve_impl(impl))
 
 
 @partial(jax.jit, static_argnames=("spec",))

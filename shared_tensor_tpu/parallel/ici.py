@@ -195,6 +195,7 @@ def build_sync_step(
     compressed: bool = True,
     config: MeshConfig | None = None,
     jit_compile: bool = True,
+    impl: str = "auto",
 ):
     """Compile one fused pod sync step: ``state -> (state', scales)``.
 
@@ -205,6 +206,10 @@ def build_sync_step(
     ``compressed=False`` builds the exact-allreduce arm instead (BASELINE
     config 4's comparison): every pending residual is delivered in full fp32
     precision and residuals drop to exactly zero.
+
+    ``impl`` selects the codec tier around the all-gather: "auto" runs the
+    fused Pallas row kernels exactly when they compile (TPU) and pure XLA
+    elsewhere; "pallas"/"xla" pin a tier (parity tests).
     """
     cfg = config or MeshConfig()
     peer_ax, shard_ax = cfg.peer_axis, cfg.shard_axis
@@ -234,12 +239,41 @@ def build_sync_step(
         rowcount = jax.lax.dynamic_slice_in_dim(rowcount_full, start, rows_local)
         lane = jax.lax.broadcasted_iota(jnp.int32, (rows_local, LANES), 1)
         live = lane < rowcount[:, None]
-        return row_leaf, live
+        return row_leaf, rowcount, live
+
+    def _compressed_pallas(values, residual):
+        """The TPU production tier: the codec halves around the all-gather run
+        as the fused Pallas row kernels (ops/codec_pallas.py) — one HBM pass
+        each — instead of XLA's multi-pass pack/unpack lowering (measured in
+        round 2: the XLA tail cost 49.8% of a training step on chip)."""
+        from ..ops import codec_pallas
+
+        r = residual.reshape(rows_local, LANES)
+        row_leaf, rowcount, live = _local_slices()
+        scales = _leaf_scales(r, row_leaf, live, ns, k, policy, shard_axis)
+        # sender half, fused: sign + LSB-first pack + error feedback
+        words, r2 = codec_pallas.quantize_rows(scales[row_leaf], rowcount, residual)
+        # the wire: 1 bit/elem + k scales per peer over ICI
+        words_all = jax.lax.all_gather(words, peer_ax)  # (n_peer, W)
+        scales_all = jax.lax.all_gather(scales, peer_ax)  # (n_peer, k)
+        # receiver half, fused: split horizon = zero out OUR column of the
+        # per-frame scales (a zero-scale frame contributes exactly nothing),
+        # then one unpack+sum+apply pass over all n_peer frames
+        me = jax.lax.axis_index(peer_ax)
+        s_all = scales_all[:, row_leaf]  # (n_peer, rows)
+        s_all = jnp.where((jnp.arange(n_peer) == me)[:, None], 0.0, s_all)
+        words2d = (
+            words_all.reshape(n_peer, rows_local, LANES // 32)
+            .transpose(1, 0, 2)
+            .reshape(rows_local, n_peer * (LANES // 32))
+        )
+        (v2,) = codec_pallas.apply_rows_batch(s_all.T, rowcount, words2d, (values,))
+        return v2, r2, scales
 
     def _compressed(values, residual):
         v = values.reshape(rows_local, LANES)
         r = residual.reshape(rows_local, LANES)
-        row_leaf, live = _local_slices()
+        row_leaf, rowcount, live = _local_slices()
         scales = _leaf_scales(r, row_leaf, live, ns, k, policy, shard_axis)
         s_row = scales[row_leaf][:, None]  # (rows, 1)
         # sender half: sign-quantize + error feedback (reference :166-174)
@@ -266,7 +300,7 @@ def build_sync_step(
 
     def _exact(values, residual):
         r = residual.reshape(rows_local, LANES)
-        row_leaf, live = _local_slices()
+        row_leaf, rowcount, live = _local_slices()
         # report the would-have-been scales so both arms expose the same
         # observability surface
         scales = _leaf_scales(r, row_leaf, live, ns, k, policy, shard_axis)
@@ -275,7 +309,12 @@ def build_sync_step(
         v2 = jnp.where(live.reshape(-1), v2, 0.0)
         return v2, jnp.zeros_like(residual), scales
 
-    body = _compressed if compressed else _exact
+    if compressed:
+        from ..ops.table import _resolve_impl
+
+        body = _compressed_pallas if _resolve_impl(impl) == "pallas" else _compressed
+    else:
+        body = _exact
 
     def _step(values, residual):
         # local blocks: (1, spec.total // n_shard)
@@ -288,6 +327,9 @@ def build_sync_step(
         mesh=mesh,
         in_specs=(spec_vr, spec_vr),
         out_specs=(spec_vr, spec_vr, P(peer_ax, None)),
+        # pallas_call outputs carry no varying-mesh-axes annotation; disable
+        # the vma checker for the kernel body (the XLA body keeps it)
+        check_vma=body is not _compressed_pallas,
     )
 
     def sync_step(state: PeerSyncState) -> Tuple[PeerSyncState, jax.Array]:

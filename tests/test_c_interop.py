@@ -25,10 +25,7 @@ NATIVE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))
 HARNESS = os.path.join(NATIVE, "stc_harness")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from tests._ports import free_port as _free_port
 
 
 @pytest.fixture(scope="module")

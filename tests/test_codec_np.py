@@ -1,0 +1,115 @@
+"""Numpy host-codec tier vs the golden XLA table codec.
+
+The numpy tier (ops/codec_np.py) is the production codec for CPU peers.
+Sign bits, packing, and error feedback must be bit-identical to the golden
+tier given the same scales; scales may differ by 1 ulp (different f32
+summation order), so cross-tier checks deliver frames across tiers and
+assert semantic equivalence.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from shared_tensor_tpu.config import ScalePolicy
+from shared_tensor_tpu.ops import codec_np as NP
+from shared_tensor_tpu.ops import table as T
+
+
+def _tree(seed, mags=(1.0, 800.0, 0.005)):
+    rng = np.random.default_rng(seed)
+    return {
+        f"l{i}": (rng.uniform(-m, m, size=s)).astype(np.float32)
+        for i, (s, m) in enumerate(zip([(30, 50), (257,), (4, 9)], mags))
+    }
+
+
+@pytest.mark.parametrize("per_leaf", [True, False])
+@pytest.mark.parametrize(
+    "policy", [ScalePolicy.POW2_RMS, ScalePolicy.RMS, ScalePolicy.ABS_MEAN]
+)
+def test_quantize_np_matches_golden(per_leaf, policy):
+    tree = _tree(1)
+    spec = T.make_spec(tree)
+    r = np.asarray(T.flatten(tree, spec))
+    fg, rg = T.quantize_table(jnp.asarray(r), spec, policy, per_leaf, impl="xla")
+    s_np, w_np, r_np = NP.quantize_table_np(r, spec, policy, per_leaf)
+    # scales agree to 1 ulp; POW2 floor makes them exactly equal in practice
+    np.testing.assert_allclose(s_np, np.asarray(fg.scales), rtol=3e-7)
+    if np.array_equal(s_np, np.asarray(fg.scales)):
+        np.testing.assert_array_equal(w_np, np.asarray(fg.words))
+        np.testing.assert_array_equal(r_np, np.asarray(rg))
+
+
+def test_apply_np_matches_golden():
+    tree = _tree(2)
+    spec = T.make_spec(tree)
+    r = np.asarray(T.flatten(tree, spec))
+    s, w, _ = NP.quantize_table_np(r, spec)
+    arrays = tuple(np.asarray(T.flatten(_tree(10 + i), spec)) for i in range(3))
+    out_np = NP.apply_table_many_np(arrays, s, w, spec)
+    frame = T.TableFrame(jnp.asarray(s), jnp.asarray(w))
+    out_g = T.apply_table_many(
+        tuple(jnp.asarray(a) for a in arrays), frame, spec, impl="xla"
+    )
+    for a, b in zip(out_np, out_g):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_cross_tier_link_convergence():
+    """A link whose sender is the numpy tier and whose receiver is the XLA
+    tier (and the reverse direction simultaneously) converges exactly like a
+    same-tier link — the wire format is the contract, not the impl."""
+    tree = _tree(3)
+    spec = T.make_spec(tree)
+    target = np.asarray(T.flatten(tree, spec))
+    r_np = target.copy()  # numpy sender's residual
+    v_xla = jnp.zeros(spec.total, jnp.float32)  # xla receiver's replica
+    for _ in range(160):
+        s, w, r_np = NP.quantize_table_np(r_np, spec)
+        if not s.any():
+            break
+        v_xla = T.apply_table_many(
+            (v_xla,), T.TableFrame(jnp.asarray(s), jnp.asarray(w)), spec, impl="xla"
+        )[0]
+    np.testing.assert_allclose(np.asarray(v_xla), target, rtol=1e-4, atol=1e-5)
+
+    r_xla = jnp.asarray(target)  # xla sender
+    v_np = np.zeros(spec.total, np.float32)  # numpy receiver
+    for _ in range(160):
+        f, r_xla = T.quantize_table(r_xla, spec, impl="xla")
+        s = np.asarray(f.scales)
+        if not s.any():
+            break
+        v_np = NP.apply_table_many_np((v_np,), s, np.asarray(f.words), spec)[0]
+    np.testing.assert_allclose(v_np, target, rtol=1e-4, atol=1e-5)
+
+
+def test_batch_np_equals_sequential():
+    tree = _tree(4)
+    spec = T.make_spec(tree)
+    r = np.asarray(T.flatten(tree, spec))
+    frames = []
+    for _ in range(6):
+        s, w, r = NP.quantize_table_np(r, spec)
+        frames.append((s, w))
+    v_seq = np.asarray(T.flatten(_tree(20), spec))
+    for s, w in frames:
+        v_seq = NP.apply_table_many_np((v_seq,), s, w, spec)[0]
+    v_batch = NP.apply_table_batch_np(
+        (np.asarray(T.flatten(_tree(20), spec)),),
+        np.stack([s for s, _ in frames]),
+        np.stack([w for _, w in frames]),
+        spec,
+    )[0]
+    np.testing.assert_allclose(v_batch, v_seq, rtol=1e-6, atol=1e-6)
+
+
+def test_accumulate_np_sanitizes():
+    tree = {"a": np.zeros(100, np.float32)}
+    spec = T.make_spec(tree)
+    v = np.zeros(spec.total, np.float32)
+    u = np.full(spec.total, np.nan, np.float32)
+    (out,) = NP.accumulate_table_np((v,), u, spec)
+    assert np.isfinite(out).all() and (out == 0).all()

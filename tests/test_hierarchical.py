@@ -125,3 +125,65 @@ def test_layout_mismatch_rejected():
             HierarchicalTrainer(pod, peer)
     finally:
         peer.close()
+
+
+def test_pod_bridge_churn_mid_training():
+    """Kill a POD BRIDGE peer mid-training (round-2 verdict item 7): four
+    2-device pods form the tree; the one that is a mid-tree parent dies while
+    every pod is actively training; its orphan re-grafts (LINK_DOWN ->
+    carry-residual -> rejoin) under live PodTrainers, and the survivors
+    converge to agreement once updates stop — no pod's progress is lost."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    meshes = [make_mesh(2, 1, devices=devs[2 * i : 2 * i + 2]) for i in range(4)]
+    port = _free_port()
+    from shared_tensor_tpu.config import Config, TransportConfig
+
+    cfg = Config(transport=TransportConfig(peer_timeout_sec=5.0, max_rejoin_attempts=8))
+    pods = {}
+    try:
+        for name, mesh in zip("mabc", meshes):
+            pods[name] = HierarchicalTrainer.create(
+                mesh, "127.0.0.1", port, _template(), _quad_loss, peer_config=cfg
+            )
+        targets = {"m": 2.0, "a": -2.0, "b": 1.0, "c": -1.0}
+        batches = {n: jnp.full((2, 8), t) for n, t in targets.items()}
+        # train everyone a bit so real residual mass is in flight
+        for _ in range(30):
+            for n, tr in pods.items():
+                tr.step(batches[n], lr=0.05)
+            time.sleep(0.002)
+        # the mid-tree parent: a non-master bridge peer with a child link
+        parent = next(
+            n for n, tr in pods.items()
+            if not tr.peer.is_master and len(tr.peer.node.links) > 1
+        )
+        pods.pop(parent).close()
+        survivors = pods
+
+        # keep training through the churn (the orphan re-grafts underneath)
+        for _ in range(30):
+            for n, tr in survivors.items():
+                tr.step(batches[n], lr=0.05)
+            time.sleep(0.002)
+
+        # stop updating; all surviving pods must agree (eventual consistency
+        # across the re-grafted tree, reference README.md:24)
+        def quiesce():
+            for n, tr in survivors.items():
+                tr.step(batches[n], lr=0.0)
+
+        def agreed():
+            means = [float(jnp.mean(tr.read(0)["w"])) for tr in survivors.values()]
+            return max(means) - min(means) < 0.05
+
+        assert _settle(quiesce, agreed, timeout=30), {
+            n: float(jnp.mean(tr.read(0)["w"])) for n, tr in survivors.items()
+        }
+        # and training actually mixed: nobody sits at its local target
+        for n, tr in survivors.items():
+            assert abs(float(jnp.mean(tr.read(0)["w"])) - targets[n]) > 0.3, n
+    finally:
+        for tr in pods.values():
+            tr.close()

@@ -63,10 +63,7 @@ WORKER = textwrap.dedent(
 )
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from tests._ports import free_port as _free_port
 
 
 def test_two_process_gspmd_mesh(tmp_path):
