@@ -33,6 +33,13 @@ SECONDS = float(os.environ.get("ST_E2E_SECONDS", "10"))
 WARMUP = float(os.environ.get("ST_E2E_WARMUP", "3"))
 
 
+#: ST_E2E_CHILD=c runs the wire-compat arm: the child is native/stc_harness —
+#: a real compiled-C peer speaking the reference's exact wire protocol — so
+#: the measurement is our peer engine vs a C peer ON THE REFERENCE'S OWN
+#: PROTOCOL (single tensor, single global scale, no handshake/ACKs).
+CHILD = os.environ.get("ST_E2E_CHILD", "py")
+
+
 def _mk_peer(port: int):
     import jax.numpy as jnp
 
@@ -40,7 +47,9 @@ def _mk_peer(port: int):
     from shared_tensor_tpu.config import Config, TransportConfig
 
     cfg = Config(
-        transport=TransportConfig(peer_timeout_sec=30.0),
+        transport=TransportConfig(
+            peer_timeout_sec=30.0, wire_compat=(CHILD == "c")
+        ),
         send_pipeline_depth=int(os.environ.get("ST_E2E_DEPTH", "8")),
     )
     template = {"t": jnp.zeros((N,), jnp.float32)}
@@ -96,12 +105,27 @@ def main() -> None:
     on_tpu = not codec_pallas._interpret()
 
     peer = _mk_peer(port)  # master, on the default (TPU) backend
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "child", str(port)],
-        env=env,
-        stderr=subprocess.DEVNULL,
-    )
+    if CHILD == "c":
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        harness = os.path.join(repo, "native", "stc_harness")
+        if not os.path.exists(harness):
+            subprocess.run(
+                ["make", "-C", os.path.join(repo, "native"), "stc_harness"],
+                check=True, capture_output=True,
+            )
+        proc = subprocess.Popen(
+            [harness, "127.0.0.1", str(port), str(N),
+             str(WARMUP + SECONDS + 60), "1.0"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+    else:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "child", str(port)],
+            env=env,
+            stderr=subprocess.DEVNULL,
+        )
     try:
         import jax.numpy as jnp
         import numpy as np
@@ -152,6 +176,9 @@ def main() -> None:
     finally:
         proc.kill()
         peer.close()
+        # the TPU plugin's background threads can abort during interpreter
+        # teardown (harmless but noisy); the JSON line is already out
+        os._exit(0)
 
 
 if __name__ == "__main__":

@@ -135,8 +135,9 @@ def test_mixed_magnitude_table_sync():
             "127.0.0.1", port, jax.tree.map(jnp.zeros_like, seed), CFG
         ) as joiner:
             # converges exactly — with one global scale the small leaf would
-            # still be at ~24% error after 48 frames
-            _wait_converged([joiner], seed, timeout=20.0)
+            # still be at ~24% error after 48 frames (generous timeout: under
+            # parallel suite load a 1-vCPU box schedules these peers slowly)
+            _wait_converged([joiner], seed, timeout=60.0)
 
 
 def test_regraft_after_parent_death():
