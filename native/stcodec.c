@@ -29,10 +29,9 @@
  * converged elements oscillate within +/-scale). With s == 0 the leaf idles:
  * bits still record signs (matching the XLA/numpy tiers bit-for-bit) but the
  * residual is untouched. */
-static void quantize_leaf(float *r, int64_t n, int64_t padded, float s,
-                          uint32_t *words) {
+static void quantize_leaf(const float *rin, float *rout, int64_t n,
+                          int64_t padded, float s, uint32_t *words) {
   int64_t nw = padded / 32;
-  int64_t j = 0;
   for (int64_t w = 0; w < nw; w++) {
     uint32_t bits = 0;
     int64_t base = w * 32;
@@ -40,19 +39,23 @@ static void quantize_leaf(float *r, int64_t n, int64_t padded, float s,
     if (lim > 32) lim = 32;
     if (s > 0.0f) {
       for (int64_t b = 0; b < lim; b++) {
-        float v = r[base + b];
+        float v = rin[base + b];
         uint32_t neg = v <= 0.0f;
         bits |= neg << b;
-        r[base + b] = v - (neg ? -s : s);
+        rout[base + b] = v - (neg ? -s : s);
       }
     } else {
       for (int64_t b = 0; b < lim; b++) {
-        bits |= (uint32_t)(r[base + b] <= 0.0f) << b;
+        float v = rin[base + b];
+        bits |= (uint32_t)(v <= 0.0f) << b;
+        rout[base + b] = v;
       }
     }
+    /* the caller hands a fresh output buffer: re-establish the all-zero
+     * padding invariant on lanes past the live elements */
+    for (int64_t b = (lim < 0 ? 0 : lim); b < 32; b++) rout[base + b] = 0.0f;
     words[w] = bits;
   }
-  (void)j;
 }
 
 /* Per-leaf reduction partials for the scale policies, one fused pass per
@@ -96,11 +99,16 @@ EXPORT void stc_scale_partials(const float *r, const int64_t *off,
   }
 }
 
-EXPORT void stc_quantize(float *r, const int64_t *off, const int64_t *ns,
-                         const int64_t *padded, int64_t n_leaves,
-                         const float *scales, uint32_t *words) {
+/* Functional form — reads rin, writes rout (the Python tier's update
+ * discipline is replace-not-mutate, so writing to a fresh output buffer
+ * saves the 4-byte-per-element input copy an in-place API would force). */
+EXPORT void stc_quantize(const float *rin, float *rout, const int64_t *off,
+                         const int64_t *ns, const int64_t *padded,
+                         int64_t n_leaves, const float *scales,
+                         uint32_t *words) {
   for (int64_t i = 0; i < n_leaves; i++) {
-    quantize_leaf(r + off[i], ns[i], padded[i], scales[i], words + off[i] / 32);
+    quantize_leaf(rin + off[i], rout + off[i], ns[i], padded[i], scales[i],
+                  words + off[i] / 32);
   }
 }
 

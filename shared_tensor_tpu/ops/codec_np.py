@@ -72,7 +72,9 @@ def _native() -> Optional[ctypes.CDLL]:
             )
         lib = ctypes.CDLL(str(path))
         lib.stc_quantize.restype = None
-        lib.stc_quantize.argtypes = [_f32p, _i64p, _i64p, _i64p, ctypes.c_int64, _f32p, _u32p]
+        lib.stc_quantize.argtypes = [
+            _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64, _f32p, _u32p,
+        ]
         lib.stc_accumulate_delta.restype = None
         lib.stc_accumulate_delta.argtypes = [_f32p, _i64p, _i64p, _i64p, ctypes.c_int64, _f32p, _u32p]
         lib.stc_add_inplace.restype = None
@@ -232,10 +234,10 @@ def quantize_table_np(
     lib = _native()
     if lib is not None:
         offs, ns, padded = _layout(spec)
-        new_r = r.copy()
+        new_r = np.empty(spec.total, np.float32)
         words = np.zeros(spec.total // 32, np.uint32)
         lib.stc_quantize(
-            new_r, offs, ns, padded, spec.num_leaves, scales, words
+            r, new_r, offs, ns, padded, spec.num_leaves, scales, words
         )
         return scales, words, new_r
     live = _live_mask_np(spec)

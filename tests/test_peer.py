@@ -32,10 +32,12 @@ from tests._ports import free_port as _free_port
 CFG = Config(transport=TransportConfig(peer_timeout_sec=10.0))
 
 
-def _wait_converged(peers, expect, tol=1e-6, timeout=30.0):
+def _wait_converged(peers, expect, tol=1e-6, timeout=90.0):
     """Poll until every peer's replica equals ``expect`` within tol (the
     codec converges *exactly* in finitely many frames for fp32 data —
-    BASELINE.md: ~28 frames for U(-1,1))."""
+    BASELINE.md: ~28 frames for U(-1,1)). The window is sized for a loaded
+    1-vCPU box running concurrent suites — convergence itself takes <1s
+    unloaded; slow must not read as wrong."""
     expect_leaves = jax.tree.leaves(expect)
     deadline = time.time() + timeout
     while time.time() < deadline:
