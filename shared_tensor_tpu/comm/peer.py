@@ -241,7 +241,7 @@ class SharedTensorPeer:
         # numpy host tier: quantize is synchronous host work — pipelining
         # just hoards the SharedTensor lock; depth only pays on device tiers
         # where dispatch/transfer are async.
-        depth = 1 if self.st._np else max(1, int(self.config.send_pipeline_depth))
+        depth = 1 if self.st.host_tier else max(1, int(self.config.send_pipeline_depth))
         pipe: dict[int, deque] = {}
         hot: set[int] = set()  # links whose last finished frame carried data
         while not self._stop.is_set():

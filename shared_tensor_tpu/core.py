@@ -124,6 +124,12 @@ class SharedTensor:
         self.frames_in = 0
         self.updates = 0
 
+    @property
+    def host_tier(self) -> bool:
+        """True when the codec runs as synchronous host (numpy/C) work rather
+        than async device dispatch — callers tune pipelining accordingly."""
+        return self._np
+
     # -- links -------------------------------------------------------------
 
     def _asarray(self, x) -> Any:

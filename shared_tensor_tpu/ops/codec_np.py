@@ -103,13 +103,8 @@ def _layout(spec: TableSpec):
     hit = _spec_layout_cache.get(spec)
     if hit is not None:
         return hit
-    offs = np.zeros(spec.num_leaves, np.int64)
-    acc = 0
-    for i, p in enumerate(spec.padded):
-        offs[i] = acc
-        acc += p
     out = (
-        offs,
+        np.asarray([off for off, _, _ in _leaf_slices(spec)], np.int64),
         np.asarray(spec.ns, np.int64),
         np.asarray(spec.padded, np.int64),
     )
@@ -235,7 +230,8 @@ def quantize_table_np(
     if lib is not None:
         offs, ns, padded = _layout(spec)
         new_r = np.empty(spec.total, np.float32)
-        words = np.zeros(spec.total // 32, np.uint32)
+        # C writes every word (padding words are emitted as 0), so empty is safe
+        words = np.empty(spec.total // 32, np.uint32)
         lib.stc_quantize(
             r, new_r, offs, ns, padded, spec.num_leaves, scales, words
         )
