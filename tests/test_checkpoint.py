@@ -9,7 +9,7 @@ import pytest
 from shared_tensor_tpu.core import SharedTensor
 from shared_tensor_tpu.models import char_rnn as m
 from shared_tensor_tpu.parallel.ici import init_state
-from shared_tensor_tpu.parallel.mesh import make_mesh
+from tests._mesh import make_mesh
 from shared_tensor_tpu.train import PodTrainer
 from shared_tensor_tpu.utils import checkpoint as ckpt
 

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from shared_tensor_tpu.parallel.mesh import make_mesh
+from tests._mesh import make_mesh
 from shared_tensor_tpu.train import HierarchicalTrainer
 from tests.test_peer import _free_port
 

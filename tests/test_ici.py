@@ -23,10 +23,11 @@ from shared_tensor_tpu.parallel import (
     build_sync_step,
     frame_ici_bytes,
     init_state,
-    make_mesh,
     read_peer,
     rows_per_shard,
 )
+from shared_tensor_tpu.parallel.mesh import make_mesh as make_mesh_strict
+from tests._mesh import make_mesh
 
 
 def template(key=0, shape=(40, 64)):
@@ -38,13 +39,13 @@ def template(key=0, shape=(40, 64)):
 
 
 def test_mesh_shapes():
-    mesh = make_mesh(4, 2)
-    assert mesh.shape == {"peer": 4, "shard": 2}
     assert rows_per_shard(2048, 4) == 4
     with pytest.raises(ValueError):
         rows_per_shard(1024, 3)  # 8 rows not divisible by 3
     with pytest.raises(ValueError):
-        make_mesh(16, 1)  # more devices than exist
+        make_mesh_strict(16, 1)  # more devices than exist
+    mesh = make_mesh(4, 2)  # skips here on a <8-device backend
+    assert mesh.shape == {"peer": 4, "shard": 2}
 
 
 def test_parity_with_golden_codec():

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import pytest
 
 from shared_tensor_tpu.models import resnet as r
-from shared_tensor_tpu.parallel.mesh import make_mesh
+from tests._mesh import make_mesh
 from shared_tensor_tpu.train import PodTrainer
 
 TINY = r.ResNetConfig(stages=(1, 1), width=8, classes=4)

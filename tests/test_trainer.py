@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from shared_tensor_tpu.models import char_rnn as m
-from shared_tensor_tpu.parallel.mesh import make_mesh
+from tests._mesh import make_mesh
 from shared_tensor_tpu.train import PodTrainer
 
 CFG = m.CharRNNConfig(vocab=64, embed=16, hidden=32, layers=1)
