@@ -1,0 +1,74 @@
+"""Host-tier codec microbench: M elem/s per core for the native C hot loops.
+
+The reference's codec measures 202 M elem/s on one core of this box class
+(BASELINE.md, probe replicating src/sharedtensor.c:106-111,153-174); the host
+tier's throughput hangs on these same loops (ops/codec_np.py dispatches to
+native/stcodec.c). Prints one JSON line per op with elem/s and the
+vs-reference ratio at matched work (quantize = RMS pass + sign/pack/feedback
+pass; apply = unpack+accumulate pass).
+
+Usage: python benchmarks/host_codec_bench.py [--n 1048576] [--reps 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 20)
+    ap.add_argument("--reps", type=int, default=50)
+    args = ap.parse_args()
+
+    from shared_tensor_tpu.config import ScalePolicy
+    from shared_tensor_tpu.ops import codec_np
+    from shared_tensor_tpu.ops.table import make_spec
+
+    lib = codec_np._native()
+    n = args.n
+    spec = make_spec(np.zeros(n, np.float32))
+    rng = np.random.default_rng(0)
+    resid = rng.uniform(-1.0, 1.0, n).astype(np.float32)
+
+    def timeit(fn, reps):
+        fn()  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - t0) / reps
+
+    t_q = timeit(
+        lambda: codec_np.quantize_table_np(resid, spec, ScalePolicy.POW2_RMS),
+        args.reps,
+    )
+    scales, words, _ = codec_np.quantize_table_np(resid, spec)
+    values = rng.uniform(-1.0, 1.0, n).astype(np.float32)
+    t_a = timeit(
+        lambda: codec_np.apply_table_many_np((values,), scales, words, spec),
+        args.reps,
+    )
+    ref_meps = 202.0  # BASELINE.md: quantize+apply fused, 1 core
+    for op, t in (("quantize", t_q), ("apply", t_a)):
+        meps = n / t / 1e6
+        print(
+            json.dumps(
+                {
+                    "op": op,
+                    "n": n,
+                    "ms": round(t * 1e3, 3),
+                    "meps": round(meps, 1),
+                    "native": lib is not None,
+                    "vs_ref_202meps": round(meps / ref_meps, 2),
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,18 @@
 
 #define EXPORT __attribute__((visibility("default")))
 
+/* AVX-512 fast paths (compile-time: the Makefile builds with -march=native,
+ * and this library is always compiled on the machine it runs on — see
+ * ops/codec_np._native's on-demand make). The reference's scalar loops run
+ * ~200 M elem/s/core (BASELINE.md); the sign-quantize and apply loops below
+ * are 1-bit-per-float mask ops, which AVX-512 expresses directly
+ * (compare->__mmask16 is the codec's bitmask, bit-for-bit). Scalar code
+ * stays as the portable fallback and the semantic reference. */
+#if defined(__AVX512F__) && defined(__AVX512DQ__)
+#include <immintrin.h>
+#define ST_AVX512 1
+#endif
+
 /* Sender half for one leaf: sign-quantize + pack + error feedback, one fused
  * pass. bit = (r <= 0) — zero counts as negative (reference quirk Q3, kept:
  * converged elements oscillate within +/-scale). With s == 0 the leaf idles:
@@ -32,7 +44,34 @@
 static void quantize_leaf(const float *rin, float *rout, int64_t n,
                           int64_t padded, float s, uint32_t *words) {
   int64_t nw = padded / 32;
-  for (int64_t w = 0; w < nw; w++) {
+  int64_t w = 0;
+#ifdef ST_AVX512
+  /* Words whose 32 lanes are all live: two 16-lane compares produce the
+   * bitmask directly; +/-s is the scale with the mask spliced into the IEEE
+   * sign bit (exactly the scalar code's union trick, 16 lanes at a time). */
+  const __m512 vzero = _mm512_setzero_ps();
+  const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
+  const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
+  for (; w < n / 32; w++) {
+    const float *p = rin + w * 32;
+    float *q = rout + w * 32;
+    __m512 v0 = _mm512_loadu_ps(p);
+    __m512 v1 = _mm512_loadu_ps(p + 16);
+    __mmask16 m0 = _mm512_cmp_ps_mask(v0, vzero, _CMP_LE_OQ);
+    __mmask16 m1 = _mm512_cmp_ps_mask(v1, vzero, _CMP_LE_OQ);
+    if (s > 0.0f) {
+      __m512 d0 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m0, vs, vsign));
+      __m512 d1 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m1, vs, vsign));
+      _mm512_storeu_ps(q, _mm512_sub_ps(v0, d0));
+      _mm512_storeu_ps(q + 16, _mm512_sub_ps(v1, d1));
+    } else {
+      _mm512_storeu_ps(q, v0);
+      _mm512_storeu_ps(q + 16, v1);
+    }
+    words[w] = (uint32_t)m0 | ((uint32_t)m1 << 16);
+  }
+#endif
+  for (; w < nw; w++) {
     uint32_t bits = 0;
     int64_t base = w * 32;
     int64_t lim = n - base;
@@ -74,6 +113,35 @@ EXPORT void stc_scale_partials(const float *r, const int64_t *off,
      * the adds pipeline (a single double accumulator costs ~4 cycles/elem) */
     double amax[4] = {0, 0, 0, 0}, ss[4] = {0, 0, 0, 0}, sabs[4] = {0, 0, 0, 0};
     int64_t j = 0;
+#ifdef ST_AVX512
+    /* 16 floats/iter; squares/sums accumulate in 8-lane doubles, so the
+     * result is a double-sum like the scalar path (order differs; double
+     * accumulation makes the difference vanish below f32 rounding — the
+     * tiers tolerate 1-ulp scale differences, see ops/codec_np.py). */
+    {
+      const __m512i vabsmask = _mm512_set1_epi32(0x7FFFFFFF);
+      __m512 vamax = _mm512_setzero_ps();
+      __m512d vss0 = _mm512_setzero_pd(), vss1 = _mm512_setzero_pd();
+      __m512d vsa0 = _mm512_setzero_pd(), vsa1 = _mm512_setzero_pd();
+      for (; j + 16 <= n; j += 16) {
+        __m512 v = _mm512_loadu_ps(p + j);
+        __m512 a = _mm512_castsi512_ps(
+            _mm512_and_epi32(_mm512_castps_si512(v), vabsmask));
+        vamax = _mm512_max_ps(vamax, a);
+        __m512d lo = _mm512_cvtps_pd(_mm512_castps512_ps256(v));
+        __m512d hi = _mm512_cvtps_pd(_mm512_extractf32x8_ps(v, 1));
+        vss0 = _mm512_fmadd_pd(lo, lo, vss0);
+        vss1 = _mm512_fmadd_pd(hi, hi, vss1);
+        __m512d alo = _mm512_cvtps_pd(_mm512_castps512_ps256(a));
+        __m512d ahi = _mm512_cvtps_pd(_mm512_extractf32x8_ps(a, 1));
+        vsa0 = _mm512_add_pd(vsa0, alo);
+        vsa1 = _mm512_add_pd(vsa1, ahi);
+      }
+      amax[0] = _mm512_reduce_max_ps(vamax);
+      ss[0] = _mm512_reduce_add_pd(vss0) + _mm512_reduce_add_pd(vss1);
+      sabs[0] = _mm512_reduce_add_pd(vsa0) + _mm512_reduce_add_pd(vsa1);
+    }
+#endif
     for (; j + 4 <= n; j += 4) {
       for (int u = 0; u < 4; u++) {
         double v = p[j + u];
@@ -128,7 +196,25 @@ EXPORT void stc_accumulate_delta(float *delta, const int64_t *off,
     float *d = delta + off[i];
     int64_t n = ns[i];
     int64_t full = n / 32; /* whole words: branch-free, vectorizable */
-    for (int64_t k = 0; k < full; k++) {
+    int64_t k = 0;
+#ifdef ST_AVX512
+    /* The packed word IS two __mmask16s: splice each bit into the IEEE sign
+     * of a broadcast s (bit set -> -s, reference src/sharedtensor.c:109)
+     * and accumulate, 16 lanes per op. */
+    const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
+    const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
+    for (; k < full; k++) {
+      uint32_t bits = w[k];
+      float *dd = d + k * 32;
+      __mmask16 m0 = (__mmask16)bits;
+      __mmask16 m1 = (__mmask16)(bits >> 16);
+      __m512 d0 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m0, vs, vsign));
+      __m512 d1 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m1, vs, vsign));
+      _mm512_storeu_ps(dd, _mm512_add_ps(_mm512_loadu_ps(dd), d0));
+      _mm512_storeu_ps(dd + 16, _mm512_add_ps(_mm512_loadu_ps(dd + 16), d1));
+    }
+#endif
+    for (; k < full; k++) {
       uint32_t bits = w[k];
       float *dd = d + k * 32;
       float signs[32];

@@ -85,13 +85,14 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def build_native(force: bool = False) -> pathlib.Path:
-    """Compile native/libsttransport.so if needed."""
-    if force or not _LIB_PATH.exists():
-        subprocess.run(
-            ["make", "-C", str(_NATIVE_DIR)] + (["-B"] if force else []),
-            check=True,
-            capture_output=True,
-        )
+    """Compile native/libsttransport.so if missing or stale (make is
+    mtime-based, a no-op when fresh — edited sources must never keep serving
+    a previously-built .so)."""
+    subprocess.run(
+        ["make", "-C", str(_NATIVE_DIR)] + (["-B"] if force else []),
+        check=True,
+        capture_output=True,
+    )
     return _LIB_PATH
 
 

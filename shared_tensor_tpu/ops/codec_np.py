@@ -64,12 +64,14 @@ def _native() -> Optional[ctypes.CDLL]:
         return None
     path = _NATIVE_DIR / "libstcodec.so"
     try:
-        if not path.exists():
-            subprocess.run(
-                ["make", "-C", str(_NATIVE_DIR), "libstcodec.so"],
-                check=True,
-                capture_output=True,
-            )
+        # Always run make (mtime-based no-op when fresh): the library is
+        # compiled -march=native, so a stale .so — older sources, or built on
+        # a different machine — must be rebuilt, not loaded as-is.
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR), "libstcodec.so"],
+            check=True,
+            capture_output=True,
+        )
         lib = ctypes.CDLL(str(path))
         lib.stc_quantize.restype = None
         lib.stc_quantize.argtypes = [
