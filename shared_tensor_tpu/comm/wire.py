@@ -50,6 +50,13 @@ WELCOME = 4  # parent -> child: accepted, streaming begins
 REJECT = 5  # parent -> child: spec mismatch, reason attached
 ACK = 6  # cumulative count of DATA frames received on this link
 
+#: Corruption ceiling for wire scales: 2^100 is ~8 orders of magnitude above
+#: any scale a training run can legitimately produce (add() clamps updates to
+#: +/-3e38, so residual RMS <= 3e38, but real update RMS is O(1)) while still
+#: needing ~1e8 consistent frames to overflow a replica — random corruption
+#: cannot do that, only a deliberate attacker could (quirk Q11, out of scope).
+_SCALE_CEIL = np.float32(2.0**100)
+
 _SYNC_FMT = "<IQ16s"  # num_leaves, total_n, layout digest
 _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
 
@@ -81,6 +88,19 @@ def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
             f"(k={k}, words={w}) — peer table layout mismatch"
         )
     scales = np.frombuffer(payload, "<f4", count=k, offset=1)
+    # Corruption guard at the trust boundary: a non-finite or absurd scale
+    # (bit flips in the exponent field are exactly what random corruption
+    # produces) would poison every replica through the flood, reference
+    # quirk Q9. Zeroing makes the leaf a no-op, which loses nothing
+    # legitimate: real scales are RMS-of-update-sized, astronomically below
+    # _SCALE_CEIL, and the sender's error feedback re-delivers the mass
+    # under the next (sane) scale. This hardens against CORRUPTION only —
+    # a hostile peer sending consistent near-ceiling scales can still drive
+    # replicas toward overflow over ~1e8 frames (no auth on the protocol,
+    # quirk Q11 — out of scope, as in the reference).
+    if not (np.abs(scales) <= _SCALE_CEIL).all():  # catches NaN/inf too
+        ok = np.isfinite(scales) & (np.abs(scales) <= _SCALE_CEIL)
+        scales = np.where(ok, scales, np.float32(0.0))
     words = np.frombuffer(payload, "<u4", count=w, offset=1 + 4 * k)
     return TableFrame(jnp.asarray(scales), jnp.asarray(words))
 
@@ -167,16 +187,21 @@ def encode_compat_frame(frame: TableFrame, spec: TableSpec) -> bytes:
 
 
 def decode_compat_frame(payload: bytes, spec: TableSpec) -> Optional[TableFrame]:
-    """Reference frame bytes -> TableFrame. Returns None for a pure keepalive
-    (scale == 0: the reference sends one idle frame/s, quirk Q2 — it carries
-    no information, so we skip the device work)."""
+    """Reference frame bytes -> TableFrame. Returns None for a frame that
+    must not be applied: a pure keepalive (scale == 0 — the reference sends
+    one idle frame/s, quirk Q2; it carries no information, so we skip the
+    device work) or a corrupt frame (non-finite / absurd scale, which would
+    poison the replica — quirk Q9; see decode_frame's corruption guard)."""
     if len(payload) != compat_frame_bytes(spec.total_n):
         raise ValueError(
             f"compat frame is {len(payload)} bytes, "
             f"expected {compat_frame_bytes(spec.total_n)}"
         )
     (scale,) = struct.unpack_from("<f", payload, 0)
-    if scale == 0.0:
+    if scale == 0.0 or not abs(scale) <= float(_SCALE_CEIL):
+        # scale 0: reference idle keepalive (quirk Q2). Non-finite or above
+        # the corruption ceiling: treat as idle, don't poison the replica
+        # (Q9; `not <=` also catches NaN).
         return None
     nwords = spec.total // 32
     raw = payload[4:].ljust(nwords * 4, b"\x00")

@@ -1,0 +1,126 @@
+"""Wire-robustness: garbage on a live link must not kill or poison a node.
+
+The reference exits the whole process on any I/O hiccup (quirk Q8,
+src/sharedtensor.c:61-63) and has no guard against a corrupt/hostile frame
+poisoning every replica through the flood (quirk Q9: one NaN makes all
+values NaN; quirk Q11: anyone who can connect can inject). Here the engine
+drops undecodable messages (comm/peer.py receive loop) and the decoder
+zeroes non-finite scales at the trust boundary (comm/wire.py), so the node
+survives, stays finite, and keeps serving real peers.
+"""
+
+import struct
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shared_tensor_tpu.comm import wire
+from shared_tensor_tpu.comm.peer import create_or_fetch
+from shared_tensor_tpu.comm.transport import TransportNode, build_native
+from shared_tensor_tpu.config import Config, TransportConfig
+from shared_tensor_tpu.ops.table import make_spec
+from tests._ports import free_port as _free_port
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    build_native()
+
+
+CFG = Config(transport=TransportConfig(peer_timeout_sec=10.0))
+
+
+def _wait(cond, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_garbage_injection_survival_and_convergence():
+    port = _free_port()
+    tpl = {"w": jnp.ones((40, 64), jnp.float32), "b": jnp.zeros((64,), jnp.float32)}
+    spec = make_spec(tpl)
+    fb = wire.frame_wire_bytes(spec)
+    with create_or_fetch("127.0.0.1", port, tpl, CFG) as master:
+        # A bare transport node joins the tree but speaks garbage instead of
+        # the SYNC handshake.
+        with TransportNode(
+            "127.0.0.1", port, CFG.transport, frame_bytes=fb
+        ) as evil:
+            assert _wait(lambda: len(evil.links) == 1)
+            link = evil.links[0]
+            k, w = spec.num_leaves, spec.total // 32
+            rng = np.random.default_rng(7)  # deterministic noise
+            nan_scales = struct.pack("<" + "f" * k, *([float("nan")] * k))
+            noise_words = rng.integers(0, 256, 4 * w, dtype=np.uint8).tobytes()
+            # noise first byte pinned off SYNC: a random SYNC would draw a
+            # legitimate REJECT + link drop, which is not what this test pins
+            payloads = [
+                b"\xff" + b"\x00" * 16,  # unknown message kind
+                bytes([wire.DATA]) + b"\x01\x02\x03",  # truncated DATA
+                bytes([wire.ACK]),  # ACK with missing body
+                b"\xfe" + rng.integers(0, 256, 511, dtype=np.uint8).tobytes(),
+                # well-formed DATA frame carrying NaN scales + random bits:
+                # must decode to a no-op, not poison the replica (Q9/Q11)
+                bytes([wire.DATA]) + nan_scales + noise_words,
+                bytes([wire.CHUNK]) + struct.pack("<Q", 1 << 60) + b"\xee",
+            ]
+            for p in payloads:
+                assert evil.send(link, p, timeout=2.0)
+            time.sleep(1.0)  # let the engine chew through all of it
+        # The master survived, its replica is finite and unchanged.
+        got = master.read()
+        assert np.isfinite(np.asarray(got["w"])).all()
+        np.testing.assert_array_equal(np.asarray(got["w"]), np.ones((40, 64)))
+        assert master.ready
+
+        # And it still serves a REAL joiner end-to-end afterward.
+        with create_or_fetch("127.0.0.1", port, tpl, CFG) as joiner:
+            master.add({"w": jnp.full((40, 64), 0.5, jnp.float32),
+                        "b": jnp.zeros((64,), jnp.float32)})
+            def converged():
+                jw = np.asarray(joiner.read()["w"])
+                return np.abs(jw - 1.5).max() < 1e-5
+            assert _wait(converged, timeout=30.0)
+
+
+def test_compat_nonfinite_scale_is_keepalive():
+    """Wire-compat tier: a reference-format frame with a non-finite scale is
+    treated as an idle keepalive instead of applied (the C reference would
+    NaN its replica and flood that to the whole tree, quirk Q9)."""
+    tpl = jnp.zeros((64,), jnp.float32)
+    spec = make_spec(tpl)
+    payload = struct.pack("<f", float("inf")) + b"\xaa" * (
+        wire.compat_frame_bytes(spec.total_n) - 4
+    )
+    assert wire.decode_compat_frame(payload, spec) is None
+    payload = struct.pack("<f", float("nan")) + b"\xaa" * (
+        wire.compat_frame_bytes(spec.total_n) - 4
+    )
+    assert wire.decode_compat_frame(payload, spec) is None
+
+
+def test_native_corrupt_scales_zeroed():
+    """Native tier: decode_frame zeroes exactly the non-finite and
+    above-corruption-ceiling scales and keeps sane ones."""
+    tpl = {"a": jnp.zeros((8, 128), jnp.float32), "b": jnp.zeros((128,), jnp.float32)}
+    spec = make_spec(tpl)
+    k, w = spec.num_leaves, spec.total // 32
+    scales = struct.pack("<ff", float("nan"), 0.25)
+    payload = bytes([wire.DATA]) + scales + b"\x00" * (4 * w)
+    frame = wire.decode_frame(payload, spec)
+    np.testing.assert_array_equal(
+        np.asarray(frame.scales), np.asarray([0.0, 0.25], np.float32)
+    )
+    # an exponent-field bit flip producing a huge-but-finite scale is
+    # corruption too: 2^120 goes to 0, the legit leaf survives
+    scales = struct.pack("<ff", 2.0**120, 1.5)
+    frame = wire.decode_frame(bytes([wire.DATA]) + scales + b"\x00" * (4 * w), spec)
+    np.testing.assert_array_equal(
+        np.asarray(frame.scales), np.asarray([0.0, 1.5], np.float32)
+    )
