@@ -1,12 +1,14 @@
 """Training-throughput benchmark: char-rnn async-DP step time, tokens/s, MFU,
 and sync overhead (VERDICT.md round-1 item 4; BASELINE config 2 workload).
 
-Three arms of the SAME fused training step (train/async_sgd.py), differing
+Four arms of the SAME fused training step (train/async_sgd.py), differing
 only in the sync tail:
 
 - ``sync_off``   — pure local SGD, no communication (isolation baseline);
 - ``compressed`` — the framework's 1-bit error-feedback codec sync (the
   reference's semantics, reference README.md:13-19);
+- ``compressed_overlap`` — same codec, collective scheduled under the
+  backward pass (async overlap mode, train/async_sgd.py ``overlap=True``);
 - ``exact``      — uncompressed delta exchange (the allreduce comparison arm,
   BASELINE config 4).
 
@@ -147,6 +149,10 @@ def main() -> None:
     arms = [
         ("sync_off", dict(sync=False)),
         ("compressed", dict(sync=True, compressed=True)),
+        # collective scheduled under the backward pass (async overlap mode,
+        # train/async_sgd.py overlap=True) — the arm that should drive
+        # sync_overhead_pct toward zero on hardware with real ICI latency
+        ("compressed_overlap", dict(sync=True, compressed=True, overlap=True)),
         ("exact", dict(sync=True, compressed=False)),
     ]
     tokens_per_step = n_peer * args.batch * args.seq

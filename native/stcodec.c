@@ -238,9 +238,16 @@ EXPORT void stc_accumulate_delta(float *delta, const int64_t *off,
 }
 
 /* values[i] += delta[i] for one target array (live lanes only — padding in
- * both is 0 by invariant, so a full-width add preserves it). */
+ * both is 0 by invariant, so a full-width add preserves it). Result clamped
+ * to +/-3e38 like every other state-mutating path (ops/codec.SAT: no
+ * absorbing inf/NaN state, any tier). Branchless min/max — vectorizes. */
 EXPORT void stc_add_inplace(float *values, const float *delta, int64_t total) {
-  for (int64_t i = 0; i < total; i++) values[i] += delta[i];
+  for (int64_t i = 0; i < total; i++) {
+    float s = values[i] + delta[i];
+    s = s > 3.0e38f ? 3.0e38f : s;
+    s = s < -3.0e38f ? -3.0e38f : s;
+    values[i] = s;
+  }
 }
 
 /* Local additive update, sanitized (quirk Q9 fix — one NaN in the reference

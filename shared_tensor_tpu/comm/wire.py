@@ -33,11 +33,14 @@ frame bytes for wire-compat interop with C peers (SURVEY.md §2.3 wire spec).
 
 from __future__ import annotations
 
+import logging
 import struct
 from typing import Iterator, Optional
 
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger("shared_tensor_tpu.wire")
 
 from ..ops.table import TableFrame, TableSpec
 
@@ -49,13 +52,6 @@ DONE = 3  # child -> parent: snapshot complete
 WELCOME = 4  # parent -> child: accepted, streaming begins
 REJECT = 5  # parent -> child: spec mismatch, reason attached
 ACK = 6  # cumulative count of DATA frames received on this link
-
-#: Corruption ceiling for wire scales: 2^100 is ~8 orders of magnitude above
-#: any scale a training run can legitimately produce (add() clamps updates to
-#: +/-3e38, so residual RMS <= 3e38, but real update RMS is O(1)) while still
-#: needing ~1e8 consistent frames to overflow a replica — random corruption
-#: cannot do that, only a deliberate attacker could (quirk Q11, out of scope).
-_SCALE_CEIL = np.float32(2.0**100)
 
 _SYNC_FMT = "<IQ16s"  # num_leaves, total_n, layout digest
 _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
@@ -88,19 +84,22 @@ def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
             f"(k={k}, words={w}) — peer table layout mismatch"
         )
     scales = np.frombuffer(payload, "<f4", count=k, offset=1)
-    # Corruption guard at the trust boundary: a non-finite or absurd scale
-    # (bit flips in the exponent field are exactly what random corruption
-    # produces) would poison every replica through the flood, reference
-    # quirk Q9. Zeroing makes the leaf a no-op, which loses nothing
-    # legitimate: real scales are RMS-of-update-sized, astronomically below
-    # _SCALE_CEIL, and the sender's error feedback re-delivers the mass
-    # under the next (sane) scale. This hardens against CORRUPTION only —
-    # a hostile peer sending consistent near-ceiling scales can still drive
-    # replicas toward overflow over ~1e8 frames (no auth on the protocol,
-    # quirk Q11 — out of scope, as in the reference).
-    if not (np.abs(scales) <= _SCALE_CEIL).all():  # catches NaN/inf too
-        ok = np.isfinite(scales) & (np.abs(scales) <= _SCALE_CEIL)
-        scales = np.where(ok, scales, np.float32(0.0))
+    # Corruption guard at the trust boundary: a non-finite scale would NaN
+    # the replica and flood the poison tree-wide (reference quirk Q9 — the
+    # receive-path analog of add()'s sanitization). Zeroing makes the leaf a
+    # no-op; the mass that frame carried is lost (the sender's error
+    # feedback already debited it), bounded to the corrupted frames
+    # themselves — strictly better than the reference, which loses the
+    # whole tree. Huge-but-finite scales pass: every f32 below inf is
+    # inside the protocol's legal domain (residuals clamp at +/-3e38, so
+    # legitimate scales range up to 2^127), and the apply paths clamp to
+    # +/-3e38 so even those cannot create an absorbing inf/NaN state.
+    if not np.isfinite(scales).all():
+        log.warning(
+            "zeroing %d non-finite scale(s) in received frame (corrupt link?)",
+            int(np.count_nonzero(~np.isfinite(scales))),
+        )
+        scales = np.where(np.isfinite(scales), scales, np.float32(0.0))
     words = np.frombuffer(payload, "<u4", count=w, offset=1 + 4 * k)
     return TableFrame(jnp.asarray(scales), jnp.asarray(words))
 
@@ -198,10 +197,11 @@ def decode_compat_frame(payload: bytes, spec: TableSpec) -> Optional[TableFrame]
             f"expected {compat_frame_bytes(spec.total_n)}"
         )
     (scale,) = struct.unpack_from("<f", payload, 0)
-    if scale == 0.0 or not abs(scale) <= float(_SCALE_CEIL):
-        # scale 0: reference idle keepalive (quirk Q2). Non-finite or above
-        # the corruption ceiling: treat as idle, don't poison the replica
-        # (Q9; `not <=` also catches NaN).
+    if scale == 0.0 or not np.isfinite(scale):
+        if not np.isfinite(scale):
+            # corrupt, not idle: don't poison the replica (Q9; see
+            # decode_frame's corruption guard)
+            log.warning("dropping compat frame with non-finite scale")
         return None
     nwords = spec.total // 32
     raw = payload[4:].ljust(nwords * 4, b"\x00")

@@ -125,6 +125,16 @@ def quantize(
     return Frame(scale, pack_bits(bits)), new_residual
 
 
+#: Saturation bound for every state-mutating path (accumulate AND apply, all
+#: tiers). Add-side sanitization alone leaves one absorbing state: values
+#: legally at +/-SAT plus one max-scale frame (2^127, legal for a residual at
+#: the clamp) overflows to inf, and inf - inf = NaN floods tree-wide
+#: (reference quirk Q9). Clamping the apply result closes the model: no
+#: reachable state is non-finite, by construction. On sane magnitudes the
+#: clip is the identity, so cross-tier bit-parity is unaffected.
+SAT = 3.0e38
+
+
 @partial(jax.jit, static_argnames=("n",))
 def apply_frame(values: jnp.ndarray, frame: Frame, n: int) -> jnp.ndarray:
     """One receiver step: ``values[i] += scale - bit_i * 2 * scale``
@@ -133,7 +143,7 @@ def apply_frame(values: jnp.ndarray, frame: Frame, n: int) -> jnp.ndarray:
     bits = unpack_bits(frame.words)
     live = jnp.arange(n_pad, dtype=jnp.int32) < n
     delta = frame.scale * (1.0 - 2.0 * bits.astype(jnp.float32))
-    return jnp.where(live, values + delta, 0.0)
+    return jnp.where(live, jnp.clip(values + delta, -SAT, SAT), 0.0)
 
 
 @partial(jax.jit, static_argnames=("n",))
@@ -147,7 +157,7 @@ def apply_frame_many(
     bits = unpack_bits(frame.words)
     live = jnp.arange(n_pad, dtype=jnp.int32) < n
     delta = jnp.where(live, frame.scale * (1.0 - 2.0 * bits.astype(jnp.float32)), 0.0)
-    return tuple(a + delta for a in arrays)
+    return tuple(jnp.clip(a + delta, -SAT, SAT) for a in arrays)
 
 
 @partial(jax.jit, static_argnames=("n",))

@@ -37,6 +37,7 @@ from typing import Optional
 import numpy as np
 
 from ..config import ScalePolicy
+from .codec import SAT as _SAT
 from .table import TableSpec
 
 # ---- native tier (native/stcodec.c) ---------------------------------------
@@ -275,7 +276,7 @@ def apply_table_batch_np(
         out = []
         for a in arrays:
             v = np.array(a, np.float32, copy=True)  # functional update
-            lib.stc_add_inplace(v, delta, spec.total)
+            lib.stc_add_inplace(v, delta, spec.total)  # clamps at +/-SAT
             out.append(v)
         return tuple(out)
     live = _live_mask_np(spec)
@@ -292,7 +293,7 @@ def apply_table_batch_np(
     delta[~live] = 0.0
     out = []
     for a in arrays:
-        v = np.asarray(a, np.float32) + delta
+        v = np.clip(np.asarray(a, np.float32) + delta, -_SAT, _SAT)
         v[~live] = 0.0
         out.append(v)
     return tuple(out)

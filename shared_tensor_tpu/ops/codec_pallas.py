@@ -36,7 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (memory spaces)
 
 from ..config import ScalePolicy
-from .codec import Frame, compute_scale
+from .codec import SAT, Frame, compute_scale
 from .packing import LANES, BITS_PER_WORD
 
 WORDS_PER_ROW = LANES // BITS_PER_WORD  # 4
@@ -205,8 +205,11 @@ def _apply_kernel(scale_ref, words_ref, *refs, n, k):
     delta = s * (1.0 - 2.0 * bits.astype(jnp.float32))
     in_refs, out_refs = refs[:k], refs[k:]
     for i_ref, o_ref in zip(in_refs, out_refs):
-        # Padding lanes forced to 0, same as the golden apply_frame.
-        o_ref[...] = jnp.where(live, i_ref[...] + delta, 0.0)
+        # Padding lanes forced to 0; result clamped like the golden
+        # apply_frame (codec.SAT — no absorbing inf/NaN state, any tier).
+        o_ref[...] = jnp.where(
+            live, jnp.clip(i_ref[...] + delta, -SAT, SAT), 0.0
+        )
 
 
 @partial(jax.jit, static_argnames=("n",), donate_argnums=(0,))
@@ -326,7 +329,9 @@ def _apply_rows_kernel(s_ref, cnt_ref, words_ref, *refs, k_frames, n_arrays):
     delta = jnp.where(live, delta, 0.0)
     in_refs, out_refs = refs[:n_arrays], refs[n_arrays:]
     for i_ref, o_ref in zip(in_refs, out_refs):
-        o_ref[...] = jnp.where(live, i_ref[...] + delta, 0.0)
+        o_ref[...] = jnp.where(
+            live, jnp.clip(i_ref[...] + delta, -SAT, SAT), 0.0
+        )
 
 
 def apply_rows_batch(

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import ScalePolicy
-from .codec import pad_flat, pow2_floor
+from .codec import SAT, pad_flat, pow2_floor
 from .packing import LANES, TILE, pack_bits, padded_len, unpack_bits
 
 
@@ -293,7 +293,10 @@ def _apply_table_many(
     live = jnp.asarray(_live_mask_flat(spec)).reshape(-1, LANES)
     delta = jnp.where(live, s_row * (1.0 - 2.0 * bits.astype(jnp.float32)), 0.0)
     flat_delta = delta.reshape(-1)
-    return tuple(jnp.where(live.reshape(-1), a + flat_delta, 0.0) for a in arrays)
+    return tuple(
+        jnp.where(live.reshape(-1), jnp.clip(a + flat_delta, -SAT, SAT), 0.0)
+        for a in arrays
+    )
 
 
 def apply_table_many(
@@ -330,7 +333,10 @@ def _apply_table_batch(
     delta = jnp.sum(s_row * (1.0 - 2.0 * bits.astype(jnp.float32)), axis=0)
     flat_delta = jnp.where(live, delta, 0.0).reshape(-1)
     live_flat = live.reshape(-1)
-    return tuple(jnp.where(live_flat, a + flat_delta, 0.0) for a in arrays)
+    return tuple(
+        jnp.where(live_flat, jnp.clip(a + flat_delta, -SAT, SAT), 0.0)
+        for a in arrays
+    )
 
 
 def apply_table_batch(

@@ -4,6 +4,7 @@ replacement for the reference's TCP tree — see parallel/ici.py)."""
 from .ici import (
     PeerSyncState,
     add_updates,
+    build_sync_phases,
     build_sync_step,
     frame_ici_bytes,
     init_state,
@@ -15,6 +16,7 @@ from .mesh import make_mesh, rows_per_shard
 __all__ = [
     "PeerSyncState",
     "add_updates",
+    "build_sync_phases",
     "build_sync_step",
     "frame_ici_bytes",
     "init_state",

@@ -52,7 +52,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import MeshConfig, ScalePolicy
-from ..ops.codec import pow2_floor
+from ..ops.codec import SAT, pow2_floor
 from ..ops.packing import BITS_PER_WORD, LANES, pack_bits, unpack_bits
 from ..ops.table import TableSpec, flatten, unflatten
 from .mesh import rows_per_shard
@@ -143,6 +143,65 @@ def apply_external(state: PeerSyncState, delta: jax.Array) -> PeerSyncState:
 # --- the fused sync step ----------------------------------------------------
 
 
+@dataclasses.dataclass(frozen=True)
+class _StepCtx:
+    """Static layout shared by the sync-step builders: mesh axes, per-shard
+    row geometry, and the leaf segmentation the scale reductions run over."""
+
+    peer_ax: str
+    shard_ax: str
+    n_peer: int
+    n_shard: int
+    rows_local: int
+    k: int
+    row_leaf_full: jnp.ndarray
+    rowcount_full: jnp.ndarray
+    ns: jnp.ndarray
+
+    def local_slices(self):
+        """This shard's (row_leaf, rowcount, live) views. Call inside
+        shard_map only (uses axis_index)."""
+        sid = jax.lax.axis_index(self.shard_ax)
+        start = sid * self.rows_local
+        row_leaf = jax.lax.dynamic_slice_in_dim(
+            self.row_leaf_full, start, self.rows_local
+        )
+        rowcount = jax.lax.dynamic_slice_in_dim(
+            self.rowcount_full, start, self.rows_local
+        )
+        lane = jax.lax.broadcasted_iota(jnp.int32, (self.rows_local, LANES), 1)
+        live = lane < rowcount[:, None]
+        return row_leaf, rowcount, live
+
+
+def _make_ctx(
+    mesh: Mesh, spec: TableSpec, per_leaf: bool, cfg: MeshConfig
+) -> _StepCtx:
+    peer_ax, shard_ax = cfg.peer_axis, cfg.shard_axis
+    n_shard = mesh.shape[shard_ax]
+    if per_leaf:
+        k = spec.num_leaves
+        row_leaf_full = jnp.asarray(spec.row_leaf())
+        ns = jnp.asarray(np.asarray(spec.ns, dtype=np.float32))
+    else:
+        # one global scale over the whole table (the reference's exact
+        # behavior, src/sharedtensor.c:153-159) — a single segment
+        k = 1
+        row_leaf_full = jnp.zeros((spec.total // LANES,), jnp.int32)
+        ns = jnp.asarray([float(spec.total_n)], jnp.float32)
+    return _StepCtx(
+        peer_ax=peer_ax,
+        shard_ax=shard_ax,
+        n_peer=mesh.shape[peer_ax],
+        n_shard=n_shard,
+        rows_local=rows_per_shard(spec.total, n_shard),
+        k=k,
+        row_leaf_full=row_leaf_full,
+        rowcount_full=jnp.asarray(spec.live_rowcount()),
+        ns=ns,
+    )
+
+
 def _leaf_scales(
     rows: jnp.ndarray,
     row_leaf: jnp.ndarray,
@@ -187,6 +246,78 @@ def _leaf_scales(
     return jnp.where((amax > 0) & jnp.isfinite(scales), scales, 0.0)
 
 
+def _codec_send(ctx: _StepCtx, policy: ScalePolicy, pallas_tier: bool, residual):
+    """Sender half of the pod sync, per shard block: per-leaf scales
+    (cross-shard reduction) + sign-quantize/pack/error-feedback + all-gather
+    of the packed frames over the peer axis — the wire is 1 bit/element +
+    k scales per peer over ICI. One source of truth for both the fused step
+    (build_sync_step) and the overlap phases (build_sync_phases).
+
+    On TPU the quantize pass runs as the fused Pallas row kernel
+    (ops/codec_pallas.quantize_rows) — one HBM pass instead of XLA's
+    multi-pass pack lowering (measured in round 2: the XLA tail cost 49.8%
+    of a training step on chip).
+
+    Returns (new_residual [flat], words_all [n_peer, W_local],
+    scales_all [n_peer, k], scales_local [k])."""
+    r = residual.reshape(ctx.rows_local, LANES)
+    row_leaf, rowcount, live = ctx.local_slices()
+    scales = _leaf_scales(r, row_leaf, live, ctx.ns, ctx.k, policy, ctx.shard_ax)
+    if pallas_tier:
+        from ..ops import codec_pallas
+
+        words, r2 = codec_pallas.quantize_rows(scales[row_leaf], rowcount, residual)
+    else:
+        s_row = scales[row_leaf][:, None]  # (rows, 1)
+        # sign-quantize + error feedback (reference :166-174)
+        neg = r <= 0.0
+        bits = jnp.logical_and(live, neg)
+        sent = jnp.where(neg, -s_row, s_row)
+        r2 = jnp.where(
+            live & (s_row > 0), r - sent, jnp.where(live, r, 0.0)
+        ).reshape(-1)
+        words = pack_bits(bits.reshape(-1))
+    words_all = jax.lax.all_gather(words, ctx.peer_ax)  # (n_peer, W_local)
+    scales_all = jax.lax.all_gather(scales, ctx.peer_ax)  # (n_peer, k)
+    return r2, words_all, scales_all, scales
+
+
+def _codec_apply(ctx: _StepCtx, pallas_tier: bool, values, words_all, scales_all):
+    """Receiver half, per shard block: apply the sum of every OTHER peer's
+    frame (split horizon = zero out OUR column of the per-frame scales; a
+    zero-scale frame contributes exactly nothing) to the local replica, in
+    one pass (fused Pallas on TPU). Result clamped to +/-codec.SAT like
+    every state-mutating path. Shared by build_sync_step and
+    build_sync_phases."""
+    row_leaf, rowcount, live = ctx.local_slices()
+    me = jax.lax.axis_index(ctx.peer_ax)
+    s_all = scales_all[:, row_leaf]  # (n_peer, rows_local)
+    s_all = jnp.where((jnp.arange(ctx.n_peer) == me)[:, None], 0.0, s_all)
+    if pallas_tier:
+        from ..ops import codec_pallas
+
+        words2d = (
+            words_all.reshape(ctx.n_peer, ctx.rows_local, LANES // 32)
+            .transpose(1, 0, 2)
+            .reshape(ctx.rows_local, ctx.n_peer * (LANES // 32))
+        )
+        (v2,) = codec_pallas.apply_rows_batch(
+            s_all.T, rowcount, words2d, (values,)
+        )
+        return v2
+    v = values.reshape(ctx.rows_local, LANES)
+    bits_all = (
+        unpack_bits(words_all)
+        .reshape(ctx.n_peer, ctx.rows_local, LANES)
+        .astype(jnp.float32)
+    )
+    # elementwise+sum (VPU): s is a power of 2 and bits are 0/1, but under
+    # RMS policy s is arbitrary — keep the arithmetic exact f32, no MXU
+    delta = jnp.sum(s_all[:, :, None] * (1.0 - 2.0 * bits_all), axis=0)
+    v2 = jnp.where(live, jnp.clip(v + delta, -SAT, SAT), 0.0)
+    return v2.reshape(-1)
+
+
 def build_sync_step(
     mesh: Mesh,
     spec: TableSpec,
@@ -212,109 +343,37 @@ def build_sync_step(
     elsewhere; "pallas"/"xla" pin a tier (parity tests).
     """
     cfg = config or MeshConfig()
-    peer_ax, shard_ax = cfg.peer_axis, cfg.shard_axis
-    n_peer = mesh.shape[peer_ax]
-    n_shard = mesh.shape[shard_ax]
-    rows_local = rows_per_shard(spec.total, n_shard)
-    # reduce over the shard axis even when its size is 1 (a no-op collective):
-    # it also lets shard_map infer the scales output is shard-replicated
-    shard_axis = shard_ax
+    ctx = _make_ctx(mesh, spec, per_leaf, cfg)
+    peer_ax, shard_ax = ctx.peer_ax, ctx.shard_ax
 
-    if per_leaf:
-        k = spec.num_leaves
-        row_leaf_full = jnp.asarray(spec.row_leaf())
-        ns = jnp.asarray(np.asarray(spec.ns, dtype=np.float32))
-    else:
-        # one global scale over the whole table (the reference's exact
-        # behavior, src/sharedtensor.c:153-159) — a single segment
-        k = 1
-        row_leaf_full = jnp.zeros((spec.total // LANES,), jnp.int32)
-        ns = jnp.asarray([float(spec.total_n)], jnp.float32)
-    rowcount_full = jnp.asarray(spec.live_rowcount())
-
-    def _local_slices():
-        sid = jax.lax.axis_index(shard_ax)
-        start = sid * rows_local
-        row_leaf = jax.lax.dynamic_slice_in_dim(row_leaf_full, start, rows_local)
-        rowcount = jax.lax.dynamic_slice_in_dim(rowcount_full, start, rows_local)
-        lane = jax.lax.broadcasted_iota(jnp.int32, (rows_local, LANES), 1)
-        live = lane < rowcount[:, None]
-        return row_leaf, rowcount, live
-
-    def _compressed_pallas(values, residual):
-        """The TPU production tier: the codec halves around the all-gather run
-        as the fused Pallas row kernels (ops/codec_pallas.py) — one HBM pass
-        each — instead of XLA's multi-pass pack/unpack lowering (measured in
-        round 2: the XLA tail cost 49.8% of a training step on chip)."""
-        from ..ops import codec_pallas
-
-        r = residual.reshape(rows_local, LANES)
-        row_leaf, rowcount, live = _local_slices()
-        scales = _leaf_scales(r, row_leaf, live, ns, k, policy, shard_axis)
-        # sender half, fused: sign + LSB-first pack + error feedback
-        words, r2 = codec_pallas.quantize_rows(scales[row_leaf], rowcount, residual)
-        # the wire: 1 bit/elem + k scales per peer over ICI
-        words_all = jax.lax.all_gather(words, peer_ax)  # (n_peer, W)
-        scales_all = jax.lax.all_gather(scales, peer_ax)  # (n_peer, k)
-        # receiver half, fused: split horizon = zero out OUR column of the
-        # per-frame scales (a zero-scale frame contributes exactly nothing),
-        # then one unpack+sum+apply pass over all n_peer frames
-        me = jax.lax.axis_index(peer_ax)
-        s_all = scales_all[:, row_leaf]  # (n_peer, rows)
-        s_all = jnp.where((jnp.arange(n_peer) == me)[:, None], 0.0, s_all)
-        words2d = (
-            words_all.reshape(n_peer, rows_local, LANES // 32)
-            .transpose(1, 0, 2)
-            .reshape(rows_local, n_peer * (LANES // 32))
-        )
-        (v2,) = codec_pallas.apply_rows_batch(s_all.T, rowcount, words2d, (values,))
-        return v2, r2, scales
-
-    def _compressed(values, residual):
-        v = values.reshape(rows_local, LANES)
-        r = residual.reshape(rows_local, LANES)
-        row_leaf, rowcount, live = _local_slices()
-        scales = _leaf_scales(r, row_leaf, live, ns, k, policy, shard_axis)
-        s_row = scales[row_leaf][:, None]  # (rows, 1)
-        # sender half: sign-quantize + error feedback (reference :166-174)
-        neg = r <= 0.0
-        bits = jnp.logical_and(live, neg)
-        sent = jnp.where(neg, -s_row, s_row)
-        r2 = jnp.where(live & (s_row > 0), r - sent, jnp.where(live, r, 0.0))
-        words = pack_bits(bits.reshape(-1))
-        # the wire: 1 bit/elem + k scales per peer over ICI
-        words_all = jax.lax.all_gather(words, peer_ax)  # (n_peer, W)
-        scales_all = jax.lax.all_gather(scales, peer_ax)  # (n_peer, k)
-        # receiver half: sum of every OTHER peer's delta (split horizon)
-        me = jax.lax.axis_index(peer_ax)
-        bits_all = (
-            unpack_bits(words_all).reshape(n_peer, rows_local, LANES).astype(jnp.float32)
-        )
-        s_all = scales_all[:, row_leaf][:, :, None]  # (n_peer, rows, 1)
-        others = (jnp.arange(n_peer) != me).astype(jnp.float32)[:, None, None]
-        # elementwise+sum (VPU): s is a power of 2 and bits are 0/1, but under
-        # RMS policy s is arbitrary — keep the arithmetic exact f32, no MXU
-        delta = jnp.sum(others * s_all * (1.0 - 2.0 * bits_all), axis=0)
-        v2 = jnp.where(live, v + delta, 0.0)
-        return v2.reshape(-1), r2.reshape(-1), scales
-
-    def _exact(values, residual):
-        r = residual.reshape(rows_local, LANES)
-        row_leaf, rowcount, live = _local_slices()
-        # report the would-have-been scales so both arms expose the same
-        # observability surface
-        scales = _leaf_scales(r, row_leaf, live, ns, k, policy, shard_axis)
-        delta_others = jax.lax.psum(residual, peer_ax) - residual
-        v2 = values + delta_others
-        v2 = jnp.where(live.reshape(-1), v2, 0.0)
-        return v2, jnp.zeros_like(residual), scales
-
+    pallas_tier = False
     if compressed:
         from ..ops.table import _resolve_impl
 
-        body = _compressed_pallas if _resolve_impl(impl) == "pallas" else _compressed
-    else:
-        body = _exact
+        pallas_tier = _resolve_impl(impl) == "pallas"
+
+    def _compressed_body(values, residual):
+        """Compose the shared codec halves (same blocks as
+        build_sync_phases — the compose-parity test pins the equivalence)."""
+        r2, words_all, scales_all, scales = _codec_send(
+            ctx, policy, pallas_tier, residual
+        )
+        v2 = _codec_apply(ctx, pallas_tier, values, words_all, scales_all)
+        return v2, r2, scales
+
+    def _exact(values, residual):
+        r = residual.reshape(ctx.rows_local, LANES)
+        row_leaf, rowcount, live = ctx.local_slices()
+        # report the would-have-been scales so both arms expose the same
+        # observability surface (the shard-axis reduction inside also lets
+        # shard_map infer the scales output is shard-replicated)
+        scales = _leaf_scales(r, row_leaf, live, ctx.ns, ctx.k, policy, shard_ax)
+        delta_others = jax.lax.psum(residual, peer_ax) - residual
+        v2 = jnp.clip(values + delta_others, -SAT, SAT)
+        v2 = jnp.where(live.reshape(-1), v2, 0.0)
+        return v2, jnp.zeros_like(residual), scales
+
+    body = _compressed_body if compressed else _exact
 
     def _step(values, residual):
         # local blocks: (1, spec.total // n_shard)
@@ -329,7 +388,7 @@ def build_sync_step(
         out_specs=(spec_vr, spec_vr, P(peer_ax, None)),
         # pallas_call outputs carry no varying-mesh-axes annotation; disable
         # the vma checker for the kernel body (the XLA body keeps it)
-        check_vma=body is not _compressed_pallas,
+        check_vma=not pallas_tier,
     )
 
     def sync_step(state: PeerSyncState) -> Tuple[PeerSyncState, jax.Array]:
@@ -341,6 +400,82 @@ def build_sync_step(
     # Raw (traceable) form for embedding into a larger jitted step
     # (train/async_sgd.py fuses grads + add_updates + sync into one program).
     return sync_step
+
+
+def build_sync_phases(
+    mesh: Mesh,
+    spec: TableSpec,
+    policy: ScalePolicy = ScalePolicy.POW2_RMS,
+    per_leaf: bool = True,
+    config: MeshConfig | None = None,
+    impl: str = "auto",
+):
+    """The sync step split into its two halves, for the OVERLAP training mode
+    (train/async_sgd.py ``overlap=True``):
+
+      ``send(residual) -> (residual', words_all, scales_all)`` — quantize the
+      outgoing residual (error feedback applied) and all-gather the packed
+      frames over the peer axis. Depends ONLY on the residual.
+
+      ``apply_gathered(values, words_all, scales_all) -> values'`` — apply
+      every OTHER peer's frame (split horizon) to the local replica.
+
+    Running ``send`` at the top of a fused train step and ``apply_gathered``
+    after the backward pass gives XLA's latency-hiding scheduler a window the
+    full width of the grad computation to run the all-gather in — the
+    collective rides ICI while the MXU does the backward pass. This realizes
+    the reference's core property, compute never waits for sync
+    (README.md:24 "fully asynchronous"; SURVEY.md §7.4 hard part 1), at the
+    cost that the local update added AFTER ``send`` rides the NEXT frame
+    (one-step-later delivery — indistinguishable under the reference's
+    always-streaming semantics, where a frame carries whatever residual mass
+    exists at frame time).
+
+    Composing ``apply_gathered(values, *send(residual)[1:])`` immediately is
+    bit-for-bit ``build_sync_step`` (tests pin this).
+
+    Shapes: ``words_all`` u32[n_peer, total//32] sharded over the shard axis;
+    ``scales_all`` f32[n_peer, num_leaves] replicated (row p = the scales
+    peer p transmitted — the same observability surface as build_sync_step).
+    """
+    from ..ops.table import _resolve_impl
+
+    cfg = config or MeshConfig()
+    ctx = _make_ctx(mesh, spec, per_leaf, cfg)
+    pallas_tier = _resolve_impl(impl) == "pallas"
+    spec_vr = P(ctx.peer_ax, ctx.shard_ax)
+
+    def _send(residual_blk):
+        r2, words_all, scales_all, _ = _codec_send(
+            ctx, policy, pallas_tier, residual_blk[0]
+        )
+        return r2[None], words_all, scales_all
+
+    # check_vma off: the gathered outputs ARE peer-replicated (all_gather
+    # over the peer axis returns identical stacks everywhere) but the
+    # varying-mesh-axes inference cannot see that through a collective's
+    # output; correctness is pinned by the compose-parity test against the
+    # fused (vma-checked) step instead.
+    send = shard_map(
+        _send,
+        mesh=mesh,
+        in_specs=(spec_vr,),
+        out_specs=(spec_vr, P(None, ctx.shard_ax), P(None, None)),
+        check_vma=False,
+    )
+
+    def _apply(values_blk, words_all, scales_all):
+        v2 = _codec_apply(ctx, pallas_tier, values_blk[0], words_all, scales_all)
+        return v2[None]
+
+    apply_gathered = shard_map(
+        _apply,
+        mesh=mesh,
+        in_specs=(spec_vr, P(None, ctx.shard_ax), P(None, None)),
+        out_specs=spec_vr,
+        check_vma=False,
+    )
+    return send, apply_gathered
 
 
 def frame_ici_bytes(spec: TableSpec, n_peer: int, compressed: bool = True) -> int:

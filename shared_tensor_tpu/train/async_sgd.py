@@ -41,6 +41,7 @@ from ..parallel.ici import (
     PeerSyncState,
     add_updates,
     add_updates_raw,
+    build_sync_phases,
     build_sync_step,
     init_state,
     read_peer,
@@ -57,6 +58,7 @@ def build_train_step(
     sync: bool = True,
     config: MeshConfig | None = None,
     optimizer=None,
+    overlap: bool = False,
 ):
     """Compile ``(state, opt_state, batch, lr) -> (state', opt_state',
     per-peer loss, scales)``.
@@ -73,8 +75,21 @@ def build_train_step(
     must be elementwise (momentum/adam/rmsprop/...), since it sees the padded
     flat buffer, not the parameter tree. Its additive updates flow through
     the same path as plain SGD deltas: visible locally at once, compressed
-    toward the group."""
+    toward the group.
+
+    ``overlap=True`` (compressed sync only) reorders the fused program so
+    the ICI all-gather has no data dependency on this step's compute: the
+    CURRENT residual is quantized + gathered first, grads run in the middle,
+    and the gathered frames + local update land at the end — XLA's latency-
+    hiding scheduler then runs the collective under the backward pass
+    instead of serializing after it (the reference's "compute never waits
+    for sync", README.md:24; SURVEY.md §7.4 hard part 1). The local update
+    is delivered one step later; eventual consistency is unchanged.
+    ``apply_gathered(values, *send(residual)[1:])`` composed immediately is
+    bit-for-bit the non-overlap sync (tests pin this)."""
     cfg = config or MeshConfig()
+    if overlap and (not sync or not compressed):
+        raise ValueError("overlap=True requires sync=True and compressed=True")
     sync_raw = (
         build_sync_step(
             mesh,
@@ -85,7 +100,14 @@ def build_train_step(
             config=cfg,
             jit_compile=False,
         )
-        if sync
+        if sync and not overlap
+        else None
+    )
+    phases = (
+        build_sync_phases(
+            mesh, spec, policy=policy, per_leaf=per_leaf, config=cfg
+        )
+        if sync and overlap
         else None
     )
     k = spec.num_leaves if per_leaf else 1
@@ -97,6 +119,25 @@ def build_train_step(
         return loss, flatten(grads, spec)
 
     def _step(state: PeerSyncState, opt_state, batch, lr):
+        if phases is not None:
+            # OVERLAP mode: quantize + all-gather the residual as it stands —
+            # no data dependency on this step's grads, so XLA's latency-
+            # hiding scheduler runs the collective under the backward pass.
+            # The local update below rides the NEXT step's frame (async
+            # semantics unchanged: a frame carries whatever residual mass
+            # exists at frame time, exactly like the reference's streams).
+            send, apply_gathered = phases
+            r2, words_all, scales_all = send(state.residual)
+            losses, g = jax.vmap(per_peer)(state.values, batch)
+            if optimizer is None:
+                updates = -lr * g
+            else:
+                updates, opt_state = jax.vmap(optimizer.update)(
+                    g, opt_state, state.values
+                )
+            v2 = apply_gathered(state.values, words_all, scales_all)
+            state = add_updates_raw(PeerSyncState(v2, r2), updates)
+            return state, opt_state, losses, scales_all
         losses, g = jax.vmap(per_peer)(state.values, batch)
         if optimizer is None:
             updates = -lr * g
@@ -131,6 +172,7 @@ class PodTrainer:
     compressed: bool = True
     sync: bool = True
     optimizer: Any = None  # optax GradientTransformation (see build_train_step)
+    overlap: bool = False  # collective under the backward pass (see build_train_step)
 
     def __post_init__(self):
         self.spec: TableSpec = make_spec(self.template)
@@ -153,6 +195,7 @@ class PodTrainer:
             sync=self.sync,
             config=self.mesh_config,
             optimizer=self.optimizer,
+            overlap=self.overlap,
         )
         self.steps = 0
 

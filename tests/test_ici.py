@@ -238,3 +238,38 @@ def test_global_scale_mode():
     for p in range(2):
         f, _ = quantize_table(jnp.asarray(r0[p]), spec, ScalePolicy.POW2_RMS, False)
         np.testing.assert_array_equal(np.asarray(scales[p]), np.asarray(f.scales)[:1])
+
+
+@pytest.mark.parametrize("n_shard", [1, 2])
+def test_sync_phases_compose_to_sync_step(n_shard):
+    """build_sync_phases is the fused step split in two: composing
+    apply_gathered(values, *send(residual)[1:]) immediately must be
+    bit-for-bit build_sync_step (the overlap training mode's correctness
+    anchor, train/async_sgd.py overlap=True)."""
+    from shared_tensor_tpu.parallel import build_sync_phases
+
+    tpl = template(11)
+    spec = make_spec(tpl)
+    mesh = make_mesh(4, n_shard)
+    ups = jnp.stack(
+        [
+            flatten(jax.tree.map(lambda x: (0.07 * (p + 1)) * x, tpl), spec)
+            for p in range(4)
+        ]
+    )
+    state = add_updates(init_state(mesh, spec, tpl), ups)
+    fused, scales_f = jax.block_until_ready(build_sync_step(mesh, spec)(state))
+
+    state2 = add_updates(init_state(make_mesh(4, n_shard), spec, tpl), ups)
+    send, apply_gathered = build_sync_phases(mesh, spec)
+
+    @jax.jit
+    def composed(st):
+        r2, words_all, scales_all = send(st.residual)
+        v2 = apply_gathered(st.values, words_all, scales_all)
+        return v2, r2, scales_all
+
+    v2, r2, scales = jax.block_until_ready(composed(state2))
+    np.testing.assert_array_equal(np.asarray(v2), np.asarray(fused.values))
+    np.testing.assert_array_equal(np.asarray(r2), np.asarray(fused.residual))
+    np.testing.assert_array_equal(np.asarray(scales), np.asarray(scales_f))

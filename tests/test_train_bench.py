@@ -29,7 +29,9 @@ def test_train_bench_emits_all_arms():
     line = proc.stdout.strip().splitlines()[-1]
     out = json.loads(line)
     assert out["metric"] == "train_step_bench"
-    assert set(out["arms"]) == {"sync_off", "compressed", "exact"}
+    assert set(out["arms"]) == {
+        "sync_off", "compressed", "compressed_overlap", "exact"
+    }
     for name, arm in out["arms"].items():
         assert "error" not in arm, (name, arm)
         assert arm["tokens_per_s"] > 0

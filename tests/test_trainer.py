@@ -138,3 +138,42 @@ def test_optax_optimizer_trains():
         last = mean
     assert last < first * 0.8, (first, last)
     assert tr.opt_state is not None
+
+
+def test_overlap_trainer_trains_and_stays_consistent():
+    """overlap=True (collective under the backward pass): loss decreases,
+    replicas stay mutually consistent, and after training stops the extra
+    sync steps drain every replica to the same point (the one-step-later
+    delivery must not strand any mass)."""
+    tr = _trainer(n_peer=4, overlap=True)
+    first = last = None
+    for i in range(80):
+        batch = tr.shard_batch(_batches(jax.random.key(i), 4))
+        losses, scales = tr.step(batch, lr=0.3)
+        mean = float(jnp.mean(losses))
+        first = mean if first is None else first
+        last = mean
+    assert last < first * 0.9, (first, last)
+    # drain: sync-only steps deliver the in-flight tail. Heavy-tailed grad
+    # residuals drain their outliers only +/-scale per frame (same as the C
+    # reference), so the bar is "shrinks like the fused trainer does", not
+    # exact zero: measured fused-mode spread after the same 40 drains is
+    # ~0.017 on this config.
+    import numpy as np
+
+    from shared_tensor_tpu.parallel.ici import build_sync_step
+
+    spread0 = tr.replica_spread()
+    drain = build_sync_step(tr.mesh, tr.spec)
+    for _ in range(40):
+        tr.state, _ = drain(tr.state)
+    spread = tr.replica_spread()
+    assert spread < 0.05 and spread < spread0, (spread0, spread)
+    assert np.isfinite(np.asarray(tr.state.values)).all()
+
+
+def test_overlap_requires_compressed_sync():
+    import pytest
+
+    with pytest.raises(ValueError):
+        _trainer(n_peer=2, overlap=True, compressed=False)

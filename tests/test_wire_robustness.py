@@ -105,9 +105,10 @@ def test_compat_nonfinite_scale_is_keepalive():
     assert wire.decode_compat_frame(payload, spec) is None
 
 
-def test_native_corrupt_scales_zeroed():
-    """Native tier: decode_frame zeroes exactly the non-finite and
-    above-corruption-ceiling scales and keeps sane ones."""
+def test_native_nonfinite_scales_zeroed():
+    """Native tier: decode_frame zeroes exactly the non-finite scales and
+    keeps finite ones (every finite f32 is inside the protocol's legal scale
+    domain — residuals clamp at +/-SAT, so scales range up to 2^127)."""
     tpl = {"a": jnp.zeros((8, 128), jnp.float32), "b": jnp.zeros((128,), jnp.float32)}
     spec = make_spec(tpl)
     k, w = spec.num_leaves, spec.total // 32
@@ -117,10 +118,31 @@ def test_native_corrupt_scales_zeroed():
     np.testing.assert_array_equal(
         np.asarray(frame.scales), np.asarray([0.0, 0.25], np.float32)
     )
-    # an exponent-field bit flip producing a huge-but-finite scale is
-    # corruption too: 2^120 goes to 0, the legit leaf survives
     scales = struct.pack("<ff", 2.0**120, 1.5)
     frame = wire.decode_frame(bytes([wire.DATA]) + scales + b"\x00" * (4 * w), spec)
     np.testing.assert_array_equal(
-        np.asarray(frame.scales), np.asarray([0.0, 1.5], np.float32)
+        np.asarray(frame.scales), np.asarray([2.0**120, 1.5], np.float32)
     )
+
+
+def test_apply_saturates_no_absorbing_inf():
+    """A max-scale frame applied to values already at the +/-SAT clamp must
+    saturate, not overflow: inf would be an absorbing state (inf - inf = NaN
+    floods tree-wide — quirk Q9's receive-path arm). All codec tiers clamp
+    the apply result (ops/codec.SAT)."""
+    from shared_tensor_tpu.core import SharedTensor
+    from shared_tensor_tpu.ops.codec import SAT
+
+    tpl = jnp.full((256,), SAT, jnp.float32)
+    st = SharedTensor(tpl, seed_values=True)
+    spec = st.spec
+    w = spec.total // 32
+    # scale 2^127 (the largest a legal residual can produce), all bits clear
+    # => +scale everywhere
+    payload = (
+        bytes([wire.DATA]) + struct.pack("<f", 2.0**127) + b"\x00" * (4 * w)
+    )
+    st.receive_frame(1, wire.decode_frame(payload, spec))
+    got = np.asarray(st.snapshot_flat())
+    assert np.isfinite(got).all()
+    assert got.max() <= SAT
