@@ -30,7 +30,9 @@ def train_pod(text: bytes, cfg, args) -> None:
     n = args.peers
     mesh = make_mesh(n, 1)
     params = m.init_params(jax.random.key(0), cfg)
-    tr = PodTrainer(mesh, params, lambda p, b: m.loss_fn(p, b, cfg))
+    tr = PodTrainer(
+        mesh, params, lambda p, b: m.loss_fn(p, b, cfg), overlap=args.overlap
+    )
     data = m.encode_corpus(text)
     print(f"{cfg.param_count} params, {n} peers, backend={jax.default_backend()}")
     t0 = time.perf_counter()
@@ -83,6 +85,10 @@ def main() -> None:
     ap.add_argument("--lr", type=float, default=0.5)
     ap.add_argument("--hidden", type=int, default=512)
     ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument(
+        "--overlap", action="store_true",
+        help="schedule the ICI sync collective under the backward pass",
+    )
     args = ap.parse_args()
 
     if args.corpus:

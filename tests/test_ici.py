@@ -240,27 +240,29 @@ def test_global_scale_mode():
         np.testing.assert_array_equal(np.asarray(scales[p]), np.asarray(f.scales)[:1])
 
 
-@pytest.mark.parametrize("n_shard", [1, 2])
-def test_sync_phases_compose_to_sync_step(n_shard):
+@pytest.mark.parametrize("n_peer,n_shard", [(1, 1), (4, 1), (4, 2)])
+def test_sync_phases_compose_to_sync_step(n_peer, n_shard):
     """build_sync_phases is the fused step split in two: composing
     apply_gathered(values, *send(residual)[1:]) immediately must be
     bit-for-bit build_sync_step (the overlap training mode's correctness
-    anchor, train/async_sgd.py overlap=True)."""
+    anchor, train/async_sgd.py overlap=True). The (1, 1) case runs on a
+    single real chip (ST_TEST_PLATFORM=axon), compiling the shard_map +
+    Pallas phase path on hardware."""
     from shared_tensor_tpu.parallel import build_sync_phases
 
     tpl = template(11)
     spec = make_spec(tpl)
-    mesh = make_mesh(4, n_shard)
+    mesh = make_mesh(n_peer, n_shard)
     ups = jnp.stack(
         [
             flatten(jax.tree.map(lambda x: (0.07 * (p + 1)) * x, tpl), spec)
-            for p in range(4)
+            for p in range(n_peer)
         ]
     )
     state = add_updates(init_state(mesh, spec, tpl), ups)
     fused, scales_f = jax.block_until_ready(build_sync_step(mesh, spec)(state))
 
-    state2 = add_updates(init_state(make_mesh(4, n_shard), spec, tpl), ups)
+    state2 = add_updates(init_state(make_mesh(n_peer, n_shard), spec, tpl), ups)
     send, apply_gathered = build_sync_phases(mesh, spec)
 
     @jax.jit
