@@ -1,0 +1,67 @@
+"""Capture a jax.profiler trace of the flagship training step (verdict r2
+item 6: a committed trace artifact attributing step time).
+
+Runs a few warm steps, then traces a short chained run of each arm
+(sync_off / compressed / compressed_overlap) into ``--out`` (default
+PROFILE_TRACE_r03/). The trace directory is the artifact; load it with
+TensorBoard's profile plugin or xprof.
+
+Usage: python benchmarks/profile_trace.py [--out DIR] [--steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="PROFILE_TRACE_r03")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=256)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from shared_tensor_tpu.models import char_rnn as m
+    from shared_tensor_tpu.parallel.mesh import make_mesh
+    from shared_tensor_tpu.train.async_sgd import PodTrainer
+    from shared_tensor_tpu.utils.profiling import trace
+
+    cfg = m.CharRNNConfig()  # flagship
+    n_peer = len(jax.devices())
+    mesh = make_mesh(n_peer, 1)
+    params = m.init_params(jax.random.key(0), cfg)
+    loss = lambda p, b: m.loss_fn(p, b, cfg)
+    text = b"the quick brown fox jumps over the lazy dog. " * 200
+    batch = m.make_batches(
+        text, batch=args.batch, seq=args.seq, key=jax.random.key(1),
+        n_peer=n_peer, vocab=cfg.vocab,
+    )
+
+    arms = [
+        ("sync_off", dict(sync=False)),
+        ("compressed", dict(sync=True, compressed=True)),
+        ("compressed_overlap", dict(sync=True, compressed=True, overlap=True)),
+    ]
+    for name, kw in arms:
+        tr = PodTrainer(mesh, params, loss, **kw)
+        b = tr.shard_batch(batch)
+        for _ in range(3):  # compile + warm
+            tr.step(b, lr=0.1)
+        jax.block_until_ready(tr.state.values)
+        with trace(os.path.join(args.out, name)):
+            for _ in range(args.steps):
+                losses, _ = tr.step(b, lr=0.1)
+            jax.block_until_ready(losses)
+        print(f"traced {name} -> {args.out}/{name}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
