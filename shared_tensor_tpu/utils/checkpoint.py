@@ -90,13 +90,14 @@ def load_shared(st: SharedTensor, path: str) -> None:
             for lid in meta.get("links", [])
             if f"link_{lid}" in z
         }
-    import jax.numpy as jnp
-
     with st._lock:
-        st.values = jnp.asarray(values)
+        # _asarray keeps the tensor's codec tier: numpy arrays on the host
+        # tier (a jnp restore would silently bounce every later frame
+        # through jax<->numpy conversions), jax arrays on device tiers.
+        st.values = st._asarray(values)
         for lid, r in links.items():
             if lid in st._links:
-                st._links[lid] = jnp.asarray(r)
+                st._links[lid] = st._asarray(r)
 
 
 def save_pod(state: "PeerSyncState", spec: TableSpec, path: str) -> None:
