@@ -71,12 +71,16 @@ def test_add_propagates_between_pods():
 def test_two_pod_training_converges_to_mixture():
     """Pod A trains toward +2, pod B toward -2; through the bridge both
     models settle near the mixture (0) instead of their local target —
-    proof the cross-pod deltas actually steer training."""
+    proof the cross-pod deltas actually steer training. Pod B runs the
+    overlap sync mode (collective under the backward pass) against pod A's
+    fused mode: the modes must interoperate through the bridge."""
     mesh_a, mesh_b = _meshes()
     port = _free_port()
     a = HierarchicalTrainer.create(mesh_a, "127.0.0.1", port, _template(), _quad_loss)
     try:
-        b = HierarchicalTrainer.create(mesh_b, "127.0.0.1", port, _template(), _quad_loss)
+        b = HierarchicalTrainer.create(
+            mesh_b, "127.0.0.1", port, _template(), _quad_loss, overlap=True
+        )
         try:
             ta = jnp.full((2, 8), 2.0)
             tb = jnp.full((2, 8), -2.0)
