@@ -173,6 +173,12 @@ class PodTrainer:
     sync: bool = True
     optimizer: Any = None  # optax GradientTransformation (see build_train_step)
     overlap: bool = False  # collective under the backward pass (see build_train_step)
+    #: Pod steps per sync exchange. With k > 1, k-1 steps run the no-sync
+    #: program (updates accumulate in the residual — the module docstring's
+    #: freshness-for-bandwidth trade, the analog of the reference's natural
+    #: TCP backpressure pacing) and every k-th step syncs the accumulated sum
+    #: as ONE frame.
+    sync_every: int = 1
 
     def __post_init__(self):
         self.spec: TableSpec = make_spec(self.template)
@@ -185,17 +191,24 @@ class PodTrainer:
             if self.optimizer is None
             else jax.vmap(self.optimizer.init)(self.state.values)
         )
-        self._step = build_train_step(
-            self.mesh,
-            self.spec,
-            self.loss_fn,
+        self.sync_every = max(1, int(self.sync_every))
+        kw = dict(
             policy=self.codec.scale_policy,
             per_leaf=self.codec.per_leaf_scale,
             compressed=self.compressed,
-            sync=self.sync,
             config=self.mesh_config,
             optimizer=self.optimizer,
-            overlap=self.overlap,
+        )
+        self._step = build_train_step(
+            self.mesh, self.spec, self.loss_fn,
+            sync=self.sync, overlap=self.overlap, **kw,
+        )
+        # the off-beat program for sync_every > 1: identical step, no
+        # exchange — updates pile into the residual until the sync beat
+        self._step_local = (
+            build_train_step(self.mesh, self.spec, self.loss_fn, sync=False, **kw)
+            if self.sync and self.sync_every > 1
+            else None
         )
         self.steps = 0
 
@@ -211,10 +224,14 @@ class PodTrainer:
         return jax.tree.map(put, batch)
 
     def step(self, batch: Any, lr: float = 1e-2):
-        """One fused train+sync step. Returns (per-peer losses f32[n_peer],
-        per-peer-leaf scales); state advances in place. With an optax
-        ``optimizer``, ``lr`` is ignored (the transform owns the step size)."""
-        self.state, self.opt_state, losses, scales = self._step(
+        """One fused train step (+sync on every ``sync_every``-th call).
+        Returns (per-peer losses f32[n_peer], per-peer-leaf scales); state
+        advances in place. With an optax ``optimizer``, ``lr`` is ignored
+        (the transform owns the step size)."""
+        fn = self._step
+        if self._step_local is not None and (self.steps + 1) % self.sync_every:
+            fn = self._step_local
+        self.state, self.opt_state, losses, scales = fn(
             self.state, self.opt_state, batch, jnp.float32(lr)
         )
         self.steps += 1

@@ -64,12 +64,20 @@ class HierarchicalTrainer:
         sync_every: int = 1,
         peer_config=None,
         timeout: float = 30.0,
+        pod_sync_every: int = 1,
         **pod_kwargs,
     ) -> "HierarchicalTrainer":
         """create_or_fetch at pod granularity: become the master pod (seeded
         from ``template``) or join the tree and start the pod from the
         replica state the tree transferred (the reference's
-        state-transfer-through-codec join, src/sharedtensor.c:379-391)."""
+        state-transfer-through-codec join, src/sharedtensor.c:379-391).
+
+        Two pacing knobs, one per tier: ``sync_every`` = pod steps between
+        TREE exchanges (this class's own pacing); ``pod_sync_every`` = pod
+        steps between INTRA-POD ICI exchanges (threaded to
+        ``PodTrainer.sync_every`` — it cannot ride ``pod_kwargs`` because
+        the name collides with this function's parameter)."""
+        pod_kwargs.setdefault("sync_every", pod_sync_every)
         from ..comm.peer import create_or_fetch
 
         peer = create_or_fetch(host, port, template, peer_config, timeout)

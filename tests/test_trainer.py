@@ -159,8 +159,6 @@ def test_overlap_trainer_trains_and_stays_consistent():
     # reference), so the bar is "shrinks like the fused trainer does", not
     # exact zero: measured fused-mode spread after the same 40 drains is
     # ~0.017 on this config.
-    import numpy as np
-
     from shared_tensor_tpu.parallel.ici import build_sync_step
 
     spread0 = tr.replica_spread()
@@ -177,3 +175,25 @@ def test_overlap_requires_compressed_sync():
 
     with pytest.raises(ValueError):
         _trainer(n_peer=2, overlap=True, compressed=False)
+
+
+def test_sync_every_paces_exchanges():
+    """sync_every=2: off-beat steps run the no-sync program (scales all 0,
+    updates pile into the residual); the beat step delivers the accumulated
+    sum as one frame. Training still converges and replicas stay bounded."""
+    tr = _trainer(n_peer=4, sync_every=2)
+    first = last = None
+    beat_scales, off_scales = [], []
+    for i in range(60):
+        batch = tr.shard_batch(_batches(jax.random.key(i), 4))
+        losses, scales = tr.step(batch, lr=0.3)
+        mean = float(jnp.mean(losses))
+        first = mean if first is None else first
+        last = mean
+        (beat_scales if tr.steps % 2 == 0 else off_scales).append(
+            float(jnp.max(scales))
+        )
+    assert last < first * 0.9, (first, last)
+    assert all(s == 0.0 for s in off_scales)  # off-beats exchange nothing
+    assert any(s > 0.0 for s in beat_scales)  # beats carry the frames
+    assert np.isfinite(np.asarray(tr.state.values)).all()
