@@ -41,7 +41,7 @@ CHILD = os.environ.get("ST_E2E_CHILD", "py")
 
 
 def _mk_peer(port: int):
-    import jax.numpy as jnp
+    import numpy as np
 
     from shared_tensor_tpu.comm.peer import create_or_fetch
     from shared_tensor_tpu.config import Config, TransportConfig
@@ -52,7 +52,10 @@ def _mk_peer(port: int):
         ),
         send_pipeline_depth=int(os.environ.get("ST_E2E_DEPTH", "8")),
     )
-    template = {"t": jnp.zeros((N,), jnp.float32)}
+    # numpy template: a host-tier (CPU) peer then never initializes a jax
+    # backend — the XLA CPU client's thread pool costs ~2.7x frame rate in
+    # contention with the C codec loops on a small host (bench.py rationale)
+    template = {"t": np.zeros((N,), np.float32)}
     return create_or_fetch("127.0.0.1", port, template, cfg, timeout=60.0)
 
 
@@ -63,12 +66,12 @@ def child(port: int) -> None:
     # the env alone cannot demote the platform (the site hook pins the TPU
     # plugin); the config update works as long as no backend is initialized
     jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import numpy as np
 
     peer = _mk_peer(port)
     rng = np.random.default_rng(1)
-    delta = {"t": jnp.asarray(rng.normal(size=N).astype(np.float32) * 1e-2)}
+    # numpy delta: keep this process jax-backend-free (see _mk_peer)
+    delta = {"t": rng.normal(size=N).astype(np.float32) * 1e-2}
     try:
         while True:
             peer.add(delta)  # keep residual mass alive -> links never idle
@@ -99,10 +102,17 @@ def main() -> None:
     plat = os.environ.get("ST_E2E_PARENT_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
-    backend = jax.default_backend()
-    from shared_tensor_tpu.ops import codec_pallas
+    if plat == "cpu":
+        # Don't initialize the backend at all: a host-tier parent with a
+        # live XLA CPU client loses ~2.7x frame rate to its thread pool
+        # (bench.py host-arm rationale). The tier decision in core.py reads
+        # the configured platform string, not the live backend.
+        backend, on_tpu = "cpu", False
+    else:
+        backend = jax.default_backend()
+        from shared_tensor_tpu.ops import codec_pallas
 
-    on_tpu = not codec_pallas._interpret()
+        on_tpu = not codec_pallas._interpret()
 
     peer = _mk_peer(port)  # master, on the default (TPU) backend
     if CHILD == "c":
@@ -127,11 +137,12 @@ def main() -> None:
             stderr=subprocess.DEVNULL,
         )
     try:
-        import jax.numpy as jnp
         import numpy as np
 
         rng = np.random.default_rng(0)
-        delta = {"t": jnp.asarray(rng.normal(size=N).astype(np.float32) * 1e-2)}
+        # numpy delta: host-tier parents stay backend-free; device tiers
+        # convert inside their jitted codec anyway
+        delta = {"t": rng.normal(size=N).astype(np.float32) * 1e-2}
 
         deadline = time.time() + 120
         while not peer.node.links and time.time() < deadline:

@@ -531,7 +531,8 @@ class SharedTensorPeer:
         elif kind == wire.DONE:
             buf = self._pending.pop(link, None)
             if buf is not None:
-                snap = jnp.asarray(np.frombuffer(bytes(buf), "<f4"))
+                # tier-native: numpy on the host tier (no backend init)
+                snap = self.st._asarray(np.frombuffer(bytes(buf), "<f4"))
                 self.st.new_link_diff(link, snap)
                 self._send_blocking(link, bytes([wire.WELCOME]))
                 self._wake.set()

@@ -37,7 +37,6 @@ import logging
 import struct
 from typing import Iterator, Optional
 
-import jax.numpy as jnp
 import numpy as np
 
 log = logging.getLogger("shared_tensor_tpu.wire")
@@ -101,7 +100,10 @@ def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
         )
         scales = np.where(np.isfinite(scales), scales, np.float32(0.0))
     words = np.frombuffer(payload, "<u4", count=w, offset=1 + 4 * k)
-    return TableFrame(jnp.asarray(scales), jnp.asarray(words))
+    # numpy, NOT jnp: a host-tier peer must never initialize a jax backend
+    # (thread-pool contention with its C codec loops); device tiers convert
+    # on entry to their jitted applies.
+    return TableFrame(np.ascontiguousarray(scales), np.ascontiguousarray(words))
 
 
 def encode_sync(spec: TableSpec) -> bytes:
@@ -207,5 +209,5 @@ def decode_compat_frame(payload: bytes, spec: TableSpec) -> Optional[TableFrame]
     raw = payload[4:].ljust(nwords * 4, b"\x00")
     words = np.frombuffer(raw, "<u4", count=nwords)
     return TableFrame(
-        jnp.full((1,), scale, jnp.float32), jnp.asarray(words)
+        np.full((1,), scale, np.float32), np.ascontiguousarray(words)
     )

@@ -75,13 +75,25 @@ class SharedTensor:
         if mode == "auto":
             # CPU backend specifically — on any accelerator (TPU or GPU) the
             # codec must stay a device computation; only a host-only backend
-            # should fall back to host loops.
-            self._np = jax.default_backend() == "cpu"
+            # should fall back to host loops. Prefer the configured platform
+            # string over jax.default_backend(): the latter INITIALIZES the
+            # backend, and a live XLA CPU client's thread pool contends with
+            # the host tier's C loops (measured on a 1-vCPU host: 2.7x
+            # slower frames). A host-tier node must never start a backend.
+            plat = jax.config.jax_platforms
+            if plat:
+                self._np = str(plat).split(",")[0] == "cpu"
+            else:
+                self._np = jax.default_backend() == "cpu"
         else:
             self._np = mode == "numpy"
         if seed_values:
-            flat = flatten(template, self.spec)
-            self.values = np.asarray(flat, np.float32) if self._np else flat
+            if self._np:
+                from .ops.codec_np import flatten_np
+
+                self.values = flatten_np(template, self.spec)
+            else:
+                self.values = flatten(template, self.spec)
         else:
             self.values = (
                 np.zeros(self.spec.total, np.float32)
@@ -250,6 +262,10 @@ class SharedTensor:
     def read(self) -> Any:
         """Snapshot of the replica as the caller's pytree structure
         (reference l_copyToTensor, src/sharedtensor.c:435-446)."""
+        if self._np:
+            from .ops.codec_np import unflatten_np
+
+            return unflatten_np(self.values, self.spec)
         return unflatten(self.values, self.spec)
 
     def snapshot_flat(self) -> jnp.ndarray:
@@ -262,7 +278,12 @@ class SharedTensor:
     def add(self, delta: Any) -> None:
         """Merge an additive update: replica and every link residual receive
         it (reference addFromInternal, src/sharedtensor.c:334-344)."""
-        update = flatten(delta, self.spec)
+        if self._np:
+            from .ops.codec_np import flatten_np
+
+            update = flatten_np(delta, self.spec)
+        else:
+            update = flatten(delta, self.spec)
         with self._lock:
             ids = tuple(self._links)
             arrays = (self.values, *(self._links[i] for i in ids))

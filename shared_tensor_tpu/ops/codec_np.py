@@ -131,6 +131,44 @@ def _leaf_slices(spec: TableSpec):
         off += p
 
 
+def flatten_np(tree, spec: TableSpec) -> np.ndarray:
+    """Numpy twin of ops.table.flatten (pytree -> padded flat f32 buffer,
+    padding exactly 0). The host tier must never run jax array ops: merely
+    creating a jnp array initializes the XLA CPU client, whose thread pool
+    contends with the C codec loops (measured 2.7x slower frames on a
+    1-vCPU host). jax.tree_util is pure Python and backend-free."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    if treedef != spec.treedef:
+        raise ValueError(
+            f"tree structure {treedef} does not match spec {spec.treedef}"
+        )
+    out = np.zeros(spec.total, np.float32)
+    for (off, n, _), leaf in zip(_leaf_slices(spec), leaves):
+        flat = np.ravel(np.asarray(leaf)).astype(np.float32, copy=False)
+        if flat.shape[0] != n:
+            raise ValueError(f"leaf has {flat.shape[0]} elements, spec expects {n}")
+        out[off : off + n] = flat
+    return out
+
+
+def unflatten_np(flat: np.ndarray, spec: TableSpec):
+    """Numpy twin of ops.table.unflatten. Leaves are COPIES, not views:
+    a view would alias the live replica buffer, and an in-place edit on a
+    read() snapshot would then mutate the replica behind the codec's back
+    (never entering any residual — permanent tree divergence). The device
+    tier gets this for free from jnp immutability."""
+    import jax
+
+    flat = np.asarray(flat)
+    leaves = [
+        flat[off : off + n].copy().reshape(shape)
+        for (off, n, _), shape in zip(_leaf_slices(spec), spec.shapes)
+    ]
+    return jax.tree.unflatten(spec.treedef, leaves)
+
+
 def compute_scales_np(
     residual: np.ndarray,
     spec: TableSpec,

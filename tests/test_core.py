@@ -283,3 +283,18 @@ def test_receive_frames_backlog_contract(monkeypatch):
         np.asarray(batched._links[2]), np.asarray(seq._links[2]),
         rtol=1e-6, atol=1e-6,
     )
+
+
+def test_host_tier_read_returns_copies():
+    """In-place edits on a read() snapshot must NOT reach the replica: the
+    host tier's numpy unflatten would alias the live buffer if it returned
+    views, silently diverging the tree (the device tier is immune — jnp
+    arrays are immutable)."""
+    tpl = {"w": np.ones((8, 16), np.float32)}
+    st = SharedTensor(tpl, seed_values=True)
+    snap = st.read()
+    arr = np.asarray(snap["w"])
+    if arr.flags.writeable:
+        arr += 99.0
+    got = np.asarray(st.read()["w"])
+    np.testing.assert_array_equal(got, np.ones((8, 16), np.float32))
