@@ -31,6 +31,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 N = int(os.environ.get("ST_E2E_N", str(1 << 20)))
 SECONDS = float(os.environ.get("ST_E2E_SECONDS", "10"))
 WARMUP = float(os.environ.get("ST_E2E_WARMUP", "3"))
+#: Seconds between add() calls on each side. An add costs one O(n) pass per
+#: link residual + replica; at large n a fixed 0.2 s cadence would burn a
+#: big share of the single core on adds instead of the codec stream being
+#: measured — scale the period with the table size.
+ADD_PERIOD = float(
+    os.environ.get("ST_E2E_ADD_PERIOD", str(max(0.2, N / (1 << 20) * 0.05)))
+)
 
 
 #: ST_E2E_CHILD=c runs the wire-compat arm: the child is native/stc_harness —
@@ -75,7 +82,7 @@ def child(port: int) -> None:
     try:
         while True:
             peer.add(delta)  # keep residual mass alive -> links never idle
-            time.sleep(0.2)  # big infrequent adds: the add itself is O(n)
+            time.sleep(ADD_PERIOD)  # big infrequent adds: the add itself is O(n)
             # host work and must not contend with the codec stream
     except Exception:
         pass
@@ -150,7 +157,7 @@ def main() -> None:
         t_end = time.time() + WARMUP
         while time.time() < t_end:
             peer.add(delta)
-            time.sleep(0.2)
+            time.sleep(ADD_PERIOD)
 
         link = peer.node.links[0]
         s0 = peer.node.stats(link)
@@ -159,7 +166,7 @@ def main() -> None:
         t_end = t0 + SECONDS
         while time.time() < t_end:
             peer.add(delta)
-            time.sleep(0.2)
+            time.sleep(ADD_PERIOD)
         dt = time.time() - t0
         s1 = peer.node.stats(link)
         frames_out = (peer.st.frames_out - f_out0) / dt

@@ -250,6 +250,88 @@ EXPORT void stc_add_inplace(float *values, const float *delta, int64_t total) {
   }
 }
 
+/* out[i] = clip(a[i] + delta[i]): the functional-update form of
+ * stc_add_inplace. One pass instead of copy-then-add — at table sizes past
+ * LLC the host tier is memory-bandwidth-bound and the extra copy pass was
+ * ~1/3 of the apply cost (measured at 16 Mi elements). */
+EXPORT void stc_add_to(float *out, const float *a, const float *delta,
+                       int64_t total) {
+  for (int64_t i = 0; i < total; i++) {
+    float s = a[i] + delta[i];
+    s = s > 3.0e38f ? 3.0e38f : s;
+    s = s < -3.0e38f ? -3.0e38f : s;
+    out[i] = s;
+  }
+}
+
+/* Fully fused single-frame apply: out = clip(in + s*(1-2*bit)) in ONE pass,
+ * no delta buffer, no copy — the K=1 receive path (the common case: one
+ * incoming frame applied to values + each other link's residual). Padding
+ * lanes beyond ns[i] are copied verbatim (0 by invariant). */
+EXPORT void stc_apply_frame(const float *vin, float *vout, const int64_t *off,
+                            const int64_t *ns, const int64_t *padded,
+                            int64_t n_leaves, const float *scales,
+                            const uint32_t *words) {
+  for (int64_t i = 0; i < n_leaves; i++) {
+    const float *in = vin + off[i];
+    float *out = vout + off[i];
+    const uint32_t *w = words + off[i] / 32;
+    int64_t n = ns[i], pad = padded[i];
+    float s = scales[i];
+    if (s == 0.0f) { /* idle leaf: pure copy */
+      memcpy(out, in, (size_t)pad * sizeof(float));
+      continue;
+    }
+    int64_t full = n / 32;
+    int64_t k = 0;
+#ifdef ST_AVX512
+    const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
+    const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
+    const __m512 vmax = _mm512_set1_ps(3.0e38f);
+    const __m512 vmin = _mm512_set1_ps(-3.0e38f);
+    for (; k < full; k++) {
+      uint32_t bits = w[k];
+      const float *pp = in + k * 32;
+      float *qq = out + k * 32;
+      __mmask16 m0 = (__mmask16)bits;
+      __mmask16 m1 = (__mmask16)(bits >> 16);
+      __m512 d0 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m0, vs, vsign));
+      __m512 d1 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m1, vs, vsign));
+      __m512 r0 = _mm512_add_ps(_mm512_loadu_ps(pp), d0);
+      __m512 r1 = _mm512_add_ps(_mm512_loadu_ps(pp + 16), d1);
+      r0 = _mm512_max_ps(_mm512_min_ps(r0, vmax), vmin);
+      r1 = _mm512_max_ps(_mm512_min_ps(r1, vmax), vmin);
+      _mm512_storeu_ps(qq, r0);
+      _mm512_storeu_ps(qq + 16, r1);
+    }
+#endif
+    for (; k < full; k++) {
+      uint32_t bits = w[k];
+      for (int b = 0; b < 32; b++) {
+        float v = in[k * 32 + b] + (((bits >> b) & 1u) ? -s : s);
+        v = v > 3.0e38f ? 3.0e38f : v;
+        v = v < -3.0e38f ? -3.0e38f : v;
+        out[k * 32 + b] = v;
+      }
+    }
+    int64_t base = full * 32;
+    if (n % 32) {
+      uint32_t bits = w[full];
+      for (int64_t b = 0; b < n - base; b++) {
+        float v = in[base + b] + (((bits >> b) & 1u) ? -s : s);
+        v = v > 3.0e38f ? 3.0e38f : v;
+        v = v < -3.0e38f ? -3.0e38f : v;
+        out[base + b] = v;
+      }
+      for (int64_t b = n - base; b < 32 && base + b < pad; b++)
+        out[base + b] = in[base + b];
+      base += 32;
+    }
+    if (base < pad)
+      memcpy(out + base, in + base, (size_t)(pad - base) * sizeof(float));
+  }
+}
+
 /* Local additive update, sanitized (quirk Q9 fix — one NaN in the reference
  * poisons every replica through the flood): u is pre-masked by the caller;
  * NaN -> 0, +/-inf and sums clamped to +/-3e38. */
@@ -263,5 +345,34 @@ EXPORT void stc_accumulate_update(float *a, const float *u, int64_t total) {
     if (s > 3.0e38f) s = 3.0e38f;
     if (s < -3.0e38f) s = -3.0e38f;
     a[i] = s;
+  }
+}
+
+/* Functional one-pass form: out = clip(a + sanitize(u)) on live lanes,
+ * out = a on padding (so a raw update's padding garbage never enters the
+ * buffer — the caller no longer pre-masks or copies). Replaces the
+ * copy-then-inplace pattern, which cost an extra full memory pass per
+ * target array (the add path runs once per link residual plus the replica). */
+EXPORT void stc_accumulate_update_to(float *vout, const float *a,
+                                     const float *u, const int64_t *off,
+                                     const int64_t *ns, const int64_t *padded,
+                                     int64_t n_leaves) {
+  for (int64_t i = 0; i < n_leaves; i++) {
+    const float *ap = a + off[i];
+    const float *up = u + off[i];
+    float *op = vout + off[i];
+    int64_t n = ns[i], pad = padded[i];
+    for (int64_t j = 0; j < n; j++) {
+      float x = up[j];
+      if (x != x) x = 0.0f; /* NaN */
+      if (x > 3.0e38f) x = 3.0e38f;
+      if (x < -3.0e38f) x = -3.0e38f;
+      float s = ap[j] + x;
+      if (s > 3.0e38f) s = 3.0e38f;
+      if (s < -3.0e38f) s = -3.0e38f;
+      op[j] = s;
+    }
+    if (n < pad)
+      memcpy(op + n, ap + n, (size_t)(pad - n) * sizeof(float));
   }
 }

@@ -102,8 +102,11 @@ def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
     words = np.frombuffer(payload, "<u4", count=w, offset=1 + 4 * k)
     # numpy, NOT jnp: a host-tier peer must never initialize a jax backend
     # (thread-pool contention with its C codec loops); device tiers convert
-    # on entry to their jitted applies.
-    return TableFrame(np.ascontiguousarray(scales), np.ascontiguousarray(words))
+    # on entry to their jitted applies. COPIES, not views: the frombuffer
+    # views start at payload offset 1, i.e. 4-byte-misaligned pointers,
+    # which the native C kernels must never receive (UB; faults on
+    # strict-alignment targets). ascontiguousarray would no-op on a view.
+    return TableFrame(scales.copy(), words.copy())
 
 
 def encode_sync(spec: TableSpec) -> bytes:

@@ -464,6 +464,13 @@ class SharedTensor:
             r = self._links.get(link_id)
         if r is None:
             return 0.0
+        if self._np:
+            # numpy on the host tier: drain()/metrics() call this, and a
+            # jnp reduction here would initialize the XLA CPU backend —
+            # undoing the tier's no-backend invariant for the process's
+            # whole lifetime (2.7x frame-rate contention, see __init__).
+            r = np.asarray(r, np.float64)
+            return float(np.sqrt(np.dot(r, r) / self.spec.total_n))
         return float(jnp.sqrt(jnp.sum(r * r) / self.spec.total_n))
 
     def __repr__(self) -> str:  # pragma: no cover

@@ -51,9 +51,12 @@ _NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_TRIED = False
 
-_i64p = np.ctypeslib.ndpointer(np.int64, flags="C")
-_f32p = np.ctypeslib.ndpointer(np.float32, flags="C")
-_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C")
+# ALIGNED: the C kernels (and their AVX paths) assume natural alignment;
+# a misaligned view (e.g. an offset np.frombuffer) must fail loudly here
+# rather than reach the library as UB.
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C,ALIGNED")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C,ALIGNED")
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C,ALIGNED")
 
 
 def _native() -> Optional[ctypes.CDLL]:
@@ -82,6 +85,12 @@ def _native() -> Optional[ctypes.CDLL]:
         lib.stc_accumulate_delta.argtypes = [_f32p, _i64p, _i64p, _i64p, ctypes.c_int64, _f32p, _u32p]
         lib.stc_add_inplace.restype = None
         lib.stc_add_inplace.argtypes = [_f32p, _f32p, ctypes.c_int64]
+        lib.stc_add_to.restype = None
+        lib.stc_add_to.argtypes = [_f32p, _f32p, _f32p, ctypes.c_int64]
+        lib.stc_apply_frame.restype = None
+        lib.stc_apply_frame.argtypes = [
+            _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64, _f32p, _u32p,
+        ]
         _f64p = np.ctypeslib.ndpointer(np.float64, flags="C")
         lib.stc_scale_partials.restype = None
         lib.stc_scale_partials.argtypes = [
@@ -89,6 +98,10 @@ def _native() -> Optional[ctypes.CDLL]:
         ]
         lib.stc_accumulate_update.restype = None
         lib.stc_accumulate_update.argtypes = [_f32p, _f32p, ctypes.c_int64]
+        lib.stc_accumulate_update_to.restype = None
+        lib.stc_accumulate_update_to.argtypes = [
+            _f32p, _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64,
+        ]
         _LIB = lib
     except Exception:  # no toolchain / build failure: numpy fallback
         _LIB = None
@@ -300,9 +313,25 @@ def apply_table_batch_np(
     f32 buffer then adding it once per target."""
     k = scales.shape[0]
     lib = _native()
-    delta = np.zeros(spec.total, np.float32)
     if lib is not None:
         offs, ns, padded = _layout(spec)
+        if k == 1:
+            # Single frame (the common receive case): fully fused
+            # out = clip(in + delta) — one memory pass per target, no delta
+            # buffer, no copy. At sizes past LLC the host tier is
+            # bandwidth-bound and this is ~2x the accumulate+copy+add path.
+            row = np.ascontiguousarray(scales[0], np.float32)
+            w0 = np.ascontiguousarray(words[0], np.uint32)
+            out = []
+            for a in arrays:
+                src = np.ascontiguousarray(a, np.float32)
+                dst = np.empty(spec.total, np.float32)
+                lib.stc_apply_frame(
+                    src, dst, offs, ns, padded, spec.num_leaves, row, w0
+                )
+                out.append(dst)
+            return tuple(out)
+        delta = np.zeros(spec.total, np.float32)
         for i in range(k):
             row = np.ascontiguousarray(scales[i], np.float32)
             if not row.any():
@@ -313,10 +342,13 @@ def apply_table_batch_np(
             )
         out = []
         for a in arrays:
-            v = np.array(a, np.float32, copy=True)  # functional update
-            lib.stc_add_inplace(v, delta, spec.total)  # clamps at +/-SAT
-            out.append(v)
+            # functional update, one pass: dst = clip(a + delta)
+            src = np.ascontiguousarray(a, np.float32)
+            dst = np.empty(spec.total, np.float32)
+            lib.stc_add_to(dst, src, delta, spec.total)
+            out.append(dst)
         return tuple(out)
+    delta = np.zeros(spec.total, np.float32)
     live = _live_mask_np(spec)
     for i in range(k):
         row = np.asarray(scales[i], np.float32)
@@ -353,17 +385,24 @@ def accumulate_table_np(
 ) -> tuple[np.ndarray, ...]:
     """values += u and each link residual += u, sanitized (quirk Q9 fix,
     matching ops/table.accumulate_table)."""
+    lib = _native()
+    if lib is not None:
+        # one fused pass per target: dst = clip(a + sanitize(u)) on live
+        # lanes, padding copied from a — no update copy, no target copy
+        offs, ns, padded = _layout(spec)
+        u_src = np.ascontiguousarray(update, np.float32)
+        out = []
+        for a in arrays:
+            src = np.ascontiguousarray(a, np.float32)
+            dst = np.empty(spec.total, np.float32)
+            lib.stc_accumulate_update_to(
+                dst, src, u_src, offs, ns, padded, spec.num_leaves
+            )
+            out.append(dst)
+        return tuple(out)
     live = _live_mask_np(spec)
     u = np.asarray(update, np.float32).copy()
     u[~live] = 0.0
-    lib = _native()
-    if lib is not None:
-        out = []
-        for a in arrays:
-            v = np.array(a, np.float32, copy=True)
-            lib.stc_accumulate_update(v, u, spec.total)
-            out.append(v)
-        return tuple(out)
     np.nan_to_num(u, copy=False, nan=0.0, posinf=3.0e38, neginf=-3.0e38)
     return tuple(
         np.clip(np.asarray(a, np.float32) + u, -3.0e38, 3.0e38) for a in arrays
