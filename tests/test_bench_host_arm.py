@@ -1,0 +1,38 @@
+"""The bench's no-chip fallback arm must always produce a valid, honest
+number: it is what the driver records for the round if the TPU tunnel is
+wedged (bench.py phase B). Runs the worker directly (fast — no supervisor
+ladder, no chip attempts)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_host_worker_emits_valid_result():
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if "axon" not in k.lower() and k != "PYTHONPATH"
+    }
+    env["PYTHONPATH"] = REPO
+    env["ST_TIMING_BUDGET_S"] = "3"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--worker", "host"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ST_BACKEND_UP cpu" in proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "sync_bandwidth_equiv_fp32_per_link"
+    assert out["detail"]["codec"] == "host"
+    assert out["detail"]["backend"] == "cpu"
+    # the host tier beats the reference codec ~5x per core; even a heavily
+    # loaded run must clear a generous fraction of the baseline
+    assert out["value"] > 0.2, out
