@@ -150,7 +150,7 @@ struct Link {
 struct Node;
 void link_sender_loop(Node* node, std::shared_ptr<Link> link);
 void link_receiver_loop(Node* node, std::shared_ptr<Link> link);
-void listener_loop(Node* node);
+void listener_loop(Node* node, int listen_fd);
 void rejoin_loop(Node* node);
 
 struct Node {
@@ -158,6 +158,9 @@ struct Node {
   std::atomic<bool> closing{false};
   std::atomic<int> active_threads{0};  // all detached; close() drains to 0
   int listen_fd = -1;
+  // Second listener bound to the rendezvous address after a master
+  // failover (rejoin_loop); -1 until then. Guarded by mu.
+  int rendezvous_listen_fd = -1;
 
   std::mutex mu;  // guards links, child slots, next id
   std::map<int32_t, std::shared_ptr<Link>> links;
@@ -367,11 +370,11 @@ void link_receiver_loop(Node* node, std::shared_ptr<Link> link) {
 // ---- topology: listener (reference do_listening, src/sharedtensor.c:
 // 192-242) ----------------------------------------------------------------
 
-void listener_loop(Node* node) {
+void listener_loop(Node* node, int listen_fd) {
   while (!node->closing) {
     sockaddr_in peer{};
     socklen_t plen = sizeof peer;
-    int fd = ::accept(node->listen_fd, (sockaddr*)&peer, &plen);
+    int fd = ::accept(listen_fd, (sockaddr*)&peer, &plen);
     if (fd < 0) {
       if (errno == EINTR) continue;
       if (node->closing) break;
@@ -500,7 +503,17 @@ int join_walk(Node* node, sockaddr_in target, bool allow_master,
 
 // Uplink died: re-graft through the rendezvous (fixes reference quirk Q8 —
 // it exits instead). Children keep streaming throughout.
+//
+// MASTER FAILOVER: when the dead parent was the master itself, nobody
+// answers at the rendezvous — every rejoin attempt gets connection-refused.
+// An orphan then tries to BIND the rendezvous address and become the new
+// master; the OS arbitrates the race between orphaned siblings
+// (EADDRINUSE = a sibling won, whom the next join cycle will reach). Only
+// a node that can neither join nor bind across two consecutive cycles is
+// genuinely isolated (kind-4 event; Python surfaces the error). The
+// reference cannot survive a master death at all (quirk Q8).
 void rejoin_loop(Node* node) {
+  int failed_cycles = 0;
   while (!node->closing) {
     {
       std::unique_lock<std::mutex> lk(node->ev_mu);
@@ -512,7 +525,10 @@ void rejoin_loop(Node* node) {
       std::lock_guard<std::mutex> lk(node->mu);
       need = !node->is_master && node->uplink_id < 0;
     }
-    if (!need) continue;
+    if (!need) {
+      failed_cycles = 0;
+      continue;
+    }
     bool rejoined = false;
     for (int attempt = 0;
          attempt < node->cfg.max_rejoin_attempts && !node->closing; attempt++) {
@@ -528,8 +544,46 @@ void rejoin_loop(Node* node) {
         break;
       }
     }
-    if (!rejoined && !node->closing) {
-      node->emit(4, 0, 1);  // rejoin failed: Python decides what to do next
+    if (rejoined || node->closing) {
+      failed_cycles = 0;
+      continue;
+    }
+    // Nobody to join: claim the rendezvous (master failover).
+    int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd >= 0) {
+      set_common_sockopts(lfd);
+      sockaddr_in rv = node->rendezvous;
+      if (::bind(lfd, (sockaddr*)&rv, sizeof rv) == 0 &&
+          ::listen(lfd, node->cfg.listen_backlog) == 0) {
+        // Publish under mu with a closing re-check: st_node_close reads
+        // rendezvous_listen_fd under the same lock AFTER setting closing,
+        // so either we see closing here (and close lfd ourselves) or
+        // close() sees the published fd — a bound rendezvous socket can
+        // never leak past shutdown.
+        bool published = false;
+        {
+          std::lock_guard<std::mutex> lk(node->mu);
+          if (!node->closing) {
+            node->is_master = true;
+            node->rendezvous_listen_fd = lfd;
+            published = true;
+          }
+        }
+        if (!published) {
+          ::close(lfd);
+          break;
+        }
+        node->active_threads += 1;
+        std::thread(listener_loop, node, lfd).detach();
+        node->emit(3, 0, 0);  // became master: Python flips its role
+        failed_cycles = 0;
+        continue;
+      }
+      ::close(lfd);  // EADDRINUSE: a sibling won the race (or foreign IP)
+    }
+    if (++failed_cycles >= 2) {
+      node->emit(4, 0, 1);  // isolated: cannot join OR claim the rendezvous
+      failed_cycles = 0;    // keep trying, but don't spam the event
     }
   }
   --node->active_threads;
@@ -650,7 +704,7 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
   node->listen_fd = listen_fd;
 
   node->active_threads += 2;
-  std::thread(listener_loop, node).detach();
+  std::thread(listener_loop, node, listen_fd).detach();
   std::thread(rejoin_loop, node).detach();
   if (up_fd >= 0) make_link(node, up_fd, /*is_uplink=*/1, nullptr);
   if (is_master) *is_master = became_master ? 1 : 0;
@@ -777,6 +831,15 @@ void st_node_close(void* h) {
   node->closing = true;
   ::shutdown(node->listen_fd, SHUT_RDWR);
   ::close(node->listen_fd);
+  int rv_fd;
+  {
+    std::lock_guard<std::mutex> lk(node->mu);
+    rv_fd = node->rendezvous_listen_fd;
+  }
+  if (rv_fd >= 0) {
+    ::shutdown(rv_fd, SHUT_RDWR);
+    ::close(rv_fd);
+  }
   std::vector<std::shared_ptr<Link>> links;
   {
     std::lock_guard<std::mutex> lk(node->mu);

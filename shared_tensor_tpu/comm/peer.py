@@ -422,6 +422,10 @@ class SharedTensorPeer:
             if ev.kind == EventKind.LINK_UP:
                 if ev.is_uplink:
                     self._uplink = ev.link_id
+                    # a re-grafted uplink supersedes any earlier isolation
+                    # verdict (REJOIN_FAILED is a status, not a sentence —
+                    # the native layer keeps retrying and may heal)
+                    self._error = None
                     if self.config.transport.wire_compat:
                         # reference protocol has no handshake: start streaming
                         # into a zero residual at once
@@ -459,14 +463,21 @@ class SharedTensorPeer:
                     self._sent_snapshot = None
                     self._uplink = None
             elif ev.kind == EventKind.BECAME_MASTER:
-                # our parent died and rejoin found nobody: we are the new root;
+                # our parent died and rejoin found nobody: we claimed the
+                # rendezvous and are the new root (native master failover);
                 # whatever state we hold is now the authoritative seed
                 self._uplink = None
                 self.is_master = True
+                self._error = None
                 self._ready.set()
             elif ev.kind == EventKind.REJOIN_FAILED:
+                # Status, not a sentence: the native layer keeps cycling
+                # join-then-claim-rendezvous forever; under detection skew a
+                # sibling may claim the rendezvous seconds after this fires,
+                # and the next LINK_UP/BECAME_MASTER clears the error.
                 self._error = ConnectionError(
-                    "uplink lost and rejoin failed; node is isolated"
+                    "uplink lost and rejoin failed; node is isolated "
+                    "(still retrying in the background)"
                 )
                 self._ready.set()  # unblock wait_ready, which re-raises
         return bool(evs)

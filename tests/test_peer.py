@@ -311,3 +311,46 @@ def test_idle_links_quiesce():
             time.sleep(1.0)
             # allow a stray in-flight frame, but no steady 1/s drumbeat
             assert a.st.frames_out - f0 <= 1
+
+
+def test_master_death_failover():
+    """The MASTER dies; its orphans cannot rejoin (nobody owns the
+    rendezvous) — one of them must claim the rendezvous address and become
+    the new master (the OS arbitrates the sibling race), the other rejoins
+    it, replicas re-converge, and a brand-new peer can still join the tree
+    at the original address. The reference tree is dead at this point
+    (quirk Q8: master death kills every process)."""
+    port = _free_port()
+    seed = jnp.ones((128,), jnp.float32)
+    cfg = Config(
+        transport=TransportConfig(peer_timeout_sec=5.0, max_rejoin_attempts=4)
+    )
+    m = create_or_fetch("127.0.0.1", port, seed, cfg)
+    peers = [m]
+    try:
+        a = create_or_fetch("127.0.0.1", port, jnp.zeros_like(seed), cfg)
+        peers.append(a)
+        b = create_or_fetch("127.0.0.1", port, jnp.zeros_like(seed), cfg)
+        peers.append(b)
+        _wait_converged(peers, np.ones(128, np.float32))
+        peers.remove(m)
+        m.close()
+        deadline = time.time() + 90  # suite convention: loaded-box window
+        while time.time() < deadline and not (a.is_master or b.is_master):
+            time.sleep(0.1)
+        assert a.is_master or b.is_master, (
+            "no orphan claimed the rendezvous: "
+            f"a(master={a.is_master}, links={a.node.links}, err={a._error}) "
+            f"b(master={b.is_master}, links={b.node.links}, err={b._error})"
+        )
+        a.add(jnp.full((128,), 0.5, jnp.float32))
+        b.add(jnp.full((128,), 0.25, jnp.float32))
+        expect = np.full(128, 1.75, np.float32)
+        _wait_converged([a, b], expect)
+        # the healed tree still serves new joiners at the original address
+        c = create_or_fetch("127.0.0.1", port, jnp.zeros_like(seed), cfg)
+        peers.append(c)
+        _wait_converged([a, b, c], expect)
+    finally:
+        for p in peers:
+            p.close()
