@@ -354,3 +354,67 @@ def test_master_death_failover():
     finally:
         for p in peers:
             p.close()
+
+
+def test_isolation_is_recoverable():
+    """REJOIN_FAILED is a status, not a sentence: a node that can neither
+    join nor claim the rendezvous reports isolation (wait_ready raises), but
+    the native layer keeps cycling and the error clears when the tree comes
+    back. Forced deterministically by squatting the rendezvous with a
+    listener that drops every connection (join fails fast, bind fails with
+    EADDRINUSE)."""
+    import threading
+
+    port = _free_port()
+    seed = jnp.full((64,), 2.0, jnp.float32)
+    cfg = Config(
+        transport=TransportConfig(peer_timeout_sec=2.0, max_rejoin_attempts=2)
+    )
+    m = create_or_fetch("127.0.0.1", port, seed, cfg)
+    a = create_or_fetch("127.0.0.1", port, jnp.zeros_like(seed), cfg)
+    try:
+        _wait_converged([a], seed)
+        m.close()
+        # squat: listener that accepts and immediately drops (fast join
+        # failure) while holding the port (bind failure for the orphan)
+        squat = socket.socket()
+        squat.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        squat.bind(("127.0.0.1", port))
+        squat.listen(16)
+        stop = threading.Event()
+
+        def drop_all():
+            squat.settimeout(0.2)
+            while not stop.is_set():
+                try:
+                    c, _ = squat.accept()
+                    c.close()
+                except OSError:
+                    continue
+
+        t = threading.Thread(target=drop_all, daemon=True)
+        t.start()
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline and a._error is None:
+                time.sleep(0.05)
+            assert a._error is not None, "isolation was never reported"
+            with pytest.raises(ConnectionError):
+                a.wait_ready(timeout=0.1)
+        finally:
+            stop.set()
+            squat.close()
+            t.join(timeout=5)
+        # the rendezvous is free again: the node heals (claims it, or joins
+        # whoever does) and the error clears
+        deadline = time.time() + 60
+        while time.time() < deadline and not (a._error is None and a.ready):
+            time.sleep(0.1)
+        assert a._error is None, a._error
+        a.wait_ready(timeout=5)
+        a.add(jnp.full((64,), 0.5, jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(a.read()), np.full(64, 2.5, np.float32), rtol=1e-5
+        )
+    finally:
+        a.close()
