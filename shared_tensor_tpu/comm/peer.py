@@ -75,6 +75,34 @@ class SharedTensorPeer:
         codec = self.config.codec
         tcfg = self.config.transport
         spec = make_spec(template)
+        from ..core import host_tier_active
+
+        # Burst sizing (Config.frame_burst): host tier + native mode only —
+        # the device tier pipelines async dispatches instead, and the
+        # reference protocol has no burst framing. Auto: burst small tables
+        # (per-message engine cost dominates their O(n) codec math); 24
+        # frames deliver ~full fp32 precision of the current residual in
+        # one message (residual halves per frame, BASELINE.md).
+        burstable = (
+            not tcfg.wire_compat
+            and host_tier_active()
+            and spec.total <= wire.BURST_MAX_TOTAL  # wire-level invariant:
+            # every peer sizes its receive buffer for a max burst of a
+            # <=BURST_MAX_TOTAL table (frame_wire_bytes), so a sender must
+            # never burst beyond that regardless of Config.frame_burst
+            and self.config.codec.suppress_zero_frames  # the burst path has
+            # no idle frames to send; honor the knob by streaming instead
+        )
+        if not burstable:
+            self._burst = 1
+        elif self.config.frame_burst == 0:
+            # auto: the smaller the table, the more per-message overhead
+            # dominates — scale the burst up (4 Ki: 128, 16 Ki: 32)
+            self._burst = max(24, min(128, (1 << 19) // max(1, spec.total)))
+        else:
+            self._burst = max(
+                1, min(wire.BURST_MAX_FRAMES, self.config.frame_burst)
+            )
         if tcfg.wire_compat:
             if spec.num_leaves != 1:
                 raise ValueError(
@@ -83,6 +111,8 @@ class SharedTensorPeer:
                 )
             frame_bytes = wire.compat_frame_bytes(spec.total_n)
         else:
+            # covers the worst-case incoming BURST from ANY peer (shared
+            # spec via the layout handshake), not just our own burst size
             frame_bytes = wire.frame_wire_bytes(spec)
         self.node = TransportNode(
             host,
@@ -251,6 +281,27 @@ class SharedTensorPeer:
                 del pipe[stale]  # LINK_DOWN already rolled their ledger back
                 hot.discard(stale)
             for link in links:
+                if self._burst > 1:
+                    # Host-tier burst path: K residual halvings quantized in
+                    # one synchronous call, ONE message, ONE ledger entry,
+                    # ONE receiver ACK (Config.frame_burst rationale).
+                    out = self.st.begin_frame_burst(link, self._burst)
+                    if out is None:
+                        continue  # link dropped concurrently
+                    seq, burst = out
+                    if not burst:
+                        self.st.ack_frame(link, seq)  # idle: no-op burst
+                        hot.discard(link)
+                        continue
+                    hot.add(link)
+                    payload = wire.encode_burst(burst, self.st.spec)
+                    with self._ack_mu:
+                        self._unacked.setdefault(link, []).append(seq)
+                    if self._send_blocking(link, payload):
+                        sent_any = True
+                    else:
+                        self.st.nack_frame(link)
+                    continue
                 q = pipe.setdefault(link, deque())
                 # top up: a cold (idle) link risks one speculative frame per
                 # wake tick; a hot link keeps the full pipeline busy
@@ -335,12 +386,15 @@ class SharedTensorPeer:
         while not self._stop.is_set():
             busy = self._handle_events()
             for link in list(self.node.links):
-                # Consecutive DATA frames batch into ONE device apply
+                # Consecutive DATA/BURST frames batch into ONE device apply
                 # (core.receive_frames): without this, per-frame dispatch on
                 # a busy device falls behind a fast sender and the RX queue
                 # backs up by hundreds of frames. Control messages flush the
-                # batch first so relative order is preserved.
+                # batch first so relative order is preserved. ``msgs`` counts
+                # wire MESSAGES (what the sender's ledger tracks and ACKs
+                # acknowledge); a burst message carries many frames.
                 batch: list = []
+                msgs = 0
                 for _ in range(256):  # bounded so other links aren't starved
                     try:
                         payload = self.node.recv(link, timeout=0.0)
@@ -357,6 +411,11 @@ class SharedTensorPeer:
                             continue
                         if payload[0] == wire.DATA:
                             batch.append(wire.decode_frame(payload, self.st.spec))
+                            msgs += 1
+                            continue
+                        if payload[0] == wire.BURST:
+                            batch.extend(wire.decode_burst(payload, self.st.spec))
+                            msgs += 1
                             continue
                     except Exception as e:  # a bad frame must not kill the node
                         log.warning("dropping bad frame on link %d: %s", link, e)
@@ -364,18 +423,18 @@ class SharedTensorPeer:
                     # control message: flush queued frames first (order), and
                     # never let a flush failure swallow the control message —
                     # a dropped WELCOME/DONE would hang the join handshake
-                    self._flush_frames(link, batch)
-                    batch = []
+                    self._flush_frames(link, batch, msgs)
+                    batch, msgs = [], 0
                     try:
                         self._on_message(link, payload)
                     except Exception as e:
                         log.warning("dropping bad message on link %d: %s", link, e)
-                self._flush_frames(link, batch)
+                self._flush_frames(link, batch, msgs)
                 self._flush_acks(link)  # retry any backpressure-dropped ACK
             if not busy:
                 time.sleep(0.002)
 
-    def _flush_frames(self, link: int, batch: list) -> None:
+    def _flush_frames(self, link: int, batch: list, msgs: int | None = None) -> None:
         if not batch:
             return
         try:
@@ -390,7 +449,9 @@ class SharedTensorPeer:
                     self.st.receive_frame(link, f)
                 except Exception as e:
                     log.warning("dropping bad frame on link %d: %s", link, e)
-        self._ack_received(link, len(batch))
+        # ACK counts wire MESSAGES (one ledger entry each), not frames: a
+        # burst message carries many frames but rolls back / acks whole.
+        self._ack_received(link, len(batch) if msgs is None else msgs)
         self._wake.set()  # flood refills other links' residuals
 
     def _ack_received(self, link: int, n: int) -> None:

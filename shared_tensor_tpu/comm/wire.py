@@ -50,7 +50,8 @@ CHUNK = 2  # child -> parent: replica snapshot chunk
 DONE = 3  # child -> parent: snapshot complete
 WELCOME = 4  # parent -> child: accepted, streaming begins
 REJECT = 5  # parent -> child: spec mismatch, reason attached
-ACK = 6  # cumulative count of DATA frames received on this link
+ACK = 6  # cumulative count of DATA/BURST messages received on this link
+BURST = 7  # K codec frames in one message (host tier, small tables)
 
 _SYNC_FMT = "<IQ16s"  # num_leaves, total_n, layout digest
 _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
@@ -60,11 +61,35 @@ _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
 CHUNK_BYTES = 1 << 22
 
 
+#: Largest table (padded elements) the BURST path applies to, and the most
+#: frames one BURST message may carry. BOTH sides derive their receive
+#: buffer bound from these and the (handshake-identical) spec, so a burst
+#: can never exceed what any peer sized for — oversized incoming messages
+#: would otherwise be silently truncated by the transport's recv copy.
+BURST_MAX_TOTAL = 1 << 15
+BURST_MAX_FRAMES = 255
+
+
+def frame_payload_bytes(spec: TableSpec) -> int:
+    """Bytes of ONE frame's wire body (scales + packed words) — the single
+    source of truth for the frame layout (decode_frame, decode_burst, and
+    the transport buffer sizing all derive from it)."""
+    return 4 * spec.num_leaves + 4 * (spec.total // 32)
+
+
+def burst_wire_bytes(spec: TableSpec) -> int:
+    """Max BURST message size for this spec (0 when the spec is too large
+    for the burst path at all)."""
+    if spec.total > BURST_MAX_TOTAL:
+        return 0
+    return 2 + BURST_MAX_FRAMES * frame_payload_bytes(spec)
+
+
 def frame_wire_bytes(spec: TableSpec) -> int:
     """Max payload size of any native-mode message for this spec."""
-    data = 1 + 4 * spec.num_leaves + 4 * (spec.total // 32)
+    data = 1 + frame_payload_bytes(spec)
     chunk = 1 + struct.calcsize(_CHUNK_HDR) + CHUNK_BYTES
-    return max(data, chunk)
+    return max(data, chunk, burst_wire_bytes(spec))
 
 
 def encode_frame(frame: TableFrame) -> bytes:
@@ -76,7 +101,7 @@ def encode_frame(frame: TableFrame) -> bytes:
 def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
     k = spec.num_leaves
     w = spec.total // 32
-    want = 1 + 4 * k + 4 * w
+    want = 1 + frame_payload_bytes(spec)
     if len(payload) != want:
         raise ValueError(
             f"DATA frame is {len(payload)} bytes, spec wants {want} "
@@ -107,6 +132,58 @@ def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
     # which the native C kernels must never receive (UB; faults on
     # strict-alignment targets). ascontiguousarray would no-op on a view.
     return TableFrame(scales.copy(), words.copy())
+
+
+def encode_burst(frames, spec: TableSpec) -> bytes:
+    """K frames in one message: [BURST][u8 k][k x (scales || words)].
+    Successive frames of one link are successive halvings of its residual;
+    shipping them together amortizes the per-message engine cost that
+    dominates at small table sizes (see Config.frame_burst)."""
+    if not 1 <= len(frames) <= BURST_MAX_FRAMES:
+        raise ValueError(f"burst of {len(frames)} frames (1..{BURST_MAX_FRAMES})")
+    if spec.total > BURST_MAX_TOTAL:
+        raise ValueError(
+            f"table of {spec.total} padded elements exceeds the burst bound "
+            f"({BURST_MAX_TOTAL}) peers sized their receive buffers for"
+        )
+    parts = [bytes([BURST, len(frames)])]
+    for f in frames:
+        parts.append(np.asarray(f.scales, dtype="<f4").tobytes())
+        parts.append(np.asarray(f.words, dtype="<u4").tobytes())
+    out = b"".join(parts)
+    assert len(out) == 2 + len(frames) * frame_payload_bytes(spec)
+    return out
+
+
+def decode_burst(payload: bytes, spec: TableSpec) -> list[TableFrame]:
+    """Inverse of :func:`encode_burst`, with the same per-frame corruption
+    guard as decode_frame (non-finite scales zeroed)."""
+    k_frames = payload[1]
+    per = frame_payload_bytes(spec)
+    want = 2 + k_frames * per
+    if len(payload) != want:
+        raise ValueError(
+            f"BURST of {k_frames} frames is {len(payload)} bytes, "
+            f"layout wants {want} — peer table layout mismatch"
+        )
+    out = []
+    for i in range(k_frames):
+        off = 2 + i * per
+        scales = np.frombuffer(payload, "<f4", count=spec.num_leaves, offset=off)
+        if not np.isfinite(scales).all():
+            log.warning(
+                "zeroing %d non-finite scale(s) in burst frame (corrupt link?)",
+                int(np.count_nonzero(~np.isfinite(scales))),
+            )
+            scales = np.where(np.isfinite(scales), scales, np.float32(0.0))
+        else:
+            scales = scales.copy()  # aligned, owned (see decode_frame)
+        words = np.frombuffer(
+            payload, "<u4", count=spec.total // 32,
+            offset=off + 4 * spec.num_leaves,
+        )
+        out.append(TableFrame(scales, words.copy()))
+    return out
 
 
 def encode_sync(spec: TableSpec) -> bytes:

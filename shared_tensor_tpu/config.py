@@ -115,6 +115,16 @@ class Config:
     #: device link (PCIe queue, TPU tunnel) throughput is bounded by
     #: bandwidth instead of round-trip latency. 1 = plain double buffering.
     send_pipeline_depth: int = 8
+    #: Frames per wire message on the host (CPU) tier, native mode only.
+    #: Successive codec frames are successive halvings of the same residual,
+    #: so a sender can quantize K frames back-to-back and ship them as ONE
+    #: message; the receiver's batched apply delivers them in one pass. For
+    #: small tables the per-message engine cost (Python dispatch, framing,
+    #: ACK) dominates the O(n) codec math, and bursting restores the frame
+    #: rate (the reference's best case: its bare C loop hits 78k frames/s at
+    #: 4 Ki elements, BASELINE.md). 0 = auto (burst small tables, stream
+    #: big ones); 1 = always single-frame messages; K>1 = force K.
+    frame_burst: int = 0
 
 
 DEFAULT = Config()

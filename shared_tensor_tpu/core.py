@@ -48,6 +48,19 @@ from .ops.table import (
 )
 
 
+def host_tier_active() -> bool:
+    """Will a SharedTensor built now run the host (numpy/C) codec tier?
+    The same decision SharedTensor.__init__ makes, callable without
+    constructing one (and without initializing any jax backend)."""
+    mode = os.environ.get("ST_HOST_CODEC", "auto")
+    if mode != "auto":
+        return mode == "numpy"
+    plat = jax.config.jax_platforms
+    if plat:
+        return str(plat).split(",")[0] == "cpu"
+    return jax.default_backend() == "cpu"
+
+
 class SharedTensor:
     """Replica + per-link residuals for one shared table of tensors.
 
@@ -71,22 +84,14 @@ class SharedTensor:
         # lowering is an order of magnitude off numpy's C loops, enough to
         # stall links via TCP backpressure at 16Mi elements (measured).
         # ST_HOST_CODEC=numpy|xla overrides (parity tests pin either).
-        mode = os.environ.get("ST_HOST_CODEC", "auto")
-        if mode == "auto":
-            # CPU backend specifically — on any accelerator (TPU or GPU) the
-            # codec must stay a device computation; only a host-only backend
-            # should fall back to host loops. Prefer the configured platform
-            # string over jax.default_backend(): the latter INITIALIZES the
-            # backend, and a live XLA CPU client's thread pool contends with
-            # the host tier's C loops (measured on a 1-vCPU host: 2.7x
-            # slower frames). A host-tier node must never start a backend.
-            plat = jax.config.jax_platforms
-            if plat:
-                self._np = str(plat).split(",")[0] == "cpu"
-            else:
-                self._np = jax.default_backend() == "cpu"
-        else:
-            self._np = mode == "numpy"
+        # CPU backend specifically — on any accelerator (TPU or GPU) the
+        # codec must stay a device computation; only a host-only backend
+        # should fall back to host loops. host_tier_active prefers the
+        # configured platform string over jax.default_backend(): the latter
+        # INITIALIZES the backend, and a live XLA CPU client's thread pool
+        # contends with the host tier's C loops (measured on a 1-vCPU host:
+        # 2.7x slower frames). A host-tier node must never start a backend.
+        self._np = host_tier_active()
         if seed_values:
             if self._np:
                 from .ops.codec_np import flatten_np
@@ -129,7 +134,7 @@ class SharedTensor:
         # to agreement via the re-graft diff handshake. The reference kills
         # the entire tree on any death (quirk Q8), so every arm of this
         # contract is strictly stronger.
-        self._inflight: dict[int, dict[int, TableFrame]] = {}
+        self._inflight: dict[int, dict[int, tuple[TableFrame, ...]]] = {}
         self._frame_seq = 0
         # observability (SURVEY.md §5.5: the reference has none)
         self.frames_out = 0
@@ -225,17 +230,21 @@ class SharedTensor:
     def _unapply(self, resid: jnp.ndarray, frames: dict) -> jnp.ndarray:
         """Roll back unacknowledged frames: a frame's delta is exactly
         scale*(1-2*bit), so re-applying it to the residual restores the
-        pre-quantize state bit-for-bit (see the ledger comment above)."""
+        pre-quantize state bit-for-bit (see the ledger comment above).
+        Ledger entries are tuples of frames (a burst rolls back whole)."""
         if self._np:
             from .ops.codec_np import apply_table_many_np
 
-            for f in frames.values():
-                resid = apply_table_many_np(
-                    (resid,), np.asarray(f.scales), np.asarray(f.words), self.spec
-                )[0]
+            for entry in frames.values():
+                for f in entry:
+                    resid = apply_table_many_np(
+                        (resid,), np.asarray(f.scales), np.asarray(f.words),
+                        self.spec,
+                    )[0]
             return resid
-        for f in frames.values():
-            resid = apply_table_many((resid,), f, self.spec)[0]
+        for entry in frames.values():
+            for f in entry:
+                resid = apply_table_many((resid,), f, self.spec)[0]
         return resid
 
     @property
@@ -244,7 +253,8 @@ class SharedTensor:
             return tuple(self._links)
 
     def inflight_total(self) -> int:
-        """Number of dispatched frames not yet acknowledged by their
+        """Number of dispatched MESSAGES (ledger entries — a burst counts
+        once, however many frames it carries) not yet acknowledged by their
         receivers, across all links (0 = everything sent has landed)."""
         with self._lock:
             return sum(len(q) for q in self._inflight.values())
@@ -340,8 +350,44 @@ class SharedTensor:
             self._frame_seq += 1
             seq = self._frame_seq
             # the frame IS its own delivery record; re-applied on nack/drop
-            self._inflight.setdefault(link_id, {})[seq] = frame
+            self._inflight.setdefault(link_id, {})[seq] = (frame,)
         return seq, frame
+
+    def begin_frame_burst(
+        self, link_id: int, k: int
+    ) -> Optional[tuple[int, list[TableFrame]]]:
+        """Quantize up to ``k`` successive frames of a link's residual in one
+        call — each frame halves what the previous one left (the same
+        sequence the streaming path would produce one message at a time),
+        stopping early when the residual quantizes to all-zero scales. The
+        burst is ONE in-flight ledger entry / ONE wire message / ONE
+        receiver ACK. Host (numpy) tier only: the loop is synchronous host
+        work. Returns (seq, frames) with 0..k frames (0 = link idle)."""
+        from .ops.codec_np import quantize_table_np
+
+        with self._lock:
+            resid = self._links.get(link_id)
+            if resid is None:
+                return None
+            frames: list[TableFrame] = []
+            for _ in range(k):
+                scales, words, new_resid = quantize_table_np(
+                    resid,
+                    self.spec,
+                    self.codec.scale_policy,
+                    self.codec.per_leaf_scale,
+                )
+                if not scales.any():
+                    break  # idle: nothing left the codec can express
+                frames.append(TableFrame(scales, words))
+                resid = new_resid
+            self._links[link_id] = resid
+            self._frame_seq += 1
+            seq = self._frame_seq
+            if frames:
+                self._inflight.setdefault(link_id, {})[seq] = tuple(frames)
+            self.frames_out += len(frames)
+        return seq, frames
 
     def ack_frame(self, link_id: int, seq: int) -> None:
         """Frame ``seq`` is accounted for — the receiver acknowledged it, or

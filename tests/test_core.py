@@ -298,3 +298,52 @@ def test_host_tier_read_returns_copies():
         arr += 99.0
     got = np.asarray(st.read()["w"])
     np.testing.assert_array_equal(got, np.ones((8, 16), np.float32))
+
+
+def test_burst_equals_sequential_frames():
+    """begin_frame_burst(k) must produce exactly the frames k sequential
+    quantizes would (successive halvings of the same residual), leave the
+    same final residual, and roll back whole on nack."""
+    from shared_tensor_tpu.ops.codec_np import quantize_table_np
+
+    tpl = np.linspace(-1.0, 1.0, 300).astype(np.float32)
+    st = SharedTensor(tpl, seed_values=True)
+    st.new_link(7, seed=True)  # residual = full replica
+    r_golden = np.asarray(st._links[7]).copy()
+    out = st.begin_frame_burst(7, 6)
+    assert out is not None
+    seq, frames = out
+    assert 1 <= len(frames) <= 6
+    for f in frames:
+        s, w, r_golden = quantize_table_np(r_golden, st.spec)
+        np.testing.assert_array_equal(np.asarray(f.scales), s)
+        np.testing.assert_array_equal(np.asarray(f.words), w)
+    np.testing.assert_array_equal(np.asarray(st._links[7]), r_golden)
+    # nack rolls the WHOLE burst back into the residual, bit-for-bit
+    pre = np.asarray(st._links[7]).copy()
+    st.nack_frame(7)
+    rolled = np.asarray(st._links[7])
+    assert not np.array_equal(rolled, pre)
+    # re-bursting after rollback reproduces the identical frames
+    out2 = st.begin_frame_burst(7, len(frames))
+    for f, g in zip(frames, out2[1]):
+        np.testing.assert_array_equal(np.asarray(f.words), np.asarray(g.words))
+
+
+def test_burst_idle_and_exhaustion():
+    """A burst stops early when the residual quantizes to nothing: an idle
+    link yields zero frames; a converged-mid-burst link yields fewer than k."""
+    tpl = np.zeros(300, np.float32)
+    st = SharedTensor(tpl, seed_values=True)
+    st.new_link(1, seed=False)  # zero residual: idle
+    seq, frames = st.begin_frame_burst(1, 8)
+    assert frames == []
+    assert st.inflight_total() == 0  # no ledger entry for a no-op burst
+    # uniform residual converges exactly in ~27 frames (BASELINE.md): a
+    # 255-frame burst must stop at exhaustion, not pad with idle frames
+    rng = np.random.default_rng(3)
+    st2 = SharedTensor(tpl, seed_values=True)
+    st2.new_link(1, residual=rng.uniform(-1, 1, st2.spec.total).astype(np.float32))
+    _, frames2 = st2.begin_frame_burst(1, 255)
+    assert 0 < len(frames2) < 255
+    assert float(np.abs(np.asarray(st2._links[1])).max()) == 0.0

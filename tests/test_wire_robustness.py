@@ -69,6 +69,10 @@ def test_garbage_injection_survival_and_convergence():
                 # must decode to a no-op, not poison the replica (Q9/Q11)
                 bytes([wire.DATA]) + nan_scales + noise_words,
                 bytes([wire.CHUNK]) + struct.pack("<Q", 1 << 60) + b"\xee",
+                # BURST with a count that does not match the payload length
+                bytes([wire.BURST, 9]) + b"\x00" * 40,
+                # BURST of 1 frame with NaN scales: zeroed, applied as no-op
+                bytes([wire.BURST, 1]) + nan_scales + noise_words,
             ]
             for p in payloads:
                 assert evil.send(link, p, timeout=2.0)
