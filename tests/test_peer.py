@@ -432,3 +432,32 @@ def test_isolation_is_recoverable():
         )
     finally:
         a.close()
+
+
+def test_frame_burst_knob():
+    """Config.frame_burst: 0 = auto (size-scaled, small tables only),
+    1 = stream single frames, K = force (clamped to the wire bound)."""
+    from shared_tensor_tpu.comm import wire
+
+    small = jnp.zeros((1000,), jnp.float32)  # padded 1024 <= BURST_MAX_TOTAL
+    big = jnp.zeros((1 << 17,), jnp.float32)  # beyond the burst bound
+
+    for tpl, cfg, expect in [
+        (small, Config(), lambda b: b > 1),  # auto bursts small tables
+        (small, Config(frame_burst=1), lambda b: b == 1),
+        (small, Config(frame_burst=7), lambda b: b == 7),
+        (small, Config(frame_burst=10_000), lambda b: b == wire.BURST_MAX_FRAMES),
+        (big, Config(), lambda b: b == 1),  # auto never bursts big tables
+        (big, Config(frame_burst=64), lambda b: b == 1),  # wire bound wins
+        (
+            small,
+            Config(codec=CodecConfig(suppress_zero_frames=False)),
+            lambda b: b == 1,  # burst has no idle frames; honor the knob
+        ),
+    ]:
+        port = _free_port()
+        p = create_or_fetch("127.0.0.1", port, tpl, cfg)
+        try:
+            assert expect(p._burst), (cfg, p._burst)
+        finally:
+            p.close()
