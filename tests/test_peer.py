@@ -182,10 +182,11 @@ def test_regraft_after_parent_death():
             p.add(jnp.full((256,), 0.25, jnp.float32))
         peers.pop(parent_name).close()
         survivors = list(peers.values())
-        # 90 s like _wait_converged: under full-suite load on one core the
-        # regraft (5 s peer timeout + rejoin backoff) plus the re-delivery
-        # drain occasionally needs more than the old 40 s.
-        deadline = time.time() + 90
+        # 120 s (like the hierarchical churn test): under full-suite load on
+        # one core the regraft (5 s peer timeout + rejoin backoff) plus the
+        # re-delivery drain intermittently exceeded 90 s (~2 in 20 loaded
+        # runs; never reproducible in isolation).
+        deadline = time.time() + 120
         while time.time() < deadline:
             vals = [np.asarray(p.read()) for p in survivors]
             spread = max(np.max(np.abs(v - vals[0])) for v in vals)
