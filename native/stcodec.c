@@ -24,16 +24,35 @@
 
 #define EXPORT __attribute__((visibility("default")))
 
-/* AVX-512 fast paths (compile-time: the Makefile builds with -march=native,
- * and this library is always compiled on the machine it runs on — see
- * ops/codec_np._native's on-demand make). The reference's scalar loops run
+/* AVX-512 fast paths with RUNTIME dispatch. The reference's scalar loops run
  * ~200 M elem/s/core (BASELINE.md); the sign-quantize and apply loops below
  * are 1-bit-per-float mask ops, which AVX-512 expresses directly
  * (compare->__mmask16 is the codec's bitmask, bit-for-bit). Scalar code
- * stays as the portable fallback and the semantic reference. */
-#if defined(__AVX512F__) && defined(__AVX512DQ__)
+ * stays as the portable fallback and the semantic reference.
+ *
+ * Why runtime and not -march=native: a prebuilt libstcodec.so can travel to
+ * another machine (docker image, rsync'd checkout, NFS) where make's
+ * mtime-only check sees it as fresh — compile-time-only AVX-512 would then
+ * SIGILL the peer process on a non-AVX-512 host. The AVX-512 bodies are
+ * compiled via __attribute__((target(...))) and selected per-process with
+ * __builtin_cpu_supports, so the same .so is correct everywhere. */
+#if defined(__x86_64__) && defined(__GNUC__)
 #include <immintrin.h>
 #define ST_AVX512 1
+static int st_has_avx512(void) {
+  static int cached = -1;
+  if (cached < 0)
+    cached = __builtin_cpu_supports("avx512f") &&
+             __builtin_cpu_supports("avx512dq");
+  return cached;
+}
+#define ST_TARGET_AVX512 __attribute__((target("avx512f,avx512dq")))
+/* The scalar loops are the only path on non-AVX-512 x86; without
+ * -march=native they'd compile to baseline SSE2. target_clones gives them
+ * an AVX2 auto-vectorized clone behind the same runtime-dispatch safety. */
+#define ST_CLONES __attribute__((target_clones("avx2", "default")))
+#else
+#define ST_CLONES
 #endif
 
 /* Sender half for one leaf: sign-quantize + pack + error feedback, one fused
@@ -41,17 +60,18 @@
  * converged elements oscillate within +/-scale). With s == 0 the leaf idles:
  * bits still record signs (matching the XLA/numpy tiers bit-for-bit) but the
  * residual is untouched. */
-static void quantize_leaf(const float *rin, float *rout, int64_t n,
-                          int64_t padded, float s, uint32_t *words) {
-  int64_t nw = padded / 32;
-  int64_t w = 0;
 #ifdef ST_AVX512
-  /* Words whose 32 lanes are all live: two 16-lane compares produce the
-   * bitmask directly; +/-s is the scale with the mask spliced into the IEEE
-   * sign bit (exactly the scalar code's union trick, 16 lanes at a time). */
+/* Words whose 32 lanes are all live: two 16-lane compares produce the
+ * bitmask directly; +/-s is the scale with the mask spliced into the IEEE
+ * sign bit (exactly the scalar code's union trick, 16 lanes at a time).
+ * Returns the number of whole words processed. */
+ST_TARGET_AVX512
+static int64_t quantize_leaf_avx512(const float *rin, float *rout, int64_t n,
+                                    float s, uint32_t *words) {
   const __m512 vzero = _mm512_setzero_ps();
   const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
   const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
+  int64_t w = 0;
   for (; w < n / 32; w++) {
     const float *p = rin + w * 32;
     float *q = rout + w * 32;
@@ -70,6 +90,17 @@ static void quantize_leaf(const float *rin, float *rout, int64_t n,
     }
     words[w] = (uint32_t)m0 | ((uint32_t)m1 << 16);
   }
+  return w;
+}
+#endif
+
+ST_CLONES
+static void quantize_leaf(const float *rin, float *rout, int64_t n,
+                          int64_t padded, float s, uint32_t *words) {
+  int64_t nw = padded / 32;
+  int64_t w = 0;
+#ifdef ST_AVX512
+  if (st_has_avx512()) w = quantize_leaf_avx512(rin, rout, n, s, words);
 #endif
   for (; w < nw; w++) {
     uint32_t bits = 0;
@@ -97,11 +128,48 @@ static void quantize_leaf(const float *rin, float *rout, int64_t n,
   }
 }
 
+#ifdef ST_AVX512
+/* 16 floats/iter; squares/sums accumulate in 8-lane doubles, so the
+ * result is a double-sum like the scalar path (order differs; double
+ * accumulation makes the difference vanish below f32 rounding — the
+ * tiers tolerate 1-ulp scale differences, see ops/codec_np.py).
+ * Returns elements consumed; partials land in amax, ss, sabs. */
+ST_TARGET_AVX512
+static int64_t scale_partials_leaf_avx512(const float *p, int64_t n,
+                                          double *amax, double *ss,
+                                          double *sabs) {
+  const __m512i vabsmask = _mm512_set1_epi32(0x7FFFFFFF);
+  __m512 vamax = _mm512_setzero_ps();
+  __m512d vss0 = _mm512_setzero_pd(), vss1 = _mm512_setzero_pd();
+  __m512d vsa0 = _mm512_setzero_pd(), vsa1 = _mm512_setzero_pd();
+  int64_t j = 0;
+  for (; j + 16 <= n; j += 16) {
+    __m512 v = _mm512_loadu_ps(p + j);
+    __m512 a = _mm512_castsi512_ps(
+        _mm512_and_epi32(_mm512_castps_si512(v), vabsmask));
+    vamax = _mm512_max_ps(vamax, a);
+    __m512d lo = _mm512_cvtps_pd(_mm512_castps512_ps256(v));
+    __m512d hi = _mm512_cvtps_pd(_mm512_extractf32x8_ps(v, 1));
+    vss0 = _mm512_fmadd_pd(lo, lo, vss0);
+    vss1 = _mm512_fmadd_pd(hi, hi, vss1);
+    __m512d alo = _mm512_cvtps_pd(_mm512_castps512_ps256(a));
+    __m512d ahi = _mm512_cvtps_pd(_mm512_extractf32x8_ps(a, 1));
+    vsa0 = _mm512_add_pd(vsa0, alo);
+    vsa1 = _mm512_add_pd(vsa1, ahi);
+  }
+  *amax = _mm512_reduce_max_ps(vamax);
+  *ss = _mm512_reduce_add_pd(vss0) + _mm512_reduce_add_pd(vss1);
+  *sabs = _mm512_reduce_add_pd(vsa0) + _mm512_reduce_add_pd(vsa1);
+  return j;
+}
+#endif
+
 /* Per-leaf reduction partials for the scale policies, one fused pass per
  * leaf: max|r|, sum(r^2), sum(|r|). Double accumulators make the raw sums
  * overflow-safe by construction (f32 max squared ~1.2e77 << DBL_MAX), where
  * the f32 tiers need the amax-normalization trick (quirk Q9 discussion in
  * ops/codec.compute_scale). The Python caller finishes the policy math. */
+ST_CLONES
 EXPORT void stc_scale_partials(const float *r, const int64_t *off,
                                const int64_t *ns, int64_t n_leaves,
                                double *out_amax, double *out_ss,
@@ -114,33 +182,8 @@ EXPORT void stc_scale_partials(const float *r, const int64_t *off,
     double amax[4] = {0, 0, 0, 0}, ss[4] = {0, 0, 0, 0}, sabs[4] = {0, 0, 0, 0};
     int64_t j = 0;
 #ifdef ST_AVX512
-    /* 16 floats/iter; squares/sums accumulate in 8-lane doubles, so the
-     * result is a double-sum like the scalar path (order differs; double
-     * accumulation makes the difference vanish below f32 rounding — the
-     * tiers tolerate 1-ulp scale differences, see ops/codec_np.py). */
-    {
-      const __m512i vabsmask = _mm512_set1_epi32(0x7FFFFFFF);
-      __m512 vamax = _mm512_setzero_ps();
-      __m512d vss0 = _mm512_setzero_pd(), vss1 = _mm512_setzero_pd();
-      __m512d vsa0 = _mm512_setzero_pd(), vsa1 = _mm512_setzero_pd();
-      for (; j + 16 <= n; j += 16) {
-        __m512 v = _mm512_loadu_ps(p + j);
-        __m512 a = _mm512_castsi512_ps(
-            _mm512_and_epi32(_mm512_castps_si512(v), vabsmask));
-        vamax = _mm512_max_ps(vamax, a);
-        __m512d lo = _mm512_cvtps_pd(_mm512_castps512_ps256(v));
-        __m512d hi = _mm512_cvtps_pd(_mm512_extractf32x8_ps(v, 1));
-        vss0 = _mm512_fmadd_pd(lo, lo, vss0);
-        vss1 = _mm512_fmadd_pd(hi, hi, vss1);
-        __m512d alo = _mm512_cvtps_pd(_mm512_castps512_ps256(a));
-        __m512d ahi = _mm512_cvtps_pd(_mm512_extractf32x8_ps(a, 1));
-        vsa0 = _mm512_add_pd(vsa0, alo);
-        vsa1 = _mm512_add_pd(vsa1, ahi);
-      }
-      amax[0] = _mm512_reduce_max_ps(vamax);
-      ss[0] = _mm512_reduce_add_pd(vss0) + _mm512_reduce_add_pd(vss1);
-      sabs[0] = _mm512_reduce_add_pd(vsa0) + _mm512_reduce_add_pd(vsa1);
-    }
+    if (st_has_avx512())
+      j = scale_partials_leaf_avx512(p, n, &amax[0], &ss[0], &sabs[0]);
 #endif
     for (; j + 4 <= n; j += 4) {
       for (int u = 0; u < 4; u++) {
@@ -170,6 +213,7 @@ EXPORT void stc_scale_partials(const float *r, const int64_t *off,
 /* Functional form — reads rin, writes rout (the Python tier's update
  * discipline is replace-not-mutate, so writing to a fresh output buffer
  * saves the 4-byte-per-element input copy an in-place API would force). */
+ST_CLONES
 EXPORT void stc_quantize(const float *rin, float *rout, const int64_t *off,
                          const int64_t *ns, const int64_t *padded,
                          int64_t n_leaves, const float *scales,
@@ -180,10 +224,35 @@ EXPORT void stc_quantize(const float *rin, float *rout, const int64_t *off,
   }
 }
 
+#ifdef ST_AVX512
+/* The packed word IS two __mmask16s: splice each bit into the IEEE sign
+ * of a broadcast s (bit set -> -s, reference src/sharedtensor.c:109)
+ * and accumulate, 16 lanes per op. Returns whole words processed. */
+ST_TARGET_AVX512
+static int64_t accumulate_leaf_avx512(float *d, const uint32_t *w,
+                                      int64_t full, float s) {
+  const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
+  const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
+  int64_t k = 0;
+  for (; k < full; k++) {
+    uint32_t bits = w[k];
+    float *dd = d + k * 32;
+    __mmask16 m0 = (__mmask16)bits;
+    __mmask16 m1 = (__mmask16)(bits >> 16);
+    __m512 d0 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m0, vs, vsign));
+    __m512 d1 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m1, vs, vsign));
+    _mm512_storeu_ps(dd, _mm512_add_ps(_mm512_loadu_ps(dd), d0));
+    _mm512_storeu_ps(dd + 16, _mm512_add_ps(_mm512_loadu_ps(dd + 16), d1));
+  }
+  return k;
+}
+#endif
+
 /* Receiver half: accumulate K frames' deltas into delta[total]
  * (delta += s * (1 - 2*bit), reference src/sharedtensor.c:109), then the
  * caller adds delta to each target array. Splitting accumulate/apply keeps
  * the per-array work to one add pass regardless of K. */
+ST_CLONES
 EXPORT void stc_accumulate_delta(float *delta, const int64_t *off,
                                  const int64_t *ns, const int64_t *padded_unused,
                                  int64_t n_leaves, const float *scales,
@@ -198,21 +267,7 @@ EXPORT void stc_accumulate_delta(float *delta, const int64_t *off,
     int64_t full = n / 32; /* whole words: branch-free, vectorizable */
     int64_t k = 0;
 #ifdef ST_AVX512
-    /* The packed word IS two __mmask16s: splice each bit into the IEEE sign
-     * of a broadcast s (bit set -> -s, reference src/sharedtensor.c:109)
-     * and accumulate, 16 lanes per op. */
-    const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
-    const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
-    for (; k < full; k++) {
-      uint32_t bits = w[k];
-      float *dd = d + k * 32;
-      __mmask16 m0 = (__mmask16)bits;
-      __mmask16 m1 = (__mmask16)(bits >> 16);
-      __m512 d0 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m0, vs, vsign));
-      __m512 d1 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m1, vs, vsign));
-      _mm512_storeu_ps(dd, _mm512_add_ps(_mm512_loadu_ps(dd), d0));
-      _mm512_storeu_ps(dd + 16, _mm512_add_ps(_mm512_loadu_ps(dd + 16), d1));
-    }
+    if (st_has_avx512()) k = accumulate_leaf_avx512(d, w, full, s);
 #endif
     for (; k < full; k++) {
       uint32_t bits = w[k];
@@ -241,6 +296,7 @@ EXPORT void stc_accumulate_delta(float *delta, const int64_t *off,
  * both is 0 by invariant, so a full-width add preserves it). Result clamped
  * to +/-3e38 like every other state-mutating path (ops/codec.SAT: no
  * absorbing inf/NaN state, any tier). Branchless min/max — vectorizes. */
+ST_CLONES
 EXPORT void stc_add_inplace(float *values, const float *delta, int64_t total) {
   for (int64_t i = 0; i < total; i++) {
     float s = values[i] + delta[i];
@@ -254,6 +310,7 @@ EXPORT void stc_add_inplace(float *values, const float *delta, int64_t total) {
  * stc_add_inplace. One pass instead of copy-then-add — at table sizes past
  * LLC the host tier is memory-bandwidth-bound and the extra copy pass was
  * ~1/3 of the apply cost (measured at 16 Mi elements). */
+ST_CLONES
 EXPORT void stc_add_to(float *out, const float *a, const float *delta,
                        int64_t total) {
   for (int64_t i = 0; i < total; i++) {
@@ -264,10 +321,39 @@ EXPORT void stc_add_to(float *out, const float *a, const float *delta,
   }
 }
 
+#ifdef ST_AVX512
+ST_TARGET_AVX512
+static int64_t apply_leaf_avx512(const float *in, float *out,
+                                 const uint32_t *w, int64_t full, float s) {
+  const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
+  const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
+  const __m512 vmax = _mm512_set1_ps(3.0e38f);
+  const __m512 vmin = _mm512_set1_ps(-3.0e38f);
+  int64_t k = 0;
+  for (; k < full; k++) {
+    uint32_t bits = w[k];
+    const float *pp = in + k * 32;
+    float *qq = out + k * 32;
+    __mmask16 m0 = (__mmask16)bits;
+    __mmask16 m1 = (__mmask16)(bits >> 16);
+    __m512 d0 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m0, vs, vsign));
+    __m512 d1 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m1, vs, vsign));
+    __m512 r0 = _mm512_add_ps(_mm512_loadu_ps(pp), d0);
+    __m512 r1 = _mm512_add_ps(_mm512_loadu_ps(pp + 16), d1);
+    r0 = _mm512_max_ps(_mm512_min_ps(r0, vmax), vmin);
+    r1 = _mm512_max_ps(_mm512_min_ps(r1, vmax), vmin);
+    _mm512_storeu_ps(qq, r0);
+    _mm512_storeu_ps(qq + 16, r1);
+  }
+  return k;
+}
+#endif
+
 /* Fully fused single-frame apply: out = clip(in + s*(1-2*bit)) in ONE pass,
  * no delta buffer, no copy — the K=1 receive path (the common case: one
  * incoming frame applied to values + each other link's residual). Padding
  * lanes beyond ns[i] are copied verbatim (0 by invariant). */
+ST_CLONES
 EXPORT void stc_apply_frame(const float *vin, float *vout, const int64_t *off,
                             const int64_t *ns, const int64_t *padded,
                             int64_t n_leaves, const float *scales,
@@ -285,25 +371,7 @@ EXPORT void stc_apply_frame(const float *vin, float *vout, const int64_t *off,
     int64_t full = n / 32;
     int64_t k = 0;
 #ifdef ST_AVX512
-    const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
-    const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
-    const __m512 vmax = _mm512_set1_ps(3.0e38f);
-    const __m512 vmin = _mm512_set1_ps(-3.0e38f);
-    for (; k < full; k++) {
-      uint32_t bits = w[k];
-      const float *pp = in + k * 32;
-      float *qq = out + k * 32;
-      __mmask16 m0 = (__mmask16)bits;
-      __mmask16 m1 = (__mmask16)(bits >> 16);
-      __m512 d0 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m0, vs, vsign));
-      __m512 d1 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m1, vs, vsign));
-      __m512 r0 = _mm512_add_ps(_mm512_loadu_ps(pp), d0);
-      __m512 r1 = _mm512_add_ps(_mm512_loadu_ps(pp + 16), d1);
-      r0 = _mm512_max_ps(_mm512_min_ps(r0, vmax), vmin);
-      r1 = _mm512_max_ps(_mm512_min_ps(r1, vmax), vmin);
-      _mm512_storeu_ps(qq, r0);
-      _mm512_storeu_ps(qq + 16, r1);
-    }
+    if (st_has_avx512()) k = apply_leaf_avx512(in, out, w, full, s);
 #endif
     for (; k < full; k++) {
       uint32_t bits = w[k];
@@ -335,6 +403,7 @@ EXPORT void stc_apply_frame(const float *vin, float *vout, const int64_t *off,
 /* Local additive update, sanitized (quirk Q9 fix — one NaN in the reference
  * poisons every replica through the flood): u is pre-masked by the caller;
  * NaN -> 0, +/-inf and sums clamped to +/-3e38. */
+ST_CLONES
 EXPORT void stc_accumulate_update(float *a, const float *u, int64_t total) {
   for (int64_t i = 0; i < total; i++) {
     float x = u[i];
@@ -353,6 +422,7 @@ EXPORT void stc_accumulate_update(float *a, const float *u, int64_t total) {
  * buffer — the caller no longer pre-masks or copies). Replaces the
  * copy-then-inplace pattern, which cost an extra full memory pass per
  * target array (the add path runs once per link residual plus the replica). */
+ST_CLONES
 EXPORT void stc_accumulate_update_to(float *vout, const float *a,
                                      const float *u, const int64_t *off,
                                      const int64_t *ns, const int64_t *padded,

@@ -1,0 +1,42 @@
+"""Serialized on-demand builds of the native/ libraries.
+
+Both the transport (comm/transport.py) and the host codec (ops/codec_np.py)
+run ``make`` on first load so an edited source can never keep serving a
+previously-built .so. Two peer processes starting concurrently against stale
+sources would otherwise both rebuild the same .so in place while a third
+dlopens the partially-written file; an inter-process flock around the make
+(and the subsequent dlopen in the callers, which only happens after their
+own locked make returned) serializes that.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import pathlib
+import subprocess
+
+NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+
+
+@contextlib.contextmanager
+def build_lock():
+    """Inter-process exclusive lock scoped to the native/ build directory."""
+    lock_path = NATIVE_DIR / ".build.lock"
+    with open(lock_path, "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+
+
+def run_make(target: str | None = None, force: bool = False) -> None:
+    """make -C native/ [target], serialized across processes."""
+    cmd = ["make", "-C", str(NATIVE_DIR)]
+    if force:
+        cmd.append("-B")
+    if target:
+        cmd.append(target)
+    with build_lock():
+        subprocess.run(cmd, check=True, capture_output=True)

@@ -410,12 +410,17 @@ class SharedTensorPeer:
                                 batch.append(frame)
                             continue
                         if payload[0] == wire.DATA:
-                            batch.append(wire.decode_frame(payload, self.st.spec))
+                            # counted BEFORE decode: an undecodable DATA was
+                            # still a received wire message, and the sender's
+                            # in-flight ledger pops one entry per message —
+                            # skipping it would permanently misalign the
+                            # cumulative ACK count and strand ledger entries
                             msgs += 1
+                            batch.append(wire.decode_frame(payload, self.st.spec))
                             continue
                         if payload[0] == wire.BURST:
-                            batch.extend(wire.decode_burst(payload, self.st.spec))
                             msgs += 1
+                            batch.extend(wire.decode_burst(payload, self.st.spec))
                             continue
                     except Exception as e:  # a bad frame must not kill the node
                         log.warning("dropping bad frame on link %d: %s", link, e)
@@ -435,24 +440,29 @@ class SharedTensorPeer:
                 time.sleep(0.002)
 
     def _flush_frames(self, link: int, batch: list, msgs: int | None = None) -> None:
-        if not batch:
-            return
-        try:
-            self.st.receive_frames(link, batch)
-        except Exception:
-            # Fall back to per-frame apply so one bad frame costs only
-            # itself, not up to 255 good ones (received deltas are never
-            # resent — the sender's error feedback already cleared them, so
-            # a discarded good frame would silently diverge the replicas).
-            for f in batch:
-                try:
-                    self.st.receive_frame(link, f)
-                except Exception as e:
-                    log.warning("dropping bad frame on link %d: %s", link, e)
+        n_ack = len(batch) if msgs is None else msgs
+        if batch:
+            try:
+                self.st.receive_frames(link, batch)
+            except Exception:
+                # Fall back to per-frame apply so one bad frame costs only
+                # itself, not up to 255 good ones (received deltas are never
+                # resent — the sender's error feedback already cleared them,
+                # so a discarded good frame would silently diverge the
+                # replicas).
+                for f in batch:
+                    try:
+                        self.st.receive_frame(link, f)
+                    except Exception as e:
+                        log.warning("dropping bad frame on link %d: %s", link, e)
+            self._wake.set()  # flood refills other links' residuals
         # ACK counts wire MESSAGES (one ledger entry each), not frames: a
-        # burst message carries many frames but rolls back / acks whole.
-        self._ack_received(link, len(batch) if msgs is None else msgs)
-        self._wake.set()  # flood refills other links' residuals
+        # burst message carries many frames but rolls back / acks whole. An
+        # undecodable DATA/BURST still counts (batch may be empty, msgs > 0)
+        # — the message was received, and the sender's ledger pops per
+        # message.
+        if n_ack:
+            self._ack_received(link, n_ack)
 
     def _ack_received(self, link: int, n: int) -> None:
         """Tell the sender its frames arrived (drives its in-flight ledger;

@@ -14,12 +14,12 @@ import ctypes
 import dataclasses
 import enum
 import pathlib
-import subprocess
 from typing import Optional
 
+from .. import _build
 from ..config import TransportConfig
 
-_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
+_NATIVE_DIR = _build.NATIVE_DIR
 _LIB_PATH = _NATIVE_DIR / "libsttransport.so"
 
 
@@ -87,12 +87,9 @@ _lib: Optional[ctypes.CDLL] = None
 def build_native(force: bool = False) -> pathlib.Path:
     """Compile native/libsttransport.so if missing or stale (make is
     mtime-based, a no-op when fresh — edited sources must never keep serving
-    a previously-built .so)."""
-    subprocess.run(
-        ["make", "-C", str(_NATIVE_DIR)] + (["-B"] if force else []),
-        check=True,
-        capture_output=True,
-    )
+    a previously-built .so). Serialized across processes via _build.run_make
+    so concurrent peer startups can't rebuild the .so under each other."""
+    _build.run_make(force=force)
     return _LIB_PATH
 
 

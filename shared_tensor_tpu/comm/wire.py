@@ -151,7 +151,13 @@ def encode_burst(frames, spec: TableSpec) -> bytes:
         parts.append(np.asarray(f.scales, dtype="<f4").tobytes())
         parts.append(np.asarray(f.words, dtype="<u4").tobytes())
     out = b"".join(parts)
-    assert len(out) == 2 + len(frames) * frame_payload_bytes(spec)
+    # hard check, not assert (would vanish under python -O): an encoder that
+    # emits a mis-sized burst silently desyncs every downstream decoder
+    if len(out) != 2 + len(frames) * frame_payload_bytes(spec):
+        raise ValueError(
+            f"encoded burst is {len(out)} bytes, layout wants "
+            f"{2 + len(frames) * frame_payload_bytes(spec)} — frame/spec mismatch"
+        )
     return out
 
 
@@ -159,6 +165,10 @@ def decode_burst(payload: bytes, spec: TableSpec) -> list[TableFrame]:
     """Inverse of :func:`encode_burst`, with the same per-frame corruption
     guard as decode_frame (non-finite scales zeroed)."""
     k_frames = payload[1]
+    if k_frames == 0:
+        # encode_burst never emits k=0; accepting one would ACK a message
+        # that delivered nothing (a 2-byte frame-less BURST is corruption)
+        raise ValueError("BURST with k_frames == 0")
     per = frame_payload_bytes(spec)
     want = 2 + k_frames * per
     if len(payload) != want:

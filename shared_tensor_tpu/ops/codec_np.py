@@ -30,12 +30,11 @@ from __future__ import annotations
 
 import ctypes
 import os
-import pathlib
-import subprocess
 from typing import Optional
 
 import numpy as np
 
+from .. import _build
 from ..config import ScalePolicy
 from .codec import SAT as _SAT
 from .table import TableSpec
@@ -47,7 +46,7 @@ from .table import TableSpec
 # always-available fallback and the semantic reference. ST_HOST_CODEC=numpy
 # additionally pins pure numpy (parity tests).
 
-_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / "native"
+_NATIVE_DIR = _build.NATIVE_DIR
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_TRIED = False
 
@@ -68,14 +67,12 @@ def _native() -> Optional[ctypes.CDLL]:
         return None
     path = _NATIVE_DIR / "libstcodec.so"
     try:
-        # Always run make (mtime-based no-op when fresh): the library is
-        # compiled -march=native, so a stale .so — older sources, or built on
-        # a different machine — must be rebuilt, not loaded as-is.
-        subprocess.run(
-            ["make", "-C", str(_NATIVE_DIR), "libstcodec.so"],
-            check=True,
-            capture_output=True,
-        )
+        # Always run make (mtime-based no-op when fresh) so edited sources
+        # never keep serving a stale .so; flock-serialized across processes
+        # (_build.run_make). ISA safety is runtime-dispatched inside the
+        # library itself (__builtin_cpu_supports in stcodec.c), so a .so
+        # built elsewhere is portable — no -march=native rebuild hazard.
+        _build.run_make(target="libstcodec.so")
         lib = ctypes.CDLL(str(path))
         lib.stc_quantize.restype = None
         lib.stc_quantize.argtypes = [
