@@ -1,0 +1,721 @@
+// stengine: the native steady-state link engine for host-tier peers.
+//
+// Round-3 measurement: the Python peer engine costs ~3 ms of interpreter
+// work per wire message, capping small-table throughput at ~300 messages/s
+// (~8.8 k frames/s at 4 Ki via 30-frame bursts) where the reference's bare
+// C loop does 78 k frames/s (BASELINE.md; reference src/sharedtensor.c:
+// 133-189 has no per-frame interpreter cost at all). This engine moves the
+// whole steady-state cycle — scale/quantize (error feedback), wire encode,
+// send, receive, decode, flood apply, ACK bookkeeping — into C, calling the
+// same stcodec.c loops the numpy tier uses (bit-identical results) and the
+// sttransport.cpp queues directly. Python keeps only what is control-plane:
+// join/SYNC handshakes, membership events, checkpoint, metrics.
+//
+// Semantics are a 1:1 port of the Python tier (comm/peer.py send/recv loops
+// + core.SharedTensor), including:
+//  - per-link residual error feedback with an unacked-message ledger;
+//    rollback on link death restores undelivered frames bit-for-bit
+//    (core.SharedTensor._unapply);
+//  - cumulative per-message ACKs, counted even for undecodable DATA/BURST
+//    (the sender's ledger pops per message — see comm/peer.py);
+//  - split-horizon flood: an incoming frame applies to the replica and to
+//    every OTHER link's residual (reference src/sharedtensor.c:124-127);
+//  - BURST framing for small tables, DATA for large, non-finite scales
+//    zeroed at the trust boundary, +/-3e38 saturation everywhere.
+//
+// Latency: the receiver BLOCKS on the transport's data-arrival condvar
+// (st_node_wait_data) and the sender on an engine condvar poked by add(),
+// attach and incoming floods — no polling floors (the Python tier's 2 ms
+// recv sleep / 50 ms drain poll don't exist here).
+//
+// Locking mirrors the Python tier: ONE mutex over (values, residuals,
+// ledgers); codec loops run under it; socket I/O outside it.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <utility>
+#include <vector>
+
+// ---- imported C APIs (same-directory .so's, linked with $ORIGIN rpath) ---
+
+extern "C" {
+// stcodec.c
+void stc_quantize(const float*, float*, const int64_t*, const int64_t*,
+                  const int64_t*, int64_t, const float*, uint32_t*);
+void stc_scale_partials(const float*, const int64_t*, const int64_t*, int64_t,
+                        double*, double*, double*);
+void stc_accumulate_delta(float*, const int64_t*, const int64_t*,
+                          const int64_t*, int64_t, const float*,
+                          const uint32_t*);
+void stc_add_to(float*, const float*, const float*, int64_t);
+void stc_apply_frame(const float*, float*, const int64_t*, const int64_t*,
+                     const int64_t*, int64_t, const float*, const uint32_t*);
+void stc_accumulate_update_to(float*, const float*, const float*,
+                              const int64_t*, const int64_t*, const int64_t*,
+                              int64_t);
+// sttransport.cpp
+int32_t st_node_send(void*, int32_t, const uint8_t*, int32_t, double);
+int32_t st_node_recv(void*, int32_t, uint8_t*, int32_t, double);
+uint64_t st_node_data_seq(void*);
+uint64_t st_node_wait_data(void*, uint64_t, double);
+}
+
+namespace {
+
+// wire message kinds (comm/wire.py)
+constexpr uint8_t kData = 0;
+constexpr uint8_t kAck = 6;
+constexpr uint8_t kBurst = 7;
+
+constexpr float kSat = 3.0e38f;
+
+// scale policies (config.ScalePolicy)
+enum Policy { kPow2Rms = 0, kRms = 1, kAbsMean = 2 };
+
+struct SentMsg {
+  // one wire message = 1..k frames; rolls back / acks whole
+  int32_t nframes;
+  std::vector<float> scales;    // nframes * L
+  std::vector<uint32_t> words;  // nframes * W
+};
+
+struct ELink {
+  std::vector<float> resid;
+  std::deque<SentMsg> unacked;
+  uint64_t acked_cum = 0;  // cumulative ACK count received from the peer
+  uint64_t rx_count = 0;   // cumulative DATA/BURST messages received
+  uint64_t ack_sent = 0;   // highest ACK value actually delivered
+  bool dirty = true;       // residual may quantize to something nonzero
+  bool dead = false;       // transport reported death; stop touching
+};
+
+struct Engine {
+  void* node = nullptr;
+  int64_t L = 0, total = 0, total_n = 0, W = 0;
+  std::vector<int64_t> off, ns, padded;
+  int policy = kPow2Rms;
+  bool per_leaf = true;
+  int burst = 1;         // frames per BURST message (1 => DATA framing)
+  int32_t recv_cap = 0;  // recv buffer size (max wire message)
+
+  std::vector<float> values;
+  std::map<int32_t, ELink> links;
+  std::mutex mu;
+
+  // sender wake (missed-wakeup-safe sequence counter)
+  std::mutex wmu;
+  std::condition_variable wcv;
+  uint64_t wseq = 0;
+
+  // control messages (non DATA/BURST/ACK) surfaced to Python
+  std::mutex cmu;
+  std::deque<std::pair<int32_t, std::vector<uint8_t>>> ctrl;
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> frames_out{0}, frames_in{0}, updates{0};
+  std::atomic<uint64_t> msgs_out{0}, msgs_in{0};
+  std::thread send_thread, recv_thread;
+
+  void wake() {
+    {
+      std::lock_guard<std::mutex> lk(wmu);
+      wseq++;
+    }
+    wcv.notify_all();
+  }
+};
+
+// scale = policy(partials); zero when the leaf is all-zero or the result is
+// non-finite. Bit-identical to ops/codec_np.compute_scales_np's native
+// branch: double math, cast to f32, pow2-floor by exponent mask.
+void compute_scales(Engine* e, const float* r, float* out) {
+  std::vector<double> amax(e->L), ss(e->L), sabs(e->L);
+  stc_scale_partials(r, e->off.data(), e->ns.data(), e->L, amax.data(),
+                     ss.data(), sabs.data());
+  if (!e->per_leaf) {
+    double am = 0, s2 = 0, sa = 0;
+    for (int64_t i = 0; i < e->L; i++) {
+      if (amax[i] > am) am = amax[i];
+      s2 += ss[i];
+      sa += sabs[i];
+    }
+    for (int64_t i = 0; i < e->L; i++) {
+      amax[i] = am;
+      ss[i] = s2;
+      sabs[i] = sa;
+    }
+  }
+  for (int64_t i = 0; i < e->L; i++) {
+    double n = e->per_leaf ? (double)e->ns[i] : (double)e->total_n;
+    float s;
+    if (e->policy == kAbsMean) {
+      s = (float)(sabs[i] / n);
+    } else {
+      s = (float)std::sqrt(ss[i] / n);
+      if (e->policy == kPow2Rms) {
+        union {
+          float f;
+          uint32_t u;
+        } b;
+        b.f = s;
+        b.u &= 0x7F800000u;  // 2^floor(log2 s); subnormals -> 0
+        s = b.f;
+      }
+    }
+    out[i] = (amax[i] > 0 && std::isfinite(s)) ? s : 0.0f;
+  }
+}
+
+bool any_nonzero(const float* s, int64_t L) {
+  for (int64_t i = 0; i < L; i++)
+    if (s[i] != 0.0f) return true;
+  return false;
+}
+
+// Roll every unacked message's error feedback back into the residual
+// (core.SharedTensor._unapply: re-applying a frame to the residual restores
+// the pre-quantize state bit-for-bit). Caller holds e->mu.
+void rollback_unacked(Engine* e, ELink& lk) {
+  for (auto& msg : lk.unacked) {
+    for (int32_t f = 0; f < msg.nframes; f++) {
+      stc_apply_frame(lk.resid.data(), lk.resid.data(), e->off.data(),
+                      e->ns.data(), e->padded.data(), e->L,
+                      msg.scales.data() + (size_t)f * e->L,
+                      msg.words.data() + (size_t)f * e->W);
+    }
+  }
+  lk.unacked.clear();
+}
+
+// Apply k decoded frames from `src_link` to the replica and every OTHER
+// link's residual (split-horizon flood). Caller holds e->mu.
+void apply_batch(Engine* e, int32_t src_link, int32_t k, const float* scales,
+                 const uint32_t* words) {
+  // NOTE: dead links are NOT skipped here (only the I/O loops skip them):
+  // a dead link's residual keeps accumulating until Python detaches it —
+  // that residual IS the carry the re-graft owes, and mass applied in the
+  // death-to-detach window would otherwise vanish from the carry AND be
+  // claimed by the re-join snapshot, losing it tree-wide
+  // (core.SharedTensor applies to all links until drop_link, same reason).
+  if (k == 1) {
+    // fused single-frame path: one clamped pass per target, no delta buffer
+    stc_apply_frame(e->values.data(), e->values.data(), e->off.data(),
+                    e->ns.data(), e->padded.data(), e->L, scales, words);
+    for (auto& kv : e->links) {
+      if (kv.first == src_link) continue;
+      stc_apply_frame(kv.second.resid.data(), kv.second.resid.data(),
+                      e->off.data(), e->ns.data(), e->padded.data(), e->L,
+                      scales, words);
+      kv.second.dirty = true;
+    }
+  } else {
+    std::vector<float> delta((size_t)e->total, 0.0f);
+    for (int32_t f = 0; f < k; f++) {
+      const float* row = scales + (size_t)f * e->L;
+      if (!any_nonzero(row, e->L)) continue;
+      stc_accumulate_delta(delta.data(), e->off.data(), e->ns.data(),
+                           e->padded.data(), e->L, row,
+                           words + (size_t)f * e->W);
+    }
+    stc_add_to(e->values.data(), e->values.data(), delta.data(), e->total);
+    for (auto& kv : e->links) {
+      if (kv.first == src_link) continue;
+      stc_add_to(kv.second.resid.data(), kv.second.resid.data(), delta.data(),
+                 e->total);
+      kv.second.dirty = true;
+    }
+  }
+  e->frames_in += (uint64_t)k;
+}
+
+// ---- sender ---------------------------------------------------------------
+
+size_t frame_bytes(const Engine* e) {
+  return (size_t)e->L * 4 + (size_t)e->W * 4;
+}
+
+void sender_loop(Engine* e) {
+  std::vector<uint8_t> payload;
+  std::vector<float> scales((size_t)e->L);
+  while (!e->stop.load()) {
+    uint64_t seq_before;
+    {
+      std::lock_guard<std::mutex> lk(e->wmu);
+      seq_before = e->wseq;
+    }
+    bool sent_any = false;
+    std::vector<int32_t> ids;
+    {
+      std::lock_guard<std::mutex> lk(e->mu);
+      for (auto& kv : e->links)
+        if (!kv.second.dead) ids.push_back(kv.first);
+    }
+    for (int32_t id : ids) {
+      if (e->stop.load()) return;
+      SentMsg msg;
+      {
+        std::lock_guard<std::mutex> lk(e->mu);
+        auto it = e->links.find(id);
+        if (it == e->links.end() || it->second.dead) continue;
+        ELink& lk2 = it->second;
+        if (!lk2.dirty) continue;
+        // quantize up to `burst` successive halvings of the residual,
+        // stopping at the first all-zero-scale frame (idle)
+        msg.nframes = 0;
+        for (int b = 0; b < e->burst; b++) {
+          compute_scales(e, lk2.resid.data(), scales.data());
+          if (!any_nonzero(scales.data(), e->L)) {
+            if (b == 0) lk2.dirty = false;  // nothing to say at all
+            break;
+          }
+          size_t base_s = msg.scales.size(), base_w = msg.words.size();
+          msg.scales.resize(base_s + (size_t)e->L);
+          msg.words.resize(base_w + (size_t)e->W);
+          std::memcpy(msg.scales.data() + base_s, scales.data(),
+                      (size_t)e->L * 4);
+          stc_quantize(lk2.resid.data(), lk2.resid.data(), e->off.data(),
+                       e->ns.data(), e->padded.data(), e->L, scales.data(),
+                       msg.words.data() + base_w);
+          msg.nframes++;
+        }
+        if (msg.nframes == 0) continue;
+        e->frames_out += (uint64_t)msg.nframes;
+        // ledger entry BEFORE the send: the receiver's ACK must never race
+        // ahead of the entry it acknowledges (comm/peer.py _send_loop)
+        it->second.unacked.push_back(msg);
+      }
+      // encode + send outside the lock
+      size_t per = frame_bytes(e);
+      if (e->burst > 1) {
+        payload.resize(2 + (size_t)msg.nframes * per);
+        payload[0] = kBurst;
+        payload[1] = (uint8_t)msg.nframes;
+        uint8_t* p = payload.data() + 2;
+        for (int32_t f = 0; f < msg.nframes; f++) {
+          std::memcpy(p, msg.scales.data() + (size_t)f * e->L,
+                      (size_t)e->L * 4);
+          p += (size_t)e->L * 4;
+          std::memcpy(p, msg.words.data() + (size_t)f * e->W,
+                      (size_t)e->W * 4);
+          p += (size_t)e->W * 4;
+        }
+      } else {
+        payload.resize(1 + per);
+        payload[0] = kData;
+        std::memcpy(payload.data() + 1, msg.scales.data(), (size_t)e->L * 4);
+        std::memcpy(payload.data() + 1 + (size_t)e->L * 4, msg.words.data(),
+                    (size_t)e->W * 4);
+      }
+      bool delivered = false;
+      while (!e->stop.load()) {
+        int32_t r = st_node_send(e->node, id, payload.data(),
+                                 (int32_t)payload.size(), 0.1);
+        if (r == 1) {
+          delivered = true;
+          break;
+        }
+        if (r < 0) break;  // dead link
+      }
+      if (delivered) {
+        e->msgs_out++;
+        sent_any = true;
+      } else {
+        // undelivered: roll ALL outstanding feedback back so a re-graft
+        // owes the full residual (peer.py nack path on send failure)
+        std::lock_guard<std::mutex> lk(e->mu);
+        auto it = e->links.find(id);
+        if (it != e->links.end()) {
+          rollback_unacked(e, it->second);
+          it->second.dead = true;
+        }
+      }
+    }
+    if (!sent_any && !e->stop.load()) {
+      std::unique_lock<std::mutex> lk(e->wmu);
+      if (e->wseq <= seq_before) {
+        e->wcv.wait_for(lk, std::chrono::milliseconds(50),
+                        [&] { return e->wseq > seq_before || e->stop.load(); });
+      }
+    }
+  }
+}
+
+// ---- receiver -------------------------------------------------------------
+
+void flush_acks(Engine* e, int32_t id, ELink& lk) {
+  // cumulative + retried (a backpressure-dropped ACK must be re-offered or
+  // the sender's ledger never drains — comm/peer.py _flush_acks)
+  if (lk.rx_count <= lk.ack_sent || lk.dead) return;
+  uint8_t ack[9];
+  ack[0] = kAck;
+  uint64_t c = lk.rx_count;
+  std::memcpy(ack + 1, &c, 8);  // little-endian host assumed (x86/arm64-le)
+  int32_t r = st_node_send(e->node, id, ack, 9, 0.0);
+  if (r == 1 || r < 0) lk.ack_sent = lk.rx_count;
+}
+
+void receiver_loop(Engine* e) {
+  std::vector<uint8_t> buf((size_t)e->recv_cap);
+  // batch accumulators (frames from one link applied in one pass)
+  std::vector<float> bscales;
+  std::vector<uint32_t> bwords;
+  size_t per = frame_bytes(e);
+  while (!e->stop.load()) {
+    uint64_t seq0 = st_node_data_seq(e->node);
+    bool busy = false;
+    std::vector<int32_t> ids;
+    {
+      std::lock_guard<std::mutex> lk(e->mu);
+      for (auto& kv : e->links)
+        if (!kv.second.dead) ids.push_back(kv.first);
+    }
+    for (int32_t id : ids) {
+      int32_t batchk = 0;
+      uint64_t msgs = 0;
+      bscales.clear();
+      bwords.clear();
+      auto flush = [&]() {
+        if (batchk == 0 && msgs == 0) return;
+        std::lock_guard<std::mutex> lk(e->mu);
+        auto it = e->links.find(id);
+        if (it == e->links.end()) return;
+        if (batchk > 0) {
+          apply_batch(e, id, batchk, bscales.data(), bwords.data());
+        }
+        it->second.rx_count += msgs;
+        e->msgs_in += msgs;
+        flush_acks(e, id, it->second);
+        batchk = 0;
+        msgs = 0;
+        bscales.clear();
+        bwords.clear();
+      };
+      for (int iter = 0; iter < 256; iter++) {  // bounded: don't starve links
+        int32_t n = st_node_recv(e->node, id, buf.data(), e->recv_cap, 0.0);
+        if (n == 0) break;
+        if (n < 0) {
+          // dead + drained; rollback happens at detach (or the sender's
+          // failed send) — membership/carry is Python's call
+          std::lock_guard<std::mutex> lk(e->mu);
+          auto it = e->links.find(id);
+          if (it != e->links.end()) it->second.dead = true;
+          break;
+        }
+        busy = true;
+        uint8_t kind = buf[0];
+        if (kind == kData || kind == kBurst) {
+          // counted even when undecodable: the message was received and the
+          // sender's ledger pops per message (comm/peer.py)
+          msgs++;
+          int32_t k = 0;
+          const uint8_t* p = nullptr;
+          if (kind == kData && (size_t)n == 1 + per) {
+            k = 1;
+            p = buf.data() + 1;
+          } else if (kind == kBurst && n >= 2 && buf[1] > 0 &&
+                     (size_t)n == 2 + (size_t)buf[1] * per) {
+            k = buf[1];
+            p = buf.data() + 2;
+          }
+          for (int32_t f = 0; f < k; f++) {
+            size_t bs = bscales.size(), bw = bwords.size();
+            bscales.resize(bs + (size_t)e->L);
+            bwords.resize(bw + (size_t)e->W);
+            std::memcpy(bscales.data() + bs, p, (size_t)e->L * 4);
+            p += (size_t)e->L * 4;
+            std::memcpy(bwords.data() + bw, p, (size_t)e->W * 4);
+            p += (size_t)e->W * 4;
+            // trust boundary: non-finite scales become no-op leaves
+            // (wire.decode_frame guard; quirk Q9's receive-path analog)
+            for (int64_t i = 0; i < e->L; i++) {
+              float* s = bscales.data() + bs + i;
+              if (!std::isfinite(*s)) *s = 0.0f;
+            }
+            batchk++;
+          }
+        } else if (kind == kAck && n == 9) {
+          uint64_t count;
+          std::memcpy(&count, buf.data() + 1, 8);
+          std::lock_guard<std::mutex> lk(e->mu);
+          auto it = e->links.find(id);
+          if (it != e->links.end()) {
+            ELink& lk2 = it->second;
+            uint64_t done = count > lk2.acked_cum ? count - lk2.acked_cum : 0;
+            lk2.acked_cum = count;
+            while (done-- > 0 && !lk2.unacked.empty())
+              lk2.unacked.pop_front();
+          }
+        } else {
+          // control-plane message (handshake retries, REJECT, unknown):
+          // preserve ordering — flush data first — then hand to Python
+          flush();
+          std::lock_guard<std::mutex> lk(e->cmu);
+          e->ctrl.emplace_back(
+              id, std::vector<uint8_t>(buf.data(), buf.data() + n));
+        }
+      }
+      bool applied = batchk > 0;
+      flush();
+      {
+        // retry any previously-backpressured ACK even on idle passes
+        std::lock_guard<std::mutex> lk(e->mu);
+        auto it = e->links.find(id);
+        if (it != e->links.end()) flush_acks(e, id, it->second);
+      }
+      if (applied) e->wake();  // flood refilled other links' residuals
+    }
+    if (!busy && !e->stop.load()) {
+      st_node_wait_data(e->node, seq0, 0.05);
+    }
+  }
+}
+
+}  // namespace
+
+// ---- C ABI ---------------------------------------------------------------
+
+extern "C" {
+
+__attribute__((visibility("default"))) void* st_engine_create(
+    void* node, const int64_t* off, const int64_t* ns, const int64_t* padded,
+    int64_t n_leaves, int64_t total, int64_t total_n,
+    const float* init_values /* or NULL */, int32_t policy, int32_t per_leaf,
+    int32_t burst, int32_t recv_cap) {
+  auto* e = new Engine();
+  e->node = node;
+  e->L = n_leaves;
+  e->total = total;
+  e->total_n = total_n;
+  e->W = total / 32;
+  e->off.assign(off, off + n_leaves);
+  e->ns.assign(ns, ns + n_leaves);
+  e->padded.assign(padded, padded + n_leaves);
+  e->policy = policy;
+  e->per_leaf = per_leaf != 0;
+  e->burst = burst < 1 ? 1 : (burst > 255 ? 255 : burst);
+  e->recv_cap = recv_cap;
+  e->values.assign((size_t)total, 0.0f);
+  if (init_values)
+    std::memcpy(e->values.data(), init_values, (size_t)total * 4);
+  return e;
+}
+
+__attribute__((visibility("default"))) void st_engine_start(void* h) {
+  auto* e = (Engine*)h;
+  e->send_thread = std::thread(sender_loop, e);
+  e->recv_thread = std::thread(receiver_loop, e);
+}
+
+// Stop the engine threads. MUST be called before st_node_close (the threads
+// block inside the node's condvars/queues).
+__attribute__((visibility("default"))) void st_engine_stop(void* h) {
+  auto* e = (Engine*)h;
+  e->stop.store(true);
+  e->wake();
+  if (e->send_thread.joinable()) e->send_thread.join();
+  if (e->recv_thread.joinable()) e->recv_thread.join();
+}
+
+__attribute__((visibility("default"))) void st_engine_destroy(void* h) {
+  delete (Engine*)h;
+}
+
+// values += sanitize(u), every residual += sanitize(u)
+// (core.SharedTensor.add / reference addFromInternal src/sharedtensor.c:
+// 334-344, with quirks Q7/Q9 fixed).
+__attribute__((visibility("default"))) void st_engine_add(void* h,
+                                                          const float* u) {
+  auto* e = (Engine*)h;
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    stc_accumulate_update_to(e->values.data(), e->values.data(), u,
+                             e->off.data(), e->ns.data(), e->padded.data(),
+                             e->L);
+    // dead links included: their residual is the re-graft carry (see
+    // apply_batch)
+    for (auto& kv : e->links) {
+      stc_accumulate_update_to(kv.second.resid.data(), kv.second.resid.data(),
+                               u, e->off.data(), e->ns.data(),
+                               e->padded.data(), e->L);
+      kv.second.dirty = true;
+    }
+    e->updates++;
+  }
+  e->wake();
+}
+
+__attribute__((visibility("default"))) void st_engine_read(void* h,
+                                                           float* out) {
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->mu);
+  std::memcpy(out, e->values.data(), (size_t)e->total * 4);
+}
+
+// Open a link with residual = values - peer_snapshot (the diff handshake
+// seed, core.SharedTensor.new_link_diff). snapshot NULL => zero residual;
+// seed!=0 => residual = full replica (reference join seeding). rx_init
+// carries the cumulative receive count Python accumulated before attach so
+// the ACK stream stays monotonic.
+__attribute__((visibility("default"))) int32_t st_engine_attach(
+    void* h, int32_t link_id, const float* snapshot, int32_t seed,
+    uint64_t rx_init) {
+  auto* e = (Engine*)h;
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    if (e->links.count(link_id)) return 0;  // already exists
+    ELink& lk2 = e->links[link_id];
+    lk2.resid.assign((size_t)e->total, 0.0f);
+    if (snapshot) {
+      for (int64_t i = 0; i < e->total; i++)
+        lk2.resid[i] = e->values[i] - snapshot[i];
+    } else if (seed) {
+      std::memcpy(lk2.resid.data(), e->values.data(), (size_t)e->total * 4);
+    }
+    lk2.rx_count = rx_init;
+    lk2.ack_sent = rx_init;
+    lk2.dirty = true;
+  }
+  e->wake();
+  return 1;
+}
+
+// Close a link; writes its undelivered residual (unacked frames rolled
+// back) into out_resid. Returns 1 if the link existed.
+__attribute__((visibility("default"))) int32_t st_engine_detach(
+    void* h, int32_t link_id, float* out_resid) {
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->links.find(link_id);
+  if (it == e->links.end()) return 0;
+  rollback_unacked(e, it->second);
+  if (out_resid)
+    std::memcpy(out_resid, it->second.resid.data(), (size_t)e->total * 4);
+  e->links.erase(it);
+  return 1;
+}
+
+// Apply k externally-decoded frames from src_link (which need not be
+// attached — the pre-WELCOME flood-in case) to values + all other
+// residuals. RX/ACK accounting for these stays with the caller.
+__attribute__((visibility("default"))) void st_engine_inject(
+    void* h, int32_t src_link, int32_t k, const float* scales,
+    const uint32_t* words) {
+  auto* e = (Engine*)h;
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    apply_batch(e, src_link, k, scales, words);
+  }
+  e->wake();
+}
+
+__attribute__((visibility("default"))) int32_t st_engine_links(void* h,
+                                                               int32_t* out,
+                                                               int32_t cap) {
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->mu);
+  int32_t n = 0;
+  for (auto& kv : e->links) {
+    if (n >= cap) break;
+    out[n++] = kv.first;
+  }
+  return n;
+}
+
+__attribute__((visibility("default"))) double st_engine_residual_rms(
+    void* h, int32_t link_id) {
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->links.find(link_id);
+  if (it == e->links.end()) return 0.0;
+  double ss = 0;
+  const float* r = it->second.resid.data();
+  for (int64_t i = 0; i < e->total; i++) ss += (double)r[i] * (double)r[i];
+  return std::sqrt(ss / (double)e->total_n);
+}
+
+__attribute__((visibility("default"))) int64_t st_engine_inflight(void* h) {
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->mu);
+  int64_t n = 0;
+  for (auto& kv : e->links) n += (int64_t)kv.second.unacked.size();
+  return n;
+}
+
+// counters: [frames_out, frames_in, updates, msgs_out, msgs_in]
+__attribute__((visibility("default"))) void st_engine_counters(
+    void* h, uint64_t* out5) {
+  auto* e = (Engine*)h;
+  out5[0] = e->frames_out.load();
+  out5[1] = e->frames_in.load();
+  out5[2] = e->updates.load();
+  out5[3] = e->msgs_out.load();
+  out5[4] = e->msgs_in.load();
+}
+
+// Pop one control-plane message; returns its length (0 = none). link_out
+// receives the source link id.
+__attribute__((visibility("default"))) int32_t st_engine_poll_ctrl(
+    void* h, int32_t* link_out, uint8_t* buf, int32_t cap) {
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->cmu);
+  if (e->ctrl.empty()) return 0;
+  auto& front = e->ctrl.front();
+  *link_out = front.first;
+  int32_t n = (int32_t)std::min<size_t>(front.second.size(), (size_t)cap);
+  std::memcpy(buf, front.second.data(), (size_t)n);
+  e->ctrl.pop_front();
+  return n;
+}
+
+// Checkpoint restore: replace the replica and the residuals of links that
+// exist both in the engine and in the checkpoint, atomically (the inverse
+// of st_engine_snapshot_all; utils/checkpoint.load_shared).
+__attribute__((visibility("default"))) void st_engine_restore(
+    void* h, const float* values, int32_t n_links, const int32_t* ids,
+    const float* resids) {
+  auto* e = (Engine*)h;
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    std::memcpy(e->values.data(), values, (size_t)e->total * 4);
+    for (int32_t i = 0; i < n_links; i++) {
+      auto it = e->links.find(ids[i]);
+      if (it == e->links.end()) continue;
+      std::memcpy(it->second.resid.data(), resids + (size_t)i * e->total,
+                  (size_t)e->total * 4);
+      it->second.dirty = true;
+    }
+  }
+  ((Engine*)h)->wake();
+}
+
+// Consistent point-in-time (values, residuals) snapshot under ONE lock —
+// the checkpoint primitive (core.SharedTensor.snapshot_all). resid_out must
+// hold max_links * total floats; returns the number of links written.
+__attribute__((visibility("default"))) int32_t st_engine_snapshot_all(
+    void* h, float* values_out, int32_t* ids_out, float* resid_out,
+    int32_t max_links) {
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->mu);
+  std::memcpy(values_out, e->values.data(), (size_t)e->total * 4);
+  int32_t n = 0;
+  for (auto& kv : e->links) {
+    if (n >= max_links) break;
+    ids_out[n] = kv.first;
+    std::memcpy(resid_out + (size_t)n * e->total, kv.second.resid.data(),
+                (size_t)e->total * 4);
+    n++;
+  }
+  return n;
+}
+
+}  // extern "C"

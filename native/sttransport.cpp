@@ -173,9 +173,26 @@ struct Node {
   std::deque<Event> events;
   std::condition_variable ev_cv;
 
+  // Data-arrival signal: bumped (and notified) whenever any link pushes a
+  // received frame, so a consumer (the native engine's receiver) can BLOCK
+  // for new input across all links instead of polling each queue — the
+  // poll-interval latency floor the Python tier suffers from (50ms drain /
+  // 2ms recv sleeps) has no reason to exist at this layer.
+  std::mutex data_mu;
+  std::condition_variable data_cv;
+  uint64_t data_seq = 0;
+
   sockaddr_in rendezvous{};
   bool is_master = false;
   std::string last_error;
+
+  void notify_data() {
+    {
+      std::lock_guard<std::mutex> lk(data_mu);
+      data_seq++;
+    }
+    data_cv.notify_all();
+  }
 
   void emit(int32_t kind, int32_t link_id, int32_t is_uplink) {
     std::lock_guard<std::mutex> lk(ev_mu);
@@ -356,14 +373,18 @@ void link_receiver_loop(Node* node, std::shared_ptr<Link> link) {
     }
     link->bytes_in += frame.size() + (node->cfg.wire_compat ? 0 : 4);
     link->frames_in++;
-    // Block if Python is behind: TCP backpressure then paces the peer,
-    // exactly like the reference's blocking frame loop. Never drop: frames
-    // are cumulative deltas.
+    // Block if the consumer is behind: TCP backpressure then paces the
+    // peer, exactly like the reference's blocking frame loop. Never drop:
+    // frames are cumulative deltas.
     while (link->alive && !node->closing) {
-      if (link->recvq.push(std::move(frame), 0.5)) break;
+      if (link->recvq.push(std::move(frame), 0.5)) {
+        node->notify_data();
+        break;
+      }
     }
   }
   kill_link(node, link);
+  node->notify_data();  // wake blocked consumers so they observe the death
   link_io_exit(node, link);
 }
 
@@ -812,6 +833,29 @@ int32_t st_node_stats(void* h, int32_t link_id, StStatsC* out) {
   return 0;
 }
 
+// Data-arrival sequence number: bumps whenever any link delivers a frame
+// into its recv queue (or a link dies). Pair with st_node_wait_data for
+// blocking multi-link consumption without per-queue polling.
+uint64_t st_node_data_seq(void* h) {
+  auto* node = (Node*)h;
+  std::lock_guard<std::mutex> lk(node->data_mu);
+  return node->data_seq;
+}
+
+// Block until the data sequence advances past last_seq (returns the new
+// value), or timeout (returns the current value). A caller that drains the
+// queues, then waits on the seq it read BEFORE draining, can never miss a
+// wakeup.
+uint64_t st_node_wait_data(void* h, uint64_t last_seq, double timeout_sec) {
+  auto* node = (Node*)h;
+  std::unique_lock<std::mutex> lk(node->data_mu);
+  if (node->data_seq <= last_seq && timeout_sec > 0) {
+    node->data_cv.wait_for(lk, std::chrono::duration<double>(timeout_sec),
+                           [&] { return node->data_seq > last_seq; });
+  }
+  return node->data_seq;
+}
+
 // Drop one link deliberately (tests / fault injection).
 int32_t st_node_drop_link(void* h, int32_t link_id) {
   auto* node = (Node*)h;
@@ -847,6 +891,7 @@ void st_node_close(void* h) {
   }
   for (auto& l : links) kill_link(node, l);
   node->ev_cv.notify_all();
+  node->notify_data();  // unblock any engine waiting in st_node_wait_data
   // All threads are detached; wait (bounded) for them to drain.
   for (int i = 0; i < 1000 && node->active_threads > 0; i++) {
     std::this_thread::sleep_for(std::chrono::milliseconds(10));

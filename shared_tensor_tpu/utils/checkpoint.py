@@ -90,6 +90,10 @@ def load_shared(st: SharedTensor, path: str) -> None:
             for lid in meta.get("links", [])
             if f"link_{lid}" in z
         }
+    restore = getattr(st, "restore_state", None)
+    if restore is not None:  # native-engine tier: state lives in C
+        restore(values, links)
+        return
     with st._lock:
         # _asarray keeps the tensor's codec tier: numpy arrays on the host
         # tier (a jnp restore would silently bounce every later frame
