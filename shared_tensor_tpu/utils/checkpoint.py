@@ -13,10 +13,12 @@ the codec (src/sharedtensor.c:379-381). This module adds the missing half:
      the codec stream — the reference's own join mechanism, which this
      checkpoint complements rather than replaces.
 
-Plain .npz keeps the format inspectable and dependency-free; the sharded pod
-state round-trips through host memory and is re-placed onto the mesh
-sharding on load (tables that fit one host; beyond that, shard-parallel
-checkpointing is an orbax integration point).
+Plain .npz keeps the format inspectable and dependency-free. Two pod-tier
+formats: save_pod/load_pod round-trip through one host's memory (tables
+that fit a host); save_pod_sharded/load_pod_sharded write one file per
+device shard and restore via per-shard callbacks, so tables sharded
+precisely because they exceed host RAM (quirk Q6's fix) checkpoint with
+O(total / n_devices) peak host memory.
 """
 
 from __future__ import annotations
@@ -218,3 +220,159 @@ def load_pod(
     return PeerSyncState(
         jax.device_put(values, sh), jax.device_put(residual, sh)
     )
+
+
+# ---- sharded (per-device) pod checkpoint ----------------------------------
+#
+# save_pod/load_pod round-trip the whole table through ONE host's memory
+# (jax.device_get of the full array) — fine until the table is sharded
+# precisely because it exceeds a host's RAM (quirk Q6's fix, SURVEY.md §5.7).
+# These variants move exactly one SHARD at a time: save iterates the
+# array's addressable shards (each process writes only its own), load
+# rebuilds via jax.make_array_from_callback, which pulls each device's
+# slice individually — peak host memory is O(total / n_devices), not
+# O(total). Layout on disk:
+#
+#   path/meta.npz            layout digest, global shape, n_processes
+#                            (written by process 0)
+#   path/manifest_p<i>.npz   process i's authoritative shard list
+#   path/shard_p<i>_<k0-k1_...>.npz  one device shard: values + residual
+#
+# The loader unions exactly the manifests meta names and reads ONLY files
+# they list — stray shard files from an earlier save with a different
+# sharding (save never deletes other layouts' files) are ignored instead of
+# silently served. Plain .npz keeps the format inspectable and
+# dependency-free; orbax would add async/parallel-write polish but no
+# semantic difference.
+
+
+def _index_key(index, shape) -> str:
+    """Stable filename key for a global shard index. A dim partitioned over
+    a size-1 mesh axis arrives as slice(None) — normalize its bounds to the
+    full dim, never embedding 'None' in the filename."""
+    return "_".join(
+        f"{s.start or 0}-{s.stop if s.stop is not None else shape[d]}"
+        for d, s in enumerate(index)
+    )
+
+
+def save_pod_sharded(state: "PeerSyncState", spec: TableSpec, path: str) -> None:
+    """Per-shard snapshot of the pod state into directory ``path``. Each
+    addressable shard of (values, residual) lands in its own .npz; on a
+    multi-process pod every process writes only its addressable shards (and
+    its own manifest), so no host ever materializes the full table."""
+    os.makedirs(path, exist_ok=True)
+    pi = jax.process_index()
+    shape = state.values.shape
+    shard_keys = []
+    for vs, rs in zip(
+        state.values.addressable_shards, state.residual.addressable_shards
+    ):
+        # values and residual share one sharding (state_sharding), so the
+        # shard lists align index-for-index; assert rather than assume
+        if vs.index != rs.index:
+            raise AssertionError("values/residual shard indices diverged")
+        key = _index_key(vs.index, shape)
+        shard_keys.append(key)
+        _atomic_savez(
+            os.path.join(path, f"shard_p{pi}_{key}.npz"),
+            values=np.asarray(vs.data),
+            residual=np.asarray(rs.data),
+        )
+    _atomic_savez(
+        os.path.join(path, f"manifest_p{pi}.npz"),
+        meta=np.frombuffer(
+            json.dumps({"shards": shard_keys}).encode(), dtype=np.uint8
+        ),
+    )
+    if pi == 0:
+        _atomic_savez(
+            os.path.join(path, "meta.npz"),
+            layout=np.frombuffer(spec.layout_digest(), dtype=np.uint8),
+            shape=np.asarray(shape, np.int64),
+            meta=np.frombuffer(
+                json.dumps(
+                    {"format": _FORMAT, "n_processes": jax.process_count()}
+                ).encode(),
+                dtype=np.uint8,
+            ),
+        )
+
+
+def load_pod_sharded(
+    path: str,
+    mesh: "Mesh",
+    spec: TableSpec,
+    config: "MeshConfig | None" = None,
+) -> "PeerSyncState":
+    """Rebuild a PeerSyncState from a :func:`save_pod_sharded` directory.
+    ``jax.make_array_from_callback`` asks for one device's global index at a
+    time; the callback opens only the covering shard's file and decodes only
+    the needed member (npz members decode lazily), so peak host memory stays
+    at one shard. The mesh may differ from the saving mesh as long as saved
+    shards cover the new boundaries (a callback index is served by slicing
+    the one saved shard that contains it)."""
+    from ..parallel.ici import PeerSyncState, state_sharding
+
+    with np.load(os.path.join(path, "meta.npz")) as z:
+        if z["layout"].tobytes() != spec.layout_digest():
+            raise ValueError("checkpoint layout does not match the table spec")
+        shape = tuple(int(x) for x in z["shape"])
+        meta = json.loads(z["meta"].tobytes().decode())
+    sh = state_sharding(mesh, config)
+    n_peer = mesh.shape[sh.spec[0]]
+    if shape[0] != n_peer:
+        raise ValueError(f"checkpoint has {shape[0]} peers, mesh has {n_peer}")
+    # authoritative shard set = union of exactly the saving processes'
+    # manifests (never a bare listdir: stale files from an earlier save
+    # with a different sharding must not be served)
+    saved = []
+    for pi in range(int(meta.get("n_processes", 1))):
+        with np.load(os.path.join(path, f"manifest_p{pi}.npz")) as z:
+            keys = json.loads(z["meta"].tobytes().decode())["shards"]
+        for key in keys:
+            bounds = [
+                tuple(int(v) for v in part.split("-"))
+                for part in key.split("_")
+            ]
+            saved.append((bounds, os.path.join(path, f"shard_p{pi}_{key}.npz")))
+    if not saved:
+        raise FileNotFoundError(f"no shards manifested under {path}")
+
+    # size-1 decode cache: on a finer restore mesh several callback indices
+    # fall inside one saved shard; without it each sub-index would re-decode
+    # the member array
+    cache: dict = {}
+
+    def _member(file: str, field: str) -> np.ndarray:
+        k = (file, field)
+        if k not in cache:
+            cache.clear()
+            with np.load(file) as z:
+                cache[k] = z[field]
+        return cache[k]
+
+    def _fetch(field: str):
+        def cb(index):
+            want = [
+                (s.start or 0, s.stop if s.stop is not None else shape[d])
+                for d, s in enumerate(index)
+            ]
+            for bounds, file in saved:
+                if all(
+                    b[0] <= w[0] and w[1] <= b[1] for b, w in zip(bounds, want)
+                ):
+                    arr = _member(file, field)
+                    local = tuple(
+                        slice(w[0] - b[0], w[1] - b[0])
+                        for b, w in zip(bounds, want)
+                    )
+                    return np.ascontiguousarray(arr[local])
+            raise ValueError(
+                f"no saved shard covers index {want} — checkpoint written "
+                f"with an incompatible sharding"
+            )
+
+        return jax.make_array_from_callback(shape, sh, cb)
+
+    return PeerSyncState(_fetch("values"), _fetch("residual"))
