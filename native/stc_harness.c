@@ -1,29 +1,42 @@
 /* stc_harness — a standalone C peer speaking the reference wire protocol.
  *
- * Purpose (VERDICT.md round-1 item 5): prove byte-level interop of the
- * framework's wire-compat mode against a real compiled-C counterpart, not a
- * Python mock. This file is written fresh from the protocol/codec SPEC
- * (SURVEY.md §2.3 + Appendix B, citing reference src/sharedtensor.c for the
- * behavior it must match); it is NOT a copy of the reference implementation
- * (different structure: single uplink leaf peer, mutex'd state, bounded
- * runtime, heap buffers, clean shutdown).
+ * Purpose (VERDICT.md round-1 item 5, extended round 4): prove byte-level
+ * interop of the framework's wire-compat mode against a real compiled-C
+ * counterpart, not a Python mock — including as an INTERIOR node: with
+ * `children=1` this peer binds a listener via the reference's addressing
+ * trick, accepts one child, and floods frames between its uplink and child
+ * with per-hop re-quantization through its own residuals (reference
+ * src/sharedtensor.c:124-127 — the behavior round-3 VERDICT Weak #5 noted
+ * was only ever interoperated at the edge). This file is written fresh from
+ * the protocol/codec SPEC (SURVEY.md §2.3 + Appendix B, citing reference
+ * src/sharedtensor.c for the behavior it must match); it is NOT a copy of
+ * the reference implementation (different structure: link array, mutex'd
+ * state, bounded runtime, heap buffers, clean shutdown).
  *
- * Protocol (reference src/sharedtensor.c:121-122, :176-177, :281-300):
+ * Protocol (reference src/sharedtensor.c:121-122, :176-177, :192-300):
  *   join:   connect; read 1 byte; 'Y' => stream on this socket;
  *           'N' => 16-byte raw sockaddr_in redirect, retry there.
+ *   listen: bind to the uplink socket's LOCAL endpoint (SO_REUSEADDR +
+ *           getsockname — the addressing trick :292-316), so the address a
+ *           parent observed via accept() doubles as our listen address and
+ *           its redirects reach us.
  *   frames: [4-byte little-endian f32 scale][ceil(n/8) bytes bitmask],
  *           bit i at byte[i/8], position i%8 (LSB-first);
  *           set bit = -scale, clear = +scale.
  *   codec:  scale = 2^floor(log2(RMS(residual))) (0 => idle frame, 1/s);
  *           sender: b_i = (r_i <= 0); r_i -= (1-2*b_i)*scale  (error
- *           feedback); receiver: values_i += (1-2*b_i)*scale.
+ *           feedback); receiver: values_i += (1-2*b_i)*scale applied to the
+ *           replica AND to every other link's residual (split horizon).
  *
- * Usage: stc_harness <host> <port> <n> <seconds> <add>
+ * Usage: stc_harness <host> <port> <n> <seconds> <add> [children]
  *   Joins the tree at host:port for a tensor of n floats, immediately
  *   contributes `add` to every element (the reference addFromTensor
- *   semantics: values += add, residual += add), streams full-duplex for
- *   `seconds`, then prints the final replica (one float per line, %.9g) on
- *   stdout and exits 0. Any protocol error exits nonzero with a message.
+ *   semantics: values += add, every residual += add), streams full-duplex
+ *   for `seconds`, then prints the final replica (one float per line,
+ *   %.9g) on stdout and exits 0. `children` (default 0) enables the
+ *   listener with that many child slots (0 or 1); extra joiners are
+ *   redirected to the child, reference-style. Any protocol error exits
+ *   nonzero with a message.
  */
 
 #include <arpa/inet.h>
@@ -40,15 +53,30 @@
 #include <time.h>
 #include <unistd.h>
 
+#define MAX_LINKS 2 /* 0 = uplink, 1 = child */
+
+typedef struct Peer Peer;
+
 typedef struct {
+    Peer *pe;
+    int idx;                  /* slot in pe->links */
     int fd;
+    float *resid;             /* this link's residual (error feedback) */
+    volatile int open;
+    struct sockaddr_in peer_addr; /* accept()-observed (redirect target) */
+    pthread_t ts, tr;
+} Link;
+
+struct Peer {
     int n;
     int mask_bytes;
-    float *values;   /* replica */
-    float *resid;    /* uplink residual (error feedback) */
+    float *values; /* replica */
+    Link links[MAX_LINKS];
     pthread_mutex_t mu;
     volatile int stop;
-} Peer;
+    int listen_fd;
+    int max_children;
+};
 
 static int read_full(int fd, void *buf, size_t len) {
     char *p = buf;
@@ -92,6 +120,12 @@ static int join_tree(const char *host, int port) {
     for (int depth = 0; depth < 64; depth++) {
         int fd = socket(AF_INET, SOCK_STREAM, 0);
         if (fd < 0) return -1;
+        /* SO_REUSEADDR on the CONNECTING socket too (as the reference does,
+         * :264): the listener later binds to this socket's local endpoint,
+         * and Linux requires every socket sharing the port to carry the
+         * flag — without it that bind fails EADDRINUSE */
+        int yes = 1;
+        setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &yes, sizeof yes);
         if (connect(fd, (struct sockaddr *)&addr, sizeof addr) != 0) {
             perror("stc_harness: connect");
             close(fd);
@@ -120,30 +154,31 @@ static int join_tree(const char *host, int port) {
 }
 
 static void *sender(void *arg) {
-    Peer *pe = arg;
+    Link *lk = arg;
+    Peer *pe = lk->pe;
     unsigned char *frame = malloc(4 + (size_t)pe->mask_bytes);
     if (!frame) return NULL;
     while (!pe->stop) {
         pthread_mutex_lock(&pe->mu);
         double ss = 0.0;
         for (int i = 0; i < pe->n; i++)
-            ss += (double)pe->resid[i] * pe->resid[i];
+            ss += (double)lk->resid[i] * lk->resid[i];
         float rms = (float)sqrt(ss / pe->n);
         float scale = rms > 0.0f ? exp2f(floorf(log2f(rms))) : 0.0f;
         memset(frame + 4, 0, (size_t)pe->mask_bytes);
         for (int i = 0; i < pe->n; i++) {
-            if (pe->resid[i] <= 0.0f) { /* send -scale; zero counts negative */
+            if (lk->resid[i] <= 0.0f) { /* send -scale; zero counts negative */
                 frame[4 + i / 8] |= (unsigned char)(1u << (i % 8));
-                pe->resid[i] += scale;
+                lk->resid[i] += scale;
             } else {
-                pe->resid[i] -= scale;
+                lk->resid[i] -= scale;
             }
         }
         pthread_mutex_unlock(&pe->mu);
         memcpy(frame, &scale, 4); /* little-endian f32 on the wire */
         if (scale == 0.0f)
             sleep(1); /* idle keepalive frame, 1/s (quirk Q2 semantics) */
-        if (write_full(pe->fd, frame, 4 + (size_t)pe->mask_bytes) != 0)
+        if (write_full(lk->fd, frame, 4 + (size_t)pe->mask_bytes) != 0)
             break;
     }
     free(frame);
@@ -151,28 +186,110 @@ static void *sender(void *arg) {
 }
 
 static void *receiver(void *arg) {
-    Peer *pe = arg;
+    Link *lk = arg;
+    Peer *pe = lk->pe;
     unsigned char *frame = malloc(4 + (size_t)pe->mask_bytes);
     if (!frame) return NULL;
     while (!pe->stop) {
-        if (read_full(pe->fd, frame, 4 + (size_t)pe->mask_bytes) != 0) break;
+        if (read_full(lk->fd, frame, 4 + (size_t)pe->mask_bytes) != 0) break;
         float scale;
         memcpy(&scale, frame, 4);
         if (scale == 0.0f) continue;
         pthread_mutex_lock(&pe->mu);
         for (int i = 0; i < pe->n; i++) {
             int bit = (frame[4 + i / 8] >> (i % 8)) & 1;
-            pe->values[i] += bit ? -scale : scale;
+            float d = bit ? -scale : scale;
+            pe->values[i] += d;
+            /* split-horizon flood with per-hop re-quantization: the delta
+             * lands in every OTHER link's residual and leaves on that
+             * link's own schedule and scale (reference :124-127) */
+            for (int l = 0; l < MAX_LINKS; l++)
+                if (l != lk->idx && pe->links[l].open)
+                    pe->links[l].resid[i] += d;
         }
         pthread_mutex_unlock(&pe->mu);
     }
+    pthread_mutex_lock(&pe->mu);
+    lk->open = 0; /* stop flooding into a dead link */
+    pthread_mutex_unlock(&pe->mu);
     free(frame);
     return NULL;
 }
 
+/* Interior-node listener (reference do_listening, :192-242, one child
+ * slot): first joiner gets 'Y' + a link engine; later joiners get 'N' +
+ * the child's accept()-observed sockaddr (which, by the addressing trick,
+ * is also its listen address). */
+static void *listener(void *arg) {
+    Peer *pe = arg;
+    while (!pe->stop) {
+        struct sockaddr_in peer_addr;
+        socklen_t plen = sizeof peer_addr;
+        int fd = accept(pe->listen_fd, (struct sockaddr *)&peer_addr, &plen);
+        if (fd < 0) {
+            if (errno == EINTR) continue;
+            break; /* listen socket shut down */
+        }
+        pthread_mutex_lock(&pe->mu);
+        Link *child = &pe->links[1];
+        /* fd < 0 = never used: a died child's slot stays closed (its old
+         * threads may still hold the resid buffer; a retake would race) */
+        int take = pe->max_children > 0 && !child->open && child->fd < 0;
+        if (take) {
+            child->fd = fd;
+            child->peer_addr = peer_addr;
+            /* seed the new child with complete state-to-date through the
+             * normal codec stream: residual = current replica (the
+             * reference achieves this by accumulating into unconnected
+             * slots from birth, :124-126/:338-342 — same net effect) */
+            memcpy(child->resid, pe->values, (size_t)pe->n * sizeof(float));
+            child->open = 1;
+        }
+        pthread_mutex_unlock(&pe->mu);
+        if (take) {
+            int fail = write_full(fd, "Y", 1) != 0 ||
+                       pthread_create(&child->tr, NULL, receiver, child) != 0;
+            if (!fail && pthread_create(&child->ts, NULL, sender, child) != 0) {
+                /* receiver already owns the link; let it die via shutdown */
+                shutdown(fd, SHUT_RDWR);
+                pthread_join(child->tr, NULL);
+                fail = 1;
+            }
+            if (fail) {
+                /* no threads hold the slot: fully reopen it (fd = -1) so a
+                 * later joiner can take it — leaving fd set would brick the
+                 * slot AND make shutdown touch a stale/reused descriptor */
+                pthread_mutex_lock(&pe->mu);
+                child->open = 0;
+                child->fd = -1;
+                pthread_mutex_unlock(&pe->mu);
+                close(fd);
+                continue;
+            }
+        } else {
+            struct sockaddr_in redir;
+            int live;
+            pthread_mutex_lock(&pe->mu);
+            redir = child->peer_addr;
+            live = child->open;
+            pthread_mutex_unlock(&pe->mu);
+            if (live) {
+                write_full(fd, "N", 1);
+                write_full(fd, &redir, sizeof redir); /* raw, ref :229-231 */
+            }
+            /* dead child: no live address to redirect to — close, rather
+             * than black-hole the joiner at a non-listening endpoint (the
+             * slot stays closed; bounded-runtime harness, not production) */
+            close(fd);
+        }
+    }
+    return NULL;
+}
+
 int main(int argc, char **argv) {
-    if (argc != 6) {
-        fprintf(stderr, "usage: %s host port n seconds add\n", argv[0]);
+    if (argc != 6 && argc != 7) {
+        fprintf(stderr, "usage: %s host port n seconds add [children]\n",
+                argv[0]);
         return 2;
     }
     /* write() on a peer-closed socket must return EPIPE, not kill us
@@ -184,8 +301,9 @@ int main(int argc, char **argv) {
     int n = atoi(argv[3]);
     double seconds = atof(argv[4]);
     float add = (float)atof(argv[5]);
-    if (n <= 0 || port <= 0) {
-        fprintf(stderr, "stc_harness: bad n/port\n");
+    int children = argc == 7 ? atoi(argv[6]) : 0;
+    if (n <= 0 || port <= 0 || children < 0 || children > 1) {
+        fprintf(stderr, "stc_harness: bad n/port/children\n");
         return 2;
     }
 
@@ -193,24 +311,58 @@ int main(int argc, char **argv) {
     memset(&pe, 0, sizeof pe);
     pe.n = n;
     pe.mask_bytes = (n + 7) / 8;
+    pe.max_children = children;
+    pe.listen_fd = -1;
     pe.values = calloc((size_t)n, sizeof(float));
-    pe.resid = calloc((size_t)n, sizeof(float));
     pthread_mutex_init(&pe.mu, NULL);
-    if (!pe.values || !pe.resid) return 1;
-
-    pe.fd = join_tree(host, port);
-    if (pe.fd < 0) return 1;
-
-    /* addFromTensor semantics: visible locally at once, queued for the
-     * uplink (reference :334-344). */
-    for (int i = 0; i < n; i++) {
-        pe.values[i] += add;
-        pe.resid[i] += add;
+    if (!pe.values) return 1;
+    for (int l = 0; l < MAX_LINKS; l++) {
+        pe.links[l].pe = &pe;
+        pe.links[l].idx = l;
+        pe.links[l].fd = -1;
+        pe.links[l].resid = calloc((size_t)n, sizeof(float));
+        if (!pe.links[l].resid) return 1;
     }
 
-    pthread_t ts, tr;
-    if (pthread_create(&tr, NULL, receiver, &pe) != 0) return 1;
-    if (pthread_create(&ts, NULL, sender, &pe) != 0) return 1;
+    Link *up = &pe.links[0];
+    up->fd = join_tree(host, port);
+    if (up->fd < 0) return 1;
+    up->open = 1;
+
+    pthread_t tl = 0;
+    if (children > 0) {
+        /* the addressing trick: listen on the uplink's local endpoint so
+         * the parent's redirects (which hand out our accept()-observed
+         * address) reach this listener (reference :292-316) */
+        struct sockaddr_in self;
+        socklen_t slen = sizeof self;
+        if (getsockname(up->fd, (struct sockaddr *)&self, &slen) != 0) {
+            perror("stc_harness: getsockname");
+            return 1;
+        }
+        pe.listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+        int yes = 1;
+        setsockopt(pe.listen_fd, SOL_SOCKET, SO_REUSEADDR, &yes, sizeof yes);
+        if (bind(pe.listen_fd, (struct sockaddr *)&self, sizeof self) != 0 ||
+            listen(pe.listen_fd, 16) != 0) {
+            perror("stc_harness: bind/listen");
+            return 1;
+        }
+        if (pthread_create(&tl, NULL, listener, &pe) != 0) return 1;
+    }
+
+    /* addFromTensor semantics: visible locally at once, queued for every
+     * link (reference :334-344). */
+    pthread_mutex_lock(&pe.mu);
+    for (int i = 0; i < n; i++) {
+        pe.values[i] += add;
+        for (int l = 0; l < MAX_LINKS; l++)
+            if (pe.links[l].open) pe.links[l].resid[i] += add;
+    }
+    pthread_mutex_unlock(&pe.mu);
+
+    if (pthread_create(&up->tr, NULL, receiver, up) != 0) return 1;
+    if (pthread_create(&up->ts, NULL, sender, up) != 0) return 1;
 
     struct timespec dur;
     dur.tv_sec = (time_t)seconds;
@@ -218,10 +370,20 @@ int main(int argc, char **argv) {
     nanosleep(&dur, NULL);
 
     pe.stop = 1;
-    shutdown(pe.fd, SHUT_RDWR); /* unblocks both threads */
-    pthread_join(ts, NULL);
-    pthread_join(tr, NULL);
-    close(pe.fd);
+    if (pe.listen_fd >= 0) shutdown(pe.listen_fd, SHUT_RDWR);
+    for (int l = 0; l < MAX_LINKS; l++)
+        if (pe.links[l].fd >= 0) shutdown(pe.links[l].fd, SHUT_RDWR);
+    if (tl) pthread_join(tl, NULL);
+    pthread_join(up->ts, NULL);
+    pthread_join(up->tr, NULL);
+    if (pe.links[1].fd >= 0) {
+        /* child threads exist only if a child attached */
+        if (pe.links[1].ts) pthread_join(pe.links[1].ts, NULL);
+        if (pe.links[1].tr) pthread_join(pe.links[1].tr, NULL);
+        close(pe.links[1].fd);
+    }
+    close(up->fd);
+    if (pe.listen_fd >= 0) close(pe.listen_fd);
 
     for (int i = 0; i < n; i++)
         printf("%.9g\n", (double)pe.values[i]);

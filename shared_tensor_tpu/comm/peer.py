@@ -119,6 +119,7 @@ class SharedTensorPeer:
             port,
             tcfg,
             frame_bytes=frame_bytes,
+            max_children=tcfg.max_children,
             keepalive_sec=min(1.0, max(0.05, tcfg.peer_timeout_sec / 4)),
         )
         self.is_master = self.node.is_master
@@ -214,7 +215,13 @@ class SharedTensorPeer:
         to leave gracefully (the reference has no flush concept at all; a
         leaving node takes its undelivered residuals down with the whole
         process, quirk Q8). A crash without drain instead falls under the
-        bounded-loss arm of the delivery contract (core.SharedTensor)."""
+        bounded-loss arm of the delivery contract (core.SharedTensor).
+
+        ``tol=0`` caveat: the pow2 scale policy flushes SUBNORMAL rms to
+        scale 0 (idle), so residual dust below the smallest normal f32
+        (~1.2e-38) can never drain — after long add sequences use a tiny
+        nonzero tol (e.g. 1e-30) unless the workload is known to cancel
+        exactly."""
         deadline = time.time() + timeout
         # the native engine quiesces in microseconds once residuals hit
         # zero; the Python tier needs the coarser poll to stay off its lock
@@ -253,11 +260,52 @@ class SharedTensorPeer:
         return self._ready.is_set()
 
     def metrics(self) -> dict:
-        """Observability the reference entirely lacks (SURVEY.md §5.5)."""
+        """Observability the reference entirely lacks (SURVEY.md §5.5).
+
+        Counter taxonomy (ONE definition per number, reconcilable across
+        layers — round-3 verdict Weak #6):
+
+        - ``frames_out`` / ``frames_in`` — CODEC frames: non-idle quantized
+          frames handed toward the wire / applied from it. A burst message
+          carries many; idle (all-zero-scale) frames count nowhere.
+          Invariant: a quiesced single-writer pair has
+          ``sender.frames_out == receiver.frames_in``.
+        - ``delivery.msgs_out`` / ``delivery.msgs_in`` — wire DATA/BURST
+          messages sent / received (what the ACK ledger tracks; an
+          undecodable data message still counts on the receive side).
+        - ``delivery.inflight_msgs`` — sent-but-unacked messages; 0 after a
+          successful :meth:`drain`. Acked messages =
+          ``msgs_out - inflight_msgs``. Wire-compat exception: the
+          reference protocol has no ACK (delivery degrades to
+          ack-on-enqueue), so there one frame == one message —
+          ``msgs_* == frames_*`` and ``inflight_msgs`` is always 0.
+        - ``links[i].wire_msgs_out/in`` — transport-level messages on the
+          socket: data AND control (ACK/SYNC/CHUNK/...), excluding
+          keepalives; ``>= `` the data-message counts above by exactly the
+          control traffic. ``bytes_*`` include framing and keepalives.
+        """
+        if self._engine is not None:
+            c = self._engine._counters()
+            msgs_out, msgs_in = int(c[3]), int(c[4])
+        elif self.config.transport.wire_compat:
+            # no ACK ledger in the reference protocol: one frame == one
+            # message (see taxonomy above)
+            msgs_out, msgs_in = self.st.frames_out, self.st.frames_in
+        else:
+            with self._ack_mu:
+                msgs_out = sum(self._acked.values()) + sum(
+                    len(v) for v in self._unacked.values()
+                )
+                msgs_in = sum(self._rx_count.values())
         out = {
             "frames_out": self.st.frames_out,
             "frames_in": self.st.frames_in,
             "updates": self.st.updates,
+            "delivery": {
+                "msgs_out": msgs_out,
+                "msgs_in": msgs_in,
+                "inflight_msgs": self.st.inflight_total(),
+            },
             "links": {},
         }
         for link in self.node.links:
@@ -266,8 +314,8 @@ class SharedTensorPeer:
                 out["links"][link] = {
                     "bytes_out": s.bytes_out,
                     "bytes_in": s.bytes_in,
-                    "frames_out": s.frames_out,
-                    "frames_in": s.frames_in,
+                    "wire_msgs_out": s.frames_out,
+                    "wire_msgs_in": s.frames_in,
                     "residual_rms": self.st.residual_rms(link),
                 }
         return out
@@ -552,9 +600,14 @@ class SharedTensorPeer:
                     # the native layer keeps retrying and may heal)
                     self._error = None
                     if self.config.transport.wire_compat:
-                        # reference protocol has no handshake: start streaming
-                        # into a zero residual at once
-                        self.st.new_link(ev.link_id, seed=False)
+                        # reference protocol has no handshake: start
+                        # streaming at once — into the carried residual
+                        # when re-grafting (our undelivered mass), else zero
+                        carry = self._carry_residual
+                        self._carry_residual = None
+                        self.st.new_link(
+                            ev.link_id, seed=False, residual=carry
+                        )
                     else:
                         self._start_join(ev.link_id)
                 else:
@@ -588,6 +641,28 @@ class SharedTensorPeer:
                         )
                     self._sent_snapshot = None
                     self._uplink = None
+                    if self.config.transport.wire_compat:
+                        # The reference protocol cannot express a stateful
+                        # re-graft: the new parent will re-seed us with its
+                        # FULL replica (no diff handshake exists), so
+                        # retained state would double. A LEAF zeroes its
+                        # replica — fresh-joiner semantics, exact: the seed
+                        # refills tree state, the carried residual
+                        # re-delivers our undelivered mass. With children
+                        # the same reset would double THEM (their state
+                        # stays while our seed-refill floods down), so an
+                        # interior node keeps state and accepts the
+                        # documented double-count — still strictly better
+                        # than the reference, which kills the whole tree
+                        # (quirk Q8).
+                        if not self.st.link_ids:
+                            self.st.reset_values()
+                        else:
+                            log.warning(
+                                "wire-compat interior node lost its uplink:"
+                                " re-seeded state may double (the reference"
+                                " protocol has no diff handshake)"
+                            )
             elif ev.kind == EventKind.BECAME_MASTER:
                 # our parent died and rejoin found nobody: we claimed the
                 # rendezvous and are the new root (native master failover);

@@ -73,6 +73,12 @@ class Event:
 
 @dataclasses.dataclass(frozen=True)
 class LinkStats:
+    """Per-link transport counters. ``frames_*`` count wire MESSAGES — data
+    AND control (ACK/SYNC/CHUNK/...), excluding synthesized keepalives — so
+    they exceed the peer layer's data-message counts by exactly the control
+    traffic (peer.metrics() exposes them as ``wire_msgs_*``). ``bytes_*``
+    include framing headers and keepalives."""
+
     bytes_out: int
     bytes_in: int
     frames_out: int

@@ -80,6 +80,18 @@ class TransportConfig:
     #: reference's quirk-Q2 behavior, which C peers' liveness relies on) —
     #: handled inside the native transport.
     wire_compat: bool = False
+    #: Tree fan-out: children per node before the listener redirects joiners
+    #: down the tree (the reference hard-codes 2 — its binary tree,
+    #: src/sharedtensor.c:201-231). 1 builds a chain (interop tests route a
+    #: joiner THROUGH an interior node this way); 1..16 (0 would silently
+    #: close every join; >16 would be silently clamped by the native layer).
+    max_children: int = 2
+
+    def __post_init__(self):
+        if not 1 <= self.max_children <= 16:
+            raise ValueError(
+                f"max_children must be in 1..16, got {self.max_children}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -171,7 +171,14 @@ class SharedTensor:
         # contract is strictly stronger.
         self._inflight: dict[int, dict[int, tuple[TableFrame, ...]]] = {}
         self._frame_seq = 0
-        # observability (SURVEY.md §5.5: the reference has none)
+        # observability (SURVEY.md §5.5: the reference has none).
+        # ONE meaning per counter (peer.metrics() documents the full
+        # taxonomy): frames_out = non-idle codec frames handed toward the
+        # wire — counted at fetch on the pipelined device path
+        # (finish_frame) and at quantize on the burst path
+        # (begin_frame_burst); same set of frames, timing differs by at
+        # most the pipeline depth. frames_in = codec frames applied from
+        # the wire. Idle (all-zero-scale) frames count in neither.
         self.frames_out = 0
         self.frames_in = 0
         self.updates = 0
@@ -312,6 +319,16 @@ class SharedTensor:
 
             return unflatten_np(self.values, self.spec)
         return unflatten(self.values, self.spec)
+
+    def reset_values(self) -> None:
+        """Zero the replica (keep links/residuals). The wire-compat re-graft
+        path uses this: the reference protocol has no diff handshake, so a
+        re-grafted uplink re-seeds us with the parent's FULL replica —
+        fresh-joiner semantics (zeroed state, undelivered residual carried
+        onto the new uplink) are the only exact ones expressible in-protocol
+        (see peer._handle_events)."""
+        with self._lock:
+            self.values = self._zeros()
 
     def snapshot_flat(self) -> jnp.ndarray:
         """Atomic snapshot of the padded flat replica (handshake / checkpoint

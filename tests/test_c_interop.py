@@ -102,3 +102,66 @@ def test_c_peer_receives_seed_state(harness_bin):
         np.testing.assert_allclose(c_values, np.asarray(seed), atol=0.02)
     finally:
         peer.close()
+
+
+def test_c_peer_as_interior_node(harness_bin):
+    """The C peer as an INTERIOR node (round-3 verdict Weak #5): master
+    (python, max_children=1) <- C harness (children=1) <- python joiner.
+    The master's single child slot is taken by the C peer, so the second
+    python peer's join walk gets redirected ('N' + raw sockaddr) to the C
+    node, which accepts it. All three replicas must then converge to
+    seed + every add — which can only happen if the C node FLOODS frames
+    between its links with per-hop re-quantization through its own
+    residuals (reference src/sharedtensor.c:124-127)."""
+    n = 192
+    port = _free_port()
+    seed = jnp.asarray(np.linspace(0.25, 1.25, n).astype("f4"))
+    cfg = Config(
+        transport=TransportConfig(
+            peer_timeout_sec=10.0, wire_compat=True, max_children=1
+        )
+    )
+    expected = np.asarray(seed) + 2.0 + 1.0 + 0.5
+
+    master = create_or_fetch("127.0.0.1", port, seed, cfg)
+    leaf = None
+    try:
+        c = subprocess.Popen(
+            [harness_bin, "127.0.0.1", str(port), str(n), "10.0", "1.0", "1"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        time.sleep(1.0)  # C interior is joined + listening
+        # this join MUST walk through the master's redirect to the C node
+        leaf = create_or_fetch(
+            "127.0.0.1", port, jnp.zeros((n,), jnp.float32), cfg
+        )
+        assert not leaf.is_master
+        master.add(jnp.full((n,), 2.0, jnp.float32))
+        leaf.add(jnp.full((n,), 0.5, jnp.float32))
+
+        out, err = c.communicate(timeout=40)
+        assert c.returncode == 0, err[-500:]
+        c_values = np.array([float(x) for x in out.split()], dtype="f4")
+        # the C interior saw both directions' mass
+        np.testing.assert_allclose(c_values, expected, atol=0.05)
+
+        # both python ends converged THROUGH the C node's flood: the
+        # master's +2 reached the leaf only via C, and the leaf's +0.5
+        # reached the master only via C
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            m = np.asarray(master.read())
+            l = np.asarray(leaf.read())
+            if np.allclose(m, expected, atol=0.05) and np.allclose(
+                l, expected, atol=0.05
+            ):
+                break
+            time.sleep(0.25)
+        np.testing.assert_allclose(np.asarray(master.read()), expected, atol=0.05)
+        np.testing.assert_allclose(np.asarray(leaf.read()), expected, atol=0.05)
+    finally:
+        if leaf is not None:
+            leaf.close()
+        master.close()
