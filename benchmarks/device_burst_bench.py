@@ -1,0 +1,165 @@
+"""Calibrated-latency proof of the device K-frame burst (verdict r03 item 3).
+
+The real tunneled device link pays ~8 ms per blocking device->host fetch,
+which capped the round-3 E2E tpu_parent arm at 109 f/s at ANY pipeline
+depth (E2E_r03.json: depth scaling 6.7 -> 45 -> 109 plateaued — every
+frame still costs one fetch round trip). The device burst quantizes K
+successive halvings in ONE dispatch and fetches them with ONE device_get,
+so a high-latency link carries K frames per round trip.
+
+With the tunnel down, this bench injects the MEASURED latency instead:
+the parent runs the XLA device tier (ST_HOST_CODEC=xla pins it on the CPU
+backend — same code path the TPU parent takes, minus the chip) with
+jax.device_get wrapped to add the calibrated per-fetch delay, and measures
+delivered frames/s for burst=1 vs burst=K. What it proves: the burst
+multiplies frames-per-round-trip exactly as designed; what it cannot
+prove: tunnel BANDWIDTH effects at K x frame-size fetches (noted in the
+artifact; the real-chip E2E re-run captures that when the tunnel heals).
+
+Emits one JSON line. Run: python benchmarks/device_burst_bench.py
+"""
+
+import json
+import multiprocessing as mp
+import os
+import socket
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# N sets the emulated compute:latency ratio. The REAL chip quantizes 1 Mi
+# in ~0.07 ms (PROFILE_r03) against the ~8 ms tunnel round trip — compute
+# is negligible, latency dominates. XLA-CPU quantize costs ~13 ms at 1 Mi
+# (it would swamp the injected delay and the harness would measure compute,
+# not amortization); 64 Ki puts XLA-CPU quantize at ~0.8 ms << 8 ms — the
+# same latency-dominated regime the chip sits in, slightly conservative.
+N = int(os.environ.get("ST_DBB_N", str(1 << 16)))
+FETCH_DELAY_S = float(os.environ.get("ST_DBB_DELAY", "0.008"))
+MEASURE_S = float(os.environ.get("ST_DBB_SECONDS", "10"))
+BURSTS = [1, 16]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child(port, done):
+    # plain host-tier CPU peer (the fast side, like the reference's CPU
+    # child under a TPU parent)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from shared_tensor_tpu import create_or_fetch
+
+    peer = create_or_fetch(
+        "127.0.0.1", port, {"t": np.zeros(N, np.float32)}, timeout=60.0
+    )
+    done.wait(timeout=MEASURE_S + 120)
+    peer.close()
+
+
+def _parent(port, burst, q):
+    os.environ["ST_HOST_CODEC"] = "xla"  # pin the device tier (engine off)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    # calibrated tunnel: every blocking fetch pays the measured round trip
+    real_get = jax.device_get
+
+    def delayed_get(x):
+        time.sleep(FETCH_DELAY_S)
+        return real_get(x)
+
+    jax.device_get = delayed_get
+    import shared_tensor_tpu.core as core
+
+    core.jax.device_get = delayed_get
+
+    import numpy as np
+
+    from shared_tensor_tpu import create_or_fetch
+    from shared_tensor_tpu.config import Config
+
+    cfg = Config(device_frame_burst=burst)
+    peer = create_or_fetch(
+        "127.0.0.1", port, {"t": np.zeros(N, np.float32)}, cfg, timeout=60.0
+    )
+    assert peer._engine is None and not peer.st.host_tier
+    rng = np.random.default_rng(0)
+    delta = {"t": rng.normal(size=N).astype(np.float32) * 1e-2}
+    deadline = time.time() + 60
+    while not peer.node.links and time.time() < deadline:
+        time.sleep(0.05)
+    t_add_end = time.time() + MEASURE_S + 3
+    f0 = peer.st.frames_out
+    t0 = time.time()
+    t_meas_end = t0 + MEASURE_S
+    fps = 0.0
+    while time.time() < t_add_end:
+        peer.add(delta)  # keep residual mass alive
+        time.sleep(0.1)
+        if time.time() >= t_meas_end and fps == 0.0:
+            fps = (peer.st.frames_out - f0) / (time.time() - t0)
+    q.put({"burst": burst, "frames_out_per_s": round(fps, 1)})
+    peer.close()
+
+
+def run_arm(burst: int) -> dict:
+    port = _free_port()
+    q = mp.Queue()
+    done = mp.Event()
+    pp = mp.Process(target=_parent, args=(port, burst, q))
+    pc = mp.Process(target=_child, args=(port, done))
+    pp.start()
+    time.sleep(1.0)
+    pc.start()
+    out = q.get(timeout=MEASURE_S + 180)
+    done.set()
+    pp.join(timeout=30)
+    pc.join(timeout=30)
+    return out
+
+
+def main() -> None:
+    mp.set_start_method("spawn")
+    arms = [run_arm(b) for b in BURSTS]
+    base = arms[0]["frames_out_per_s"]
+    k = BURSTS[-1]
+    # Projection to the chip's 1 Mi row — ARITHMETIC, not a measurement:
+    # frames per fetch cycle / (tunnel RTT + K x on-chip quantize time).
+    # On-chip 1 Mi quantize is ~0.07 ms (PROFILE_r03); the r03 plateau
+    # pins the RTT at ~1/109 s. Needs the real chip to confirm (tunnel
+    # bandwidth at Kx-size fetches is not modeled).
+    chip_quantize_s = 0.00007
+    rtt_s = 1.0 / 109.0
+    projected = k / (rtt_s + k * chip_quantize_s)
+    out = {
+        "bench": "device_burst_calibrated",
+        "n": N,
+        "fetch_delay_ms": FETCH_DELAY_S * 1e3,
+        "arms": arms,
+        "speedup": round(arms[-1]["frames_out_per_s"] / max(base, 1e-9), 2),
+        "projected_1mi_fps_on_chip": round(projected, 1),
+        "projected_vs_reference_1mi": round(projected / 242.0, 2),
+        "note": (
+            "XLA device tier + injected per-fetch delay calibrated to the "
+            "measured tunnel round trip (~8 ms; r03 tpu_parent plateaued "
+            "at 109 f/s — matching this harness's burst=1 arm). The "
+            "MEASURED claim is the speedup (K frames per round trip) in "
+            "the chip's latency-dominated regime; frames here are 64 Ki, "
+            "NOT comparable 1:1 to the reference's 1 Mi E2E row. The "
+            "projected_* fields are arithmetic from measured RTT + "
+            "on-chip quantize time and need the real chip to confirm."
+        ),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

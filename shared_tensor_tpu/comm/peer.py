@@ -100,8 +100,26 @@ class SharedTensorPeer:
             # dominates — scale the burst up (4 Ki: 128, 16 Ki: 32)
             self._burst = max(24, min(128, (1 << 19) // max(1, spec.total)))
         else:
-            self._burst = max(
-                1, min(wire.BURST_MAX_FRAMES, self.config.frame_burst)
+            self._burst = max(1, self.config.frame_burst)
+        self._burst = min(self._burst, wire.burst_frames_cap(spec))
+        # Device-tier burst (Config.device_frame_burst): any size — the
+        # point is amortizing the device-link round trip, which hurts at
+        # every table size (VERDICT r03 item 3).
+        dev_burstable = (
+            not tcfg.wire_compat
+            and not host_tier_active()
+            and self.config.codec.suppress_zero_frames
+        )
+        if not dev_burstable:
+            self._burst_device = 1
+        elif self.config.device_frame_burst == 0:
+            self._burst_device = min(16, wire.burst_frames_cap(spec))
+        else:
+            self._burst_device = max(
+                1,
+                min(
+                    wire.burst_frames_cap(spec), self.config.device_frame_burst
+                ),
             )
         if tcfg.wire_compat:
             if spec.num_leaves != 1:
@@ -164,6 +182,7 @@ class SharedTensorPeer:
         # in during the handshake).
         self._carry_residual: Optional[jnp.ndarray] = None
         self._sent_snapshot: Optional[jnp.ndarray] = None
+        self._compat_reset_on_regraft = False
         self._uplink: Optional[int] = None
         # delivery accounting (see _send_loop): sent-but-unacked frame seqs
         # per link (send thread appends, recv thread pops on wire.ACK), and
@@ -285,22 +304,29 @@ class SharedTensorPeer:
           control traffic. ``bytes_*`` include framing and keepalives.
         """
         if self._engine is not None:
+            # ONE snapshot for every engine counter: separate reads would
+            # mix instants and could show e.g. msgs_in > frames_in mid-run
             c = self._engine._counters()
+            frames_out, frames_in, updates = int(c[0]), int(c[1]), int(c[2])
             msgs_out, msgs_in = int(c[3]), int(c[4])
         elif self.config.transport.wire_compat:
             # no ACK ledger in the reference protocol: one frame == one
             # message (see taxonomy above)
-            msgs_out, msgs_in = self.st.frames_out, self.st.frames_in
+            frames_out, frames_in = self.st.frames_out, self.st.frames_in
+            updates = self.st.updates
+            msgs_out, msgs_in = frames_out, frames_in
         else:
+            frames_out, frames_in = self.st.frames_out, self.st.frames_in
+            updates = self.st.updates
             with self._ack_mu:
                 msgs_out = sum(self._acked.values()) + sum(
                     len(v) for v in self._unacked.values()
                 )
                 msgs_in = sum(self._rx_count.values())
         out = {
-            "frames_out": self.st.frames_out,
-            "frames_in": self.st.frames_in,
-            "updates": self.st.updates,
+            "frames_out": frames_out,
+            "frames_in": frames_in,
+            "updates": updates,
             "delivery": {
                 "msgs_out": msgs_out,
                 "msgs_in": msgs_in,
@@ -384,12 +410,28 @@ class SharedTensorPeer:
                     else:
                         self.st.nack_frame(link)
                     continue
+                # Device tier: K-frame bursts when enabled — ONE dispatch +
+                # ONE device->host fetch per message (self._burst_device;
+                # a tunneled/PCIe device link pays its round trip per
+                # FETCH, so K frames per fetch multiply delivered residual
+                # per round trip exactly as BURST does on host).
+                dev_burst = (
+                    not compat
+                    and not self.st.host_tier
+                    and self._burst_device > 1
+                )
                 q = pipe.setdefault(link, deque())
                 # top up: a cold (idle) link risks one speculative frame per
                 # wake tick; a hot link keeps the full pipeline busy
                 target = depth if link in hot else 1
                 while len(q) < target:
-                    df = self.st.begin_frame(link)
+                    df = (
+                        self.st.begin_frame_burst_device(
+                            link, self._burst_device
+                        )
+                        if dev_burst
+                        else self.st.begin_frame(link)
+                    )
                     if df is None:
                         break  # link dropped concurrently
                     for arr in df[1]:
@@ -400,8 +442,16 @@ class SharedTensorPeer:
                     q.append(df)
                 if not q:
                     continue
+
+                def _finish(d):
+                    return (
+                        self.st.finish_frame_burst(d)
+                        if dev_burst
+                        else self.st.finish_frame(d)
+                    )
+
                 seq, df = q.popleft()
-                frame = self.st.finish_frame(df)
+                frame = _finish(df)
                 while frame is None:
                     # Idle frame (a no-op: scale 0 left the residual
                     # untouched): ack it and drain the remaining speculative
@@ -414,15 +464,16 @@ class SharedTensorPeer:
                     if not q:
                         break
                     seq, df = q.popleft()
-                    frame = self.st.finish_frame(df)
+                    frame = _finish(df)
                 if frame is None:
                     continue
                 hot.add(link)
-                payload = (
-                    wire.encode_compat_frame(frame, self.st.spec)
-                    if compat
-                    else wire.encode_frame(frame)
-                )
+                if dev_burst:
+                    payload = wire.encode_burst(frame, self.st.spec)
+                elif compat:
+                    payload = wire.encode_compat_frame(frame, self.st.spec)
+                else:
+                    payload = wire.encode_frame(frame)
                 if not compat:
                     # register BEFORE sending: the receiver's ACK must never
                     # race ahead of the ledger entry it acknowledges
@@ -602,7 +653,14 @@ class SharedTensorPeer:
                     if self.config.transport.wire_compat:
                         # reference protocol has no handshake: start
                         # streaming at once — into the carried residual
-                        # when re-grafting (our undelivered mass), else zero
+                        # when re-grafting (our undelivered mass), else
+                        # zero. A re-grafting leaf zeroes its replica NOW
+                        # (fresh-joiner semantics; the parent's re-seed
+                        # refills tree state, the carry re-delivers ours —
+                        # see the LINK_DOWN comment).
+                        if self._compat_reset_on_regraft:
+                            self._compat_reset_on_regraft = False
+                            self.st.reset_values()
                         carry = self._carry_residual
                         self._carry_residual = None
                         self.st.new_link(
@@ -645,18 +703,19 @@ class SharedTensorPeer:
                         # The reference protocol cannot express a stateful
                         # re-graft: the new parent will re-seed us with its
                         # FULL replica (no diff handshake exists), so
-                        # retained state would double. A LEAF zeroes its
-                        # replica — fresh-joiner semantics, exact: the seed
-                        # refills tree state, the carried residual
-                        # re-delivers our undelivered mass. With children
-                        # the same reset would double THEM (their state
-                        # stays while our seed-refill floods down), so an
-                        # interior node keeps state and accepts the
-                        # documented double-count — still strictly better
-                        # than the reference, which kills the whole tree
-                        # (quirk Q8).
+                        # retained state would double. A LEAF therefore
+                        # zeroes its replica — but only AT the re-graft
+                        # (LINK_UP below), never here: rejoin may instead
+                        # end in BECAME_MASTER, where our retained state IS
+                        # the authoritative seed and zeroing it would serve
+                        # an empty tree. With children the reset would
+                        # double THEM (their state stays while our
+                        # seed-refill floods down), so an interior node
+                        # keeps state and accepts the documented
+                        # double-count — still strictly better than the
+                        # reference, which kills the whole tree (quirk Q8).
                         if not self.st.link_ids:
-                            self.st.reset_values()
+                            self._compat_reset_on_regraft = True
                         else:
                             log.warning(
                                 "wire-compat interior node lost its uplink:"
@@ -666,7 +725,11 @@ class SharedTensorPeer:
             elif ev.kind == EventKind.BECAME_MASTER:
                 # our parent died and rejoin found nobody: we claimed the
                 # rendezvous and are the new root (native master failover);
-                # whatever state we hold is now the authoritative seed
+                # whatever state we hold is now the authoritative seed —
+                # including in wire-compat, where a pending re-graft reset
+                # must be cancelled (zeroing the new root would serve an
+                # empty tree)
+                self._compat_reset_on_regraft = False
                 self._uplink = None
                 self.is_master = True
                 self._error = None

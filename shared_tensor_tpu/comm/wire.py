@@ -61,13 +61,24 @@ _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
 CHUNK_BYTES = 1 << 22
 
 
-#: Largest table (padded elements) the BURST path applies to, and the most
-#: frames one BURST message may carry. BOTH sides derive their receive
+#: Burst bounds. A BURST message may carry at most BURST_MAX_FRAMES frames
+#: and at most ~BURST_MAX_BYTES of payload (so huge tables burst with a
+#: small K instead of a 33 MB message). BOTH sides derive their receive
 #: buffer bound from these and the (handshake-identical) spec, so a burst
 #: can never exceed what any peer sized for — oversized incoming messages
 #: would otherwise be silently truncated by the transport's recv copy.
+#: BURST_MAX_TOTAL additionally bounds the HOST tier's auto-burst policy
+#: (small tables, where per-message engine cost dominates); the device
+#: tier bursts at any size to amortize the device-link round trip.
 BURST_MAX_TOTAL = 1 << 15
 BURST_MAX_FRAMES = 255
+BURST_MAX_BYTES = 1 << 22
+
+
+def burst_frames_cap(spec: TableSpec) -> int:
+    """Most frames one BURST message may carry for this spec (>= 1)."""
+    per = frame_payload_bytes(spec)
+    return max(1, min(BURST_MAX_FRAMES, (BURST_MAX_BYTES - 2) // per))
 
 
 def frame_payload_bytes(spec: TableSpec) -> int:
@@ -78,11 +89,8 @@ def frame_payload_bytes(spec: TableSpec) -> int:
 
 
 def burst_wire_bytes(spec: TableSpec) -> int:
-    """Max BURST message size for this spec (0 when the spec is too large
-    for the burst path at all)."""
-    if spec.total > BURST_MAX_TOTAL:
-        return 0
-    return 2 + BURST_MAX_FRAMES * frame_payload_bytes(spec)
+    """Max BURST message size for this spec."""
+    return 2 + burst_frames_cap(spec) * frame_payload_bytes(spec)
 
 
 def frame_wire_bytes(spec: TableSpec) -> int:
@@ -139,12 +147,11 @@ def encode_burst(frames, spec: TableSpec) -> bytes:
     Successive frames of one link are successive halvings of its residual;
     shipping them together amortizes the per-message engine cost that
     dominates at small table sizes (see Config.frame_burst)."""
-    if not 1 <= len(frames) <= BURST_MAX_FRAMES:
-        raise ValueError(f"burst of {len(frames)} frames (1..{BURST_MAX_FRAMES})")
-    if spec.total > BURST_MAX_TOTAL:
+    cap = burst_frames_cap(spec)
+    if not 1 <= len(frames) <= cap:
         raise ValueError(
-            f"table of {spec.total} padded elements exceeds the burst bound "
-            f"({BURST_MAX_TOTAL}) peers sized their receive buffers for"
+            f"burst of {len(frames)} frames (this spec allows 1..{cap} — "
+            f"the bound peers sized their receive buffers for)"
         )
     parts = [bytes([BURST, len(frames)])]
     for f in frames:

@@ -137,6 +137,14 @@ class Config:
     #: 4 Ki elements, BASELINE.md). 0 = auto (burst small tables, stream
     #: big ones); 1 = always single-frame messages; K>1 = force K.
     frame_burst: int = 0
+    #: Frames per wire message on the DEVICE tier (accelerator-backed
+    #: peers), native mode only. K successive halvings quantize in ONE
+    #: jitted dispatch and fetch with ONE device->host sync, so a
+    #: high-latency device link (PCIe queue, TPU tunnel: ~8 ms/frame round
+    #: trip, which capped E2E at 109 f/s at any pipeline depth) carries K
+    #: frames per round trip instead of one. 0 = auto (16, wire-capped);
+    #: 1 = single-frame messages (the pure pipelined path).
+    device_frame_burst: int = 0
     #: Run the host-tier steady-state loop (quantize, encode, send, receive,
     #: flood apply, ACK ledger) in the native engine (native/stengine.cpp) —
     #: two C threads calling the same stcodec.c loops, no per-message

@@ -47,6 +47,7 @@ from .ops.table import (
     flatten,
     make_spec,
     quantize_table,
+    quantize_table_burst,
     unflatten,
 )
 
@@ -440,6 +441,55 @@ class SharedTensor:
                 self._inflight.setdefault(link_id, {})[seq] = tuple(frames)
             self.frames_out += len(frames)
         return seq, frames
+
+    def begin_frame_burst_device(
+        self, link_id: int, k: int
+    ) -> Optional[tuple[int, TableFrame]]:
+        """Device-tier burst: K successive halvings quantized in ONE jitted
+        dispatch (ops/table.quantize_table_burst), fetched later with ONE
+        device->host sync (:meth:`finish_frame_burst`). One ledger entry /
+        wire message / receiver ACK, like the host burst. Returns
+        (seq, stacked TableFrame with leading K axis) — device arrays, not
+        yet fetched."""
+        with self._lock:
+            resid = self._links.get(link_id)
+            if resid is None:
+                return None
+            frames, new_resid = quantize_table_burst(
+                resid,
+                self.spec,
+                k,
+                self.codec.scale_policy,
+                self.codec.per_leaf_scale,
+            )
+            self._links[link_id] = new_resid
+            self._frame_seq += 1
+            seq = self._frame_seq
+            # ledger rollback re-applies per frame; zero-scale tail frames
+            # are exact no-ops so storing all K is correct
+            self._inflight.setdefault(link_id, {})[seq] = tuple(
+                TableFrame(frames.scales[i], frames.words[i]) for i in range(k)
+            )
+        return seq, frames
+
+    def finish_frame_burst(
+        self, frames: TableFrame
+    ) -> Optional[list[TableFrame]]:
+        """Fetch a dispatched burst with one blocking sync and trim the
+        all-zero-scale tail (once a frame quantizes to zero scales, every
+        later scan step is a no-op — zeros appear only as a suffix).
+        Returns None for a fully idle burst (suppressed, like
+        finish_frame)."""
+        scales, words = jax.device_get((frames.scales, frames.words))
+        k_eff = 0
+        for i in range(scales.shape[0]):
+            if not scales[i].any():
+                break
+            k_eff = i + 1
+        if k_eff == 0:
+            return None
+        self.frames_out += k_eff
+        return [TableFrame(scales[i], words[i]) for i in range(k_eff)]
 
     def ack_frame(self, link_id: int, seq: int) -> None:
         """Frame ``seq`` is accounted for — the receiver acknowledged it, or

@@ -257,6 +257,46 @@ def quantize_table(
     return _quantize_table(residual, spec, policy, per_leaf, _resolve_impl(impl))
 
 
+@partial(jax.jit, static_argnames=("spec", "k", "policy", "per_leaf", "impl"))
+def _quantize_table_burst(
+    residual: jnp.ndarray,
+    spec: TableSpec,
+    k: int,
+    policy: ScalePolicy,
+    per_leaf: bool,
+    impl: str,
+) -> tuple[TableFrame, jnp.ndarray]:
+    def body(r, _):
+        frame, r2 = _quantize_table(r, spec, policy, per_leaf, impl)
+        return r2, (frame.scales, frame.words)
+
+    new_r, (scales, words) = jax.lax.scan(body, residual, None, length=k)
+    return TableFrame(scales, words), new_r
+
+
+def quantize_table_burst(
+    residual: jnp.ndarray,
+    spec: TableSpec,
+    k: int,
+    policy: ScalePolicy = ScalePolicy.POW2_RMS,
+    per_leaf: bool = True,
+    impl: str = "auto",
+) -> tuple[TableFrame, jnp.ndarray]:
+    """K successive residual halvings in ONE device dispatch (lax.scan of
+    the sender step): returns stacked (scales f32[K,L], words u32[K,W]) and
+    the final residual. The point is the peer tier's device BURST path —
+    one dispatch + ONE device->host fetch carries K frames, amortizing the
+    device-link round trip exactly as the host burst amortizes per-message
+    engine cost (round-3 verdict item 3: the tunneled device link's
+    ~8 ms/frame round trip capped E2E at 109 f/s regardless of pipeline
+    depth). Once the residual quantizes to all-zero scales every later
+    frame in the scan is an exact no-op (scale 0 idles), so the host side
+    trims the zero tail after the fetch."""
+    return _quantize_table_burst(
+        residual, spec, int(k), policy, per_leaf, _resolve_impl(impl)
+    )
+
+
 def _batch_layout(frames: TableFrame, spec: TableSpec):
     """(scales [K,L], words [K,W]) -> the row-major layout the Pallas batch
     kernel consumes: s_rows f32[rows, K], words2d u32[rows, K*4] (frame k's
