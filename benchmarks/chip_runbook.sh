@@ -1,18 +1,18 @@
 #!/bin/bash
 # On-chip artifact runbook: produces the round's on-chip evidence
 # (AXON suite groups, 4-arm train bench, headline bench sanity, pareto
-# spot-check). Run when the axon tunnel is up; see AXON_SUITE_r03.txt for
-# the wedge failure modes this script's structure avoids.
-# Every step is timeout-wrapped (SIGTERM, never SIGKILL) and sequential:
-# exactly ONE process touches the chip at a time (grant-wedge avoidance,
-# .claude/skills/verify/SKILL.md).
+# spot-check, device-burst E2E). Run when the axon tunnel is up; see
+# AXON_SUITE_r03.txt for the wedge failure modes this script's structure
+# avoids. Every step is timeout-wrapped (SIGTERM, never SIGKILL) and
+# sequential: exactly ONE process touches the chip at a time (grant-wedge
+# avoidance, .claude/skills/verify/SKILL.md).
 set -x
 cd /root/repo
 AX="env ST_TEST_PLATFORM=axon PYTHONPATH=/root/repo:/root/.axon_site"
 
 step() { echo "=== $* ==="; }
 
-step "1/5 device-relevant suite on chip -> AXON groups"
+step "1/6 device-relevant suite on chip -> AXON groups"
 $AX timeout 560 python -m pytest tests/test_codec.py tests/test_codec_pallas.py \
     tests/test_table.py tests/test_table_pallas.py -q 2>&1 | tail -2 | tee /tmp/ax_g1.txt
 $AX timeout 560 python -m pytest tests/test_core.py tests/test_checkpoint.py \
@@ -21,17 +21,27 @@ $AX timeout 560 python -m pytest tests/test_char_rnn.py tests/test_resnet.py \
     tests/test_codec_np.py tests/test_compat.py tests/test_profiling.py \
     tests/test_wire_robustness.py tests/test_codec.py -q 2>&1 | tail -2 | tee /tmp/ax_g3.txt
 
-step "2/5 train bench (4 arms incl. overlap) -> TRAIN_BENCH_r03.json"
+step "2/6 train bench (4 arms incl. overlap) -> TRAIN_BENCH_r04.json"
 PYTHONPATH=/root/repo:/root/.axon_site ST_TRAIN_BENCH_BUDGET_S=420 \
-  timeout 500 python benchmarks/train_bench.py > /tmp/train_bench_r03.json 2>/tmp/tb_err.log
-tail -1 /tmp/train_bench_r03.json
+  timeout 500 python benchmarks/train_bench.py > /tmp/train_bench_r04.json 2>/tmp/tb_err.log
+tail -1 /tmp/train_bench_r04.json
 
-step "3/5 headline bench sanity"
+step "3/6 headline bench sanity"
 PYTHONPATH=/root/repo:/root/.axon_site ST_BENCH_BUDGET_S=300 \
   timeout 380 python bench.py 2>/dev/null | tail -1 | tee /tmp/bench_sanity.json
 
-step "4/5 pareto spot-check (1Mi only, confirms chip state)"
+step "4/6 pareto spot-check (1Mi only, confirms chip state)"
 PYTHONPATH=/root/repo:/root/.axon_site timeout 300 \
   python benchmarks/pareto.py --sizes 20 2>/dev/null | tail -1
 
-step "5/5 done — assemble artifacts manually"
+step "5/6 device-burst E2E on the real tunnel -> E2E_r04 tpu_parent arm"
+# The parent runs the real chip (device tier, K-frame bursts by default);
+# the child is a CPU host-tier peer. This is the measurement the
+# DEVICE_BURST_r04.json projection (~1554 f/s at 1 Mi) stands in for.
+PYTHONPATH=/root/repo:/root/.axon_site ST_E2E_SECONDS=20 timeout 300 \
+  python benchmarks/e2e_sync.py 2>/dev/null | tail -1 | tee /tmp/e2e_tpu_burst.json
+# single-frame comparison arm (burst disabled): should reproduce ~109 f/s
+PYTHONPATH=/root/repo:/root/.axon_site ST_E2E_SECONDS=15 timeout 240 \
+  env ST_E2E_DEVICE_BURST=1 python benchmarks/e2e_sync.py 2>/dev/null | tail -1
+
+step "6/6 done — assemble artifacts manually (BENCH_r04, TRAIN_BENCH_r04, AXON_SUITE_r04, E2E_r04)"

@@ -58,6 +58,10 @@ def _mk_peer(port: int):
             peer_timeout_sec=30.0, wire_compat=(CHILD == "c")
         ),
         send_pipeline_depth=int(os.environ.get("ST_E2E_DEPTH", "8")),
+        # ST_E2E_DEVICE_BURST=1 pins single-frame device messages (the r03
+        # comparison arm); default 0 = auto K-frame bursts (chip_runbook
+        # step 5 measures both on the real tunnel)
+        device_frame_burst=int(os.environ.get("ST_E2E_DEVICE_BURST", "0")),
     )
     # numpy template: a host-tier (CPU) peer then never initializes a jax
     # backend — the XLA CPU client's thread pool costs ~2.7x frame rate in
