@@ -64,6 +64,7 @@ def _error_result(attempts, reason: str) -> dict:
         "value": 0.0,
         "unit": "GB/s",
         "vs_baseline": 0.0,
+        "tier": "none",
         "detail": {"error": reason, "attempts": attempts},
     }
 
@@ -375,6 +376,13 @@ def main() -> None:
     best.setdefault("detail", {})
     best["detail"]["attempts"] = attempts
     best["detail"]["chip_state"] = chip_state
+    # Top-level tier label (round-3 verdict Weak #1): round-over-round
+    # comparisons must not silently cross tiers — a skim reader of
+    # BENCH_r{N}.json sees at the top level whether this is the on-chip
+    # number or a degraded host capture.
+    best.setdefault(
+        "tier", "host-fallback" if best["detail"].get("degraded") else "device"
+    )
     _emit(best)
 
 
