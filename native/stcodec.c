@@ -248,6 +248,120 @@ static int64_t accumulate_leaf_avx512(float *d, const uint32_t *w,
 }
 #endif
 
+#ifdef ST_AVX512
+/* Fused quantize + next-frame partials: the burst sender needs the NEW
+ * residual's scale partials for frame k+1, and they are free to accumulate
+ * while frame k's residual values are still in registers — one memory pass
+ * instead of quantize-then-rescan (the two-pass shape costs ~40% of the
+ * engine's per-frame time at 1 Mi). Returns whole words processed. */
+ST_TARGET_AVX512
+static int64_t quantize_partials_leaf_avx512(const float *rin, float *rout,
+                                             int64_t n, float s,
+                                             uint32_t *words, double *amax,
+                                             double *ss, double *sabs) {
+  const __m512 vzero = _mm512_setzero_ps();
+  const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
+  const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
+  const __m512i vabsmask = _mm512_set1_epi32(0x7FFFFFFF);
+  __m512 vamax = _mm512_setzero_ps();
+  __m512d vss0 = _mm512_setzero_pd(), vss1 = _mm512_setzero_pd();
+  __m512d vsa0 = _mm512_setzero_pd(), vsa1 = _mm512_setzero_pd();
+  int64_t w = 0;
+  for (; w < n / 32; w++) {
+    const float *p = rin + w * 32;
+    float *q = rout + w * 32;
+    __m512 v0 = _mm512_loadu_ps(p);
+    __m512 v1 = _mm512_loadu_ps(p + 16);
+    __mmask16 m0 = _mm512_cmp_ps_mask(v0, vzero, _CMP_LE_OQ);
+    __mmask16 m1 = _mm512_cmp_ps_mask(v1, vzero, _CMP_LE_OQ);
+    __m512 r0 = v0, r1 = v1;
+    if (s > 0.0f) {
+      __m512 d0 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m0, vs, vsign));
+      __m512 d1 = _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m1, vs, vsign));
+      r0 = _mm512_sub_ps(v0, d0);
+      r1 = _mm512_sub_ps(v1, d1);
+    }
+    _mm512_storeu_ps(q, r0);
+    _mm512_storeu_ps(q + 16, r1);
+    words[w] = (uint32_t)m0 | ((uint32_t)m1 << 16);
+    /* partials of the residual just written (scale_partials_leaf_avx512's
+     * arithmetic, fused here) */
+    __m512 a0 = _mm512_castsi512_ps(
+        _mm512_and_epi32(_mm512_castps_si512(r0), vabsmask));
+    __m512 a1 = _mm512_castsi512_ps(
+        _mm512_and_epi32(_mm512_castps_si512(r1), vabsmask));
+    vamax = _mm512_max_ps(vamax, _mm512_max_ps(a0, a1));
+    __m512d lo0 = _mm512_cvtps_pd(_mm512_castps512_ps256(r0));
+    __m512d hi0 = _mm512_cvtps_pd(_mm512_extractf32x8_ps(r0, 1));
+    __m512d lo1 = _mm512_cvtps_pd(_mm512_castps512_ps256(r1));
+    __m512d hi1 = _mm512_cvtps_pd(_mm512_extractf32x8_ps(r1, 1));
+    vss0 = _mm512_fmadd_pd(lo0, lo0, vss0);
+    vss1 = _mm512_fmadd_pd(hi0, hi0, vss1);
+    vss0 = _mm512_fmadd_pd(lo1, lo1, vss0);
+    vss1 = _mm512_fmadd_pd(hi1, hi1, vss1);
+    vsa0 = _mm512_add_pd(
+        vsa0, _mm512_cvtps_pd(_mm512_castps512_ps256(a0)));
+    vsa1 = _mm512_add_pd(
+        vsa1, _mm512_cvtps_pd(_mm512_extractf32x8_ps(a0, 1)));
+    vsa0 = _mm512_add_pd(
+        vsa0, _mm512_cvtps_pd(_mm512_castps512_ps256(a1)));
+    vsa1 = _mm512_add_pd(
+        vsa1, _mm512_cvtps_pd(_mm512_extractf32x8_ps(a1, 1)));
+  }
+  *amax = _mm512_reduce_max_ps(vamax);
+  *ss = _mm512_reduce_add_pd(vss0) + _mm512_reduce_add_pd(vss1);
+  *sabs = _mm512_reduce_add_pd(vsa0) + _mm512_reduce_add_pd(vsa1);
+  return w;
+}
+#endif
+
+/* Sender step + NEXT frame's scale partials, one fused pass per leaf (see
+ * quantize_partials_leaf_avx512). Partials are per-leaf overwrites like
+ * stc_scale_partials; live lanes only. Semantics of the quantize half are
+ * identical to stc_quantize. */
+ST_CLONES
+EXPORT void stc_quantize_ef_partials(
+    const float *rin, float *rout, const int64_t *off, const int64_t *ns,
+    const int64_t *padded, int64_t n_leaves, const float *scales,
+    uint32_t *words, double *out_amax, double *out_ss, double *out_sabs) {
+  for (int64_t i = 0; i < n_leaves; i++) {
+    const float *p = rin + off[i];
+    float *q = rout + off[i];
+    uint32_t *wp = words + off[i] / 32;
+    int64_t n = ns[i], pad = padded[i];
+    float s = scales[i];
+    double amax = 0, ssum = 0, sabs = 0;
+    int64_t w = 0;
+#ifdef ST_AVX512
+    if (st_has_avx512())
+      w = quantize_partials_leaf_avx512(p, q, n, s, wp, &amax, &ssum, &sabs);
+#endif
+    int64_t nw = pad / 32;
+    for (; w < nw; w++) {
+      uint32_t bits = 0;
+      int64_t base = w * 32;
+      int64_t lim = n - base;
+      if (lim > 32) lim = 32;
+      for (int64_t b = 0; b < (lim < 0 ? 0 : lim); b++) {
+        float v = p[base + b];
+        uint32_t neg = v <= 0.0f;
+        bits |= neg << b;
+        float r = s > 0.0f ? v - (neg ? -s : s) : v;
+        q[base + b] = r;
+        double a = r < 0 ? -(double)r : (double)r;
+        if (a > amax) amax = a;
+        ssum += (double)r * (double)r;
+        sabs += a;
+      }
+      for (int64_t b = (lim < 0 ? 0 : lim); b < 32; b++) q[base + b] = 0.0f;
+      wp[w] = bits;
+    }
+    out_amax[i] = amax;
+    out_ss[i] = ssum;
+    out_sabs[i] = sabs;
+  }
+}
+
 /* Receiver half: accumulate K frames' deltas into delta[total]
  * (delta += s * (1 - 2*bit), reference src/sharedtensor.c:109), then the
  * caller adds delta to each target array. Splitting accumulate/apply keeps

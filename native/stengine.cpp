@@ -7,8 +7,13 @@
 // 133-189 has no per-frame interpreter cost at all). This engine moves the
 // whole steady-state cycle — scale/quantize (error feedback), wire encode,
 // send, receive, decode, flood apply, ACK bookkeeping — into C, calling the
-// same stcodec.c loops the numpy tier uses (bit-identical results) and the
-// sttransport.cpp queues directly. Python keeps only what is control-plane:
+// same stcodec.c loops the numpy tier uses — bit-identical GIVEN the same
+// scales; burst frames b >= 1 derive their scales from partials fused into
+// the previous quantize pass (stc_quantize_ef_partials), whose summation
+// order can differ from a standalone rescan by ~1 ulp, within the tier
+// tolerance every scale consumer already accepts (scales ride the wire,
+// receivers never recompute them) — and the sttransport.cpp queues
+// directly. Python keeps only what is control-plane:
 // join/SYNC handshakes, membership events, checkpoint, metrics.
 //
 // Semantics are a 1:1 port of the Python tier (comm/peer.py send/recv loops
@@ -52,6 +57,10 @@ extern "C" {
 // stcodec.c
 void stc_quantize(const float*, float*, const int64_t*, const int64_t*,
                   const int64_t*, int64_t, const float*, uint32_t*);
+void stc_quantize_ef_partials(const float*, float*, const int64_t*,
+                              const int64_t*, const int64_t*, int64_t,
+                              const float*, uint32_t*, double*, double*,
+                              double*);
 void stc_scale_partials(const float*, const int64_t*, const int64_t*, int64_t,
                         double*, double*, double*);
 void stc_accumulate_delta(float*, const int64_t*, const int64_t*,
@@ -136,12 +145,11 @@ struct Engine {
 };
 
 // scale = policy(partials); zero when the leaf is all-zero or the result is
-// non-finite. Bit-identical to ops/codec_np.compute_scales_np's native
-// branch: double math, cast to f32, pow2-floor by exponent mask.
-void compute_scales(Engine* e, const float* r, float* out) {
-  std::vector<double> amax(e->L), ss(e->L), sabs(e->L);
-  stc_scale_partials(r, e->off.data(), e->ns.data(), e->L, amax.data(),
-                     ss.data(), sabs.data());
+// non-finite. Same math as ops/codec_np.compute_scales_np's native branch:
+// double math, cast to f32, pow2-floor by exponent mask.
+void scales_from_partials(Engine* e, std::vector<double>& amax,
+                          std::vector<double>& ss, std::vector<double>& sabs,
+                          float* out) {
   if (!e->per_leaf) {
     double am = 0, s2 = 0, sa = 0;
     for (int64_t i = 0; i < e->L; i++) {
@@ -247,6 +255,8 @@ size_t frame_bytes(const Engine* e) {
 void sender_loop(Engine* e) {
   std::vector<uint8_t> payload;
   std::vector<float> scales((size_t)e->L);
+  std::vector<double> amax((size_t)e->L), ss((size_t)e->L),
+      sabs((size_t)e->L);
   while (!e->stop.load()) {
     uint64_t seq_before;
     {
@@ -270,10 +280,15 @@ void sender_loop(Engine* e) {
         ELink& lk2 = it->second;
         if (!lk2.dirty) continue;
         // quantize up to `burst` successive halvings of the residual,
-        // stopping at the first all-zero-scale frame (idle)
+        // stopping at the first all-zero-scale frame (idle). Frame b's
+        // quantize pass accumulates the scale partials frame b+1 needs
+        // (stc_quantize_ef_partials) — one memory pass per frame instead
+        // of quantize-then-rescan; only frame 0 pays a standalone scan.
         msg.nframes = 0;
+        stc_scale_partials(lk2.resid.data(), e->off.data(), e->ns.data(),
+                           e->L, amax.data(), ss.data(), sabs.data());
         for (int b = 0; b < e->burst; b++) {
-          compute_scales(e, lk2.resid.data(), scales.data());
+          scales_from_partials(e, amax, ss, sabs, scales.data());
           if (!any_nonzero(scales.data(), e->L)) {
             if (b == 0) lk2.dirty = false;  // nothing to say at all
             break;
@@ -283,9 +298,18 @@ void sender_loop(Engine* e) {
           msg.words.resize(base_w + (size_t)e->W);
           std::memcpy(msg.scales.data() + base_s, scales.data(),
                       (size_t)e->L * 4);
-          stc_quantize(lk2.resid.data(), lk2.resid.data(), e->off.data(),
-                       e->ns.data(), e->padded.data(), e->L, scales.data(),
-                       msg.words.data() + base_w);
+          if (b + 1 < e->burst) {
+            stc_quantize_ef_partials(
+                lk2.resid.data(), lk2.resid.data(), e->off.data(),
+                e->ns.data(), e->padded.data(), e->L, scales.data(),
+                msg.words.data() + base_w, amax.data(), ss.data(),
+                sabs.data());
+          } else {
+            // last frame of the burst: nobody consumes its partials
+            stc_quantize(lk2.resid.data(), lk2.resid.data(), e->off.data(),
+                         e->ns.data(), e->padded.data(), e->L, scales.data(),
+                         msg.words.data() + base_w);
+          }
           msg.nframes++;
         }
         if (msg.nframes == 0) continue;

@@ -86,10 +86,6 @@ class SharedTensorPeer:
         burstable = (
             not tcfg.wire_compat
             and host_tier_active()
-            and spec.total <= wire.BURST_MAX_TOTAL  # wire-level invariant:
-            # every peer sizes its receive buffer for a max burst of a
-            # <=BURST_MAX_TOTAL table (frame_wire_bytes), so a sender must
-            # never burst beyond that regardless of Config.frame_burst
             and self.config.codec.suppress_zero_frames  # the burst path has
             # no idle frames to send; honor the knob by streaming instead
         )
@@ -97,10 +93,16 @@ class SharedTensorPeer:
             self._burst = 1
         elif self.config.frame_burst == 0:
             # auto: the smaller the table, the more per-message overhead
-            # dominates — scale the burst up (4 Ki: 128, 16 Ki: 32)
-            self._burst = max(24, min(128, (1 << 19) // max(1, spec.total)))
+            # dominates — scale the burst up (4 Ki: 128, 16 Ki: 32). Large
+            # tables keep a small burst floor: the native engine's fused
+            # quantize+partials pass only amortizes its frame-0 scale scan
+            # across a burst, and K>=8 batches ACK traffic for free.
+            self._burst = max(8, min(128, (1 << 19) // max(1, spec.total)))
         else:
             self._burst = max(1, self.config.frame_burst)
+        # wire-level invariant: every peer sizes its receive buffer for
+        # burst_frames_cap(spec) frames (frame_wire_bytes), so a sender
+        # must never burst beyond that regardless of Config.frame_burst
         self._burst = min(self._burst, wire.burst_frames_cap(spec))
         # Device-tier burst (Config.device_frame_burst): any size — the
         # point is amortizing the device-link round trip, which hurts at

@@ -67,10 +67,9 @@ CHUNK_BYTES = 1 << 22
 #: buffer bound from these and the (handshake-identical) spec, so a burst
 #: can never exceed what any peer sized for — oversized incoming messages
 #: would otherwise be silently truncated by the transport's recv copy.
-#: BURST_MAX_TOTAL additionally bounds the HOST tier's auto-burst policy
-#: (small tables, where per-message engine cost dominates); the device
-#: tier bursts at any size to amortize the device-link round trip.
-BURST_MAX_TOTAL = 1 << 15
+#: Every tier bursts at every size now (host: amortizes per-message cost
+#: and the engine's frame-0 scale scan; device: amortizes the device-link
+#: round trip) — the K for a spec comes from burst_frames_cap below.
 BURST_MAX_FRAMES = 255
 BURST_MAX_BYTES = 1 << 22
 

@@ -113,3 +113,76 @@ def test_accumulate_np_sanitizes():
     u = np.full(spec.total, np.nan, np.float32)
     (out,) = NP.accumulate_table_np((v,), u, spec)
     assert np.isfinite(out).all() and (out == 0).all()
+
+
+def test_fused_quantize_partials_matches_separate_passes():
+    """stc_quantize_ef_partials must equal stc_quantize followed by
+    stc_scale_partials on the new residual: identical rout/words always;
+    partials to tight float tolerance (summation order differs by design).
+    Exercises whatever ISA path the host dispatches (AVX-512 where
+    available — the production path this would otherwise leave untested)."""
+    import ctypes
+
+    from shared_tensor_tpu.ops import codec_np as cn
+
+    lib = cn._native()
+    if lib is None:
+        pytest.skip("native codec unavailable")
+    _f64p = np.ctypeslib.ndpointer(np.float64, flags="C")
+    lib.stc_quantize_ef_partials.restype = None
+    lib.stc_quantize_ef_partials.argtypes = [
+        cn._f32p, cn._f32p, cn._i64p, cn._i64p, cn._i64p, ctypes.c_int64,
+        cn._f32p, cn._u32p, _f64p, _f64p, _f64p,
+    ]
+    rng = np.random.default_rng(11)
+    # ragged leaves: full words, partial tail word, padding — every loop arm
+    template = {
+        "a": np.zeros(300, np.float32),   # n % 32 != 0
+        "b": np.zeros(1024, np.float32),  # whole words (AVX path)
+        "c": np.zeros(7, np.float32),     # tiny tail-only leaf
+    }
+    from shared_tensor_tpu.ops.table import make_spec
+
+    spec = make_spec(template)
+    offs, ns, padded = cn._layout(spec)
+    L = spec.num_leaves
+    r = cn.flatten_np(
+        {k: rng.standard_normal(v.shape).astype(np.float32) for k, v in template.items()},
+        spec,
+    )
+    for scale_case in ("normal", "zero-leaf"):
+        scales = cn.compute_scales_np(r, spec)
+        if scale_case == "zero-leaf":
+            scales = scales.copy()
+            scales[0] = 0.0
+        # separate passes
+        out_a = np.empty(spec.total, np.float32)
+        words_a = np.empty(spec.total // 32, np.uint32)
+        lib.stc_quantize(r, out_a, offs, ns, padded, L, scales, words_a)
+        amax_a = np.zeros(L); ss_a = np.zeros(L); sabs_a = np.zeros(L)
+        lib.stc_scale_partials(out_a, offs, ns, L, amax_a, ss_a, sabs_a)
+        # fused
+        out_b = np.empty(spec.total, np.float32)
+        words_b = np.empty(spec.total // 32, np.uint32)
+        amax_b = np.zeros(L); ss_b = np.zeros(L); sabs_b = np.zeros(L)
+        lib.stc_quantize_ef_partials(
+            r, out_b, offs, ns, padded, L, scales, words_b,
+            amax_b, ss_b, sabs_b,
+        )
+        np.testing.assert_array_equal(out_b, out_a, err_msg=scale_case)
+        np.testing.assert_array_equal(words_b, words_a, err_msg=scale_case)
+        np.testing.assert_array_equal(amax_b, amax_a, err_msg=scale_case)
+        np.testing.assert_allclose(ss_b, ss_a, rtol=1e-12, err_msg=scale_case)
+        np.testing.assert_allclose(
+            sabs_b, sabs_a, rtol=1e-12, err_msg=scale_case
+        )
+        # aliased in-place form (how the engine calls it)
+        out_c = r.copy()
+        words_c = np.empty(spec.total // 32, np.uint32)
+        amax_c = np.zeros(L); ss_c = np.zeros(L); sabs_c = np.zeros(L)
+        lib.stc_quantize_ef_partials(
+            out_c, out_c, offs, ns, padded, L, scales, words_c,
+            amax_c, ss_c, sabs_c,
+        )
+        np.testing.assert_array_equal(out_c, out_a, err_msg=scale_case)
+        np.testing.assert_array_equal(words_c, words_a, err_msg=scale_case)

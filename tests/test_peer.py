@@ -436,20 +436,22 @@ def test_isolation_is_recoverable():
 
 
 def test_frame_burst_knob():
-    """Config.frame_burst: 0 = auto (size-scaled, small tables only),
-    1 = stream single frames, K = force (clamped to the wire bound)."""
+    """Config.frame_burst: 0 = auto (size-scaled: big for small tables, a
+    small floor for big ones — the engine's fused quantize+partials pass
+    amortizes its frame-0 scale scan across the burst), 1 = stream single
+    frames, K = force (clamped to the per-spec wire bound)."""
     from shared_tensor_tpu.comm import wire
 
-    small = jnp.zeros((1000,), jnp.float32)  # padded 1024 <= BURST_MAX_TOTAL
-    big = jnp.zeros((1 << 17,), jnp.float32)  # beyond the burst bound
+    small = jnp.zeros((1000,), jnp.float32)  # padded 1024
+    big = jnp.zeros((1 << 17,), jnp.float32)
 
     for tpl, cfg, expect in [
-        (small, Config(), lambda b: b > 1),  # auto bursts small tables
+        (small, Config(), lambda b: b > 8),  # auto bursts small tables big
         (small, Config(frame_burst=1), lambda b: b == 1),
         (small, Config(frame_burst=7), lambda b: b == 7),
         (small, Config(frame_burst=10_000), lambda b: b == wire.BURST_MAX_FRAMES),
-        (big, Config(), lambda b: b == 1),  # auto never bursts big tables
-        (big, Config(frame_burst=64), lambda b: b == 1),  # wire bound wins
+        (big, Config(), lambda b: b == 8),  # auto floor for big tables
+        (big, Config(frame_burst=64), lambda b: b == 64),
         (
             small,
             Config(codec=CodecConfig(suppress_zero_frames=False)),
