@@ -89,15 +89,20 @@ class SharedTensorPeer:
             and self.config.codec.suppress_zero_frames  # the burst path has
             # no idle frames to send; honor the knob by streaming instead
         )
+        from .engine import engine_eligible
+
         if not burstable:
             self._burst = 1
         elif self.config.frame_burst == 0:
             # auto: the smaller the table, the more per-message overhead
             # dominates — scale the burst up (4 Ki: 128, 16 Ki: 32). Large
-            # tables keep a small burst floor: the native engine's fused
-            # quantize+partials pass only amortizes its frame-0 scale scan
-            # across a burst, and K>=8 batches ACK traffic for free.
-            self._burst = max(8, min(128, (1 << 19) // max(1, spec.total)))
+            # tables get a K>=8 floor ONLY when the native engine will run:
+            # its fused quantize+partials pass amortizes the frame-0 scale
+            # scan across the burst (and batches ACKs). The Python fallback
+            # tier pays a full synchronous numpy rescan per frame under the
+            # SharedTensor lock, so its big tables keep streaming singly.
+            floor = 8 if engine_eligible(self.config) else 1
+            self._burst = max(floor, min(128, (1 << 19) // max(1, spec.total)))
         else:
             self._burst = max(1, self.config.frame_burst)
         # wire-level invariant: every peer sizes its receive buffer for

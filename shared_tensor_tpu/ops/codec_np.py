@@ -99,6 +99,13 @@ def _native() -> Optional[ctypes.CDLL]:
         lib.stc_accumulate_update_to.argtypes = [
             _f32p, _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64,
         ]
+        # fused sender pass + next-frame scale partials (the native
+        # engine's burst loop; parity-pinned in test_codec_np)
+        lib.stc_quantize_ef_partials.restype = None
+        lib.stc_quantize_ef_partials.argtypes = [
+            _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64, _f32p, _u32p,
+            _f64p, _f64p, _f64p,
+        ]
         _LIB = lib
     except Exception:  # no toolchain / build failure: numpy fallback
         _LIB = None

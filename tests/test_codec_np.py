@@ -121,19 +121,11 @@ def test_fused_quantize_partials_matches_separate_passes():
     partials to tight float tolerance (summation order differs by design).
     Exercises whatever ISA path the host dispatches (AVX-512 where
     available — the production path this would otherwise leave untested)."""
-    import ctypes
-
     from shared_tensor_tpu.ops import codec_np as cn
 
-    lib = cn._native()
+    lib = cn._native()  # declares stc_quantize_ef_partials' signature
     if lib is None:
         pytest.skip("native codec unavailable")
-    _f64p = np.ctypeslib.ndpointer(np.float64, flags="C")
-    lib.stc_quantize_ef_partials.restype = None
-    lib.stc_quantize_ef_partials.argtypes = [
-        cn._f32p, cn._f32p, cn._i64p, cn._i64p, cn._i64p, ctypes.c_int64,
-        cn._f32p, cn._u32p, _f64p, _f64p, _f64p,
-    ]
     rng = np.random.default_rng(11)
     # ragged leaves: full words, partial tail word, padding — every loop arm
     template = {

@@ -165,3 +165,44 @@ def test_counter_taxonomy_reconciles_across_layers():
     assert wire_out >= ma["delivery"]["msgs_out"]
     a.close()
     b.close()
+
+
+def test_engine_link_churn_loses_nothing():
+    """Link-death churn under the engine: kill the child's uplink
+    repeatedly while both sides add. Link death with both PROCESSES alive
+    must lose nothing (first-hop delivery: unacked frames roll back into
+    the carry, the re-graft diff handshake re-derives the rest) — the
+    strong arm of the delivery contract, exercising the engine's
+    rollback/detach/carry path (its riskiest code)."""
+    port = free_port()
+    a = _mk(port, {"w": np.zeros(512, np.float32)})
+    b = _mk(port, {"w": np.zeros(512, np.float32)})
+    total = np.zeros(512, np.float32)
+    try:
+        for k in range(4):
+            da = np.linspace(-1 - k, 1 + k, 512, dtype=np.float32)
+            db = np.linspace(0.5 + k, -0.5 - k, 512, dtype=np.float32)
+            a.add({"w": da})
+            b.add({"w": db})
+            total += da + db
+            time.sleep(0.3)
+            # kill the live link out from under the engine (transport-level
+            # drop: both processes survive, re-graft re-derives the diff)
+            links = b.node.links
+            if links:
+                b.node.drop_link(links[0])
+            time.sleep(0.3)
+        # wait for the final re-graft + convergence
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            va, vb = a.read()["w"], b.read()["w"]
+            if np.allclose(va, total, atol=1e-4) and np.allclose(
+                vb, total, atol=1e-4
+            ):
+                break
+            time.sleep(0.2)
+        np.testing.assert_allclose(a.read()["w"], total, atol=1e-4)
+        np.testing.assert_allclose(b.read()["w"], total, atol=1e-4)
+    finally:
+        a.close()
+        b.close()
