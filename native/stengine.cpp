@@ -119,6 +119,15 @@ struct Engine {
 
   std::vector<float> values;
   std::map<int32_t, ELink> links;
+  // The re-graft carry as a LIVE slot (the reference's unconnected-slot
+  // mechanism, src/sharedtensor.c:124-126/:338-342): a dead uplink's
+  // rolled-back residual parks here and KEEPS accumulating add()/flood
+  // mass while the node is orphaned — an add made with no links must ride
+  // the re-graft, or the join snapshot presents it as tree-known state and
+  // the parent's diff seed erases it everywhere (measured as tree-wide
+  // loss in the churn soak before this existed).
+  std::vector<float> carry;
+  bool has_carry = false;
   std::mutex mu;
 
   // sender wake (missed-wakeup-safe sequence counter)
@@ -131,6 +140,14 @@ struct Engine {
   std::deque<std::pair<int32_t, std::vector<uint8_t>>> ctrl;
 
   std::atomic<bool> stop{false};
+  // Sealed ingress (graceful-leave step 1): DATA/BURST messages are popped
+  // and DISCARDED — not applied, not counted, not ACKed — so their senders'
+  // ledgers keep them and re-deliver after our departure's re-graft. This
+  // closes the leave-time loss window: without it, a frame applied+ACKed
+  // in the instant between drain()'s last check and close() puts mass into
+  // residuals that die with us, and its sender (holding our ACK) never
+  // re-sends. ACK and control handling continue (our own drain needs them).
+  std::atomic<bool> sealed{false};
   std::atomic<uint64_t> frames_out{0}, frames_in{0}, updates{0};
   std::atomic<uint64_t> msgs_out{0}, msgs_in{0};
   std::thread send_thread, recv_thread;
@@ -242,6 +259,12 @@ void apply_batch(Engine* e, int32_t src_link, int32_t k, const float* scales,
                  e->total);
       kv.second.dirty = true;
     }
+    if (e->has_carry)
+      stc_add_to(e->carry.data(), e->carry.data(), delta.data(), e->total);
+  }
+  if (k == 1 && e->has_carry) {
+    stc_apply_frame(e->carry.data(), e->carry.data(), e->off.data(),
+                    e->ns.data(), e->padded.data(), e->L, scales, words);
   }
   e->frames_in += (uint64_t)k;
 }
@@ -438,6 +461,7 @@ void receiver_loop(Engine* e) {
         busy = true;
         uint8_t kind = buf[0];
         if (kind == kData || kind == kBurst) {
+          if (e->sealed.load()) continue;  // leaving: sender re-delivers
           // counted even when undecodable: the message was received and the
           // sender's ledger pops per message (comm/peer.py)
           msgs++;
@@ -540,6 +564,11 @@ __attribute__((visibility("default"))) void st_engine_start(void* h) {
   e->recv_thread = std::thread(receiver_loop, e);
 }
 
+// Seal ingress for a graceful leave (see Engine::sealed).
+__attribute__((visibility("default"))) void st_engine_seal(void* h) {
+  ((Engine*)h)->sealed.store(true);
+}
+
 // Stop the engine threads. MUST be called before st_node_close (the threads
 // block inside the node's condvars/queues).
 __attribute__((visibility("default"))) void st_engine_stop(void* h) {
@@ -573,6 +602,10 @@ __attribute__((visibility("default"))) void st_engine_add(void* h,
                                e->padded.data(), e->L);
       kv.second.dirty = true;
     }
+    if (e->has_carry)
+      stc_accumulate_update_to(e->carry.data(), e->carry.data(), u,
+                               e->off.data(), e->ns.data(), e->padded.data(),
+                               e->L);
     e->updates++;
   }
   e->wake();
@@ -610,6 +643,44 @@ __attribute__((visibility("default"))) int32_t st_engine_attach(
     lk2.dirty = true;
   }
   e->wake();
+  return 1;
+}
+
+// Park a dead uplink's residual (unacked rolled back) into the LIVE carry
+// slot, which keeps accumulating add()/flood mass until the re-graft
+// consumes it (see Engine::carry). Returns 1 if the link existed.
+__attribute__((visibility("default"))) int32_t st_engine_stash_carry(
+    void* h, int32_t link_id) {
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->links.find(link_id);
+  if (it == e->links.end()) return 0;
+  rollback_unacked(e, it->second);
+  if (!e->has_carry) {
+    e->carry = std::move(it->second.resid);
+    e->has_carry = true;
+  } else {
+    for (int64_t i = 0; i < e->total; i++)
+      e->carry[i] += it->second.resid[i];
+  }
+  e->links.erase(it);
+  return 1;
+}
+
+// Atomically read the replica snapshot AND consume the carry (one lock —
+// an add() between the two reads would land in the snapshot but not the
+// carry, re-creating the orphan-add loss this slot exists to fix).
+// Returns 1 when a carry was written to carry_out, 0 otherwise.
+__attribute__((visibility("default"))) int32_t st_engine_take_carry_and_snapshot(
+    void* h, float* carry_out, float* values_out) {
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->mu);
+  std::memcpy(values_out, e->values.data(), (size_t)e->total * 4);
+  if (!e->has_carry) return 0;
+  std::memcpy(carry_out, e->carry.data(), (size_t)e->total * 4);
+  e->has_carry = false;
+  e->carry.clear();
+  e->carry.shrink_to_fit();
   return 1;
 }
 
@@ -712,6 +783,13 @@ __attribute__((visibility("default"))) void st_engine_restore(
     std::lock_guard<std::mutex> lk(e->mu);
     std::memcpy(e->values.data(), values, (size_t)e->total * 4);
     for (int32_t i = 0; i < n_links; i++) {
+      if (ids[i] == -1) {  // the carry pseudo-slot (snapshot_all)
+        e->carry.assign((size_t)e->total, 0.0f);
+        std::memcpy(e->carry.data(), resids + (size_t)i * e->total,
+                    (size_t)e->total * 4);
+        e->has_carry = true;
+        continue;
+      }
       auto it = e->links.find(ids[i]);
       if (it == e->links.end()) continue;
       std::memcpy(it->second.resid.data(), resids + (size_t)i * e->total,
@@ -736,6 +814,14 @@ __attribute__((visibility("default"))) int32_t st_engine_snapshot_all(
     if (n >= max_links) break;
     ids_out[n] = kv.first;
     std::memcpy(resid_out + (size_t)n * e->total, kv.second.resid.data(),
+                (size_t)e->total * 4);
+    n++;
+  }
+  if (e->has_carry && n < max_links) {
+    // the carry is owed state: persist it as pseudo-link -1 (restore
+    // recognizes the id)
+    ids_out[n] = -1;
+    std::memcpy(resid_out + (size_t)n * e->total, e->carry.data(),
                 (size_t)e->total * 4);
     n++;
   }

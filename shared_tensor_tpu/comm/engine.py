@@ -58,6 +58,14 @@ def load_engine() -> Optional[ctypes.CDLL]:
         ]
         lib.st_engine_start.restype = None
         lib.st_engine_start.argtypes = [ctypes.c_void_p]
+        lib.st_engine_seal.restype = None
+        lib.st_engine_seal.argtypes = [ctypes.c_void_p]
+        lib.st_engine_stash_carry.restype = ctypes.c_int32
+        lib.st_engine_stash_carry.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.st_engine_take_carry_and_snapshot.restype = ctypes.c_int32
+        lib.st_engine_take_carry_and_snapshot.argtypes = [
+            ctypes.c_void_p, _f32p, _f32p,
+        ]
         lib.st_engine_stop.restype = None
         lib.st_engine_stop.argtypes = [ctypes.c_void_p]
         lib.st_engine_destroy.restype = None
@@ -183,6 +191,12 @@ class EngineTensor:
 
     # -- lifecycle ----------------------------------------------------------
 
+    def seal(self) -> None:
+        """Graceful-leave step 1: discard (never apply/ACK) further
+        incoming DATA/BURST so their senders re-deliver after our
+        departure — closes the leave-time in-transit loss window."""
+        self._lib.st_engine_seal(self._h)
+
     def stop(self) -> None:
         """Stop the engine threads. MUST run before TransportNode.close()
         (the threads block inside the node's queues/condvars)."""
@@ -249,6 +263,26 @@ class EngineTensor:
         )
         if r == 0:
             raise ValueError(f"link {link_id} already exists")
+
+    def stash_carry(self, link_id: int) -> bool:
+        """Park a dead uplink's residual in the engine's LIVE carry slot —
+        it keeps accumulating add()/flood mass while orphaned (an orphan
+        add with no residual to live in would be erased tree-wide by the
+        re-graft diff; the reference's unconnected-slot mechanism)."""
+        return bool(self._lib.st_engine_stash_carry(self._h, link_id))
+
+    def take_carry_and_snapshot(
+        self,
+    ) -> tuple[Optional[np.ndarray], np.ndarray]:
+        """Atomically consume the carry and snapshot the replica (ONE lock:
+        an add between the two would land in the snapshot but not the
+        carry, re-creating the orphan-add loss)."""
+        carry = np.empty(self.spec.total, np.float32)
+        values = np.empty(self.spec.total, np.float32)
+        has = self._lib.st_engine_take_carry_and_snapshot(
+            self._h, carry, values
+        )
+        return (carry if has else None), values
 
     def drop_link(self, link_id: int) -> Optional[np.ndarray]:
         out = np.empty(self.spec.total, np.float32)

@@ -47,6 +47,17 @@ from .transport import EventKind, TransportNode
 
 log = logging.getLogger("shared_tensor_tpu.peer")
 
+#: Pseudo-link id holding the re-graft carry as a LIVE slot in the Python
+#: tier's SharedTensor (the engine keeps its carry internally): a dead
+#: uplink's rolled-back residual parks here and keeps receiving add()/flood
+#: mass while the node is orphaned. Without a live slot, an add made with
+#: no links lives only in the replica; the re-join snapshot then presents
+#: it as tree-known state and the parent's diff seed erases it tree-wide
+#: (the reference avoids this by accumulating into unconnected slots,
+#: src/sharedtensor.c:124-126/:338-342). Never a transport link id
+#: (transport ids start at 1); the send loop and drain skip it.
+CARRY_LINK = -1
+
 
 class SpecMismatch(ConnectionError):
     """Peer tried to sync a different table layout (the reference's
@@ -187,9 +198,13 @@ class SharedTensorPeer:
         # until WELCOME so the uplink residual can be seeded with
         # replica_now - sent_snapshot (= carry + everything added or flooded
         # in during the handshake).
-        self._carry_residual: Optional[jnp.ndarray] = None
         self._sent_snapshot: Optional[jnp.ndarray] = None
+        # set when the uplink died BEFORE the handshake finished (no codec
+        # link existed to stash): the carry is then values - this base,
+        # computed lazily at re-join so orphan-period adds are included
+        self._mid_handshake_base: Optional[jnp.ndarray] = None
         self._compat_reset_on_regraft = False
+        self._sealed = False  # leave() in progress: discard unACKed ingress
         self._uplink: Optional[int] = None
         # delivery accounting (see _send_loop): sent-but-unacked frame seqs
         # per link (send thread appends, recv thread pops on wire.ACK), and
@@ -253,7 +268,10 @@ class SharedTensorPeer:
         # zero; the Python tier needs the coarser poll to stay off its lock
         poll = 0.005 if self._engine is not None else 0.05
         while time.time() < deadline and not self._stop.is_set():
-            links = self.st.link_ids
+            # the carry pseudo-slot (CARRY_LINK) is excluded: an orphan by
+            # definition has nobody to deliver to — its owed mass rides the
+            # next re-graft, not this drain
+            links = [l for l in self.st.link_ids if l >= 0]
             if all(self.st.residual_rms(l) <= tol for l in links):
                 stats = [self.node.stats(l) for l in self.node.links]
                 if (
@@ -263,6 +281,29 @@ class SharedTensorPeer:
                     return True
             time.sleep(poll)
         return False
+
+    def leave(self, timeout: float = 60.0, tol: float = 1e-30) -> bool:
+        """Graceful exit that loses nothing even MID-STREAM: (1) seal
+        ingress — further incoming frames are discarded unACKed, so their
+        senders keep them ledgered and re-deliver after our departure's
+        re-graft; (2) drain everything we owe; (3) close. Returns the drain
+        verdict.
+
+        A bare ``drain(); close()`` has a loss window this closes: a frame
+        that lands (and is applied + ACKed, flooding into our other links'
+        residuals) in the instant between drain's last check and close dies
+        with those residuals, and its sender — holding our ACK — never
+        re-sends. Sealing first makes new arrivals un-ACKed, so the
+        interrupted mass re-routes around us instead. (Wire-compat mode has
+        no ACK ledger; there a mid-stream leave keeps the reference
+        protocol's lossy semantics.) ``tol`` defaults just above the
+        subnormal-dust floor (see :meth:`drain`)."""
+        if self._engine is not None:
+            self._engine.seal()
+        self._sealed = True
+        ok = self.drain(timeout=timeout, tol=tol)
+        self.close()
+        return ok
 
     def close(self) -> None:
         """Leave the tree. Peers survive and re-graft (the reference prints an
@@ -391,7 +432,7 @@ class SharedTensorPeer:
         hot: set[int] = set()  # links whose last finished frame carried data
         while not self._stop.is_set():
             sent_any = False
-            links = self.st.link_ids
+            links = [l for l in self.st.link_ids if l >= 0]  # skip CARRY_LINK
             for stale in [l for l in pipe if l not in links]:
                 del pipe[stale]  # LINK_DOWN already rolled their ledger back
                 hot.discard(stale)
@@ -563,18 +604,25 @@ class SharedTensorPeer:
                             if frame is not None:
                                 batch.append(frame)
                             continue
-                        if payload[0] == wire.DATA:
+                        if payload[0] in (wire.DATA, wire.BURST):
+                            if self._sealed:
+                                # leaving: discard unACKed — the sender's
+                                # ledger re-delivers after our departure
+                                continue
                             # counted BEFORE decode: an undecodable DATA was
                             # still a received wire message, and the sender's
                             # in-flight ledger pops one entry per message —
                             # skipping it would permanently misalign the
                             # cumulative ACK count and strand ledger entries
                             msgs += 1
-                            batch.append(wire.decode_frame(payload, self.st.spec))
-                            continue
-                        if payload[0] == wire.BURST:
-                            msgs += 1
-                            batch.extend(wire.decode_burst(payload, self.st.spec))
+                            if payload[0] == wire.DATA:
+                                batch.append(
+                                    wire.decode_frame(payload, self.st.spec)
+                                )
+                            else:
+                                batch.extend(
+                                    wire.decode_burst(payload, self.st.spec)
+                                )
                             continue
                     except Exception as e:  # a bad frame must not kill the node
                         log.warning("dropping bad frame on link %d: %s", link, e)
@@ -668,8 +716,7 @@ class SharedTensorPeer:
                         if self._compat_reset_on_regraft:
                             self._compat_reset_on_regraft = False
                             self.st.reset_values()
-                        carry = self._carry_residual
-                        self._carry_residual = None
+                        carry, _ = self.st.take_link_and_snapshot(CARRY_LINK)
                         self.st.new_link(
                             ev.link_id, seed=False, residual=carry
                         )
@@ -692,18 +739,22 @@ class SharedTensorPeer:
                     self._acked.pop(ev.link_id, None)
                     self._rx_count.pop(ev.link_id, None)
                     self._ack_sent.pop(ev.link_id, None)
-                resid = self.st.drop_link(ev.link_id)
                 if ev.is_uplink:
                     # Keep undelivered upward updates for the re-grafted
-                    # uplink. If the parent died mid-handshake the codec link
-                    # never existed (resid None); everything we owe the tree
-                    # is then replica - sent_snapshot.
-                    if resid is not None:
-                        self._carry_residual = resid
-                    elif self._sent_snapshot is not None:
-                        self._carry_residual = (
-                            self.st.snapshot_flat() - self._sent_snapshot
-                        )
+                    # uplink — in a LIVE carry slot that continues to absorb
+                    # add()/flood mass while we are orphaned (see
+                    # CARRY_LINK). If the parent died mid-handshake the
+                    # codec link never existed; everything we owe the tree
+                    # is then replica - sent_snapshot, computed LAZILY at
+                    # re-join time so orphan-period adds are included.
+                    if self._engine is not None:
+                        stashed = self._engine.stash_carry(ev.link_id)
+                    else:
+                        # one lock: a concurrent add() must find either the
+                        # dying link or the carry slot, never neither
+                        stashed = self.st.stash_carry(ev.link_id, CARRY_LINK)
+                    if not stashed and self._sent_snapshot is not None:
+                        self._mid_handshake_base = self._sent_snapshot
                     self._sent_snapshot = None
                     self._uplink = None
                     if self.config.transport.wire_compat:
@@ -721,7 +772,9 @@ class SharedTensorPeer:
                         # keeps state and accepts the documented
                         # double-count — still strictly better than the
                         # reference, which kills the whole tree (quirk Q8).
-                        if not self.st.link_ids:
+                        # (the carry pseudo-slot is not a real link)
+                        real = [l for l in self.st.link_ids if l >= 0]
+                        if not real:
                             self._compat_reset_on_regraft = True
                         else:
                             log.warning(
@@ -729,13 +782,23 @@ class SharedTensorPeer:
                                 " re-seeded state may double (the reference"
                                 " protocol has no diff handshake)"
                             )
+                else:
+                    self.st.drop_link(ev.link_id)
             elif ev.kind == EventKind.BECAME_MASTER:
                 # our parent died and rejoin found nobody: we claimed the
                 # rendezvous and are the new root (native master failover);
                 # whatever state we hold is now the authoritative seed —
                 # including in wire-compat, where a pending re-graft reset
                 # must be cancelled (zeroing the new root would serve an
-                # empty tree)
+                # empty tree). The carry is DROPPED: its mass is already in
+                # our (now-authoritative) replica, a root never re-joins
+                # upward, and a live-but-unconsumable carry would cost an
+                # extra O(total) pass on every add/apply forever.
+                if self._engine is not None:
+                    self._engine.take_carry_and_snapshot()
+                else:
+                    self.st.take_link_and_snapshot(CARRY_LINK)
+                self._mid_handshake_base = None
                 self._compat_reset_on_regraft = False
                 self._uplink = None
                 self.is_master = True
@@ -777,14 +840,28 @@ class SharedTensorPeer:
 
     # native-mode join handshake, child side
     def _start_join(self, uplink: int) -> None:
-        snap = self.st.snapshot_flat()
-        if self._carry_residual is not None:
+        # Consume the carry ATOMICALLY with the replica snapshot (one lock
+        # in the state layer): an add() racing between the two would appear
+        # in the snapshot but not the carry — presented to the parent as
+        # tree-known state and erased tree-wide by its diff seed.
+        if self._engine is not None:
+            carry, snap = self._engine.take_carry_and_snapshot()
+        else:
+            carry, snap = self.st.take_link_and_snapshot(CARRY_LINK)
+        if carry is None and self._mid_handshake_base is not None:
+            # parent died before the handshake finished: everything we owe
+            # is values - base, including orphan-period adds (lazy compute)
+            carry = snap - self._mid_handshake_base
+        self._mid_handshake_base = None
+        if carry is not None:
             # exclude updates we still owe the tree, else the parent's diff
             # seed would subtract them from us while our carried residual
             # re-delivers them upward — a permanent divergence of exactly
             # the carried amount
-            snap = snap - self._carry_residual
-            self._carry_residual = None
+            snap = snap - carry
+            # the carry rides the NEW uplink: seeded at WELCOME as
+            # values_now - sent_snapshot, which is exactly carry + whatever
+            # lands during the handshake (the live slot keeps absorbing)
         self._sent_snapshot = snap
         self._send_blocking(uplink, wire.encode_sync(self.st.spec))
         for chunk in wire.encode_snapshot_chunks(np.asarray(snap, dtype="<f4")):

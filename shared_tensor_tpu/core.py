@@ -255,6 +255,41 @@ class SharedTensor:
                 )
             self._links[link_id] = self.values - snap
 
+    def stash_carry(self, link_id: int, carry_id: int) -> bool:
+        """Move a dead link's residual (unacked frames rolled back) into the
+        live carry pseudo-slot ``carry_id``, merging with any existing carry
+        — ONE lock acquisition. A multi-step pop/merge/create would leave a
+        window where a concurrent add() finds neither the dead link nor the
+        carry slot, and that orphan mass would later be erased tree-wide by
+        the re-graft diff (the loss the live slot exists to prevent).
+        Returns False if ``link_id`` is unknown (mid-handshake death)."""
+        with self._lock:
+            resid = self._links.pop(link_id, None)
+            if resid is None:
+                return False
+            inflight = self._inflight.pop(link_id, {})
+            resid = self._unapply(resid, inflight)
+            prev = self._links.pop(carry_id, None)
+            if prev is not None:
+                resid = resid + prev
+            self._links[carry_id] = resid
+            return True
+
+    def take_link_and_snapshot(
+        self, link_id: int
+    ) -> tuple[Optional[jnp.ndarray], jnp.ndarray]:
+        """drop_link + replica snapshot under ONE lock acquisition. The
+        peer's re-graft uses this on its carry pseudo-link: an add() landing
+        between a separate drop and snapshot would appear in the snapshot
+        but not the carry — presenting orphan-period mass as tree-known
+        state, which the parent's diff seed then erases tree-wide."""
+        with self._lock:
+            resid = self._links.pop(link_id, None)
+            inflight = self._inflight.pop(link_id, {})
+            if resid is not None:
+                resid = self._unapply(resid, inflight)
+            return resid, self.values
+
     def drop_link(self, link_id: int) -> Optional[jnp.ndarray]:
         """Close a link (peer died or left); returns its undelivered residual
         (or None if unknown) INCLUDING any unacknowledged in-flight frame

@@ -104,6 +104,12 @@ def load_shared(st: SharedTensor, path: str) -> None:
         for lid, r in links.items():
             if lid in st._links:
                 st._links[lid] = st._asarray(r)
+            elif lid < 0:
+                # the carry pseudo-slot (owed re-graft mass): recreate it
+                # unconditionally, matching the engine tier's restore —
+                # dropping it would present the restored mass as tree-known
+                # at the next handshake and erase it tree-wide
+                st._links[lid] = st._asarray(r)
 
 
 def save_pod(state: "PeerSyncState", spec: TableSpec, path: str) -> None:

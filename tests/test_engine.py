@@ -17,7 +17,7 @@ import pytest
 
 from shared_tensor_tpu import create_or_fetch
 from shared_tensor_tpu.comm.engine import engine_eligible, load_engine
-from shared_tensor_tpu.config import Config
+from shared_tensor_tpu.config import Config, TransportConfig
 
 from _ports import free_port
 
@@ -206,3 +206,61 @@ def test_engine_link_churn_loses_nothing():
     finally:
         a.close()
         b.close()
+
+
+def test_engine_midstream_leave_loses_nothing():
+    """peer.leave() mid-stream (seal -> drain -> close) must lose NOTHING
+    even while siblings stream hard. The leaver MUST be an INTERIOR node
+    (max_children=1 chain a <- b <- c): the loss window only exists there —
+    a frame applied+ACKed at b floods into b's OTHER link's residual, and
+    without the seal one landing between drain's last check and close dies
+    with that residual while its sender, holding b's ACK, never re-sends.
+    A leaf leaver floods nowhere and would pass seal-less. No hard kills
+    here, so the final sum is EXACT."""
+    port = free_port()
+    chain = dict(transport=TransportConfig(max_children=1))
+    a = _mk(port, {"w": np.zeros(1024, np.float32)}, **chain)
+    b = _mk(port, {"w": np.zeros(1024, np.float32)}, **chain)
+    c = _mk(port, {"w": np.zeros(1024, np.float32)}, **chain)
+    # chain: master a took b; c was redirected through b — b is interior
+    assert len(b.node.links) == 2, b.node.links
+    total = np.zeros(1024, np.float64)
+    stop = {"v": False}
+
+    import threading
+
+    def hammer(peer, seed):
+        rng = np.random.default_rng(seed)
+        while not stop["v"]:
+            lo, hi = sorted(rng.uniform(-1, 1, size=2))
+            d = np.linspace(lo, hi, 1024, dtype=np.float32)
+            peer.add({"w": d})
+            with lock:
+                total_acc.append(d.astype(np.float64))
+            time.sleep(0.01)
+
+    lock = threading.Lock()
+    total_acc: list = []
+    threads = [
+        threading.Thread(target=hammer, args=(a, 1)),
+        threading.Thread(target=hammer, args=(c, 2)),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    b.add({"w": np.full(1024, 0.5, np.float32)})
+    assert b.leave(timeout=30.0)  # mid-stream: a and c still hammering
+    time.sleep(0.5)
+    stop["v"] = True
+    for t in threads:
+        t.join()
+    with lock:
+        total = np.sum(total_acc, axis=0) + 0.5
+    # quiesce and drain both survivors
+    assert a.drain(timeout=60.0, tol=1e-30)
+    assert c.drain(timeout=60.0, tol=1e-30)
+    time.sleep(1.0)
+    np.testing.assert_allclose(a.read()["w"], total, atol=1e-3)
+    np.testing.assert_allclose(c.read()["w"], total, atol=1e-3)
+    a.close()
+    c.close()
