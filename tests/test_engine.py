@@ -167,16 +167,19 @@ def test_counter_taxonomy_reconciles_across_layers():
     b.close()
 
 
-def test_engine_link_churn_loses_nothing():
-    """Link-death churn under the engine: kill the child's uplink
-    repeatedly while both sides add. Link death with both PROCESSES alive
-    must lose nothing (first-hop delivery: unacked frames roll back into
-    the carry, the re-graft diff handshake re-derives the rest) — the
-    strong arm of the delivery contract, exercising the engine's
-    rollback/detach/carry path (its riskiest code)."""
+@pytest.mark.parametrize("native", [True, False])
+def test_engine_link_churn_loses_nothing(native):
+    """Link-death churn: kill the child's uplink repeatedly while both
+    sides add. Link death with both PROCESSES alive must lose nothing
+    (first-hop delivery: unacked frames roll back into the LIVE carry
+    slot — which keeps absorbing orphan-period adds — and the re-graft
+    diff handshake re-derives the rest). Parametrized over both tiers:
+    the engine's C carry and the Python tier's pseudo-link carry are
+    separate implementations of the same contract."""
     port = free_port()
-    a = _mk(port, {"w": np.zeros(512, np.float32)})
-    b = _mk(port, {"w": np.zeros(512, np.float32)})
+    a = _mk(port, {"w": np.zeros(512, np.float32)}, native_engine=native)
+    b = _mk(port, {"w": np.zeros(512, np.float32)}, native_engine=native)
+    assert (b._engine is not None) == native
     total = np.zeros(512, np.float32)
     try:
         for k in range(4):
@@ -208,7 +211,8 @@ def test_engine_link_churn_loses_nothing():
         b.close()
 
 
-def test_engine_midstream_leave_loses_nothing():
+@pytest.mark.parametrize("native", [True, False])
+def test_engine_midstream_leave_loses_nothing(native):
     """peer.leave() mid-stream (seal -> drain -> close) must lose NOTHING
     even while siblings stream hard. The leaver MUST be an INTERIOR node
     (max_children=1 chain a <- b <- c): the loss window only exists there —
@@ -216,9 +220,13 @@ def test_engine_midstream_leave_loses_nothing():
     without the seal one landing between drain's last check and close dies
     with that residual while its sender, holding b's ACK, never re-sends.
     A leaf leaver floods nowhere and would pass seal-less. No hard kills
-    here, so the final sum is EXACT."""
+    here, so the final sum is EXACT. Parametrized over both tiers (the
+    seal and the live carry have separate engine/Python implementations).
+    """
     port = free_port()
-    chain = dict(transport=TransportConfig(max_children=1))
+    chain = dict(
+        transport=TransportConfig(max_children=1), native_engine=native
+    )
     a = _mk(port, {"w": np.zeros(1024, np.float32)}, **chain)
     b = _mk(port, {"w": np.zeros(1024, np.float32)}, **chain)
     c = _mk(port, {"w": np.zeros(1024, np.float32)}, **chain)
