@@ -20,7 +20,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SIZES = [4096, 65536, 1 << 20]
+SIZES = [
+    int(x)
+    for x in os.environ.get(
+        "ST_ENGINE_BENCH_SIZES", f"4096,65536,{1 << 20}"
+    ).split(",")
+]
 MEASURE_S = float(os.environ.get("ST_ENGINE_BENCH_S", "8"))
 
 
