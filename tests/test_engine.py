@@ -272,3 +272,34 @@ def test_engine_midstream_leave_loses_nothing(native):
     np.testing.assert_allclose(c.read()["w"], total, atol=1e-3)
     a.close()
     c.close()
+
+
+def test_engine_forwards_unknown_messages_without_disruption():
+    """An unknown message kind arriving on an engine-attached link must be
+    forwarded to Python's control path (logged + dropped there) while the
+    data stream keeps flowing — the engine owns only DATA/BURST/ACK."""
+    port = free_port()
+    a = _mk(port, {"w": np.zeros(256, np.float32)})
+    b = _mk(port, {"w": np.zeros(256, np.float32)})
+    try:
+        link = b.node.links[0]
+        for _ in range(3):
+            b.node.send(link, bytes([99]) + b"garbage", timeout=1.0)
+        b.add({"w": np.full(256, 1.25, np.float32)})
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if np.allclose(a.read()["w"], 1.25):
+                break
+            time.sleep(0.05)
+        np.testing.assert_allclose(a.read()["w"], 1.25)
+        # and the reverse direction still works after the garbage
+        a.add({"w": np.full(256, -0.25, np.float32)})
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if np.allclose(b.read()["w"], 1.0):
+                break
+            time.sleep(0.05)
+        np.testing.assert_allclose(b.read()["w"], 1.0)
+    finally:
+        a.close()
+        b.close()
