@@ -59,6 +59,15 @@ log = logging.getLogger("shared_tensor_tpu.peer")
 CARRY_LINK = -1
 
 
+def _python_tier_auto_burst(spec) -> int:
+    """Auto burst for the PYTHON fallback tier: each burst frame is a full
+    synchronous numpy rescan under the state lock, so only small tables —
+    where per-message dispatch dominates — come out ahead."""
+    if spec.total <= (1 << 15):
+        return max(24, min(128, (1 << 19) // max(1, spec.total)))
+    return 1
+
+
 class SpecMismatch(ConnectionError):
     """Peer tried to sync a different table layout (the reference's
     THError("Not the right size!"), src/sharedtensor.c:335, made explicit
@@ -89,11 +98,11 @@ class SharedTensorPeer:
         from ..core import host_tier_active
 
         # Burst sizing (Config.frame_burst): host tier + native mode only —
-        # the device tier pipelines async dispatches instead, and the
-        # reference protocol has no burst framing. Auto: burst small tables
-        # (per-message engine cost dominates their O(n) codec math); 24
-        # frames deliver ~full fp32 precision of the current residual in
-        # one message (residual halves per frame, BASELINE.md).
+        # the device tier pipelines async dispatches (and has its own
+        # device_frame_burst), and the reference protocol has no burst
+        # framing. Auto policy is TWO-branch: the native engine fills the
+        # wire message budget at every size; the Python fallback tier
+        # bursts only small tables (see the branches below).
         burstable = (
             not tcfg.wire_compat
             and host_tier_active()
@@ -105,15 +114,16 @@ class SharedTensorPeer:
         if not burstable:
             self._burst = 1
         elif self.config.frame_burst == 0:
-            # auto: the smaller the table, the more per-message overhead
-            # dominates — scale the burst up (4 Ki: 128, 16 Ki: 32). Large
-            # tables get a K>=8 floor ONLY when the native engine will run:
-            # its fused quantize+partials pass amortizes the frame-0 scale
-            # scan across the burst (and batches ACKs). The Python fallback
-            # tier pays a full synchronous numpy rescan per frame under the
-            # SharedTensor lock, so its big tables keep streaming singly.
-            floor = 8 if engine_eligible(self.config) else 1
-            self._burst = max(floor, min(128, (1 << 19) // max(1, spec.total)))
+            if engine_eligible(self.config):
+                # auto (engine): FILL the wire message budget — throughput
+                # is monotone in K up to the per-spec cap at every measured
+                # size (4 Ki: 352 k f/s at K=255 vs 300 k at 128; 64 Ki:
+                # +50% over K=8; 1 Mi: +38% at its 31-frame cap). The
+                # engine's fused quantize+partials makes marginal frames
+                # one memory pass, and a burst is one ledger entry/ACK.
+                self._burst = wire.burst_frames_cap(spec)
+            else:
+                self._burst = _python_tier_auto_burst(spec)
         else:
             self._burst = max(1, self.config.frame_burst)
         # wire-level invariant: every peer sizes its receive buffer for
@@ -182,6 +192,12 @@ class SharedTensorPeer:
             except Exception as e:
                 log.warning("native engine unavailable, using python tier: %s", e)
         if self._engine is None:
+            # the burst was sized for the engine (fill the wire budget);
+            # if the engine did not actually construct, the Python tier
+            # must re-size — at the cap it would pay up to 255 synchronous
+            # numpy rescans per message under the state lock
+            if self.config.frame_burst == 0 and self._burst > 1:
+                self._burst = min(self._burst, _python_tier_auto_burst(spec))
             self.st = SharedTensor(template, codec, seed_values=self.is_master)
         self._ready = threading.Event()
         self._error: Optional[Exception] = None

@@ -436,21 +436,30 @@ def test_isolation_is_recoverable():
 
 
 def test_frame_burst_knob():
-    """Config.frame_burst: 0 = auto (size-scaled: big for small tables, a
-    small floor for big ones — the engine's fused quantize+partials pass
-    amortizes its frame-0 scale scan across the burst), 1 = stream single
-    frames, K = force (clamped to the per-spec wire bound)."""
+    """Config.frame_burst: 0 = auto — the ENGINE tier fills the wire
+    message budget at every size (throughput is monotone in K up to the
+    cap); the PYTHON fallback tier bursts only small tables (each burst
+    frame is a synchronous numpy rescan). 1 = stream single frames,
+    K = force (clamped to the per-spec wire bound)."""
     from shared_tensor_tpu.comm import wire
+    from shared_tensor_tpu.comm.engine import engine_eligible
 
     small = jnp.zeros((1000,), jnp.float32)  # padded 1024
     big = jnp.zeros((1 << 17,), jnp.float32)
 
+    eng = engine_eligible(Config())
+    auto_small = (lambda b: b == wire.BURST_MAX_FRAMES) if eng else (
+        lambda b: b == 128
+    )
+    auto_big = (lambda b: b == wire.BURST_MAX_FRAMES) if eng else (
+        lambda b: b == 1
+    )
     for tpl, cfg, expect in [
-        (small, Config(), lambda b: b > 8),  # auto bursts small tables big
+        (small, Config(), auto_small),
         (small, Config(frame_burst=1), lambda b: b == 1),
         (small, Config(frame_burst=7), lambda b: b == 7),
         (small, Config(frame_burst=10_000), lambda b: b == wire.BURST_MAX_FRAMES),
-        (big, Config(), lambda b: b == 8),  # auto floor for big tables
+        (big, Config(), auto_big),  # cap at 128Ki is 255
         (big, Config(frame_burst=64), lambda b: b == 64),
         (
             small,
