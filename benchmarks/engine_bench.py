@@ -67,7 +67,16 @@ def _child(n, port, q, done: "mp.Event"):
     from shared_tensor_tpu import create_or_fetch
 
     peer = create_or_fetch("127.0.0.1", port, {"w": np.zeros(n, np.float32)})
-    time.sleep(1.5)  # past join transient
+    # Open the measure window only once frames actually flow: a fixed sleep
+    # undershoots on a loaded box (large-n join state transfer can outlast
+    # it, measuring zero) and silently folds startup into the rate.
+    # 25 s: ample for the join transfer even 10x contended, yet short enough
+    # that bench.py's engine arm (timeout >= 30 s) still sees the fail-fast
+    # "no frames" diagnostic instead of SIGKILLing a still-waiting child.
+    deadline = time.time() + 25
+    while peer.st.frames_in == 0 and time.time() < deadline:
+        time.sleep(0.1)
+    time.sleep(0.5)  # settle just past the first delivery
     f0, t0 = peer.st.frames_in, time.time()
     time.sleep(MEASURE_S)
     f1, t1 = peer.st.frames_in, time.time()
@@ -111,6 +120,7 @@ def run_size(n: int) -> dict:
     pm.join(timeout=30)
     pc.join(timeout=30)
     row = dict(out["child"])
+    row["master_engine"] = bool(out["master"])
     row["n"] = n
     return row
 
