@@ -1,0 +1,125 @@
+"""Codec-lab Pareto: error-vs-bytes-vs-frames for the experimental
+compression methods (ops/codec_lab.py; reference README.md:45 "try
+different compression methods" TODO).
+
+For each (method, residual distribution): run the error-feedback loop on
+one link trajectory and record how fast the residual RMS falls per frame
+and per byte sent, plus host encode throughput. Emits one JSON line
+(-> CODEC_LAB_r{N}.json).
+
+Run: python benchmarks/codec_lab.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from shared_tensor_tpu.ops.codec_lab import standard_lab
+
+N = int(os.environ.get("ST_CODEC_LAB_N", str(1 << 18)))
+MAX_FRAMES = 400
+TARGET = 1e-2  # "converged" mark for the frames/bytes-to-target columns
+
+
+def distributions(rng):
+    heavy = (rng.standard_t(1.2, N) * 1e-3).astype(np.float32)
+    heavy[rng.integers(0, N, max(8, N // 8192))] += rng.choice(
+        [-100.0, 100.0], max(8, N // 8192)
+    ).astype(np.float32)
+    # two "leaves" three orders of magnitude apart, concatenated — the flat
+    # single-scale view of BASELINE config 3's mixed-magnitude table (the
+    # per-leaf-scale table codec solves this properly; the lab measures how
+    # much each POLICY suffers without that)
+    mixed = np.concatenate(
+        [
+            rng.standard_normal(N // 2).astype(np.float32),
+            (rng.standard_normal(N - N // 2) * 1e-3).astype(np.float32),
+        ]
+    )
+    return {
+        "uniform": rng.uniform(-1.0, 1.0, N).astype(np.float32),
+        "gaussian": rng.standard_normal(N).astype(np.float32),
+        "heavy_tail": heavy,
+        "mixed_magnitude": mixed,
+    }
+
+
+def _rms(r):
+    return float(np.sqrt(np.mean(r.astype(np.float64) ** 2)))
+
+
+def run(codec, r0):
+    r = r0.copy()
+    rms0 = _rms(r0)
+    bytes_total = 0
+    first_payload = None
+    frames_to_target = None
+    bytes_to_target = None
+    rms_at_20 = None
+    t_encode = 0.0
+    for i in range(1, MAX_FRAMES + 1):
+        t0 = time.perf_counter()
+        frame, r = codec.encode(r)
+        t_encode += time.perf_counter() - t0
+        bytes_total += frame.payload_bytes
+        if first_payload is None:
+            first_payload = frame.payload_bytes
+        rel = _rms(r) / rms0
+        if i == 20:
+            rms_at_20 = rel
+        if frames_to_target is None and rel < TARGET:
+            frames_to_target, bytes_to_target = i, bytes_total
+        if frame.payload_bytes <= 4 and not r.any():
+            break
+    rel_final = _rms(r) / rms0
+    return {
+        "method": codec.name,
+        "frames_to_1pct": frames_to_target,
+        "bytes_to_1pct": bytes_to_target,
+        "bytes_per_frame": first_payload,
+        "rms_decay_per_frame_20": (
+            round(rms_at_20 ** (1 / 20), 4) if rms_at_20 is not None else None
+        ),
+        "final_rel_rms": float(f"{rel_final:.3e}"),
+        "frames_run": i,
+        "encode_Melem_s": round(N * i / t_encode / 1e6, 1),
+    }
+
+
+def main():
+    rng = np.random.default_rng(0)
+    rows = []
+    for dist_name, r0 in distributions(rng).items():
+        for codec in standard_lab(N):
+            row = run(codec, r0)
+            row["dist"] = dist_name
+            rows.append(row)
+    print(
+        json.dumps(
+            {
+                "bench": "codec_lab_pareto",
+                "n_elements": N,
+                "target_rel_rms": TARGET,
+                "rows": rows,
+                "reading": (
+                    "per-byte winner: min bytes_to_1pct per dist; per-frame "
+                    "(latency) winner: min frames_to_1pct. Measured regimes: "
+                    "sign1 byte-optimal on uniform (the reference's choice, "
+                    "exact drain); sign2 wins gaussian per frame AND per "
+                    "byte to 1% (sign1's tail stalls at ±s/frame); topk "
+                    "dominant on heavy tails (1 frame to 1%, sign1 never "
+                    "in 400); mixed_magnitude is why the production table "
+                    "codec has per-leaf scales"
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,222 @@
+"""Experimental compression methods — the reference's last open TODO.
+
+Reference README.md:45 asks to "try different compression methods in the
+real world". The production planes (ops/codec*.py, native/) are pinned to
+the reference's 1-bit sign codec so every tier stays bit-compatible; this
+module is the LAB: alternative delta codecs under the identical
+error-feedback frame contract, so they can be compared on the same
+residual trajectories the production codec runs —
+
+    encode(residual)  -> (frame, new_residual)   # residual -= decode(frame)
+    decode(frame)     -> dense delta             # receiver: values += delta
+
+Every method keeps the two invariants the framework's semantics rest on
+(SURVEY.md App. B):
+
+- **conservation**: ``residual_in == decode(frame) + residual_out`` to
+  within 1 ulp of the sent magnitude (the f32 subtraction rounds when
+  exponents differ — the same ~1 ulp bound the production codec documents
+  for receiver accumulation; TopK is exactly conservative since it ships
+  f32 copies);
+- **boundedness**: an all-zero residual encodes to an idle frame
+  (``scale == 0`` / empty payload) and decodes to zero.
+
+Methods:
+
+``Sign1``
+    The production codec (1 bit/elem + 4 B scale), wrapped into the lab
+    interface as the baseline — reference src/sharedtensor.c:145-177.
+
+``Sign2``
+    Two-bit sign-magnitude extension of the same idea: per element send
+    ``±scale`` or ``±3·scale`` (magnitude bit set when ``|r| > 2·scale``),
+    the measured-best design of the 2-bit family (see the class docstring
+    for the design sweep and the limit-cycle failure mode that rules out
+    mid-rise levels). Faster per frame on gaussian residuals; identical to
+    Sign1 (and exactly draining) on uniform ones.
+
+``TopK``
+    Sparse exact transfer: the k largest-|r| elements go over as
+    ``(index, f32 value)`` pairs and are subtracted exactly; the rest stay
+    in the residual. 8 bytes per sent element — wins when updates are
+    heavy-tailed (a few big coordinates carry most of the RMS), loses on
+    dense uniform noise. This is the signSGD-vs-sparsification trade the
+    literature studies, measurable here on real link trajectories.
+
+Implementations are numpy (host tier): the lab's job is apples-to-apples
+*policy* comparison on CPU-measurable trajectories (benchmarks/codec_lab.py
+-> CODEC_LAB_r{N}.json), not another production data plane. The production
+integration point for a winning method is ops/table.py's dispatch plus a
+wire frame tag (comm/wire.py) — deliberately not wired until a method earns
+it on the Pareto.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Protocol
+
+import numpy as np
+
+from .codec_np import _pow2_floor_np
+
+
+def _rms_scale(residual: np.ndarray) -> float:
+    """The reference scale rule on a numpy residual (overflow-safe, like
+    ops/codec.py compute_scale)."""
+    amax = float(np.max(np.abs(residual))) if residual.size else 0.0
+    if not (amax > 0.0) or not np.isfinite(amax):
+        return 0.0
+    norm = residual.astype(np.float64) / amax
+    rms = amax * float(np.sqrt(np.mean(norm * norm)))
+    s = float(_pow2_floor_np(np.float32(rms))[()])
+    return s if np.isfinite(s) and s > 0 else 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class LabFrame:
+    """One lab frame: an opaque payload plus its honest wire cost.
+
+    ``payload_bytes`` counts exactly what a wire message would carry
+    (scale/header + packed element data) so the Pareto's bytes axis is
+    method-comparable."""
+
+    kind: str
+    scale: float
+    data: np.ndarray  # kind-specific: packed codes or (idx, val) pairs
+    payload_bytes: int
+
+
+class LabCodec(Protocol):
+    name: str
+
+    def encode(self, residual: np.ndarray) -> tuple[LabFrame, np.ndarray]: ...
+
+    def decode(self, frame: LabFrame, n: int) -> np.ndarray: ...
+
+
+class Sign1:
+    """Production 1-bit codec in lab clothing (baseline)."""
+
+    name = "sign1"
+
+    def encode(self, residual: np.ndarray) -> tuple[LabFrame, np.ndarray]:
+        s = _rms_scale(residual)
+        if s == 0.0:
+            return LabFrame(self.name, 0.0, np.zeros(0, np.uint8), 4), residual
+        neg = residual <= 0  # bit set => -scale (reference sign rule, Q3)
+        sent = np.where(neg, -s, s).astype(np.float32)
+        new_r = (residual - sent).astype(np.float32)
+        bits = np.packbits(neg.astype(np.uint8), bitorder="little")
+        return LabFrame(self.name, s, bits, 4 + bits.nbytes), new_r
+
+    def decode(self, frame: LabFrame, n: int) -> np.ndarray:
+        if frame.scale == 0.0:
+            return np.zeros(n, np.float32)
+        neg = np.unpackbits(frame.data, count=n, bitorder="little")
+        return np.where(neg, -frame.scale, frame.scale).astype(np.float32)
+
+
+class Sign2:
+    """2-bit sign-magnitude: sign bit + magnitude bit selecting ``±s`` or
+    ``±3s`` (magnitude set when ``|r| > 2s``), at the reference's pow2-RMS
+    step so Pareto differences are attributable to the quantizer alone.
+
+    Chosen by measurement over the 2-bit design space ({a,b}·s level pairs
+    and deadzone variants, geometric-mean rms decay over 20 frames on a
+    gaussian residual, n=64 Ki): {±s, ±3s} decays 0.79/frame vs Sign1's
+    0.85 — the best of the family — while every mid-rise variant without
+    an exact ±s level (e.g. {±s/2, ±3s/2}) falls into scale-pinned limit
+    cycles and never drains. On a uniform residual |r| never exceeds 2s,
+    the magnitude bit sits idle, and the trajectory is bit-identical to
+    Sign1's — the exact-drain property is preserved by construction. Both
+    magnitudes are exact f32 multiples of the pow2 scale (3s has a 1.5
+    mantissa), keeping the 1-ulp conservation bound.
+
+    The lab's headline finding (CODEC_LAB artifact): the regimes split by
+    residual shape. On UNIFORM residuals Sign1 is byte-optimal (Sign2
+    degenerates to it at 2x the bytes). On GAUSSIAN ones the early decay
+    favors Sign1 per byte (0.85 per n/8 B compounds past 0.79), but the
+    tail flips it: outliers move only ±s per frame under Sign1 (decay
+    stalls toward 1.0 — the known slow gaussian tail), while ±3s moves
+    them 3x faster, so to a 1% target Sign2 wins per frame AND per byte
+    (measured 20 vs 72 frames, 1.31 vs 2.36 MB at n=256 Ki). On heavy
+    tails neither competes with TopK (Sign1 never reaches 1% in 400
+    frames; TopK does in one)."""
+
+    name = "sign2"
+
+    def encode(self, residual: np.ndarray) -> tuple[LabFrame, np.ndarray]:
+        s = _rms_scale(residual)
+        if s == 0.0:
+            return LabFrame(self.name, 0.0, np.zeros(0, np.uint8), 4), residual
+        neg = (residual <= 0).astype(np.uint8)
+        big = (np.abs(residual) > np.float32(2.0 * s)).astype(np.uint8)
+        mag = np.where(big, np.float32(3.0 * s), np.float32(s))
+        sent = np.where(neg, -mag, mag).astype(np.float32)
+        new_r = (residual - sent).astype(np.float32)
+        codes = neg | (big << 1)  # 2 bits/elem
+        packed = np.packbits(
+            np.stack([codes & 1, codes >> 1], axis=1).reshape(-1),
+            bitorder="little",
+        )
+        return LabFrame(self.name, s, packed, 4 + packed.nbytes), new_r
+
+    def decode(self, frame: LabFrame, n: int) -> np.ndarray:
+        if frame.scale == 0.0:
+            return np.zeros(n, np.float32)
+        flat = np.unpackbits(frame.data, count=2 * n, bitorder="little")
+        codes = flat.reshape(n, 2)
+        neg, big = codes[:, 0], codes[:, 1]
+        mag = np.where(
+            big, np.float32(3.0 * frame.scale), np.float32(frame.scale)
+        )
+        return np.where(neg, -mag, mag).astype(np.float32)
+
+
+class TopK:
+    """Sparse exact transfer of the k largest-|residual| coordinates."""
+
+    name = "topk"
+
+    def __init__(self, k: int):
+        if k <= 0:
+            raise ValueError(f"k must be positive, got {k}")
+        self.k = k
+        self.name = f"topk{k}"
+
+    def encode(self, residual: np.ndarray) -> tuple[LabFrame, np.ndarray]:
+        n = residual.shape[0]
+        k = min(self.k, n)
+        absr = np.abs(residual)
+        if not absr.any():
+            empty = np.zeros((0, 2), np.float32)
+            return LabFrame(self.name, 0.0, empty, 4), residual
+        idx = np.argpartition(absr, n - k)[n - k:]
+        idx = idx[absr[idx] > 0]  # never ship zero coordinates
+        vals = residual[idx].astype(np.float32)
+        new_r = residual.copy()
+        new_r[idx] = 0.0  # exact subtraction: r - r == 0
+        # indices ride as u32 bit patterns viewed f32: exact at any n (a
+        # float32 ASTYPE would corrupt indices past 2^24 — 16 Mi tables are
+        # in range, PARETO_r03)
+        pairs = np.stack(
+            [idx.astype(np.uint32).view(np.float32), vals], axis=1
+        )
+        # honest wire cost: 4 B count header + (u32 index + f32 value) per elem
+        return LabFrame(self.name, 1.0, pairs, 4 + 8 * len(idx)), new_r
+
+    def decode(self, frame: LabFrame, n: int) -> np.ndarray:
+        out = np.zeros(n, np.float32)
+        if frame.data.size:
+            idx = frame.data[:, 0].view(np.uint32).astype(np.int64)
+            out[idx] = frame.data[:, 1]  # indices are distinct by construction
+        return out
+
+
+def standard_lab(n: int) -> list:
+    """The comparison set the benchmark and tests share: baseline, the
+    2-bit variant, and top-k at 1/32 density (8 B x n/32 = n/4 bytes — the
+    same wire cost per frame as Sign2, making that pair directly
+    comparable)."""
+    return [Sign1(), Sign2(), TopK(max(1, n // 32))]

@@ -1,0 +1,159 @@
+"""The codec lab (ops/codec_lab.py — reference README.md:45's "try
+different compression methods" TODO): every method must keep the two
+invariants the framework's semantics rest on, and the documented
+convergence orderings must actually hold on real trajectories."""
+
+import numpy as np
+import pytest
+
+from shared_tensor_tpu.ops.codec_lab import Sign1, Sign2, TopK, standard_lab
+
+N = 4096
+
+
+def _codecs():
+    return standard_lab(N)
+
+
+@pytest.mark.parametrize("codec", _codecs(), ids=lambda c: c.name)
+def test_conservation(codec):
+    """residual_in == decode(frame) + residual_out to within 1 ulp of the
+    sent magnitude (the f32 subtraction rounds when exponents differ — the
+    production codec documents the same receiver-side ~1 ulp); TopK ships
+    exact f32 copies, so for it the identity is bit-for-bit."""
+    rng = np.random.default_rng(0)
+    r = rng.standard_normal(N).astype(np.float32)
+    frame, new_r = codec.encode(r)
+    delta = codec.decode(frame, N)
+    if codec.name.startswith("topk"):
+        np.testing.assert_array_equal(delta + new_r, r)
+    else:
+        # ulp(sent) <= ulp(1.5 * scale) <= 2^-22 * scale
+        bound = frame.scale * 2.0**-22
+        np.testing.assert_allclose(delta + new_r, r, rtol=0, atol=bound)
+
+
+@pytest.mark.parametrize("codec", _codecs(), ids=lambda c: c.name)
+def test_idle_on_zero_residual(codec):
+    r = np.zeros(N, np.float32)
+    frame, new_r = codec.encode(r)
+    np.testing.assert_array_equal(new_r, r)
+    np.testing.assert_array_equal(codec.decode(frame, N), np.zeros(N, np.float32))
+    assert frame.payload_bytes <= 4  # idle frames cost at most the header
+
+
+@pytest.mark.parametrize("codec", _codecs(), ids=lambda c: c.name)
+def test_payload_bytes_honest(codec):
+    """The Pareto's bytes axis must match what the data actually occupies."""
+    rng = np.random.default_rng(1)
+    r = rng.standard_normal(N).astype(np.float32)
+    frame, _ = codec.encode(r)
+    assert frame.payload_bytes == 4 + frame.data.nbytes
+
+
+def _frames_to_drain(codec, r, max_frames=200):
+    for i in range(max_frames):
+        if not r.any():
+            return i
+        frame, r = codec.encode(r)
+        if frame.payload_bytes <= 4 and r.any():
+            pytest.fail(f"{codec.name} idled on a nonzero residual")
+    return max_frames
+
+
+def test_sign1_exact_convergence_unchanged():
+    """The lab baseline reproduces the production codec's signature
+    behavior: a uniform residual drains to exactly zero in ~27 frames
+    (SURVEY.md App. B; pinned on the production tier in test_codec.py)."""
+    rng = np.random.default_rng(2)
+    r = rng.uniform(-1.0, 1.0, N).astype(np.float32)
+    frames = _frames_to_drain(Sign1(), r)
+    assert 20 <= frames <= 35, frames
+
+
+def test_sign2_uniform_trajectory_identical_to_sign1():
+    """On a uniform residual |r| never exceeds 2s, so Sign2's magnitude
+    bit is idle and its trajectory must be BIT-identical to Sign1's —
+    which is how Sign2 inherits the exact-drain property."""
+    rng = np.random.default_rng(3)
+    r1 = rng.uniform(-1.0, 1.0, N).astype(np.float32)
+    r2 = r1.copy()
+    s1, s2 = Sign1(), Sign2()
+    for _ in range(30):
+        if not r1.any():
+            break
+        f1, r1 = s1.encode(r1)
+        f2, r2 = s2.encode(r2)
+        assert f1.scale == f2.scale
+        np.testing.assert_array_equal(r1, r2)
+    assert not r1.any() and not r2.any()
+
+
+def _rms(r):
+    return float(np.sqrt(np.mean(r.astype(np.float64) ** 2)))
+
+
+def test_sign2_faster_per_frame_on_gaussian():
+    """The 2-bit quantizer's point: on dense gaussian residuals (where the
+    magnitude bit fires in the tails) it decays faster per frame than
+    Sign1 — it pays 2x the bytes for latency. Design-sweep measurement:
+    ~0.79 vs ~0.85 geometric-mean decay over 20 frames."""
+    rng = np.random.default_rng(7)
+    r0 = rng.standard_normal(1 << 14).astype(np.float32)
+
+    def decay(codec, frames=20):
+        r = r0.copy()
+        for _ in range(frames):
+            _, r = codec.encode(r)
+        return (_rms(r) / _rms(r0)) ** (1.0 / frames)
+
+    d1, d2 = decay(Sign1()), decay(Sign2())
+    assert d2 < d1 - 0.02, (d2, d1)
+
+
+def test_topk_full_k_converges_in_one_frame():
+    """k == n ships the whole residual exactly."""
+    rng = np.random.default_rng(4)
+    r = rng.standard_normal(N).astype(np.float32)
+    frame, new_r = TopK(N).encode(r)
+    assert not new_r.any()
+    np.testing.assert_array_equal(TopK(N).decode(frame, N), r)
+
+
+def test_topk_wins_on_heavy_tailed_residuals_per_byte():
+    """The trade the lab exists to measure: on a heavy-tailed residual
+    (few coordinates carry most of the RMS), sparse exact transfer beats
+    dense 1-bit per byte sent; Sign1 keeps dense-noise workloads."""
+
+    def rms_after_budget(codec, r, byte_budget):
+        spent = 0
+        while spent < byte_budget:
+            frame, r = codec.encode(r)
+            spent += frame.payload_bytes
+            if frame.payload_bytes <= 4:
+                break
+        return float(np.sqrt(np.mean(r.astype(np.float64) ** 2)))
+
+    rng = np.random.default_rng(5)
+    heavy = (rng.standard_t(1.2, N) * 1e-3).astype(np.float32)
+    heavy[rng.integers(0, N, 8)] += rng.choice([-100.0, 100.0], 8).astype(
+        np.float32
+    )
+    budget = 3 * (4 + N // 8)  # three sign1 frames' worth of bytes
+    r_sign = rms_after_budget(Sign1(), heavy.copy(), budget)
+    r_topk = rms_after_budget(TopK(N // 32), heavy.copy(), budget)
+    assert r_topk < r_sign, (r_topk, r_sign)
+
+
+def test_topk_indices_exact_past_2_24():
+    """Index transport must be exact for any table size this framework
+    ships (PARETO_r03 goes to 64 Mi): a float32 round-trip would corrupt
+    indices past 2^24 — the lab views u32 bit patterns instead."""
+    n = (1 << 24) + 64
+    r = np.zeros(n, np.float32)
+    r[-1] = 5.0  # index 2^24 + 63: not representable in f32
+    frame, new_r = TopK(1).encode(r)
+    assert not new_r.any()
+    delta = TopK(1).decode(frame, n)
+    assert delta[-1] == 5.0
+    assert np.count_nonzero(delta) == 1
