@@ -232,7 +232,16 @@ void apply_batch(Engine* e, int32_t src_link, int32_t k, const float* scales,
   // death-to-detach window would otherwise vanish from the carry AND be
   // claimed by the re-join snapshot, losing it tree-wide
   // (core.SharedTensor applies to all links until drop_link, same reason).
+  // Corruption-zeroed (all-zero-scale) frames apply as no-ops and must
+  // count NOWHERE: the metrics taxonomy promises a quiesced pair satisfies
+  // sender.frames_out == receiver.frames_in (idle frames count on neither
+  // side), and a sender never emits all-zero frames — counting a zeroed
+  // frame here would read as a phantom discrepancy exactly when an
+  // operator is debugging a corrupt link.
+  uint64_t applied = 0;
   if (k == 1) {
+    if (!any_nonzero(scales, e->L)) return;
+    applied = 1;
     // fused single-frame path: one clamped pass per target, no delta buffer
     stc_apply_frame(e->values.data(), e->values.data(), e->off.data(),
                     e->ns.data(), e->padded.data(), e->L, scales, words);
@@ -248,6 +257,7 @@ void apply_batch(Engine* e, int32_t src_link, int32_t k, const float* scales,
     for (int32_t f = 0; f < k; f++) {
       const float* row = scales + (size_t)f * e->L;
       if (!any_nonzero(row, e->L)) continue;
+      applied++;
       stc_accumulate_delta(delta.data(), e->off.data(), e->ns.data(),
                            e->padded.data(), e->L, row,
                            words + (size_t)f * e->W);
@@ -266,7 +276,7 @@ void apply_batch(Engine* e, int32_t src_link, int32_t k, const float* scales,
     stc_apply_frame(e->carry.data(), e->carry.data(), e->off.data(),
                     e->ns.data(), e->padded.data(), e->L, scales, words);
   }
-  e->frames_in += (uint64_t)k;
+  e->frames_in += applied;
 }
 
 // ---- sender ---------------------------------------------------------------
@@ -670,14 +680,20 @@ __attribute__((visibility("default"))) int32_t st_engine_stash_carry(
 // Atomically read the replica snapshot AND consume the carry (one lock —
 // an add() between the two reads would land in the snapshot but not the
 // carry, re-creating the orphan-add loss this slot exists to fix).
-// Returns 1 when a carry was written to carry_out, 0 otherwise.
+// Either out pointer may be NULL to skip that copy: the BECAME_MASTER
+// failover only needs the consume side effect (the carry's mass is already
+// in the now-authoritative replica) and must not pay two full-table copies
+// for it. Returns 1 when the carry existed (and, if carry_out is non-NULL,
+// was written), 0 otherwise.
 __attribute__((visibility("default"))) int32_t st_engine_take_carry_and_snapshot(
     void* h, float* carry_out, float* values_out) {
   auto* e = (Engine*)h;
   std::lock_guard<std::mutex> lk(e->mu);
-  std::memcpy(values_out, e->values.data(), (size_t)e->total * 4);
+  if (values_out)
+    std::memcpy(values_out, e->values.data(), (size_t)e->total * 4);
   if (!e->has_carry) return 0;
-  std::memcpy(carry_out, e->carry.data(), (size_t)e->total * 4);
+  if (carry_out)
+    std::memcpy(carry_out, e->carry.data(), (size_t)e->total * 4);
   e->has_carry = false;
   e->carry.clear();
   e->carry.shrink_to_fit();

@@ -64,7 +64,8 @@ def load_engine() -> Optional[ctypes.CDLL]:
         lib.st_engine_stash_carry.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.st_engine_take_carry_and_snapshot.restype = ctypes.c_int32
         lib.st_engine_take_carry_and_snapshot.argtypes = [
-            ctypes.c_void_p, _f32p, _f32p,
+            # both out pointers nullable (drop_carry) -> void_p
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
         lib.st_engine_stop.restype = None
         lib.st_engine_stop.argtypes = [ctypes.c_void_p]
@@ -280,9 +281,18 @@ class EngineTensor:
         carry = np.empty(self.spec.total, np.float32)
         values = np.empty(self.spec.total, np.float32)
         has = self._lib.st_engine_take_carry_and_snapshot(
-            self._h, carry, values
+            self._h,
+            carry.ctypes.data_as(ctypes.c_void_p),
+            values.ctypes.data_as(ctypes.c_void_p),
         )
         return (carry if has else None), values
+
+    def drop_carry(self) -> None:
+        """Consume the carry WITHOUT snapshotting — the BECAME_MASTER
+        failover path: its mass is already in the (now-authoritative)
+        replica, and paying two full-table copies just to discard them is
+        ~128 MB of transient traffic at a 16 Mi table."""
+        self._lib.st_engine_take_carry_and_snapshot(self._h, None, None)
 
     def drop_link(self, link_id: int) -> Optional[np.ndarray]:
         out = np.empty(self.spec.total, np.float32)

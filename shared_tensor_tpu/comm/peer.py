@@ -725,17 +725,27 @@ class SharedTensorPeer:
                         # reference protocol has no handshake: start
                         # streaming at once — into the carried residual
                         # when re-grafting (our undelivered mass), else
-                        # zero. A re-grafting leaf zeroes its replica NOW
-                        # (fresh-joiner semantics; the parent's re-seed
-                        # refills tree state, the carry re-delivers ours —
-                        # see the LINK_DOWN comment).
+                        # zero. A re-grafting leaf resets its replica NOW
+                        # to EXACTLY the carry (fresh-joiner semantics: a
+                        # true fresh joiner with pending adds holds them in
+                        # values AND residual; the parent's re-seed then
+                        # refills tree state additively on top). Resetting
+                        # to zero instead would desync this node by the
+                        # carry forever: the carry floods to every OTHER
+                        # peer, and split horizon never returns it here —
+                        # see the LINK_DOWN comment.
                         if self._compat_reset_on_regraft:
                             self._compat_reset_on_regraft = False
-                            self.st.reset_values()
-                        carry, _ = self.st.take_link_and_snapshot(CARRY_LINK)
-                        self.st.new_link(
-                            ev.link_id, seed=False, residual=carry
-                        )
+                            self.st.regraft_reset_to_carry(
+                                CARRY_LINK, ev.link_id
+                            )
+                        else:
+                            carry, _ = self.st.take_link_and_snapshot(
+                                CARRY_LINK
+                            )
+                            self.st.new_link(
+                                ev.link_id, seed=False, residual=carry
+                            )
                     else:
                         self._start_join(ev.link_id)
                 else:
@@ -811,7 +821,7 @@ class SharedTensorPeer:
                 # upward, and a live-but-unconsumable carry would cost an
                 # extra O(total) pass on every add/apply forever.
                 if self._engine is not None:
-                    self._engine.take_carry_and_snapshot()
+                    self._engine.drop_carry()
                 else:
                     self.st.take_link_and_snapshot(CARRY_LINK)
                 self._mid_handshake_base = None

@@ -73,6 +73,14 @@ def _accelerator_plausible() -> bool:
         if spec is not None and spec.submodule_search_locations:
             if any(pkgutil.iter_modules(list(spec.submodule_search_locations))):
                 return True
+        # PJRT plugins may register ONLY via the entry-point group (no
+        # jax_plugins namespace package, no matching /dev node — e.g.
+        # jax-metal): missing them would silently demote an accelerator
+        # host to the numpy tier.
+        import importlib.metadata as _md
+
+        if any(True for _ in _md.entry_points(group="jax_plugins")):
+            return True
     except Exception:
         return True  # can't tell: be conservative, ask the real backend
     return False
@@ -365,6 +373,35 @@ class SharedTensor:
         (see peer._handle_events)."""
         with self._lock:
             self.values = self._zeros()
+
+    def regraft_reset_to_carry(self, carry_id: int, new_link_id: int) -> None:
+        """The wire-compat leaf re-graft, as ONE atomic step: consume the
+        carry pseudo-slot, set the replica to EXACTLY the carry, and open
+        the new uplink with the carry as its residual.
+
+        Fresh-joiner semantics under the reference protocol mean the parent
+        re-seeds us with its full replica additively — so our replica must
+        start at precisely the mass the tree does NOT yet know (the carry),
+        the way a true fresh joiner with pending adds holds them in values
+        AND residual (add(): both sides). Resetting to zero instead loses
+        the carry from this node forever: it streams up and floods to every
+        OTHER peer (split horizon never returns it), ending with the tree
+        at state+carry and this node at state. Atomicity for the same
+        reason as stash_carry: a concurrent add() must land either in
+        (carry -> values+residual) or in (values+new residual), never
+        partially."""
+        with self._lock:
+            if new_link_id in self._links:
+                raise ValueError(f"link {new_link_id} already exists")
+            carry = self._links.pop(carry_id, None)
+            if carry is None:
+                self.values = self._zeros()
+                self._links[new_link_id] = self._zeros()
+            else:
+                # arrays are functional (replaced, never mutated) on both
+                # tiers, so values and the residual may share storage
+                self.values = carry
+                self._links[new_link_id] = carry
 
     def snapshot_flat(self) -> jnp.ndarray:
         """Atomic snapshot of the padded flat replica (handshake / checkpoint

@@ -163,6 +163,21 @@ def test_counter_taxonomy_reconciles_across_layers():
     # transport wire messages include control traffic on top of data
     wire_out = sum(l["wire_msgs_out"] for l in ma["links"].values())
     assert wire_out >= ma["delivery"]["msgs_out"]
+    # corruption-zeroed (all-zero-scale) frames count NOWHERE: a sender
+    # never emits one (idle suppression), so counting it at the receiver
+    # would present reconciliation drift exactly while an operator debugs
+    # a corrupt link (the trust boundary zeroes non-finite scales)
+    import types
+
+    zeroed = types.SimpleNamespace(
+        scales=np.zeros(1, np.float32),
+        words=np.arange(2048 // 32, dtype=np.uint32),
+    )
+    fin = b.st.frames_in
+    vals = np.asarray(b.read()["w"]).copy()
+    b.st.receive_frames(b.node.links[0], [zeroed])
+    assert b.st.frames_in == fin, "zeroed frame must not count as applied"
+    np.testing.assert_array_equal(np.asarray(b.read()["w"]), vals)
     a.close()
     b.close()
 

@@ -211,6 +211,68 @@ def test_regraft_after_parent_death():
             p.close()
 
 
+def test_compat_leaf_regraft_keeps_orphan_adds():
+    """Wire-compat re-graft of a LEAF whose parent died: the reference
+    protocol has no diff handshake, so the leaf resets to fresh-joiner
+    state — which must mean replica == carry (a true fresh joiner with
+    pending adds holds them in values AND residual), NOT replica == 0.
+    A zero reset desyncs the leaf by exactly the carry forever: the carry
+    floods to every OTHER peer and split horizon never returns it
+    (core.SharedTensor.regraft_reset_to_carry).
+
+    Topology: master M + children A, B; C redirected under one of them.
+    Kill C's parent, wait until C is orphaned, then add at C — the add is
+    guaranteed undelivered (it lands in the live carry slot) — and assert
+    every survivor INCLUDING C converges to the full sum."""
+    port = _free_port()
+    seed = jnp.ones((256,), jnp.float32)
+    cfg = Config(
+        transport=TransportConfig(
+            peer_timeout_sec=5.0, max_rejoin_attempts=8, wire_compat=True
+        )
+    )
+    m = create_or_fetch("127.0.0.1", port, seed, cfg)
+    peers = {"m": m}
+    try:
+        for name in ("a", "b", "c"):
+            peers[name] = create_or_fetch(
+                "127.0.0.1", port, jnp.zeros_like(seed), cfg
+            )
+        for p in peers.values():
+            p.add(jnp.full((256,), 0.5, jnp.float32))
+        settled = jnp.full((256,), 1.0 + 4 * 0.5, jnp.float32)
+        _wait_converged(list(peers.values()), settled)
+        # the interior child (2 links: uplink + its own child)
+        parent_name = next(
+            n for n, p in peers.items()
+            if not p.is_master and len(p.node.links) > 1
+        )
+        candidates = [p for n, p in peers.items() if n != "m"]
+        before = {id(p): p._uplink for p in candidates}
+        peers.pop(parent_name).close()
+        # the orphan is whichever non-master survivor loses its uplink; if
+        # it re-grafts between polls (new link id) the add below just rides
+        # the new uplink — also covered by the contract, only less pointed
+        orphan = None
+        deadline = time.time() + 60
+        while orphan is None and time.time() < deadline:
+            orphan = next(
+                (p for p in candidates if p._uplink != before[id(p)]), None
+            )
+            time.sleep(0.05)
+        assert orphan is not None, "orphan never detected parent death"
+        # now guaranteed-undelivered: this add exists only in C's replica
+        # and its live carry slot
+        orphan.add(jnp.full((256,), 0.25, jnp.float32))
+        survivors = list(peers.values())
+        expect = jnp.full((256,), 1.0 + 4 * 0.5 + 0.25, jnp.float32)
+        # generous: re-graft needs the 5 s timeout + rejoin walk under load
+        _wait_converged(survivors, expect, tol=1e-4, timeout=120.0)
+    finally:
+        for p in peers.values():
+            p.close()
+
+
 def test_graceful_leave_loses_nothing():
     """drain() + close() = the zero-loss arm of the delivery contract: after
     a successful drain, EVERY update the leaving node ever merged — its own
