@@ -43,12 +43,15 @@ Methods:
     dense uniform noise. This is the signSGD-vs-sparsification trade the
     literature studies, measurable here on real link trajectories.
 
-Implementations are numpy (host tier): the lab's job is apples-to-apples
-*policy* comparison on CPU-measurable trajectories (benchmarks/codec_lab.py
--> CODEC_LAB_r{N}.json), not another production data plane. The production
-integration point for a winning method is ops/table.py's dispatch plus a
-wire frame tag (comm/wire.py) — deliberately not wired until a method earns
-it on the Pareto.
+Implementations here are numpy (host tier): the lab's job is
+apples-to-apples *policy* comparison on CPU-measurable trajectories
+(benchmarks/codec_lab.py -> CODEC_LAB_r{N}.json), not another production
+data plane. The two winners also have jitted device-tier implementations
+(ops/codec_lab_jax.py), bit-parity-pinned against this module, proving
+they drop into the TPU compute path. The production integration point for
+a winning method is ops/table.py's dispatch plus a wire frame tag
+(comm/wire.py) — deliberately not wired until a method earns it on the
+Pareto.
 """
 
 from __future__ import annotations

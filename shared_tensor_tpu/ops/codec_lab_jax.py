@@ -1,0 +1,118 @@
+"""Device-tier (jitted JAX) implementations of the codec-lab methods.
+
+ops/codec_lab.py measures the alternative compression methods on host
+trajectories; this module shows the two winners are implementable in the
+TPU compute path with the same layout discipline as the production codec
+(ops/codec.py: flat f32 padded to the (8,128) tile, pad lanes pinned to
+zero, LSB-first bit packing from ops/packing.py — so the packed codes
+serialize to the identical bytes the numpy lab produces):
+
+``sign2_quantize`` / ``sign2_apply``
+    The 2-bit sign-magnitude quantizer ({±s, ±3s}, magnitude bit at
+    |r| > 2s — the measured-best 2-bit design, see codec_lab.Sign2).
+    Codes interleave as flat bits [sign_0, mag_0, sign_1, mag_1, ...]
+    packed into uint32 words, exactly the numpy lab's
+    ``packbits(..., bitorder="little")`` layout.
+
+``topk_quantize`` / ``topk_apply``
+    Sparse exact transfer via ``lax.top_k`` on |r|. Static k (XLA needs
+    static shapes); coordinates whose residual is exactly zero still
+    occupy slots but carry value 0 — a no-op on both ends, so
+    conservation is unaffected (the host lab instead drops them from the
+    payload; on device the fixed-size slot IS the honest wire cost).
+
+Everything is jittable with static ``n``/``k``/``policy`` and runs under
+the standard test mesh (CPU) today; on TPU these compile to the same
+fused elementwise + reduce shapes the production codec uses. Parity with
+the numpy lab is pinned bit-for-bit in tests/test_codec_lab_jax.py —
+with the same caveat every cross-tier scale comparison in this codebase
+carries (stengine.cpp header, ops/codec_np.py): the RMS accumulations
+differ in summation order/precision across tiers, and the pow2 floor
+absorbs those ulps EXCEPT when the true RMS sits exactly at an octave
+boundary, where the tiers may legally pick adjacent octaves. Scales ride
+the wire (receivers never recompute them), so this affects only
+same-trajectory comparisons, never correctness.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ScalePolicy
+from .codec import SAT, compute_scale
+from .packing import pack_bits, unpack_bits
+
+
+@partial(jax.jit, static_argnames=("n", "policy"))
+def sign2_quantize(
+    residual: jnp.ndarray,
+    n: int,
+    policy: ScalePolicy = ScalePolicy.POW2_RMS,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One 2-bit sender step: residual -> (scale, packed codes, new_residual).
+
+    Sign rule matches the production codec (r <= 0 => sign bit set, quirk
+    Q3's zero-negative convention); magnitude bit set where |r| > 2s sends
+    ±3s instead of ±s. With scale == 0 the residual is untouched (idle
+    frame). Pad lanes: both bits forced 0, residual stays 0."""
+    n_pad = residual.shape[0]
+    scale = compute_scale(residual, n, policy)
+    live = jnp.arange(n_pad, dtype=jnp.int32) < n
+    neg = residual <= 0
+    big = jnp.abs(residual) > 2.0 * scale
+    mag = jnp.where(big, 3.0 * scale, scale)
+    sent = jnp.where(neg, -mag, mag)
+    new_residual = jnp.where(live, residual - sent, 0.0)
+    new_residual = jnp.where(scale > 0, new_residual, residual)
+    codes = jnp.stack(
+        [jnp.where(live, neg, False), jnp.where(live, big, False)], axis=-1
+    ).reshape(2 * n_pad)
+    return scale, pack_bits(codes), new_residual
+
+
+@partial(jax.jit, static_argnames=("n",))
+def sign2_apply(
+    values: jnp.ndarray, scale: jnp.ndarray, words: jnp.ndarray, n: int
+) -> jnp.ndarray:
+    """One 2-bit receiver step, clamped to ±SAT like every apply tier."""
+    n_pad = values.shape[0]
+    codes = unpack_bits(words).reshape(n_pad, 2)
+    neg = codes[:, 0].astype(jnp.float32)
+    big = codes[:, 1].astype(jnp.float32)
+    mag = scale * (1.0 + 2.0 * big)
+    delta = (1.0 - 2.0 * neg) * mag
+    live = jnp.arange(n_pad, dtype=jnp.int32) < n
+    # scale == 0 (idle/corrupt-zeroed) decodes to a no-op even though the
+    # sign bits would otherwise read as ±scale
+    delta = jnp.where(live & (scale > 0), delta, 0.0)
+    return jnp.where(live, jnp.clip(values + delta, -SAT, SAT), 0.0)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def topk_quantize(
+    residual: jnp.ndarray, k: int
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sparse sender step: residual -> (indices u32[k], values f32[k],
+    new_residual). The k largest-|r| coordinates ship exactly and zero out
+    of the residual (exact conservation — f32 copies, no rounding)."""
+    absr = jnp.abs(residual)
+    _, idx = jax.lax.top_k(absr, k)
+    vals = residual[idx]
+    new_residual = residual.at[idx].set(0.0)
+    return idx.astype(jnp.uint32), vals, new_residual
+
+
+@partial(jax.jit, static_argnames=("n",))
+def topk_apply(
+    values: jnp.ndarray, idx: jnp.ndarray, vals: jnp.ndarray, n: int
+) -> jnp.ndarray:
+    """Sparse receiver step: values[idx] += vals, clamped to ±SAT. Indices
+    are distinct by construction (top_k), so add == set semantics on the
+    delta; zero-valued slots are no-ops."""
+    n_pad = values.shape[0]
+    out = values.at[idx.astype(jnp.int32)].add(vals)
+    live = jnp.arange(n_pad, dtype=jnp.int32) < n
+    return jnp.where(live, jnp.clip(out, -SAT, SAT), 0.0)

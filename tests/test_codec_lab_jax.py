@@ -1,0 +1,90 @@
+"""Device-tier codec-lab parity: the jitted JAX implementations
+(ops/codec_lab_jax.py) must match the numpy lab (ops/codec_lab.py)
+bit-for-bit on these seed-pinned trajectories — same scales, same packed
+bytes, same decoded deltas — and keep the production codec's padding/idle
+invariants. (Cross-tier scale parity carries the repo-wide octave-boundary
+caveat documented in codec_lab_jax's module docstring.)"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shared_tensor_tpu.ops import codec_lab_jax as lj
+from shared_tensor_tpu.ops.codec import pad_flat
+from shared_tensor_tpu.ops.codec_lab import Sign2, TopK
+from shared_tensor_tpu.ops.packing import padded_len, wire_to_words, words_to_wire
+
+N = 4096  # == padded_len(N): no pad lanes, so numpy-lab arrays align 1:1
+
+
+def _r(seed, n=N):
+    return np.random.default_rng(seed).standard_normal(n).astype(np.float32)
+
+
+def test_sign2_parity_with_numpy_lab():
+    r = _r(0)
+    frame, new_np = Sign2().encode(r.copy())
+    scale, words, new_jx = lj.sign2_quantize(jnp.asarray(r), N)
+    assert float(scale) == frame.scale
+    # identical wire bytes: LSB-first interleaved [sign, mag] bits, via the
+    # real serialization helper (2*N "bit elements" on the wire)
+    assert words_to_wire(np.asarray(words), 2 * N) == frame.data.tobytes()
+    np.testing.assert_array_equal(np.asarray(new_jx), new_np)
+
+
+def test_sign2_apply_parity_with_numpy_lab():
+    r = _r(1)
+    frame, _ = Sign2().encode(r.copy())
+    delta_np = Sign2().decode(frame, N)
+    vals = _r(2)
+    out = lj.sign2_apply(
+        jnp.asarray(vals),
+        jnp.float32(frame.scale),
+        jnp.asarray(wire_to_words(frame.data.tobytes(), 2 * N)),
+        N,
+    )
+    np.testing.assert_array_equal(np.asarray(out), vals + delta_np)
+
+
+def test_sign2_padding_and_idle_invariants():
+    n = 1000
+    n_pad = padded_len(n)
+    r = pad_flat(jnp.asarray(_r(3, n)), n_pad)
+    scale, words, new_r = lj.sign2_quantize(r, n)
+    assert float(scale) > 0
+    # pad lanes: residual stays exactly 0, both bits 0
+    np.testing.assert_array_equal(np.asarray(new_r)[n:], 0.0)
+    from shared_tensor_tpu.ops.packing import unpack_bits
+
+    bits = np.asarray(unpack_bits(words)).reshape(n_pad, 2)
+    np.testing.assert_array_equal(bits[n:], 0)
+    # idle: zero residual -> untouched, apply with scale 0 is a no-op
+    z = jnp.zeros(n_pad, jnp.float32)
+    s0, w0, nr0 = lj.sign2_quantize(z, n)
+    assert float(s0) == 0.0
+    np.testing.assert_array_equal(np.asarray(nr0), 0.0)
+    vals = pad_flat(jnp.asarray(_r(4, n)), n_pad)
+    out = lj.sign2_apply(vals, s0, w0, n)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(vals))
+
+
+def test_topk_parity_with_numpy_lab():
+    k = N // 32
+    r = _r(5)
+    frame, new_np = TopK(k).encode(r.copy())
+    idx, vals, new_jx = lj.topk_quantize(jnp.asarray(r), k)
+    np.testing.assert_array_equal(np.asarray(new_jx), new_np)
+    # same coordinate set (order may differ between top_k and argpartition)
+    np_idx = frame.data[:, 0].view(np.uint32)
+    assert set(np.asarray(idx).tolist()) == set(np_idx.tolist())
+    # exact conservation on device too
+    out = lj.topk_apply(jnp.asarray(new_jx), idx, vals, N)
+    np.testing.assert_array_equal(np.asarray(out), r)
+
+
+def test_topk_zero_residual_noop():
+    z = jnp.zeros(N, jnp.float32)
+    idx, vals, new_r = lj.topk_quantize(z, 8)
+    np.testing.assert_array_equal(np.asarray(vals), 0.0)
+    np.testing.assert_array_equal(np.asarray(new_r), 0.0)
+    out = lj.topk_apply(jnp.asarray(_r(6)), idx, vals, N)
+    np.testing.assert_array_equal(np.asarray(out), _r(6))
