@@ -116,6 +116,12 @@ struct Engine {
   bool per_leaf = true;
   int burst = 1;         // frames per BURST message (1 => DATA framing)
   int32_t recv_cap = 0;  // recv buffer size (max wire message)
+  // Wire-compat mode (reference raw protocol, comm/wire.py
+  // encode_compat_frame): every wire message is exactly compat_bytes =
+  // [f32 scale LE][ceil(n/8) bitmask bytes] — no kind byte, no bursts, no
+  // ACKs (so no ledger: the reference protocol cannot acknowledge).
+  // 0 = native framing.
+  int32_t compat_bytes = 0;
 
   std::vector<float> values;
   std::map<int32_t, ELink> links;
@@ -348,12 +354,23 @@ void sender_loop(Engine* e) {
         if (msg.nframes == 0) continue;
         e->frames_out += (uint64_t)msg.nframes;
         // ledger entry BEFORE the send: the receiver's ACK must never race
-        // ahead of the entry it acknowledges (comm/peer.py _send_loop)
-        it->second.unacked.push_back(msg);
+        // ahead of the entry it acknowledges (comm/peer.py _send_loop).
+        // Compat: no ACKs exist, so no ledger — delivery degrades to
+        // ack-on-send like the Python compat tier (peer.py _send_loop
+        // docstring); a failed send rolls back THIS message inline below.
+        if (!e->compat_bytes) it->second.unacked.push_back(msg);
       }
       // encode + send outside the lock
       size_t per = frame_bytes(e);
-      if (e->burst > 1) {
+      if (e->compat_bytes) {
+        // reference raw frame: [f32 scale][ceil(n/8) mask bytes]; L == 1
+        // (the peer rejects multi-leaf tables in compat mode) and
+        // ceil(n/8) <= W*4, so the words buffer always covers the mask
+        payload.resize((size_t)e->compat_bytes);
+        std::memcpy(payload.data(), msg.scales.data(), 4);
+        std::memcpy(payload.data() + 4, msg.words.data(),
+                    (size_t)e->compat_bytes - 4);
+      } else if (e->burst > 1) {
         payload.resize(2 + (size_t)msg.nframes * per);
         payload[0] = kBurst;
         payload[1] = (uint8_t)msg.nframes;
@@ -388,11 +405,22 @@ void sender_loop(Engine* e) {
         sent_any = true;
       } else {
         // undelivered: roll ALL outstanding feedback back so a re-graft
-        // owes the full residual (peer.py nack path on send failure)
+        // owes the full residual (peer.py nack path on send failure).
+        // Compat has no ledger — roll back this message's own frames
+        // directly (stronger than the reference, which loses them).
         std::lock_guard<std::mutex> lk(e->mu);
         auto it = e->links.find(id);
         if (it != e->links.end()) {
-          rollback_unacked(e, it->second);
+          if (e->compat_bytes) {
+            for (int32_t f = 0; f < msg.nframes; f++)
+              stc_apply_frame(it->second.resid.data(),
+                              it->second.resid.data(), e->off.data(),
+                              e->ns.data(), e->padded.data(), e->L,
+                              msg.scales.data() + (size_t)f * e->L,
+                              msg.words.data() + (size_t)f * e->W);
+          } else {
+            rollback_unacked(e, it->second);
+          }
           it->second.dead = true;
         }
       }
@@ -412,6 +440,7 @@ void sender_loop(Engine* e) {
 void flush_acks(Engine* e, int32_t id, ELink& lk) {
   // cumulative + retried (a backpressure-dropped ACK must be re-offered or
   // the sender's ledger never drains — comm/peer.py _flush_acks)
+  if (e->compat_bytes) return;  // the reference protocol has no ACKs
   if (lk.rx_count <= lk.ack_sent || lk.dead) return;
   uint8_t ack[9];
   ack[0] = kAck;
@@ -469,6 +498,28 @@ void receiver_loop(Engine* e) {
           break;
         }
         busy = true;
+        if (e->compat_bytes) {
+          // raw reference frame: [f32 scale][mask bytes], fixed size (the
+          // transport's compat framing delivers whole frames only).
+          // scale == 0 is the reference's idle keepalive (quirk Q2) and
+          // non-finite is corruption (quirk Q9) — both are no-ops that
+          // count nowhere, keeping msgs == frames (the compat exception in
+          // peer.metrics()'s taxonomy).
+          if ((size_t)n != (size_t)e->compat_bytes || e->sealed.load())
+            continue;
+          float sc;
+          std::memcpy(&sc, buf.data(), 4);
+          if (sc == 0.0f || !std::isfinite(sc)) continue;
+          msgs++;
+          size_t bs = bscales.size(), bw = bwords.size();
+          bscales.resize(bs + (size_t)e->L);  // L == 1 in compat
+          bwords.resize(bw + (size_t)e->W, 0u);
+          bscales[bs] = sc;
+          std::memcpy(bwords.data() + bw, buf.data() + 4,
+                      (size_t)e->compat_bytes - 4);
+          batchk++;
+          continue;
+        }
         uint8_t kind = buf[0];
         if (kind == kData || kind == kBurst) {
           if (e->sealed.load()) continue;  // leaving: sender re-delivers
@@ -548,7 +599,11 @@ __attribute__((visibility("default"))) void* st_engine_create(
     void* node, const int64_t* off, const int64_t* ns, const int64_t* padded,
     int64_t n_leaves, int64_t total, int64_t total_n,
     const float* init_values /* or NULL */, int32_t policy, int32_t per_leaf,
-    int32_t burst, int32_t recv_cap) {
+    int32_t burst, int32_t recv_cap, int32_t compat_frame_bytes) {
+  if (compat_frame_bytes > 0 &&
+      (n_leaves != 1 || compat_frame_bytes < 5 ||
+       (int64_t)(compat_frame_bytes - 4) > total / 8))
+    return nullptr;  // compat: one flat tensor, mask must fit the words
   auto* e = new Engine();
   e->node = node;
   e->L = n_leaves;
@@ -561,6 +616,8 @@ __attribute__((visibility("default"))) void* st_engine_create(
   e->policy = policy;
   e->per_leaf = per_leaf != 0;
   e->burst = burst < 1 ? 1 : (burst > 255 ? 255 : burst);
+  e->compat_bytes = compat_frame_bytes > 0 ? compat_frame_bytes : 0;
+  if (e->compat_bytes) e->burst = 1;  // the reference protocol has no bursts
   e->recv_cap = recv_cap;
   e->values.assign((size_t)total, 0.0f);
   if (init_values)
@@ -651,6 +708,37 @@ __attribute__((visibility("default"))) int32_t st_engine_attach(
     lk2.rx_count = rx_init;
     lk2.ack_sent = rx_init;
     lk2.dirty = true;
+  }
+  e->wake();
+  return 1;
+}
+
+// The wire-compat LEAF re-graft as ONE atomic step (the C analog of
+// core.SharedTensor.regraft_reset_to_carry, same rationale): consume the
+// carry, set the replica to EXACTLY the carry (fresh-joiner semantics — a
+// true fresh joiner with pending adds holds them in values AND residual;
+// the parent's full-replica re-seed then refills tree state additively),
+// and open the new uplink with the carry as its residual. Resetting to
+// zero instead would desync this node by the carry forever (split horizon
+// never returns it). Returns 0 if the link already exists.
+__attribute__((visibility("default"))) int32_t st_engine_compat_regraft(
+    void* h, int32_t link_id) {
+  auto* e = (Engine*)h;
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    if (e->links.count(link_id)) return 0;
+    ELink& l = e->links[link_id];
+    if (e->has_carry) {
+      l.resid = e->carry;             // copy: the residual the tree is owed
+      e->values = std::move(e->carry);  // replica = exactly the carry
+      e->has_carry = false;
+      e->carry.clear();
+      e->carry.shrink_to_fit();
+    } else {
+      std::fill(e->values.begin(), e->values.end(), 0.0f);
+      l.resid.assign((size_t)e->total, 0.0f);
+    }
+    l.dirty = true;
   }
   e->wake();
   return 1;

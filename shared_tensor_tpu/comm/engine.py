@@ -55,6 +55,11 @@ def load_engine() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_void_p,  # init values (nullable -> void_p)
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32,  # compat_frame_bytes (0 = native framing)
+        ]
+        lib.st_engine_compat_regraft.restype = ctypes.c_int32
+        lib.st_engine_compat_regraft.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
         ]
         lib.st_engine_start.restype = None
         lib.st_engine_start.argtypes = [ctypes.c_void_p]
@@ -115,9 +120,13 @@ def load_engine() -> Optional[ctypes.CDLL]:
 
 def engine_eligible(config) -> bool:
     """Should the peer run the native engine for this node? Host tier,
-    native protocol, zero-frame suppression on (the engine has no idle-frame
-    path — transport keepalives carry liveness), engine lib available, and
-    not explicitly disabled (ST_NATIVE_ENGINE=0 or Config.native_engine)."""
+    zero-frame suppression on (the engine has no idle-frame path —
+    transport keepalives carry liveness, and in wire-compat mode the
+    transport's idle zero-scale frames do), engine lib available, and not
+    explicitly disabled (ST_NATIVE_ENGINE=0 or Config.native_engine). Both
+    wire protocols are engine-capable: native framing with bursts + the
+    ACK ledger, or the reference's raw compat frames (no ACKs, ledgerless
+    — see stengine.cpp's compat_bytes)."""
     from ..core import host_tier_active
 
     if os.environ.get("ST_NATIVE_ENGINE", "1") == "0":
@@ -127,8 +136,6 @@ def engine_eligible(config) -> bool:
         # the pinned tier, not the engine's C loops
         return False
     if not getattr(config, "native_engine", True):
-        return False
-    if config.transport.wire_compat:
         return False
     if not config.codec.suppress_zero_frames:
         return False
@@ -154,6 +161,7 @@ class EngineTensor:
         node,  # TransportNode
         burst: int,
         recv_cap: int,
+        compat_frame_bytes: int = 0,  # >0 => reference raw wire protocol
     ):
         from ..ops.codec_np import _layout, flatten_np
 
@@ -180,6 +188,7 @@ class EngineTensor:
             1 if codec.per_leaf_scale else 0,
             burst,
             recv_cap,
+            compat_frame_bytes,
         )
         if not self._h:
             raise RuntimeError("st_engine_create failed")
@@ -271,6 +280,13 @@ class EngineTensor:
         add with no residual to live in would be erased tree-wide by the
         re-graft diff; the reference's unconnected-slot mechanism)."""
         return bool(self._lib.st_engine_stash_carry(self._h, link_id))
+
+    def compat_regraft(self, link_id: int) -> None:
+        """Wire-compat LEAF re-graft, atomic in C: replica = carry, new
+        uplink residual = carry (core.SharedTensor.regraft_reset_to_carry's
+        engine analog — see that docstring for why zero would desync)."""
+        if self._lib.st_engine_compat_regraft(self._h, link_id) == 0:
+            raise ValueError(f"link {link_id} already exists")
 
     def take_carry_and_snapshot(
         self,

@@ -187,6 +187,9 @@ class SharedTensorPeer:
                     node=self.node,
                     burst=self._burst,
                     recv_cap=frame_bytes,
+                    # compat: the engine speaks the reference's raw frames
+                    # directly (no ACK ledger — the protocol has none)
+                    compat_frame_bytes=frame_bytes if tcfg.wire_compat else 0,
                 )
                 self._engine = self.st
             except Exception as e:
@@ -582,6 +585,21 @@ class SharedTensorPeer:
         compat = self.config.transport.wire_compat
         while not self._stop.is_set():
             busy = self._handle_events()
+            if (
+                compat
+                and self._engine is not None
+                and not self._ready.is_set()
+                and self._uplink is not None
+            ):
+                # Engine-mode compat readiness: the engine consumes the
+                # uplink's frames, so _decode_compat (the python tier's
+                # readiness hook) never runs. The transport's per-link
+                # frames_in counts EVERY received frame including zero-scale
+                # keepalives — the same "parent's stream is flowing, even
+                # idle" bar (quirk Q4's fix) the python tier uses.
+                s = self.node.stats(self._uplink)
+                if s is not None and s.frames_in > 0:
+                    self._ready.set()
             if self._engine is not None:
                 # control-plane messages the engine deferred (it owns only
                 # DATA/BURST/ACK on attached links)
@@ -736,9 +754,24 @@ class SharedTensorPeer:
                         # see the LINK_DOWN comment.
                         if self._compat_reset_on_regraft:
                             self._compat_reset_on_regraft = False
-                            self.st.regraft_reset_to_carry(
-                                CARRY_LINK, ev.link_id
-                            )
+                            if self._engine is not None:
+                                self._engine.compat_regraft(ev.link_id)
+                            else:
+                                self.st.regraft_reset_to_carry(
+                                    CARRY_LINK, ev.link_id
+                                )
+                        elif self._engine is not None:
+                            # interior re-graft (or first join): residual =
+                            # carry + anything added since the consume —
+                            # attach-by-diff recomputes against live values,
+                            # so the two-step consume/attach loses nothing
+                            carry, snap = self._engine.take_carry_and_snapshot()
+                            if carry is not None:
+                                self._engine.new_link_diff(
+                                    ev.link_id, np.asarray(snap - carry, "<f4")
+                                )
+                            else:
+                                self._engine.new_link(ev.link_id, seed=False)
                         else:
                             carry, _ = self.st.take_link_and_snapshot(
                                 CARRY_LINK
@@ -746,13 +779,19 @@ class SharedTensorPeer:
                             self.st.new_link(
                                 ev.link_id, seed=False, residual=carry
                             )
+                        if self._engine is not None:
+                            self._engine_links.add(ev.link_id)
                     else:
                         self._start_join(ev.link_id)
                 else:
                     if self.config.transport.wire_compat:
                         # reference join: seed the child with the full replica
                         # through the codec stream (src/sharedtensor.c:379-381)
-                        self.st.new_link(ev.link_id, seed=True)
+                        if self._engine is not None:
+                            self._engine.new_link(ev.link_id, seed=True)
+                            self._engine_links.add(ev.link_id)
+                        else:
+                            self.st.new_link(ev.link_id, seed=True)
                     else:
                         # native: wait for the child's SYNC snapshot before
                         # opening the codec link

@@ -211,14 +211,16 @@ def test_regraft_after_parent_death():
             p.close()
 
 
-def test_compat_leaf_regraft_keeps_orphan_adds():
+@pytest.mark.parametrize("native", [True, False])
+def test_compat_leaf_regraft_keeps_orphan_adds(native):
     """Wire-compat re-graft of a LEAF whose parent died: the reference
     protocol has no diff handshake, so the leaf resets to fresh-joiner
     state — which must mean replica == carry (a true fresh joiner with
     pending adds holds them in values AND residual), NOT replica == 0.
     A zero reset desyncs the leaf by exactly the carry forever: the carry
     floods to every OTHER peer and split horizon never returns it
-    (core.SharedTensor.regraft_reset_to_carry).
+    (core.SharedTensor.regraft_reset_to_carry; the engine analog is
+    st_engine_compat_regraft — both tiers parametrized here).
 
     Topology: master M + children A, B; C redirected under one of them.
     Kill C's parent, wait until C is orphaned, then add at C — the add is
@@ -227,9 +229,10 @@ def test_compat_leaf_regraft_keeps_orphan_adds():
     port = _free_port()
     seed = jnp.ones((256,), jnp.float32)
     cfg = Config(
+        native_engine=native,
         transport=TransportConfig(
             peer_timeout_sec=5.0, max_rejoin_attempts=8, wire_compat=True
-        )
+        ),
     )
     m = create_or_fetch("127.0.0.1", port, seed, cfg)
     peers = {"m": m}
@@ -238,6 +241,10 @@ def test_compat_leaf_regraft_keeps_orphan_adds():
             peers[name] = create_or_fetch(
                 "127.0.0.1", port, jnp.zeros_like(seed), cfg
             )
+        # the tier under test must actually be the one running: a silent
+        # engine-construction fallback would vacuously re-test python
+        for p in peers.values():
+            assert (p._engine is not None) == native
         for p in peers.values():
             p.add(jnp.full((256,), 0.5, jnp.float32))
         settled = jnp.full((256,), 1.0 + 4 * 0.5, jnp.float32)
