@@ -27,6 +27,11 @@ SIZES = [
     ).split(",")
 ]
 MEASURE_S = float(os.environ.get("ST_ENGINE_BENCH_S", "8"))
+#: ST_ENGINE_BENCH_COMPAT=1 runs both peers on the reference's raw wire
+#: protocol (engine compat data plane, K-frame compat bursts) — the
+#: saturation measurement behind the "faster than the reference at its own
+#: protocol" claim.
+COMPAT = os.environ.get("ST_ENGINE_BENCH_COMPAT", "0") == "1"
 
 
 def _force_cpu():
@@ -40,13 +45,25 @@ def _force_cpu():
     jax.config.update("jax_platforms", "cpu")
 
 
+def _cfg():
+    if not COMPAT:
+        return None
+    from shared_tensor_tpu.config import Config, TransportConfig
+
+    return Config(
+        transport=TransportConfig(peer_timeout_sec=30.0, wire_compat=True)
+    )
+
+
 def _master(n, port, q, done: "mp.Event"):
     _force_cpu()
     import numpy as np
 
     from shared_tensor_tpu import create_or_fetch
 
-    peer = create_or_fetch("127.0.0.1", port, {"w": np.zeros(n, np.float32)})
+    peer = create_or_fetch(
+        "127.0.0.1", port, {"w": np.zeros(n, np.float32)}, _cfg()
+    )
     rng = np.random.default_rng(0)
     delta = {"w": rng.standard_normal(n).astype(np.float32)}
     # keep streaming until the child reports its window closed — a fixed
@@ -66,7 +83,9 @@ def _child(n, port, q, done: "mp.Event"):
 
     from shared_tensor_tpu import create_or_fetch
 
-    peer = create_or_fetch("127.0.0.1", port, {"w": np.zeros(n, np.float32)})
+    peer = create_or_fetch(
+        "127.0.0.1", port, {"w": np.zeros(n, np.float32)}, _cfg()
+    )
     # Open the measure window only once frames actually flow: a fixed sleep
     # undershoots on a loaded box (large-n join state transfer can outlast
     # it, measuring zero) and silently folds startup into the rate.
@@ -137,6 +156,10 @@ def main() -> None:
             {
                 "bench": "engine_steady_state",
                 "tier": "host-native-engine",
+                # compat runs must be distinguishable from native rows: a
+                # 155 k f/s compat measurement pasted as a native row (or
+                # vice versa) would silently mislabel the artifact
+                "wire": "compat" if COMPAT else "native",
                 "measure_s": MEASURE_S,
                 "rows": rows,
                 "reference": "BASELINE.md E2E loopback table "

@@ -363,13 +363,18 @@ void sender_loop(Engine* e) {
       // encode + send outside the lock
       size_t per = frame_bytes(e);
       if (e->compat_bytes) {
-        // reference raw frame: [f32 scale][ceil(n/8) mask bytes]; L == 1
-        // (the peer rejects multi-leaf tables in compat mode) and
-        // ceil(n/8) <= W*4, so the words buffer always covers the mask
-        payload.resize((size_t)e->compat_bytes);
-        std::memcpy(payload.data(), msg.scales.data(), 4);
-        std::memcpy(payload.data() + 4, msg.words.data(),
-                    (size_t)e->compat_bytes - 4);
+        // reference raw frames, nframes of them back-to-back (see the
+        // compat-burst note in st_engine_create): each is
+        // [f32 scale][ceil(n/8) mask bytes]; L == 1 (the peer rejects
+        // multi-leaf tables in compat mode) and ceil(n/8) <= W*4, so the
+        // words buffer always covers each mask
+        payload.resize((size_t)msg.nframes * e->compat_bytes);
+        for (int32_t f = 0; f < msg.nframes; f++) {
+          uint8_t* p = payload.data() + (size_t)f * e->compat_bytes;
+          std::memcpy(p, msg.scales.data() + (size_t)f * e->L, 4);
+          std::memcpy(p + 4, msg.words.data() + (size_t)f * e->W,
+                      (size_t)e->compat_bytes - 4);
+        }
       } else if (e->burst > 1) {
         payload.resize(2 + (size_t)msg.nframes * per);
         payload[0] = kBurst;
@@ -401,7 +406,10 @@ void sender_loop(Engine* e) {
         if (r < 0) break;  // dead link
       }
       if (delivered) {
-        e->msgs_out++;
+        // compat: every frame IS a protocol message (the reference wire has
+        // no message framing beyond the fixed frame size), keeping the
+        // taxonomy's msgs == frames on both ends of a compat link
+        e->msgs_out += e->compat_bytes ? (uint64_t)msg.nframes : 1;
         sent_any = true;
       } else {
         // undelivered: roll ALL outstanding feedback back so a re-graft
@@ -616,8 +624,11 @@ __attribute__((visibility("default"))) void* st_engine_create(
   e->policy = policy;
   e->per_leaf = per_leaf != 0;
   e->burst = burst < 1 ? 1 : (burst > 255 ? 255 : burst);
+  // Compat bursts ARE protocol-legal: the reference stream is just
+  // back-to-back fixed-size frames, so K quantized frames concatenated in
+  // one wire message are indistinguishable from K sequential sends to any
+  // reference peer — while costing ONE lock cycle + ONE write here.
   e->compat_bytes = compat_frame_bytes > 0 ? compat_frame_bytes : 0;
-  if (e->compat_bytes) e->burst = 1;  // the reference protocol has no bursts
   e->recv_cap = recv_cap;
   e->values.assign((size_t)total, 0.0f);
   if (init_values)

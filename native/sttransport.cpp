@@ -347,7 +347,15 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
     }
     if (!ok) break;
     if (have) {
-      link->frames_out++;
+      // compat: one queued payload may carry K concatenated fixed-size
+      // frames (the engine's compat bursts) — count the frames actually
+      // put on the wire, so sender wire counts reconcile with both the
+      // receiver's per-frame re-framing and the engine's per-frame
+      // delivery counters (peer.metrics() taxonomy).
+      link->frames_out += node->cfg.wire_compat
+                              ? frame.size() /
+                                    (size_t)node->cfg.compat_frame_bytes
+                              : 1;
     }
     link->bytes_out += frame.size() + (node->cfg.wire_compat ? 0 : 4);
   }

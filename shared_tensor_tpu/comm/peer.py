@@ -97,24 +97,47 @@ class SharedTensorPeer:
         spec = make_spec(template)
         from ..core import host_tier_active
 
-        # Burst sizing (Config.frame_burst): host tier + native mode only —
-        # the device tier pipelines async dispatches (and has its own
-        # device_frame_burst), and the reference protocol has no burst
-        # framing. Auto policy is TWO-branch: the native engine fills the
+        # Burst sizing (Config.frame_burst): host tier only — the device
+        # tier pipelines async dispatches (and has its own
+        # device_frame_burst). Auto policy: the native engine fills the
         # wire message budget at every size; the Python fallback tier
-        # bursts only small tables (see the branches below).
+        # bursts only small tables and never in compat mode (its compat
+        # path sends one reference frame per message). Compat bursts exist
+        # only on the engine: K fixed-size reference frames concatenate
+        # into one wire message — protocol-identical to K sequential sends
+        # for any reference peer (stengine.cpp compat-burst note).
         burstable = (
-            not tcfg.wire_compat
-            and host_tier_active()
+            host_tier_active()
             and self.config.codec.suppress_zero_frames  # the burst path has
             # no idle frames to send; honor the knob by streaming instead
         )
         from .engine import engine_eligible
 
+        engine_ok = burstable and engine_eligible(self.config)
         if not burstable:
             self._burst = 1
+        elif tcfg.wire_compat:
+            if not engine_ok:
+                self._burst = 1
+            else:
+                # the same wire-message byte budget as native mode bounds
+                # BOTH the auto fill and an explicit Config.frame_burst —
+                # without it a 255-frame burst on a 16 Mi tensor would
+                # build single ~535 MB payloads
+                cap = max(
+                    1,
+                    min(
+                        wire.BURST_MAX_FRAMES,
+                        wire.BURST_MAX_BYTES
+                        // wire.compat_frame_bytes(spec.total_n),
+                    ),
+                )
+                if self.config.frame_burst == 0:
+                    self._burst = cap
+                else:
+                    self._burst = min(max(1, self.config.frame_burst), cap)
         elif self.config.frame_burst == 0:
-            if engine_eligible(self.config):
+            if engine_ok:
                 # auto (engine): FILL the wire message budget — throughput
                 # is monotone in K up to the per-spec cap at every measured
                 # size (4 Ki: 352 k f/s at K=255 vs 300 k at 128; 64 Ki:
@@ -126,10 +149,14 @@ class SharedTensorPeer:
                 self._burst = _python_tier_auto_burst(spec)
         else:
             self._burst = max(1, self.config.frame_burst)
-        # wire-level invariant: every peer sizes its receive buffer for
-        # burst_frames_cap(spec) frames (frame_wire_bytes), so a sender
-        # must never burst beyond that regardless of Config.frame_burst
-        self._burst = min(self._burst, wire.burst_frames_cap(spec))
+        if not tcfg.wire_compat:
+            # wire-level invariant (native framing): every peer sizes its
+            # receive buffer for burst_frames_cap(spec) frames
+            # (frame_wire_bytes), so a sender must never burst beyond that
+            # regardless of Config.frame_burst. Compat needs no cap-by-spec:
+            # each frame is its own fixed-size wire message on the receive
+            # side.
+            self._burst = min(self._burst, wire.burst_frames_cap(spec))
         # Device-tier burst (Config.device_frame_burst): any size — the
         # point is amortizing the device-link round trip, which hurts at
         # every table size (VERDICT r03 item 3).
@@ -198,8 +225,11 @@ class SharedTensorPeer:
             # the burst was sized for the engine (fill the wire budget);
             # if the engine did not actually construct, the Python tier
             # must re-size — at the cap it would pay up to 255 synchronous
-            # numpy rescans per message under the state lock
-            if self.config.frame_burst == 0 and self._burst > 1:
+            # numpy rescans per message under the state lock. Its compat
+            # path has no burst at all (one reference frame per message).
+            if tcfg.wire_compat:
+                self._burst = 1
+            elif self.config.frame_burst == 0 and self._burst > 1:
                 self._burst = min(self._burst, _python_tier_auto_burst(spec))
             self.st = SharedTensor(template, codec, seed_values=self.is_master)
         self._ready = threading.Event()
