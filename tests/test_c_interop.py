@@ -51,7 +51,11 @@ def test_c_peer_mutual_convergence(harness_bin):
     peer = create_or_fetch("127.0.0.1", port, seed, cfg)
     try:
         c = subprocess.Popen(
-            [harness_bin, "127.0.0.1", str(port), str(n), "6.0", "1.0"],
+            # 12 s runtime: the harness deadline is wall-clock, and under
+            # full-suite load on this 1-vCPU box a 6 s window intermittently
+            # closed before the master's +2 add finished streaming (one
+            # observed suite failure; the interior-node sibling uses 10 s)
+            [harness_bin, "127.0.0.1", str(port), str(n), "12.0", "1.0"],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
@@ -59,7 +63,7 @@ def test_c_peer_mutual_convergence(harness_bin):
         time.sleep(1.0)  # C peer is joined and streaming; now add our delta
         peer.add(jnp.full((n,), 2.0, jnp.float32))
 
-        out, err = c.communicate(timeout=30)
+        out, err = c.communicate(timeout=40)
         assert c.returncode == 0, err[-500:]
 
         expected = np.asarray(seed) + 1.0 + 2.0
