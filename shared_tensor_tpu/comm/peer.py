@@ -399,6 +399,10 @@ class SharedTensorPeer:
           socket: data AND control (ACK/SYNC/CHUNK/...), excluding
           keepalives; ``>= `` the data-message counts above by exactly the
           control traffic. ``bytes_*`` include framing and keepalives.
+          Wire-compat caveat: a compat keepalive IS a real zero-scale frame
+          on the wire, indistinguishable at the transport layer — so the
+          RECEIVE-side wire count includes idle-period keepalives there
+          (the send side still excludes them).
         """
         if self._engine is not None:
             # ONE snapshot for every engine counter: separate reads would
