@@ -1,0 +1,107 @@
+"""The codec lab's pod-tier test bed (parallel/ici_lab.py): the 2-bit
+sign2 sync step on the 8-device virtual CPU mesh must keep the production
+step's semantic invariants (agreement, split horizon, idle behavior) and
+reproduce the host lab's measured ordering: faster per-frame RMS decay
+than 1-bit on gaussian residuals, identical trajectories on uniform."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shared_tensor_tpu.ops.table import make_spec
+from shared_tensor_tpu.parallel import add_updates, build_sync_step, init_state
+from shared_tensor_tpu.parallel.ici_lab import build_sign2_sync_step
+from tests._mesh import make_mesh
+
+
+def _mk(n_peer=4, n_shard=2, n=4096, seed=0, dist="normal"):
+    mesh = make_mesh(n_peer, n_shard)
+    tpl = {"w": jnp.zeros((n,), jnp.float32)}
+    spec = make_spec(tpl)
+    state = init_state(mesh, spec, tpl)
+    rng = np.random.default_rng(seed)
+    draw = rng.standard_normal if dist == "normal" else (
+        lambda size: rng.uniform(-1.0, 1.0, size)
+    )
+    ups = jnp.asarray(
+        np.stack([draw(size=spec.total) for _ in range(n_peer)]), jnp.float32
+    )
+    return mesh, spec, add_updates(state, ups)
+
+
+def _rms(state):
+    r = np.asarray(state.residual, dtype=np.float64)
+    return float(np.sqrt(np.mean(r * r)))
+
+
+def test_sign2_step_reaches_agreement():
+    """After residuals drain, every peer holds the same replica — the sum
+    of all peers' updates (the eventual-consistency contract, delivered
+    through the 2-bit wire)."""
+    mesh, spec, state = _mk(dist="uniform")
+    expect = np.asarray(jnp.sum(state.residual, axis=0))
+    step = build_sign2_sync_step(mesh, spec)
+    for _ in range(40):
+        state, scales = step(state)
+        if not bool(jnp.any(state.residual != 0.0)):
+            break
+    assert not bool(jnp.any(state.residual != 0.0)), "did not drain"
+    vals = np.asarray(state.values)
+    for p in range(vals.shape[0]):
+        np.testing.assert_allclose(vals[p], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_sign2_uniform_trajectory_matches_production_step():
+    """On uniform residuals |r| never exceeds 2s: the magnitude bit idles
+    and the 2-bit step's state must track the production 1-bit step's
+    bit-for-bit, frame by frame — how the lab design inherits the exact
+    drain (mirrors the host lab's test)."""
+    mesh, spec, s1 = _mk(dist="uniform")
+    _, _, s2 = _mk(dist="uniform")
+    step1 = build_sync_step(mesh, spec, impl="xla")
+    step2 = build_sign2_sync_step(mesh, spec)
+    for _ in range(30):
+        s1, sc1 = step1(s1)
+        s2, sc2 = step2(s2)
+        np.testing.assert_array_equal(np.asarray(sc1), np.asarray(sc2))
+        np.testing.assert_array_equal(
+            np.asarray(s1.residual), np.asarray(s2.residual)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s1.values), np.asarray(s2.values)
+        )
+        if not bool(jnp.any(s1.residual != 0.0)):
+            break
+    assert not bool(jnp.any(s1.residual != 0.0))
+
+
+def test_sign2_decays_faster_on_gaussian():
+    """The lab's device-tier claim: on gaussian residuals the ±3s level
+    moves tail elements 3x faster, beating the production step's per-frame
+    decay (host lab measured 0.79 vs 0.85 geometric mean over 20 frames)."""
+    frames = 20
+    mesh, spec, s1 = _mk(dist="normal")
+    _, _, s2 = _mk(dist="normal")
+    rms0 = _rms(s1)
+    step1 = build_sync_step(mesh, spec, impl="xla")
+    step2 = build_sign2_sync_step(mesh, spec)
+    for _ in range(frames):
+        s1, _ = step1(s1)
+        s2, _ = step2(s2)
+    d1 = (_rms(s1) / rms0) ** (1.0 / frames)
+    d2 = (_rms(s2) / rms0) ** (1.0 / frames)
+    assert d2 < d1 - 0.02, (d2, d1)
+
+
+def test_sign2_idle_state_stays_idle():
+    """Zero residuals produce zero scales and a no-op step (idle pods cost
+    nothing but the collective itself)."""
+    mesh = make_mesh(4, 2)
+    tpl = {"w": jnp.zeros((4096,), jnp.float32)}
+    spec = make_spec(tpl)
+    state = init_state(mesh, spec, tpl)
+    step = build_sign2_sync_step(mesh, spec)
+    state2, scales = step(state)
+    assert not bool(jnp.any(np.asarray(scales) != 0.0))
+    np.testing.assert_array_equal(np.asarray(state2.values), 0.0)
+    np.testing.assert_array_equal(np.asarray(state2.residual), 0.0)
