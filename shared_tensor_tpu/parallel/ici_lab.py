@@ -124,5 +124,11 @@ def build_sign2_sync_step(
         return PeerSyncState(v, r), scales
 
     if jit_compile:
-        return jax.jit(sync_step, donate_argnums=(0,))
+        # NO buffer donation, deliberately (production donates): with many
+        # live executables in one process (a full pytest run), donated
+        # shard_map buffers on the virtual CPU mesh intermittently abort
+        # the XLA CPU runtime (SIGABRT reproduced at suite position #132,
+        # gone without donation). The lab step measures semantics, not
+        # allocator throughput — correctness over the copy.
+        return jax.jit(sync_step)
     return sync_step

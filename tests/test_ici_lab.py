@@ -86,8 +86,14 @@ def test_sign2_decays_faster_on_gaussian():
     step1 = build_sync_step(mesh, spec, impl="xla")
     step2 = build_sign2_sync_step(mesh, spec)
     for _ in range(frames):
-        s1, _ = step1(s1)
-        s2, _ = step2(s2)
+        # block each iteration: a deep unsynchronized dispatch queue of
+        # alternating donated (production) and non-donated shard_map
+        # programs intermittently SIGABRTs the XLA CPU runtime when many
+        # executables are live in one process (reproduced at suite
+        # position #132; every other test here syncs per-iter via
+        # np.asarray and never hits it)
+        s1, _ = jax.block_until_ready(step1(s1))
+        s2, _ = jax.block_until_ready(step2(s2))
     d1 = (_rms(s1) / rms0) ** (1.0 / frames)
     d2 = (_rms(s2) / rms0) ** (1.0 / frames)
     assert d2 < d1 - 0.02, (d2, d1)
