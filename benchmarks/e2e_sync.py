@@ -187,6 +187,10 @@ def main() -> None:
         baseline = 1.01e9  # BASELINE.md E2E row, equiv-fp32 B/s per link
         out = {
             "metric": "e2e_host_sync",
+            # compat rows must be distinguishable from native-framing rows
+            # (same rule as engine_bench.py / soak.py): C child implies the
+            # reference protocol too
+            "wire": "compat" if (COMPAT or CHILD == "c") else "native",
             "n": N,
             "seconds": round(dt, 2),
             "backend": backend,

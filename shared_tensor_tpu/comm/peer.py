@@ -124,14 +124,7 @@ class SharedTensorPeer:
                 # BOTH the auto fill and an explicit Config.frame_burst —
                 # without it a 255-frame burst on a 16 Mi tensor would
                 # build single ~535 MB payloads
-                cap = max(
-                    1,
-                    min(
-                        wire.BURST_MAX_FRAMES,
-                        wire.BURST_MAX_BYTES
-                        // wire.compat_frame_bytes(spec.total_n),
-                    ),
-                )
+                cap = wire.compat_burst_frames_cap(spec.total_n)
                 if self.config.frame_burst == 0:
                     self._burst = cap
                 else:

@@ -80,6 +80,15 @@ def burst_frames_cap(spec: TableSpec) -> int:
     return max(1, min(BURST_MAX_FRAMES, (BURST_MAX_BYTES - 2) // per))
 
 
+def compat_burst_frames_cap(n: int) -> int:
+    """Most reference-protocol frames one wire message may carry for an
+    n-element tensor (>= 1) — the compat twin of burst_frames_cap, kept
+    here so both modes' burst bounds share the BURST_MAX_* budget (a
+    K-frame compat burst is K fixed-size frames concatenated; see
+    stengine.cpp's compat-burst note)."""
+    return max(1, min(BURST_MAX_FRAMES, BURST_MAX_BYTES // compat_frame_bytes(n)))
+
+
 def frame_payload_bytes(spec: TableSpec) -> int:
     """Bytes of ONE frame's wire body (scales + packed words) — the single
     source of truth for the frame layout (decode_frame, decode_burst, and
