@@ -261,7 +261,7 @@ def test_compat_leaf_regraft_keeps_orphan_adds(native):
         # it re-grafts between polls (new link id) the add below just rides
         # the new uplink — also covered by the contract, only less pointed
         orphan = None
-        deadline = time.time() + 60
+        deadline = time.time() + 90
         while orphan is None and time.time() < deadline:
             orphan = next(
                 (p for p in candidates if p._uplink != before[id(p)]), None
@@ -273,8 +273,11 @@ def test_compat_leaf_regraft_keeps_orphan_adds(native):
         orphan.add(jnp.full((256,), 0.25, jnp.float32))
         survivors = list(peers.values())
         expect = jnp.full((256,), 1.0 + 4 * 0.5 + 0.25, jnp.float32)
-        # generous: re-graft needs the 5 s timeout + rejoin walk under load
-        _wait_converged(survivors, expect, tol=1e-4, timeout=120.0)
+        # generous: re-graft needs the 5 s timeout + the rejoin walk, and
+        # under 2-worker xdist on this 1-vCPU box the whole sequence is
+        # scheduled against a concurrent full suite (one observed 120 s
+        # miss in ~10 loaded runs; 180 s follows the churn tests' margin)
+        _wait_converged(survivors, expect, tol=1e-4, timeout=180.0)
     finally:
         for p in peers.values():
             p.close()
