@@ -54,6 +54,119 @@ def test_engine_disabled_by_codec_pin(monkeypatch):
     assert not engine_eligible(Config())
 
 
+def _capture_engine_checkpoint(tmp_path):
+    """Build a 2-node engine tree whose master has a GUARANTEED-nonzero
+    link residual at save time: single-frame messages through a 2 KB/s
+    token bucket pace the drain to ~15 frames/s, and the residual halves
+    per frame (never reaching zero before f32 underflow), so a save ~0.3 s
+    after the add always captures live link state. An unpaced engine
+    drains 512 elements in microseconds — the race the cap removes."""
+    from shared_tensor_tpu.utils import checkpoint as ckpt
+
+    port = free_port()
+    a = _mk(
+        port,
+        {"w": np.zeros(512, np.float32)},
+        frame_burst=1,
+        transport=TransportConfig(bandwidth_cap_bytes_per_sec=2000),
+    )
+    b = _mk(port, {"w": np.zeros(512, np.float32)})
+    try:
+        assert a._engine is not None
+        # NON-constant delta: a constant one is the degenerate case (rms ==
+        # every |element| == a power of two's mantissa -> one frame drains
+        # it exactly); linspace keeps the residual halving for 100+ frames
+        a.add({"w": np.linspace(0.1, 1.0, 512, dtype=np.float32)})
+        time.sleep(0.2)
+        path = str(tmp_path / "engine_peer.npz")
+        ckpt.save_shared(a.st, path)
+    finally:
+        a.close()
+        b.close()
+    # expectations come from the FILE, not a re-snapshot: the paced link
+    # keeps draining through the npz write, so live state taken after
+    # save_shared can be one halving behind what was saved
+    with np.load(path) as z:
+        values = z["values"]
+        links = {
+            int(k.split("_", 1)[1]): z[k]
+            for k in z.files
+            if k.startswith("link_")
+        }
+    resid = links[min(links)]
+    # the engine quantizes ahead of the paced wire (sendq depth + ACKed
+    # frames): ~13 halvings by save time -> rms ~1e-4; the guard sits well
+    # below that but far above f32 dust
+    assert float(np.sqrt((resid * resid).mean())) > 1e-6, "resid drained"
+    return path, values, links
+
+
+def test_engine_checkpoint_restore_then_join(tmp_path):
+    """load_shared's restore_state branch (engine tier: state lives in C)
+    + the join seed: a peer joining AFTER the restore receives the full
+    restored replica through the normal state-transfer handshake."""
+    from shared_tensor_tpu.utils import checkpoint as ckpt
+
+    path, values, _ = _capture_engine_checkpoint(tmp_path)
+    port2 = free_port()
+    a2 = _mk(port2, {"w": np.zeros(512, np.float32)})
+    try:
+        assert a2._engine is not None
+        ckpt.load_shared(a2.st, path)
+        np.testing.assert_array_equal(a2.st.snapshot_all()[0], values)
+        b2 = _mk(port2, {"w": np.zeros(512, np.float32)})
+        try:
+            assert a2.drain(timeout=30.0, tol=1e-30)
+            expect = values[:512]  # live lanes of the padded flat replica
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if np.allclose(np.asarray(b2.read()["w"]), expect, atol=1e-5):
+                    break
+                time.sleep(0.05)
+            np.testing.assert_allclose(
+                np.asarray(b2.read()["w"]), expect, atol=1e-5
+            )
+        finally:
+            b2.close()
+    finally:
+        a2.close()
+
+
+def test_engine_checkpoint_restored_residual_streams(tmp_path):
+    """Restoring onto a LIVE link must install the saved residual in the
+    C engine AND mark the link dirty so it streams: the peer on the other
+    end converges to exactly the restored residual's mass (its join
+    predated the restore, so the residual is all it is owed)."""
+    from shared_tensor_tpu.utils import checkpoint as ckpt
+
+    path, values, links = _capture_engine_checkpoint(tmp_path)
+    lid = min(links)
+    port2 = free_port()
+    a2 = _mk(port2, {"w": np.zeros(512, np.float32)})
+    b2 = _mk(port2, {"w": np.zeros(512, np.float32)})
+    try:
+        assert a2._engine is not None and lid in a2.st.link_ids
+        ckpt.load_shared(a2.st, path)
+        # no snapshot probe of the restored residual: restore marks the
+        # link dirty and the (uncapped) engine streams it away in
+        # microseconds — b2's convergence below IS the proof it was
+        # installed; only the replica is stable enough to compare
+        np.testing.assert_array_equal(a2.st.snapshot_all()[0], values)
+        assert a2.drain(timeout=30.0, tol=1e-30)
+        expect = links[lid][:512]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if np.allclose(np.asarray(b2.read()["w"]), expect, atol=1e-5):
+                break
+            time.sleep(0.05)
+        np.testing.assert_allclose(
+            np.asarray(b2.read()["w"]), expect, atol=1e-5
+        )
+    finally:
+        a2.close()
+        b2.close()
+
+
 def test_engine_vs_python_tier_convergence_parity():
     """Same workload through the engine and through the Python tier must
     reach the same fixed point (uniform deltas converge exactly — verify
