@@ -22,7 +22,10 @@ Measured on the 8-virtual-device test mesh (tests/test_ici_lab.py): on
 gaussian residuals the sign2 step drains RMS faster per frame than the
 production step at every frame count checked, matching the host lab's
 0.79-vs-0.85 per-frame decay; on uniform residuals the magnitude bit idles
-and both steps drain identically (exact zero in ~28 frames).
+and both steps drain identically (exact zero in ~28 frames); and the
+flagship char-rnn TRAINS through the 2-bit sync to statistically
+comparable loss on the same pinned data stream (the training-level A/B,
+mirroring the overlap A/B in tests/test_trainer.py).
 """
 
 from __future__ import annotations
