@@ -225,13 +225,23 @@ def test_compat_leaf_regraft_keeps_orphan_adds(native):
     Topology: master M + children A, B; C redirected under one of them.
     Kill C's parent, wait until C is orphaned, then add at C — the add is
     guaranteed undelivered (it lands in the live carry slot) — and assert
-    every survivor INCLUDING C converges to the full sum."""
+    every survivor INCLUDING C converges to the full sum.
+
+    peer_timeout is 15 s, deliberately above this box's worst loaded-run
+    scheduler stalls: the test pins the SINGLE-event leaf re-graft, whose
+    outcome is exact; a SECONDARY spurious liveness timeout during the
+    recovery window fires the documented compat interior re-seed
+    double-count (README.md delivery-contract notes) — a real, documented
+    protocol property, but a different scenario than this test's subject
+    (one full-suite run in ~15 observed exactly that: a survivor at
+    settled+carry+settled). Multi-event compat churn is bounded by
+    SOAK_COMPAT_r04.json's envelope instead."""
     port = _free_port()
     seed = jnp.ones((256,), jnp.float32)
     cfg = Config(
         native_engine=native,
         transport=TransportConfig(
-            peer_timeout_sec=5.0, max_rejoin_attempts=8, wire_compat=True
+            peer_timeout_sec=15.0, max_rejoin_attempts=8, wire_compat=True
         ),
     )
     m = create_or_fetch("127.0.0.1", port, seed, cfg)
