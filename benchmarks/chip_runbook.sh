@@ -21,10 +21,10 @@ $AX timeout 560 python -m pytest tests/test_char_rnn.py tests/test_resnet.py \
     tests/test_codec_np.py tests/test_compat.py tests/test_profiling.py \
     tests/test_wire_robustness.py tests/test_codec.py -q 2>&1 | tail -2 | tee /tmp/ax_g3.txt
 
-step "2/6 train bench (4 arms incl. overlap) -> TRAIN_BENCH_r04.json"
+step "2/6 train bench (4 arms incl. overlap) -> TRAIN_BENCH_r05.json"
 PYTHONPATH=/root/repo:/root/.axon_site ST_TRAIN_BENCH_BUDGET_S=420 \
-  timeout 500 python benchmarks/train_bench.py > /tmp/train_bench_r04.json 2>/tmp/tb_err.log
-tail -1 /tmp/train_bench_r04.json
+  timeout 500 python benchmarks/train_bench.py > /tmp/train_bench_r05.json 2>/tmp/tb_err.log
+tail -1 /tmp/train_bench_r05.json
 
 step "3/6 headline bench sanity"
 PYTHONPATH=/root/repo:/root/.axon_site ST_BENCH_BUDGET_S=300 \
@@ -34,7 +34,7 @@ step "4/6 pareto spot-check (1Mi only, confirms chip state)"
 PYTHONPATH=/root/repo:/root/.axon_site timeout 300 \
   python benchmarks/pareto.py --sizes 20 2>/dev/null | tail -1
 
-step "5/6 device-burst E2E on the real tunnel -> E2E_r04 tpu_parent arm"
+step "5/6 device-burst E2E on the real tunnel -> E2E_r05 tpu_parent arm"
 # The parent runs the real chip (device tier, K-frame bursts by default);
 # the child is a CPU host-tier peer. This is the measurement the
 # DEVICE_BURST_r04.json projection (~1554 f/s at 1 Mi) stands in for.
@@ -44,4 +44,4 @@ PYTHONPATH=/root/repo:/root/.axon_site ST_E2E_SECONDS=20 timeout 300 \
 PYTHONPATH=/root/repo:/root/.axon_site ST_E2E_SECONDS=15 timeout 240 \
   env ST_E2E_DEVICE_BURST=1 python benchmarks/e2e_sync.py 2>/dev/null | tail -1
 
-step "6/6 done — assemble artifacts manually (BENCH_r04, TRAIN_BENCH_r04, AXON_SUITE_r04, E2E_r04)"
+step "6/6 done — assemble artifacts manually (BENCH_r05, TRAIN_BENCH_r05, AXON_SUITE_r05, E2E_r05)"
