@@ -15,8 +15,19 @@
  * (ops/packing.py wire contract; byte-identical to the reference's
  * data[i/8] |= 1 << (i%8)).
  *
- * Plain C ABI for ctypes (no pybind11 in this image). Single-threaded by
- * design: one link engine per thread, like the reference.
+ * Plain C ABI for ctypes (no pybind11 in this image).
+ *
+ * Threading: each entry point runs serial below ST_CODEC_PAR_MIN elements
+ * (one link engine per thread, like the reference — small tables are
+ * latency-bound and a pool handoff would only add wakeup cost). Above the
+ * threshold the loops run chunked on a small process-wide worker pool
+ * (stc_pool below): chunks are fixed 2 Mi-element word-aligned ranges, so
+ * reduction grouping — and therefore every scale partial — is a pure
+ * function of the table layout, NOT of the thread count; results are
+ * deterministic for any ST_CODEC_THREADS value, differing from the serial
+ * pass only by the ~1-ulp summation-order tolerance every scale consumer
+ * already accepts (scales ride the wire, receivers never recompute them).
+ * Elementwise loops (quantize/apply/add) are bit-exact under any split.
  */
 
 #include <stdint.h>
@@ -55,6 +66,189 @@ static int st_has_avx512(void) {
 #define ST_CLONES
 #endif
 
+/* ---- worker pool ---------------------------------------------------------
+ *
+ * One process-wide pool, lazily spawned on the first large-table call.
+ * Thread count: ST_CODEC_THREADS env (<=1 disables), else min(nproc, 8).
+ * Submitters serialize on job_mu with TRYLOCK: if the pool is busy (the
+ * engine's sender and receiver threads can both hit large-table codec ops
+ * concurrently) the second caller just runs its loop inline — never blocks,
+ * never deadlocks. Workers pull chunk indices from one atomic counter.
+ * Fork safety: Python peers fork worker processes (multiprocessing); pool
+ * threads do not survive fork, so an atfork child handler marks the pool
+ * dead and every later call in the child runs inline (correct, just
+ * serial) until nothing — the child can never wait on absent workers. */
+#if defined(__unix__)
+#define ST_POOL 1
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdlib.h>
+#include <unistd.h>
+
+/* chunk granularity: 2 Mi elements = 8 MiB of f32 (multiple of 32, so a
+ * chunk boundary never splits a packed word); parallel threshold below. */
+#define ST_CHUNK_ELEMS ((int64_t)2 * 1024 * 1024)
+#define ST_PAR_MIN_ELEMS ((int64_t)4 * 1024 * 1024)
+
+typedef void (*stc_seg_fn)(void *ctx, int64_t seg);
+
+static struct {
+  pthread_mutex_t mu;
+  pthread_cond_t cv_job, cv_done;
+  pthread_mutex_t job_mu; /* serializes submitters (trylock) */
+  int started;            /* 0 = not yet, 1 = live, -1 = dead (fork child) */
+  int nworkers;
+  uint64_t gen;
+  stc_seg_fn fn;
+  void *ctx;
+  int64_t nseg;
+  _Atomic int64_t next;
+  int64_t finished;
+} g_pool = {PTHREAD_MUTEX_INITIALIZER, PTHREAD_COND_INITIALIZER,
+            PTHREAD_COND_INITIALIZER,  PTHREAD_MUTEX_INITIALIZER,
+            0,                         0,
+            0,                         0,
+            0,                         0,
+            0,                         0};
+
+static void *stc_pool_worker(void *arg) {
+  (void)arg;
+  uint64_t seen = 0;
+  for (;;) {
+    pthread_mutex_lock(&g_pool.mu);
+    while (g_pool.gen == seen) pthread_cond_wait(&g_pool.cv_job, &g_pool.mu);
+    seen = g_pool.gen;
+    stc_seg_fn fn = g_pool.fn;
+    void *ctx = g_pool.ctx;
+    int64_t nseg = g_pool.nseg;
+    pthread_mutex_unlock(&g_pool.mu);
+    int64_t done = 0;
+    for (;;) {
+      int64_t s = atomic_fetch_add(&g_pool.next, 1);
+      if (s >= nseg) break;
+      fn(ctx, s);
+      done++;
+    }
+    pthread_mutex_lock(&g_pool.mu);
+    g_pool.finished += done;
+    if (g_pool.finished >= nseg) pthread_cond_signal(&g_pool.cv_done);
+    pthread_mutex_unlock(&g_pool.mu);
+  }
+  return NULL;
+}
+
+static void stc_pool_child(void) { g_pool.started = -1; }
+
+static int stc_pool_threads(void) {
+  static int cached = 0;
+  if (!cached) {
+    const char *env = getenv("ST_CODEC_THREADS");
+    long v = env ? strtol(env, NULL, 10) : 0;
+    if (v <= 0) {
+      long np = sysconf(_SC_NPROCESSORS_ONLN);
+      v = np < 1 ? 1 : (np > 8 ? 8 : np);
+    }
+    cached = v > 64 ? 64 : (int)v;
+  }
+  return cached;
+}
+
+/* Ensure workers exist. Returns 0 when threading is unavailable. */
+static int stc_pool_up(void) {
+  if (g_pool.started == 1) return 1;
+  if (g_pool.started < 0) return 0;
+  pthread_mutex_lock(&g_pool.mu);
+  if (g_pool.started == 0) {
+    int nt = stc_pool_threads();
+    if (nt <= 1) {
+      g_pool.started = -1;
+    } else {
+      pthread_atfork(NULL, NULL, stc_pool_child);
+      int spawned = 0;
+      for (int i = 0; i < nt - 1; i++) { /* submitter participates */
+        pthread_t t;
+        if (pthread_create(&t, NULL, stc_pool_worker, NULL) == 0) {
+          pthread_detach(t);
+          spawned++;
+        }
+      }
+      g_pool.nworkers = spawned;
+      g_pool.started = spawned > 0 ? 1 : -1;
+    }
+  }
+  int ok = g_pool.started == 1;
+  pthread_mutex_unlock(&g_pool.mu);
+  return ok;
+}
+
+/* Run fn(ctx, seg) for seg in [0, nseg) across the pool; the caller works
+ * too. Returns 1 if the job ran on the pool, 0 if the caller must run the
+ * whole loop inline (pool busy / dead / tiny job). */
+static int stc_pool_run(stc_seg_fn fn, void *ctx, int64_t nseg) {
+  if (nseg < 2 || !stc_pool_up()) return 0;
+  if (pthread_mutex_trylock(&g_pool.job_mu) != 0) return 0;
+  pthread_mutex_lock(&g_pool.mu);
+  g_pool.fn = fn;
+  g_pool.ctx = ctx;
+  g_pool.nseg = nseg;
+  atomic_store(&g_pool.next, 0);
+  g_pool.finished = 0;
+  g_pool.gen++;
+  pthread_cond_broadcast(&g_pool.cv_job);
+  pthread_mutex_unlock(&g_pool.mu);
+  int64_t done = 0;
+  for (;;) {
+    int64_t s = atomic_fetch_add(&g_pool.next, 1);
+    if (s >= nseg) break;
+    fn(ctx, s);
+    done++;
+  }
+  pthread_mutex_lock(&g_pool.mu);
+  g_pool.finished += done;
+  while (g_pool.finished < nseg) pthread_cond_wait(&g_pool.cv_done, &g_pool.mu);
+  pthread_mutex_unlock(&g_pool.mu);
+  pthread_mutex_unlock(&g_pool.job_mu);
+  return 1;
+}
+
+/* A chunk is a word range [w0, w1) inside ONE leaf (never spans leaves —
+ * each kernel body stays a single-leaf range loop). Fixed decomposition:
+ * every leaf splits at ST_CHUNK_ELEMS boundaries of its own padded span. */
+typedef struct {
+  int64_t leaf, w0, w1;
+} stc_chunk;
+
+/* total padded elements + chunk count for a layout */
+static int64_t stc_count_chunks(const int64_t *padded, int64_t n_leaves,
+                                int64_t *out_total) {
+  int64_t total = 0, nc = 0;
+  for (int64_t i = 0; i < n_leaves; i++) {
+    total += padded[i];
+    nc += (padded[i] + ST_CHUNK_ELEMS - 1) / ST_CHUNK_ELEMS;
+  }
+  if (out_total) *out_total = total;
+  return nc;
+}
+
+static void stc_build_chunks(const int64_t *padded, int64_t n_leaves,
+                             stc_chunk *out) {
+  int64_t k = 0;
+  for (int64_t i = 0; i < n_leaves; i++) {
+    int64_t nw = padded[i] / 32, cw = ST_CHUNK_ELEMS / 32;
+    for (int64_t w0 = 0; w0 < nw; w0 += cw) {
+      out[k].leaf = i;
+      out[k].w0 = w0;
+      out[k].w1 = w0 + cw < nw ? w0 + cw : nw;
+      k++;
+    }
+    /* an empty leaf (padded == 0) contributes no chunks; partial outputs
+     * for it are zero-filled by the wrappers */
+  }
+}
+#else
+#define ST_PAR_MIN_ELEMS ((int64_t)1 << 62)
+#endif
+
 /* Sender half for one leaf: sign-quantize + pack + error feedback, one fused
  * pass. bit = (r <= 0) — zero counts as negative (reference quirk Q3, kept:
  * converged elements oscillate within +/-scale). With s == 0 the leaf idles:
@@ -64,15 +258,16 @@ static int st_has_avx512(void) {
 /* Words whose 32 lanes are all live: two 16-lane compares produce the
  * bitmask directly; +/-s is the scale with the mask spliced into the IEEE
  * sign bit (exactly the scalar code's union trick, 16 lanes at a time).
- * Returns the number of whole words processed. */
+ * Processes words [w0, min(w1, n/32)); returns the stopping word. */
 ST_TARGET_AVX512
 static int64_t quantize_leaf_avx512(const float *rin, float *rout, int64_t n,
-                                    float s, uint32_t *words) {
+                                    float s, uint32_t *words, int64_t w0,
+                                    int64_t w1) {
   const __m512 vzero = _mm512_setzero_ps();
   const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
   const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
-  int64_t w = 0;
-  for (; w < n / 32; w++) {
+  int64_t w = w0, wl = n / 32 < w1 ? n / 32 : w1;
+  for (; w < wl; w++) {
     const float *p = rin + w * 32;
     float *q = rout + w * 32;
     __m512 v0 = _mm512_loadu_ps(p);
@@ -94,13 +289,16 @@ static int64_t quantize_leaf_avx512(const float *rin, float *rout, int64_t n,
 }
 #endif
 
+/* words [w0, w1) of one leaf (w1 <= padded/32) */
 ST_CLONES
-static void quantize_leaf(const float *rin, float *rout, int64_t n,
-                          int64_t padded, float s, uint32_t *words) {
-  int64_t nw = padded / 32;
-  int64_t w = 0;
+static void quantize_leaf_range(const float *rin, float *rout, int64_t n,
+                                float s, uint32_t *words, int64_t w0,
+                                int64_t w1) {
+  int64_t nw = w1;
+  int64_t w = w0;
 #ifdef ST_AVX512
-  if (st_has_avx512()) w = quantize_leaf_avx512(rin, rout, n, s, words);
+  if (st_has_avx512())
+    w = quantize_leaf_avx512(rin, rout, n, s, words, w0, w1);
 #endif
   for (; w < nw; w++) {
     uint32_t bits = 0;
@@ -133,16 +331,17 @@ static void quantize_leaf(const float *rin, float *rout, int64_t n,
  * result is a double-sum like the scalar path (order differs; double
  * accumulation makes the difference vanish below f32 rounding — the
  * tiers tolerate 1-ulp scale differences, see ops/codec_np.py).
- * Returns elements consumed; partials land in amax, ss, sabs. */
+ * Covers elements [j0, n) in 16-lane steps; returns the stopping element;
+ * partials land in amax, ss, sabs. */
 ST_TARGET_AVX512
 static int64_t scale_partials_leaf_avx512(const float *p, int64_t n,
                                           double *amax, double *ss,
-                                          double *sabs) {
+                                          double *sabs, int64_t j0) {
   const __m512i vabsmask = _mm512_set1_epi32(0x7FFFFFFF);
   __m512 vamax = _mm512_setzero_ps();
   __m512d vss0 = _mm512_setzero_pd(), vss1 = _mm512_setzero_pd();
   __m512d vsa0 = _mm512_setzero_pd(), vsa1 = _mm512_setzero_pd();
-  int64_t j = 0;
+  int64_t j = j0;
   for (; j + 16 <= n; j += 16) {
     __m512 v = _mm512_loadu_ps(p + j);
     __m512 a = _mm512_castsi512_ps(
@@ -164,76 +363,193 @@ static int64_t scale_partials_leaf_avx512(const float *p, int64_t n,
 }
 #endif
 
-/* Per-leaf reduction partials for the scale policies, one fused pass per
- * leaf: max|r|, sum(r^2), sum(|r|). Double accumulators make the raw sums
+/* Reduction partials of LIVE elements [e0, e1) of one leaf (e1 <= ns):
+ * max|r|, sum(r^2), sum(|r|). Double accumulators make the raw sums
  * overflow-safe by construction (f32 max squared ~1.2e77 << DBL_MAX), where
  * the f32 tiers need the amax-normalization trick (quirk Q9 discussion in
  * ops/codec.compute_scale). The Python caller finishes the policy math. */
 ST_CLONES
+static void scale_partials_range(const float *p, int64_t e0, int64_t e1,
+                                 double *out_amax, double *out_ss,
+                                 double *out_sabs) {
+  /* 4-way unrolled accumulators: breaks the serial FP dependency chain so
+   * the adds pipeline (a single double accumulator costs ~4 cycles/elem) */
+  double amax[4] = {0, 0, 0, 0}, ss[4] = {0, 0, 0, 0}, sabs[4] = {0, 0, 0, 0};
+  int64_t j = e0;
+#ifdef ST_AVX512
+  if (st_has_avx512())
+    j = scale_partials_leaf_avx512(p, e1, &amax[0], &ss[0], &sabs[0], e0);
+#endif
+  for (; j + 4 <= e1; j += 4) {
+    for (int u = 0; u < 4; u++) {
+      double v = p[j + u];
+      double a = v < 0 ? -v : v;
+      if (a > amax[u]) amax[u] = a;
+      ss[u] += v * v;
+      sabs[u] += a;
+    }
+  }
+  for (; j < e1; j++) {
+    double v = p[j];
+    double a = v < 0 ? -v : v;
+    if (a > amax[0]) amax[0] = a;
+    ss[0] += v * v;
+    sabs[0] += a;
+  }
+  double am = amax[0];
+  for (int u = 1; u < 4; u++)
+    if (amax[u] > am) am = amax[u];
+  *out_amax = am;
+  *out_ss = ss[0] + ss[1] + ss[2] + ss[3];
+  *out_sabs = sabs[0] + sabs[1] + sabs[2] + sabs[3];
+}
+
+#ifdef ST_POOL
+/* Per-leaf reduction of per-chunk partials, in chunk order: the grouping is
+ * fixed by the layout (stc_build_chunks), so the result is identical for
+ * every thread count. */
+static void reduce_chunk_partials(const stc_chunk *chunks, int64_t nc,
+                                  int64_t n_leaves, const double *camax,
+                                  const double *css, const double *csabs,
+                                  double *out_amax, double *out_ss,
+                                  double *out_sabs) {
+  for (int64_t i = 0; i < n_leaves; i++) {
+    out_amax[i] = 0;
+    out_ss[i] = 0;
+    out_sabs[i] = 0;
+  }
+  for (int64_t c = 0; c < nc; c++) {
+    int64_t i = chunks[c].leaf;
+    if (camax[c] > out_amax[i]) out_amax[i] = camax[c];
+    out_ss[i] += css[c];
+    out_sabs[i] += csabs[c];
+  }
+}
+
+typedef struct {
+  const float *r;
+  const int64_t *off, *ns;
+  const stc_chunk *chunks;
+  double *camax, *css, *csabs;
+} sp_ctx;
+
+static void scale_partials_seg(void *vctx, int64_t c) {
+  sp_ctx *x = (sp_ctx *)vctx;
+  const stc_chunk *ch = &x->chunks[c];
+  int64_t n = x->ns[ch->leaf];
+  int64_t e0 = ch->w0 * 32, e1 = ch->w1 * 32;
+  if (e1 > n) e1 = n;
+  if (e0 > e1) e0 = e1;
+  scale_partials_range(x->r + x->off[ch->leaf], e0, e1, &x->camax[c],
+                       &x->css[c], &x->csabs[c]);
+}
+#endif
+
 EXPORT void stc_scale_partials(const float *r, const int64_t *off,
                                const int64_t *ns, int64_t n_leaves,
                                double *out_amax, double *out_ss,
                                double *out_sabs) {
-  for (int64_t i = 0; i < n_leaves; i++) {
-    const float *p = r + off[i];
-    int64_t n = ns[i];
-    /* 4-way unrolled accumulators: breaks the serial FP dependency chain so
-     * the adds pipeline (a single double accumulator costs ~4 cycles/elem) */
-    double amax[4] = {0, 0, 0, 0}, ss[4] = {0, 0, 0, 0}, sabs[4] = {0, 0, 0, 0};
-    int64_t j = 0;
-#ifdef ST_AVX512
-    if (st_has_avx512())
-      j = scale_partials_leaf_avx512(p, n, &amax[0], &ss[0], &sabs[0]);
-#endif
-    for (; j + 4 <= n; j += 4) {
-      for (int u = 0; u < 4; u++) {
-        double v = p[j + u];
-        double a = v < 0 ? -v : v;
-        if (a > amax[u]) amax[u] = a;
-        ss[u] += v * v;
-        sabs[u] += a;
+#ifdef ST_POOL
+  int64_t total = 0;
+  int64_t nc = 0;
+  /* chunk over round32(ns) word spans — identical decomposition to the
+   * other ops when padded == round32(ns), which the table layout
+   * guarantees, so fused and standalone partials group alike */
+  for (int64_t i = 0; i < n_leaves; i++) total += ns[i];
+  if (total >= ST_PAR_MIN_ELEMS) {
+    /* build chunks over round32(ns) per leaf */
+    int64_t cap = 0;
+    for (int64_t i = 0; i < n_leaves; i++)
+      cap += ((ns[i] + 31) / 32 * 32 + ST_CHUNK_ELEMS - 1) / ST_CHUNK_ELEMS;
+    stc_chunk *chunks = (stc_chunk *)malloc((size_t)cap * sizeof(stc_chunk));
+    double *pbuf = (double *)malloc((size_t)cap * 3 * sizeof(double));
+    if (chunks && pbuf) {
+      int64_t k = 0;
+      for (int64_t i = 0; i < n_leaves; i++) {
+        int64_t nw = (ns[i] + 31) / 32, cw = ST_CHUNK_ELEMS / 32;
+        for (int64_t w0 = 0; w0 < nw; w0 += cw) {
+          chunks[k].leaf = i;
+          chunks[k].w0 = w0;
+          chunks[k].w1 = w0 + cw < nw ? w0 + cw : nw;
+          k++;
+        }
+      }
+      nc = k;
+      sp_ctx x = {r, off, ns, chunks, pbuf, pbuf + nc, pbuf + 2 * nc};
+      if (stc_pool_run(scale_partials_seg, &x, nc)) {
+        reduce_chunk_partials(chunks, nc, n_leaves, x.camax, x.css, x.csabs,
+                              out_amax, out_ss, out_sabs);
+        free(chunks);
+        free(pbuf);
+        return;
       }
     }
-    for (; j < n; j++) {
-      double v = p[j];
-      double a = v < 0 ? -v : v;
-      if (a > amax[0]) amax[0] = a;
-      ss[0] += v * v;
-      sabs[0] += a;
-    }
-    double am = amax[0];
-    for (int u = 1; u < 4; u++)
-      if (amax[u] > am) am = amax[u];
-    out_amax[i] = am;
-    out_ss[i] = ss[0] + ss[1] + ss[2] + ss[3];
-    out_sabs[i] = sabs[0] + sabs[1] + sabs[2] + sabs[3];
+    free(chunks);
+    free(pbuf);
   }
+#endif
+  for (int64_t i = 0; i < n_leaves; i++)
+    scale_partials_range(r + off[i], 0, ns[i], &out_amax[i], &out_ss[i],
+                         &out_sabs[i]);
 }
+
+#ifdef ST_POOL
+typedef struct {
+  const float *rin;
+  float *rout;
+  const int64_t *off, *ns;
+  const float *scales;
+  uint32_t *words;
+  const stc_chunk *chunks;
+} qz_ctx;
+
+static void quantize_seg(void *vctx, int64_t c) {
+  qz_ctx *x = (qz_ctx *)vctx;
+  const stc_chunk *ch = &x->chunks[c];
+  int64_t i = ch->leaf;
+  quantize_leaf_range(x->rin + x->off[i], x->rout + x->off[i], x->ns[i],
+                      x->scales[i], x->words + x->off[i] / 32, ch->w0, ch->w1);
+}
+#endif
 
 /* Functional form — reads rin, writes rout (the Python tier's update
  * discipline is replace-not-mutate, so writing to a fresh output buffer
  * saves the 4-byte-per-element input copy an in-place API would force). */
-ST_CLONES
 EXPORT void stc_quantize(const float *rin, float *rout, const int64_t *off,
                          const int64_t *ns, const int64_t *padded,
                          int64_t n_leaves, const float *scales,
                          uint32_t *words) {
+#ifdef ST_POOL
+  int64_t total = 0;
+  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
+  if (total >= ST_PAR_MIN_ELEMS) {
+    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
+    if (chunks) {
+      stc_build_chunks(padded, n_leaves, chunks);
+      qz_ctx x = {rin, rout, off, ns, scales, words, chunks};
+      int ran = stc_pool_run(quantize_seg, &x, nc);
+      free(chunks);
+      if (ran) return;
+    }
+  }
+#endif
   for (int64_t i = 0; i < n_leaves; i++) {
-    quantize_leaf(rin + off[i], rout + off[i], ns[i], padded[i], scales[i],
-                  words + off[i] / 32);
+    quantize_leaf_range(rin + off[i], rout + off[i], ns[i], scales[i],
+                        words + off[i] / 32, 0, padded[i] / 32);
   }
 }
 
 #ifdef ST_AVX512
 /* The packed word IS two __mmask16s: splice each bit into the IEEE sign
  * of a broadcast s (bit set -> -s, reference src/sharedtensor.c:109)
- * and accumulate, 16 lanes per op. Returns whole words processed. */
+ * and accumulate, 16 lanes per op. Covers whole words [w0, full);
+ * returns the stopping word. */
 ST_TARGET_AVX512
 static int64_t accumulate_leaf_avx512(float *d, const uint32_t *w,
-                                      int64_t full, float s) {
+                                      int64_t full, float s, int64_t w0) {
   const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
   const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
-  int64_t k = 0;
+  int64_t k = w0;
   for (; k < full; k++) {
     uint32_t bits = w[k];
     float *dd = d + k * 32;
@@ -253,12 +569,14 @@ static int64_t accumulate_leaf_avx512(float *d, const uint32_t *w,
  * residual's scale partials for frame k+1, and they are free to accumulate
  * while frame k's residual values are still in registers — one memory pass
  * instead of quantize-then-rescan (the two-pass shape costs ~40% of the
- * engine's per-frame time at 1 Mi). Returns whole words processed. */
+ * engine's per-frame time at 1 Mi). Covers words [w0, min(w1, n/32));
+ * returns the stopping word. */
 ST_TARGET_AVX512
 static int64_t quantize_partials_leaf_avx512(const float *rin, float *rout,
                                              int64_t n, float s,
                                              uint32_t *words, double *amax,
-                                             double *ss, double *sabs) {
+                                             double *ss, double *sabs,
+                                             int64_t w0, int64_t w1) {
   const __m512 vzero = _mm512_setzero_ps();
   const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
   const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
@@ -266,8 +584,8 @@ static int64_t quantize_partials_leaf_avx512(const float *rin, float *rout,
   __m512 vamax = _mm512_setzero_ps();
   __m512d vss0 = _mm512_setzero_pd(), vss1 = _mm512_setzero_pd();
   __m512d vsa0 = _mm512_setzero_pd(), vsa1 = _mm512_setzero_pd();
-  int64_t w = 0;
-  for (; w < n / 32; w++) {
+  int64_t w = w0, wl = n / 32 < w1 ? n / 32 : w1;
+  for (; w < wl; w++) {
     const float *p = rin + w * 32;
     float *q = rout + w * 32;
     __m512 v0 = _mm512_loadu_ps(p);
@@ -315,119 +633,192 @@ static int64_t quantize_partials_leaf_avx512(const float *rin, float *rout,
 }
 #endif
 
+/* Quantize + new-residual partials for words [w0, w1) of one leaf (the
+ * fused body of stc_quantize_ef_partials). */
+ST_CLONES
+static void quantize_partials_range(const float *p, float *q, int64_t n,
+                                    float s, uint32_t *wp, int64_t w0,
+                                    int64_t w1, double *out_amax,
+                                    double *out_ss, double *out_sabs) {
+  double amax = 0, ssum = 0, sabs = 0;
+  int64_t w = w0;
+#ifdef ST_AVX512
+  if (st_has_avx512())
+    w = quantize_partials_leaf_avx512(p, q, n, s, wp, &amax, &ssum, &sabs, w0,
+                                      w1);
+#endif
+  for (; w < w1; w++) {
+    uint32_t bits = 0;
+    int64_t base = w * 32;
+    int64_t lim = n - base;
+    if (lim > 32) lim = 32;
+    for (int64_t b = 0; b < (lim < 0 ? 0 : lim); b++) {
+      float v = p[base + b];
+      uint32_t neg = v <= 0.0f;
+      bits |= neg << b;
+      float r = s > 0.0f ? v - (neg ? -s : s) : v;
+      q[base + b] = r;
+      double a = r < 0 ? -(double)r : (double)r;
+      if (a > amax) amax = a;
+      ssum += (double)r * (double)r;
+      sabs += a;
+    }
+    for (int64_t b = (lim < 0 ? 0 : lim); b < 32; b++) q[base + b] = 0.0f;
+    wp[w] = bits;
+  }
+  *out_amax = amax;
+  *out_ss = ssum;
+  *out_sabs = sabs;
+}
+
+#ifdef ST_POOL
+typedef struct {
+  const float *rin;
+  float *rout;
+  const int64_t *off, *ns;
+  const float *scales;
+  uint32_t *words;
+  const stc_chunk *chunks;
+  double *camax, *css, *csabs;
+} qzp_ctx;
+
+static void quantize_partials_seg(void *vctx, int64_t c) {
+  qzp_ctx *x = (qzp_ctx *)vctx;
+  const stc_chunk *ch = &x->chunks[c];
+  int64_t i = ch->leaf;
+  quantize_partials_range(x->rin + x->off[i], x->rout + x->off[i], x->ns[i],
+                          x->scales[i], x->words + x->off[i] / 32, ch->w0,
+                          ch->w1, &x->camax[c], &x->css[c], &x->csabs[c]);
+}
+#endif
+
 /* Sender step + NEXT frame's scale partials, one fused pass per leaf (see
  * quantize_partials_leaf_avx512). Partials are per-leaf overwrites like
  * stc_scale_partials; live lanes only. Semantics of the quantize half are
  * identical to stc_quantize. */
-ST_CLONES
 EXPORT void stc_quantize_ef_partials(
     const float *rin, float *rout, const int64_t *off, const int64_t *ns,
     const int64_t *padded, int64_t n_leaves, const float *scales,
     uint32_t *words, double *out_amax, double *out_ss, double *out_sabs) {
-  for (int64_t i = 0; i < n_leaves; i++) {
-    const float *p = rin + off[i];
-    float *q = rout + off[i];
-    uint32_t *wp = words + off[i] / 32;
-    int64_t n = ns[i], pad = padded[i];
-    float s = scales[i];
-    double amax = 0, ssum = 0, sabs = 0;
-    int64_t w = 0;
-#ifdef ST_AVX512
-    if (st_has_avx512())
-      w = quantize_partials_leaf_avx512(p, q, n, s, wp, &amax, &ssum, &sabs);
-#endif
-    int64_t nw = pad / 32;
-    for (; w < nw; w++) {
-      uint32_t bits = 0;
-      int64_t base = w * 32;
-      int64_t lim = n - base;
-      if (lim > 32) lim = 32;
-      for (int64_t b = 0; b < (lim < 0 ? 0 : lim); b++) {
-        float v = p[base + b];
-        uint32_t neg = v <= 0.0f;
-        bits |= neg << b;
-        float r = s > 0.0f ? v - (neg ? -s : s) : v;
-        q[base + b] = r;
-        double a = r < 0 ? -(double)r : (double)r;
-        if (a > amax) amax = a;
-        ssum += (double)r * (double)r;
-        sabs += a;
+#ifdef ST_POOL
+  int64_t total = 0;
+  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
+  if (total >= ST_PAR_MIN_ELEMS) {
+    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
+    double *pbuf = (double *)malloc((size_t)nc * 3 * sizeof(double));
+    if (chunks && pbuf) {
+      stc_build_chunks(padded, n_leaves, chunks);
+      qzp_ctx x = {rin,    rout,  off,  ns,         scales,
+                   words,  chunks, pbuf, pbuf + nc, pbuf + 2 * nc};
+      if (stc_pool_run(quantize_partials_seg, &x, nc)) {
+        reduce_chunk_partials(chunks, nc, n_leaves, x.camax, x.css, x.csabs,
+                              out_amax, out_ss, out_sabs);
+        free(chunks);
+        free(pbuf);
+        return;
       }
-      for (int64_t b = (lim < 0 ? 0 : lim); b < 32; b++) q[base + b] = 0.0f;
-      wp[w] = bits;
     }
-    out_amax[i] = amax;
-    out_ss[i] = ssum;
-    out_sabs[i] = sabs;
+    free(chunks);
+    free(pbuf);
+  }
+#endif
+  for (int64_t i = 0; i < n_leaves; i++) {
+    quantize_partials_range(rin + off[i], rout + off[i], ns[i], scales[i],
+                            words + off[i] / 32, 0, padded[i] / 32,
+                            &out_amax[i], &out_ss[i], &out_sabs[i]);
   }
 }
+
+/* delta += s*(1-2*bit) over words [w0, w1) of one leaf; the partial word
+ * (if any) is handled when it falls inside the range. */
+ST_CLONES
+static void accumulate_delta_range(float *d, const uint32_t *w, int64_t n,
+                                   float s, int64_t w0, int64_t w1) {
+  int64_t full = n / 32; /* whole words: branch-free, vectorizable */
+  if (full > w1) full = w1;
+  int64_t k = w0;
+#ifdef ST_AVX512
+  if (st_has_avx512()) k = accumulate_leaf_avx512(d, w, full, s, w0);
+#endif
+  for (; k < full; k++) {
+    uint32_t bits = w[k];
+    float *dd = d + k * 32;
+    float signs[32];
+    /* +/-s differ only in the IEEE sign bit: splice the codec bit in */
+    for (int b = 0; b < 32; b++) {
+      union { float f; uint32_t u; } u;
+      u.f = s;
+      u.u |= ((bits >> b) & 1u) << 31;
+      signs[b] = u.f;
+    }
+    for (int b = 0; b < 32; b++) dd[b] += signs[b];
+  }
+  if (n % 32 && n / 32 >= w0 && n / 32 < w1) {
+    int64_t base = (n / 32) * 32;
+    uint32_t bits = w[n / 32];
+    for (int64_t b = 0; b < n - base; b++) {
+      d[base + b] += ((bits >> b) & 1u) ? -s : s;
+    }
+  }
+}
+
+#ifdef ST_POOL
+typedef struct {
+  float *delta;
+  const int64_t *off, *ns;
+  const float *scales;
+  const uint32_t *words;
+  const stc_chunk *chunks;
+} ad_ctx;
+
+static void accumulate_delta_seg(void *vctx, int64_t c) {
+  ad_ctx *x = (ad_ctx *)vctx;
+  const stc_chunk *ch = &x->chunks[c];
+  int64_t i = ch->leaf;
+  float s = x->scales[i];
+  if (s == 0.0f) return;
+  accumulate_delta_range(x->delta + x->off[i], x->words + x->off[i] / 32,
+                         x->ns[i], s, ch->w0, ch->w1);
+}
+#endif
 
 /* Receiver half: accumulate K frames' deltas into delta[total]
  * (delta += s * (1 - 2*bit), reference src/sharedtensor.c:109), then the
  * caller adds delta to each target array. Splitting accumulate/apply keeps
  * the per-array work to one add pass regardless of K. */
-ST_CLONES
 EXPORT void stc_accumulate_delta(float *delta, const int64_t *off,
-                                 const int64_t *ns, const int64_t *padded_unused,
+                                 const int64_t *ns, const int64_t *padded,
                                  int64_t n_leaves, const float *scales,
                                  const uint32_t *words) {
-  (void)padded_unused;
+#ifdef ST_POOL
+  if (padded) {
+    int64_t total = 0;
+    int64_t nc = stc_count_chunks(padded, n_leaves, &total);
+    if (total >= ST_PAR_MIN_ELEMS) {
+      stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
+      if (chunks) {
+        stc_build_chunks(padded, n_leaves, chunks);
+        ad_ctx x = {delta, off, ns, scales, words, chunks};
+        int ran = stc_pool_run(accumulate_delta_seg, &x, nc);
+        free(chunks);
+        if (ran) return;
+      }
+    }
+  }
+#endif
+  (void)padded;
   for (int64_t i = 0; i < n_leaves; i++) {
     float s = scales[i];
     if (s == 0.0f) continue;
-    const uint32_t *w = words + off[i] / 32;
-    float *d = delta + off[i];
-    int64_t n = ns[i];
-    int64_t full = n / 32; /* whole words: branch-free, vectorizable */
-    int64_t k = 0;
-#ifdef ST_AVX512
-    if (st_has_avx512()) k = accumulate_leaf_avx512(d, w, full, s);
-#endif
-    for (; k < full; k++) {
-      uint32_t bits = w[k];
-      float *dd = d + k * 32;
-      float signs[32];
-      /* +/-s differ only in the IEEE sign bit: splice the codec bit in */
-      for (int b = 0; b < 32; b++) {
-        union { float f; uint32_t u; } u;
-        u.f = s;
-        u.u |= ((bits >> b) & 1u) << 31;
-        signs[b] = u.f;
-      }
-      for (int b = 0; b < 32; b++) dd[b] += signs[b];
-    }
-    if (n % 32) {
-      uint32_t bits = w[full];
-      int64_t base = full * 32;
-      for (int64_t b = 0; b < n - base; b++) {
-        d[base + b] += ((bits >> b) & 1u) ? -s : s;
-      }
-    }
+    accumulate_delta_range(delta + off[i], words + off[i] / 32, ns[i], s, 0,
+                           ns[i] / 32 + (ns[i] % 32 ? 1 : 0));
   }
 }
 
-/* values[i] += delta[i] for one target array (live lanes only — padding in
- * both is 0 by invariant, so a full-width add preserves it). Result clamped
- * to +/-3e38 like every other state-mutating path (ops/codec.SAT: no
- * absorbing inf/NaN state, any tier). Branchless min/max — vectorizes. */
 ST_CLONES
-EXPORT void stc_add_inplace(float *values, const float *delta, int64_t total) {
-  for (int64_t i = 0; i < total; i++) {
-    float s = values[i] + delta[i];
-    s = s > 3.0e38f ? 3.0e38f : s;
-    s = s < -3.0e38f ? -3.0e38f : s;
-    values[i] = s;
-  }
-}
-
-/* out[i] = clip(a[i] + delta[i]): the functional-update form of
- * stc_add_inplace. One pass instead of copy-then-add — at table sizes past
- * LLC the host tier is memory-bandwidth-bound and the extra copy pass was
- * ~1/3 of the apply cost (measured at 16 Mi elements). */
-ST_CLONES
-EXPORT void stc_add_to(float *out, const float *a, const float *delta,
-                       int64_t total) {
-  for (int64_t i = 0; i < total; i++) {
+static void add_to_range(float *out, const float *a, const float *delta,
+                         int64_t i0, int64_t i1) {
+  for (int64_t i = i0; i < i1; i++) {
     float s = a[i] + delta[i];
     s = s > 3.0e38f ? 3.0e38f : s;
     s = s < -3.0e38f ? -3.0e38f : s;
@@ -435,15 +826,59 @@ EXPORT void stc_add_to(float *out, const float *a, const float *delta,
   }
 }
 
+#ifdef ST_POOL
+/* flat elementwise split: fixed ST_CHUNK_ELEMS ranges over [0, total) */
+typedef struct {
+  float *out;
+  const float *a, *b;
+  int64_t total;
+  int op; /* 0 = add_to, 1 = accumulate_update */
+} ew_ctx;
+
+static void elementwise_seg(void *vctx, int64_t c);
+
+static int elementwise_par(int op, float *out, const float *a, const float *b,
+                           int64_t total) {
+  if (total < ST_PAR_MIN_ELEMS) return 0;
+  ew_ctx x = {out, a, b, total, op};
+  int64_t nseg = (total + ST_CHUNK_ELEMS - 1) / ST_CHUNK_ELEMS;
+  return stc_pool_run(elementwise_seg, &x, nseg);
+}
+#endif
+
+/* values[i] += delta[i] for one target array (live lanes only — padding in
+ * both is 0 by invariant, so a full-width add preserves it). Result clamped
+ * to +/-3e38 like every other state-mutating path (ops/codec.SAT: no
+ * absorbing inf/NaN state, any tier). Branchless min/max — vectorizes. */
+EXPORT void stc_add_inplace(float *values, const float *delta, int64_t total) {
+#ifdef ST_POOL
+  if (elementwise_par(0, values, values, delta, total)) return;
+#endif
+  add_to_range(values, values, delta, 0, total);
+}
+
+/* out[i] = clip(a[i] + delta[i]): the functional-update form of
+ * stc_add_inplace. One pass instead of copy-then-add — at table sizes past
+ * LLC the host tier is memory-bandwidth-bound and the extra copy pass was
+ * ~1/3 of the apply cost (measured at 16 Mi elements). */
+EXPORT void stc_add_to(float *out, const float *a, const float *delta,
+                       int64_t total) {
+#ifdef ST_POOL
+  if (elementwise_par(0, out, a, delta, total)) return;
+#endif
+  add_to_range(out, a, delta, 0, total);
+}
+
 #ifdef ST_AVX512
 ST_TARGET_AVX512
 static int64_t apply_leaf_avx512(const float *in, float *out,
-                                 const uint32_t *w, int64_t full, float s) {
+                                 const uint32_t *w, int64_t full, float s,
+                                 int64_t w0) {
   const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
   const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
   const __m512 vmax = _mm512_set1_ps(3.0e38f);
   const __m512 vmin = _mm512_set1_ps(-3.0e38f);
-  int64_t k = 0;
+  int64_t k = w0;
   for (; k < full; k++) {
     uint32_t bits = w[k];
     const float *pp = in + k * 32;
@@ -463,63 +898,108 @@ static int64_t apply_leaf_avx512(const float *in, float *out,
 }
 #endif
 
+/* out = clip(in + s*(1-2*bit)) over words [w0, w1) of one leaf; padding
+ * lanes inside the range are copied verbatim (0 by invariant). */
+ST_CLONES
+static void apply_frame_range(const float *in, float *out, const uint32_t *w,
+                              int64_t n, int64_t pad, float s, int64_t w0,
+                              int64_t w1) {
+  if (s == 0.0f) { /* idle leaf: pure copy */
+    memcpy(out + w0 * 32, in + w0 * 32, (size_t)(w1 - w0) * 32 * sizeof(float));
+    return;
+  }
+  int64_t full = n / 32;
+  if (full > w1) full = w1;
+  int64_t k = w0;
+#ifdef ST_AVX512
+  if (st_has_avx512()) k = apply_leaf_avx512(in, out, w, full, s, w0);
+#endif
+  for (; k < full; k++) {
+    uint32_t bits = w[k];
+    for (int b = 0; b < 32; b++) {
+      float v = in[k * 32 + b] + (((bits >> b) & 1u) ? -s : s);
+      v = v > 3.0e38f ? 3.0e38f : v;
+      v = v < -3.0e38f ? -3.0e38f : v;
+      out[k * 32 + b] = v;
+    }
+  }
+  int64_t base = full * 32;
+  if (n % 32 && n / 32 >= w0 && n / 32 < w1) {
+    base = (n / 32) * 32;
+    uint32_t bits = w[n / 32];
+    for (int64_t b = 0; b < n - base; b++) {
+      float v = in[base + b] + (((bits >> b) & 1u) ? -s : s);
+      v = v > 3.0e38f ? 3.0e38f : v;
+      v = v < -3.0e38f ? -3.0e38f : v;
+      out[base + b] = v;
+    }
+    for (int64_t b = n - base; b < 32 && base + b < pad; b++)
+      out[base + b] = in[base + b];
+    base += 32;
+  }
+  /* trailing pure-padding words of THIS range only (a chunk past the live
+   * data must not copy below its own w0 — that is another chunk's region) */
+  if (base < w0 * 32) base = w0 * 32;
+  int64_t end = w1 * 32;
+  if (base < end && base < pad) {
+    int64_t stop = end < pad ? end : pad;
+    if (stop > base)
+      memcpy(out + base, in + base, (size_t)(stop - base) * sizeof(float));
+  }
+}
+
+#ifdef ST_POOL
+typedef struct {
+  const float *vin;
+  float *vout;
+  const int64_t *off, *ns, *padded;
+  const float *scales;
+  const uint32_t *words;
+  const stc_chunk *chunks;
+} ap_ctx;
+
+static void apply_frame_seg(void *vctx, int64_t c) {
+  ap_ctx *x = (ap_ctx *)vctx;
+  const stc_chunk *ch = &x->chunks[c];
+  int64_t i = ch->leaf;
+  apply_frame_range(x->vin + x->off[i], x->vout + x->off[i],
+                    x->words + x->off[i] / 32, x->ns[i], x->padded[i],
+                    x->scales[i], ch->w0, ch->w1);
+}
+#endif
+
 /* Fully fused single-frame apply: out = clip(in + s*(1-2*bit)) in ONE pass,
  * no delta buffer, no copy — the K=1 receive path (the common case: one
  * incoming frame applied to values + each other link's residual). Padding
  * lanes beyond ns[i] are copied verbatim (0 by invariant). */
-ST_CLONES
 EXPORT void stc_apply_frame(const float *vin, float *vout, const int64_t *off,
                             const int64_t *ns, const int64_t *padded,
                             int64_t n_leaves, const float *scales,
                             const uint32_t *words) {
-  for (int64_t i = 0; i < n_leaves; i++) {
-    const float *in = vin + off[i];
-    float *out = vout + off[i];
-    const uint32_t *w = words + off[i] / 32;
-    int64_t n = ns[i], pad = padded[i];
-    float s = scales[i];
-    if (s == 0.0f) { /* idle leaf: pure copy */
-      memcpy(out, in, (size_t)pad * sizeof(float));
-      continue;
+#ifdef ST_POOL
+  int64_t total = 0;
+  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
+  if (total >= ST_PAR_MIN_ELEMS) {
+    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
+    if (chunks) {
+      stc_build_chunks(padded, n_leaves, chunks);
+      ap_ctx x = {vin, vout, off, ns, padded, scales, words, chunks};
+      int ran = stc_pool_run(apply_frame_seg, &x, nc);
+      free(chunks);
+      if (ran) return;
     }
-    int64_t full = n / 32;
-    int64_t k = 0;
-#ifdef ST_AVX512
-    if (st_has_avx512()) k = apply_leaf_avx512(in, out, w, full, s);
+  }
 #endif
-    for (; k < full; k++) {
-      uint32_t bits = w[k];
-      for (int b = 0; b < 32; b++) {
-        float v = in[k * 32 + b] + (((bits >> b) & 1u) ? -s : s);
-        v = v > 3.0e38f ? 3.0e38f : v;
-        v = v < -3.0e38f ? -3.0e38f : v;
-        out[k * 32 + b] = v;
-      }
-    }
-    int64_t base = full * 32;
-    if (n % 32) {
-      uint32_t bits = w[full];
-      for (int64_t b = 0; b < n - base; b++) {
-        float v = in[base + b] + (((bits >> b) & 1u) ? -s : s);
-        v = v > 3.0e38f ? 3.0e38f : v;
-        v = v < -3.0e38f ? -3.0e38f : v;
-        out[base + b] = v;
-      }
-      for (int64_t b = n - base; b < 32 && base + b < pad; b++)
-        out[base + b] = in[base + b];
-      base += 32;
-    }
-    if (base < pad)
-      memcpy(out + base, in + base, (size_t)(pad - base) * sizeof(float));
+  for (int64_t i = 0; i < n_leaves; i++) {
+    apply_frame_range(vin + off[i], vout + off[i], words + off[i] / 32, ns[i],
+                      padded[i], scales[i], 0, padded[i] / 32);
   }
 }
 
-/* Local additive update, sanitized (quirk Q9 fix — one NaN in the reference
- * poisons every replica through the flood): u is pre-masked by the caller;
- * NaN -> 0, +/-inf and sums clamped to +/-3e38. */
 ST_CLONES
-EXPORT void stc_accumulate_update(float *a, const float *u, int64_t total) {
-  for (int64_t i = 0; i < total; i++) {
+static void accumulate_update_range(float *a, const float *u, int64_t i0,
+                                    int64_t i1) {
+  for (int64_t i = i0; i < i1; i++) {
     float x = u[i];
     if (x != x) x = 0.0f; /* NaN */
     if (x > 3.0e38f) x = 3.0e38f;
@@ -531,32 +1011,481 @@ EXPORT void stc_accumulate_update(float *a, const float *u, int64_t total) {
   }
 }
 
+#ifdef ST_POOL
+static void elementwise_seg(void *vctx, int64_t c) {
+  ew_ctx *x = (ew_ctx *)vctx;
+  int64_t i0 = c * ST_CHUNK_ELEMS;
+  int64_t i1 = i0 + ST_CHUNK_ELEMS;
+  if (i1 > x->total) i1 = x->total;
+  if (x->op == 0)
+    add_to_range(x->out, x->a, x->b, i0, i1);
+  else
+    accumulate_update_range(x->out, x->b, i0, i1);
+}
+#endif
+
+/* Local additive update, sanitized (quirk Q9 fix — one NaN in the reference
+ * poisons every replica through the flood): u is pre-masked by the caller;
+ * NaN -> 0, +/-inf and sums clamped to +/-3e38. */
+EXPORT void stc_accumulate_update(float *a, const float *u, int64_t total) {
+#ifdef ST_POOL
+  if (elementwise_par(1, a, a, u, total)) return;
+#endif
+  accumulate_update_range(a, u, 0, total);
+}
+
+/* out = clip(a + sanitize(u)) on live lanes of elements [e0, e1) of one
+ * leaf (e0/e1 in padded coordinates); padding lanes in range copy from a.
+ * Optional partials of the RESULT (live lanes in range) — fusing them here
+ * makes a sender-side scale scan free whenever an add() already has to
+ * traverse the residual (stengine.cpp partials cache). */
+ST_CLONES
+static void accumulate_update_to_range(float *op, const float *ap,
+                                       const float *up, int64_t n, int64_t pad,
+                                       int64_t e0, int64_t e1, double *out_amax,
+                                       double *out_ss, double *out_sabs) {
+  double amax = 0, ssum = 0, sabs = 0;
+  int64_t live = n < e1 ? n : e1;
+  for (int64_t j = e0; j < live; j++) {
+    float x = up[j];
+    if (x != x) x = 0.0f; /* NaN */
+    if (x > 3.0e38f) x = 3.0e38f;
+    if (x < -3.0e38f) x = -3.0e38f;
+    float s = ap[j] + x;
+    if (s > 3.0e38f) s = 3.0e38f;
+    if (s < -3.0e38f) s = -3.0e38f;
+    op[j] = s;
+    if (out_amax) {
+      double d = s < 0 ? -(double)s : (double)s;
+      if (d > amax) amax = d;
+      ssum += (double)s * (double)s;
+      sabs += d;
+    }
+  }
+  int64_t cs = n > e0 ? n : e0;
+  if (cs < e1 && cs < pad) {
+    int64_t stop = e1 < pad ? e1 : pad;
+    if (stop > cs)
+      memcpy(op + cs, ap + cs, (size_t)(stop - cs) * sizeof(float));
+  }
+  if (out_amax) {
+    *out_amax = amax;
+    *out_ss = ssum;
+    *out_sabs = sabs;
+  }
+}
+
+#ifdef ST_POOL
+typedef struct {
+  float *vout;
+  const float *a, *u;
+  const int64_t *off, *ns, *padded;
+  const stc_chunk *chunks;
+  double *camax, *css, *csabs; /* NULL when no partials requested */
+} au_ctx;
+
+static void accumulate_update_to_seg(void *vctx, int64_t c) {
+  au_ctx *x = (au_ctx *)vctx;
+  const stc_chunk *ch = &x->chunks[c];
+  int64_t i = ch->leaf;
+  accumulate_update_to_range(
+      x->vout + x->off[i], x->a + x->off[i], x->u + x->off[i], x->ns[i],
+      x->padded[i], ch->w0 * 32, ch->w1 * 32,
+      x->camax ? &x->camax[c] : NULL, x->camax ? &x->css[c] : NULL,
+      x->camax ? &x->csabs[c] : NULL);
+}
+#endif
+
+static void accumulate_update_to_impl(float *vout, const float *a,
+                                      const float *u, const int64_t *off,
+                                      const int64_t *ns, const int64_t *padded,
+                                      int64_t n_leaves, double *out_amax,
+                                      double *out_ss, double *out_sabs) {
+#ifdef ST_POOL
+  int64_t total = 0;
+  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
+  if (total >= ST_PAR_MIN_ELEMS) {
+    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
+    double *pbuf =
+        out_amax ? (double *)malloc((size_t)nc * 3 * sizeof(double)) : NULL;
+    if (chunks && (!out_amax || pbuf)) {
+      stc_build_chunks(padded, n_leaves, chunks);
+      au_ctx x = {vout,   a,
+                  u,      off,
+                  ns,     padded,
+                  chunks, pbuf,
+                  pbuf ? pbuf + nc : NULL, pbuf ? pbuf + 2 * nc : NULL};
+      if (stc_pool_run(accumulate_update_to_seg, &x, nc)) {
+        if (out_amax)
+          reduce_chunk_partials(chunks, nc, n_leaves, x.camax, x.css, x.csabs,
+                                out_amax, out_ss, out_sabs);
+        free(chunks);
+        free(pbuf);
+        return;
+      }
+    }
+    free(chunks);
+    free(pbuf);
+  }
+#endif
+  for (int64_t i = 0; i < n_leaves; i++) {
+    accumulate_update_to_range(vout + off[i], a + off[i], u + off[i], ns[i],
+                               padded[i], 0, padded[i],
+                               out_amax ? &out_amax[i] : NULL,
+                               out_amax ? &out_ss[i] : NULL,
+                               out_amax ? &out_sabs[i] : NULL);
+  }
+}
+
 /* Functional one-pass form: out = clip(a + sanitize(u)) on live lanes,
  * out = a on padding (so a raw update's padding garbage never enters the
  * buffer — the caller no longer pre-masks or copies). Replaces the
  * copy-then-inplace pattern, which cost an extra full memory pass per
  * target array (the add path runs once per link residual plus the replica). */
-ST_CLONES
 EXPORT void stc_accumulate_update_to(float *vout, const float *a,
                                      const float *u, const int64_t *off,
                                      const int64_t *ns, const int64_t *padded,
                                      int64_t n_leaves) {
-  for (int64_t i = 0; i < n_leaves; i++) {
-    const float *ap = a + off[i];
-    const float *up = u + off[i];
-    float *op = vout + off[i];
-    int64_t n = ns[i], pad = padded[i];
-    for (int64_t j = 0; j < n; j++) {
-      float x = up[j];
-      if (x != x) x = 0.0f; /* NaN */
-      if (x > 3.0e38f) x = 3.0e38f;
-      if (x < -3.0e38f) x = -3.0e38f;
-      float s = ap[j] + x;
-      if (s > 3.0e38f) s = 3.0e38f;
-      if (s < -3.0e38f) s = -3.0e38f;
-      op[j] = s;
+  accumulate_update_to_impl(vout, a, u, off, ns, padded, n_leaves, NULL, NULL,
+                            NULL);
+}
+
+/* stc_accumulate_update_to + scale partials of the result in the same pass
+ * (the stengine.cpp per-link partials cache: an add() that already walks a
+ * residual refreshes its scale partials for free, killing the sender's
+ * standalone stc_scale_partials scan — at 16 Mi that scan was a full 64 MiB
+ * read per frame, 1/3 of the sender's memory traffic). */
+EXPORT void stc_accumulate_update_to_partials(
+    float *vout, const float *a, const float *u, const int64_t *off,
+    const int64_t *ns, const int64_t *padded, int64_t n_leaves,
+    double *out_amax, double *out_ss, double *out_sabs) {
+  accumulate_update_to_impl(vout, a, u, off, ns, padded, n_leaves, out_amax,
+                            out_ss, out_sabs);
+}
+
+/* ---- k-frame fused apply --------------------------------------------------
+ *
+ * out = clip(in + sum_f s_f*(1-2*bit_f)) in ONE pass over the target.
+ * The batched receive path previously accumulated k frames into a delta
+ * buffer (k read-modify-write passes over total*4 bytes) and then added the
+ * delta to each target — at 16 Mi that is k*128 MiB of traffic before any
+ * target is touched. This kernel reads each frame's PACKED words instead
+ * (k * total/8 bytes — 16x smaller) and visits the target once:
+ * per batch per target, 128 MiB + k*8 MiB instead of k*128 + 192 MiB.
+ *
+ * Bit-exact equivalence with both existing paths by construction:
+ *   - the +/-s_f sum accumulates from 0 in frame order, exactly the order
+ *     stc_accumulate_delta applied them to the delta buffer, and the final
+ *     add+clip matches stc_add_to's clip(a + delta);
+ *   - k == 1 reduces to clip(in +/- s), stc_apply_frame's arithmetic.
+ * Leaves where every frame's scale is zero are copied verbatim (the k == 1
+ * path's idle-leaf memcpy).
+ *
+ * Optional out_amax/out_ss/out_sabs (NULL ok): scale partials of the result,
+ * fused like stc_quantize_ef_partials — for residual targets whose next
+ * quantize needs them (stengine.cpp partials cache). */
+
+#ifdef ST_AVX512
+/* whole live words [w0, wl): m active (nonzero-scale) frames, per-frame
+ * splatted scale vectors prebuilt by the caller. */
+ST_TARGET_AVX512
+static int64_t apply_frames_avx512(const float *in, float *out,
+                                   const uint32_t *const *wps,
+                                   const float *svals, int m, int64_t wl,
+                                   int64_t w0, int do_part, double *amax,
+                                   double *ss, double *sabs) {
+  const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
+  const __m512 vmax = _mm512_set1_ps(3.0e38f);
+  const __m512 vmin = _mm512_set1_ps(-3.0e38f);
+  const __m512i vabsmask = _mm512_set1_epi32(0x7FFFFFFF);
+  __m512 vamax = _mm512_setzero_ps();
+  __m512d vss0 = _mm512_setzero_pd(), vss1 = _mm512_setzero_pd();
+  __m512d vsa0 = _mm512_setzero_pd(), vsa1 = _mm512_setzero_pd();
+  int64_t w = w0;
+  for (; w < wl; w++) {
+    __m512 acc0 = _mm512_setzero_ps();
+    __m512 acc1 = _mm512_setzero_ps();
+    for (int f = 0; f < m; f++) {
+      uint32_t bits = wps[f][w];
+      const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(svals[f]));
+      __mmask16 m0 = (__mmask16)bits;
+      __mmask16 m1 = (__mmask16)(bits >> 16);
+      acc0 = _mm512_add_ps(
+          acc0, _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m0, vs, vsign)));
+      acc1 = _mm512_add_ps(
+          acc1, _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m1, vs, vsign)));
     }
-    if (n < pad)
-      memcpy(op + n, ap + n, (size_t)(pad - n) * sizeof(float));
+    const float *pp = in + w * 32;
+    float *qq = out + w * 32;
+    __m512 r0 = _mm512_add_ps(_mm512_loadu_ps(pp), acc0);
+    __m512 r1 = _mm512_add_ps(_mm512_loadu_ps(pp + 16), acc1);
+    r0 = _mm512_max_ps(_mm512_min_ps(r0, vmax), vmin);
+    r1 = _mm512_max_ps(_mm512_min_ps(r1, vmax), vmin);
+    _mm512_storeu_ps(qq, r0);
+    _mm512_storeu_ps(qq + 16, r1);
+    if (do_part) {
+      __m512 a0 = _mm512_castsi512_ps(
+          _mm512_and_epi32(_mm512_castps_si512(r0), vabsmask));
+      __m512 a1 = _mm512_castsi512_ps(
+          _mm512_and_epi32(_mm512_castps_si512(r1), vabsmask));
+      vamax = _mm512_max_ps(vamax, _mm512_max_ps(a0, a1));
+      __m512d lo0 = _mm512_cvtps_pd(_mm512_castps512_ps256(r0));
+      __m512d hi0 = _mm512_cvtps_pd(_mm512_extractf32x8_ps(r0, 1));
+      __m512d lo1 = _mm512_cvtps_pd(_mm512_castps512_ps256(r1));
+      __m512d hi1 = _mm512_cvtps_pd(_mm512_extractf32x8_ps(r1, 1));
+      vss0 = _mm512_fmadd_pd(lo0, lo0, vss0);
+      vss1 = _mm512_fmadd_pd(hi0, hi0, vss1);
+      vss0 = _mm512_fmadd_pd(lo1, lo1, vss0);
+      vss1 = _mm512_fmadd_pd(hi1, hi1, vss1);
+      vsa0 = _mm512_add_pd(vsa0, _mm512_cvtps_pd(_mm512_castps512_ps256(a0)));
+      vsa1 =
+          _mm512_add_pd(vsa1, _mm512_cvtps_pd(_mm512_extractf32x8_ps(a0, 1)));
+      vsa0 = _mm512_add_pd(vsa0, _mm512_cvtps_pd(_mm512_castps512_ps256(a1)));
+      vsa1 =
+          _mm512_add_pd(vsa1, _mm512_cvtps_pd(_mm512_extractf32x8_ps(a1, 1)));
+    }
+  }
+  if (do_part) {
+    *amax = _mm512_reduce_max_ps(vamax);
+    *ss = _mm512_reduce_add_pd(vss0) + _mm512_reduce_add_pd(vss1);
+    *sabs = _mm512_reduce_add_pd(vsa0) + _mm512_reduce_add_pd(vsa1);
+  }
+  return w;
+}
+#endif
+
+/* One leaf's words [w0, w1): m active frames with word pointers wps[] and
+ * scales svals[]. Partials (when requested) cover live lanes in range. */
+ST_CLONES
+static void apply_frames_range(const float *in, float *out,
+                               const uint32_t *const *wps, const float *svals,
+                               int m, int64_t n, int64_t pad, int64_t w0,
+                               int64_t w1, double *out_amax, double *out_ss,
+                               double *out_sabs) {
+  double amax = 0, ssum = 0, sabs = 0;
+  int64_t full = n / 32;
+  if (full > w1) full = w1;
+  int64_t k = w0;
+  int do_part = out_amax != NULL;
+#ifdef ST_AVX512
+  if (st_has_avx512() && k < full) {
+    double a2 = 0, s2 = 0, b2 = 0;
+    k = apply_frames_avx512(in, out, wps, svals, m, full, w0, do_part, &a2,
+                            &s2, &b2);
+    if (do_part) {
+      amax = a2;
+      ssum = s2;
+      sabs = b2;
+    }
+  }
+#endif
+  for (; k < full; k++) {
+    for (int b = 0; b < 32; b++) {
+      float acc = 0.0f;
+      for (int f = 0; f < m; f++) {
+        float s = svals[f];
+        acc += ((wps[f][k] >> b) & 1u) ? -s : s;
+      }
+      float v = in[k * 32 + b] + acc;
+      v = v > 3.0e38f ? 3.0e38f : v;
+      v = v < -3.0e38f ? -3.0e38f : v;
+      out[k * 32 + b] = v;
+      if (do_part) {
+        double a = v < 0 ? -(double)v : (double)v;
+        if (a > amax) amax = a;
+        ssum += (double)v * (double)v;
+        sabs += a;
+      }
+    }
+  }
+  int64_t base = full * 32;
+  if (n % 32 && n / 32 >= w0 && n / 32 < w1) {
+    base = (n / 32) * 32;
+    int64_t pw = n / 32;
+    for (int64_t b = 0; b < n - base; b++) {
+      float acc = 0.0f;
+      for (int f = 0; f < m; f++) {
+        float s = svals[f];
+        acc += ((wps[f][pw] >> b) & 1u) ? -s : s;
+      }
+      float v = in[base + b] + acc;
+      v = v > 3.0e38f ? 3.0e38f : v;
+      v = v < -3.0e38f ? -3.0e38f : v;
+      out[base + b] = v;
+      if (do_part) {
+        double a = v < 0 ? -(double)v : (double)v;
+        if (a > amax) amax = a;
+        ssum += (double)v * (double)v;
+        sabs += a;
+      }
+    }
+    for (int64_t b = n - base; b < 32 && base + b < pad; b++)
+      out[base + b] = in[base + b];
+    base += 32;
+  }
+  if (base < w0 * 32) base = w0 * 32;
+  int64_t end = w1 * 32;
+  if (base < end && base < pad) {
+    int64_t stop = end < pad ? end : pad;
+    if (stop > base)
+      memcpy(out + base, in + base, (size_t)(stop - base) * sizeof(float));
+  }
+  if (out_amax) {
+    *out_amax = amax;
+    *out_ss = ssum;
+    *out_sabs = sabs;
+  }
+}
+
+/* idle-leaf range: copy + optional partials of the (unchanged) live lanes */
+ST_CLONES
+static void copy_partials_range(const float *in, float *out, int64_t n,
+                                int64_t pad, int64_t e0, int64_t e1,
+                                double *out_amax, double *out_ss,
+                                double *out_sabs) {
+  if (out != in) {
+    int64_t stop = e1 < pad ? e1 : pad;
+    if (stop > e0)
+      memcpy(out + e0, in + e0, (size_t)(stop - e0) * sizeof(float));
+  }
+  if (out_amax) {
+    int64_t live = n < e1 ? n : e1;
+    scale_partials_range(out, e0 < live ? e0 : live, live, out_amax, out_ss,
+                         out_sabs);
+  }
+}
+
+typedef struct {
+  const float *vin;
+  float *vout;
+  const int64_t *off, *ns, *padded;
+  int64_t W;
+  int32_t k;
+  const float *scales;
+  const uint32_t *words;
+  double *camax, *css, *csabs;
+#ifdef ST_POOL
+  const stc_chunk *chunks;
+#endif
+  /* per-leaf active-frame table, built once by the wrapper: for leaf i,
+   * frames af[i*k .. i*k+am[i]) are the nonzero-scale ones */
+  const uint32_t *const *wps; /* [L * k] word pointers */
+  const float *svals;         /* [L * k] scales */
+  const int32_t *am;          /* [L] active counts */
+} af_ctx;
+
+static void apply_frames_leaf_range(af_ctx *x, int64_t i, int64_t w0,
+                                    int64_t w1, double *pa, double *ps,
+                                    double *pb) {
+  int m = x->am[i];
+  if (m == 0) {
+    copy_partials_range(x->vin + x->off[i], x->vout + x->off[i], x->ns[i],
+                        x->padded[i], w0 * 32, w1 * 32, pa, ps, pb);
+    return;
+  }
+  apply_frames_range(x->vin + x->off[i], x->vout + x->off[i],
+                     x->wps + (size_t)i * x->k, x->svals + (size_t)i * x->k, m,
+                     x->ns[i], x->padded[i], w0, w1, pa, ps, pb);
+}
+
+#ifdef ST_POOL
+static void apply_frames_seg(void *vctx, int64_t c) {
+  af_ctx *x = (af_ctx *)vctx;
+  const stc_chunk *ch = &x->chunks[c];
+  apply_frames_leaf_range(x, ch->leaf, ch->w0, ch->w1,
+                          x->camax ? &x->camax[c] : NULL,
+                          x->camax ? &x->css[c] : NULL,
+                          x->camax ? &x->csabs[c] : NULL);
+}
+#endif
+
+EXPORT void stc_apply_frames(const float *vin, float *vout, const int64_t *off,
+                             const int64_t *ns, const int64_t *padded,
+                             int64_t n_leaves, int64_t W, int32_t k,
+                             const float *scales /* k*L */,
+                             const uint32_t *words /* k*W */,
+                             double *out_amax, double *out_ss,
+                             double *out_sabs) {
+  if (k <= 0) return;
+  /* active-frame table: per leaf, the frames whose scale is nonzero */
+  const uint32_t **wps =
+      (const uint32_t **)malloc((size_t)n_leaves * k * sizeof(uint32_t *));
+  float *svals = (float *)malloc((size_t)n_leaves * k * sizeof(float));
+  int32_t *am = (int32_t *)malloc((size_t)n_leaves * sizeof(int32_t));
+  if (!wps || !svals || !am) { /* OOM: fall back to frame-at-a-time */
+    free(wps);
+    free(svals);
+    free(am);
+    for (int32_t f = 0; f < k; f++)
+      stc_apply_frame(f == 0 ? vin : vout, vout, off, ns, padded, n_leaves,
+                      scales + (size_t)f * n_leaves, words + (size_t)f * W);
+    if (out_amax)
+      stc_scale_partials(vout, off, ns, n_leaves, out_amax, out_ss, out_sabs);
+    return;
+  }
+  for (int64_t i = 0; i < n_leaves; i++) {
+    int32_t m = 0;
+    for (int32_t f = 0; f < k; f++) {
+      float s = scales[(size_t)f * n_leaves + i];
+      if (s == 0.0f) continue;
+      wps[(size_t)i * k + m] = words + (size_t)f * W + off[i] / 32;
+      svals[(size_t)i * k + m] = s;
+      m++;
+    }
+    am[i] = m;
+  }
+  af_ctx x;
+  x.vin = vin;
+  x.vout = vout;
+  x.off = off;
+  x.ns = ns;
+  x.padded = padded;
+  x.W = W;
+  x.k = k;
+  x.scales = scales;
+  x.words = words;
+  x.camax = NULL;
+  x.css = NULL;
+  x.csabs = NULL;
+  x.wps = wps;
+  x.svals = svals;
+  x.am = am;
+#ifdef ST_POOL
+  int64_t total = 0;
+  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
+  if (total >= ST_PAR_MIN_ELEMS) {
+    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
+    double *pbuf =
+        out_amax ? (double *)malloc((size_t)nc * 3 * sizeof(double)) : NULL;
+    if (chunks && (!out_amax || pbuf)) {
+      stc_build_chunks(padded, n_leaves, chunks);
+      x.chunks = chunks;
+      x.camax = pbuf;
+      x.css = pbuf ? pbuf + nc : NULL;
+      x.csabs = pbuf ? pbuf + 2 * nc : NULL;
+      if (stc_pool_run(apply_frames_seg, &x, nc)) {
+        if (out_amax)
+          reduce_chunk_partials(chunks, nc, n_leaves, x.camax, x.css, x.csabs,
+                                out_amax, out_ss, out_sabs);
+        free(chunks);
+        free(pbuf);
+        free(wps);
+        free(svals);
+        free(am);
+        return;
+      }
+      x.camax = NULL;
+      x.css = NULL;
+      x.csabs = NULL;
+    }
+    free(chunks);
+    free(pbuf);
+  }
+#endif
+  for (int64_t i = 0; i < n_leaves; i++) {
+    apply_frames_leaf_range(&x, i, 0, padded[i] / 32,
+                            out_amax ? &out_amax[i] : NULL,
+                            out_amax ? &out_ss[i] : NULL,
+                            out_amax ? &out_sabs[i] : NULL);
   }
 }
