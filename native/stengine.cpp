@@ -72,6 +72,13 @@ void stc_apply_frame(const float*, float*, const int64_t*, const int64_t*,
 void stc_accumulate_update_to(float*, const float*, const float*,
                               const int64_t*, const int64_t*, const int64_t*,
                               int64_t);
+void stc_accumulate_update_to_partials(float*, const float*, const float*,
+                                       const int64_t*, const int64_t*,
+                                       const int64_t*, int64_t, double*,
+                                       double*, double*);
+void stc_apply_frames(const float*, float*, const int64_t*, const int64_t*,
+                      const int64_t*, int64_t, int64_t, int32_t, const float*,
+                      const uint32_t*, double*, double*, double*);
 // sttransport.cpp
 int32_t st_node_send(void*, int32_t, const uint8_t*, int32_t, double);
 int32_t st_node_recv(void*, int32_t, uint8_t*, int32_t, double);
@@ -106,6 +113,14 @@ struct ELink {
   uint64_t ack_sent = 0;   // highest ACK value actually delivered
   bool dirty = true;       // residual may quantize to something nonzero
   bool dead = false;       // transport reported death; stop touching
+  // Scale-partials cache for this residual: every pass that already walks
+  // the residual (quantize, flood apply, add) refreshes it fused, so the
+  // sender's standalone stc_scale_partials scan — a full-table read per
+  // message, 1/3 of sender traffic at 16 Mi — only runs after the rare
+  // writes that bypass the fused kernels (rollback, restore). pvalid
+  // guards staleness; all access under Engine::mu.
+  std::vector<double> pamax, pss, psabs;
+  bool pvalid = false;
 };
 
 struct Engine {
@@ -170,29 +185,29 @@ struct Engine {
 // scale = policy(partials); zero when the leaf is all-zero or the result is
 // non-finite. Same math as ops/codec_np.compute_scales_np's native branch:
 // double math, cast to f32, pow2-floor by exponent mask.
-void scales_from_partials(Engine* e, std::vector<double>& amax,
-                          std::vector<double>& ss, std::vector<double>& sabs,
-                          float* out) {
+void scales_from_partials(Engine* e, const std::vector<double>& amax,
+                          const std::vector<double>& ss,
+                          const std::vector<double>& sabs, float* out) {
+  // NON-mutating (the inputs may be a link's partials cache): the
+  // aggregate for per_leaf == false lives in locals.
+  double g_am = 0, g_s2 = 0, g_sa = 0;
   if (!e->per_leaf) {
-    double am = 0, s2 = 0, sa = 0;
     for (int64_t i = 0; i < e->L; i++) {
-      if (amax[i] > am) am = amax[i];
-      s2 += ss[i];
-      sa += sabs[i];
-    }
-    for (int64_t i = 0; i < e->L; i++) {
-      amax[i] = am;
-      ss[i] = s2;
-      sabs[i] = sa;
+      if (amax[i] > g_am) g_am = amax[i];
+      g_s2 += ss[i];
+      g_sa += sabs[i];
     }
   }
   for (int64_t i = 0; i < e->L; i++) {
     double n = e->per_leaf ? (double)e->ns[i] : (double)e->total_n;
+    double am = e->per_leaf ? amax[i] : g_am;
+    double s2 = e->per_leaf ? ss[i] : g_s2;
+    double sa = e->per_leaf ? sabs[i] : g_sa;
     float s;
     if (e->policy == kAbsMean) {
-      s = (float)(sabs[i] / n);
+      s = (float)(sa / n);
     } else {
-      s = (float)std::sqrt(ss[i] / n);
+      s = (float)std::sqrt(s2 / n);
       if (e->policy == kPow2Rms) {
         union {
           float f;
@@ -203,7 +218,7 @@ void scales_from_partials(Engine* e, std::vector<double>& amax,
         s = b.f;
       }
     }
-    out[i] = (amax[i] > 0 && std::isfinite(s)) ? s : 0.0f;
+    out[i] = (am > 0 && std::isfinite(s)) ? s : 0.0f;
   }
 }
 
@@ -226,6 +241,7 @@ void rollback_unacked(Engine* e, ELink& lk) {
     }
   }
   lk.unacked.clear();
+  lk.pvalid = false;  // rollback bypasses the fused-partials kernels
 }
 
 // Apply k decoded frames from `src_link` to the replica and every OTHER
@@ -245,43 +261,35 @@ void apply_batch(Engine* e, int32_t src_link, int32_t k, const float* scales,
   // frame here would read as a phantom discrepancy exactly when an
   // operator is debugging a corrupt link.
   uint64_t applied = 0;
-  if (k == 1) {
-    if (!any_nonzero(scales, e->L)) return;
-    applied = 1;
-    // fused single-frame path: one clamped pass per target, no delta buffer
-    stc_apply_frame(e->values.data(), e->values.data(), e->off.data(),
-                    e->ns.data(), e->padded.data(), e->L, scales, words);
-    for (auto& kv : e->links) {
-      if (kv.first == src_link) continue;
-      stc_apply_frame(kv.second.resid.data(), kv.second.resid.data(),
-                      e->off.data(), e->ns.data(), e->padded.data(), e->L,
-                      scales, words);
-      kv.second.dirty = true;
+  for (int32_t f = 0; f < k; f++)
+    if (any_nonzero(scales + (size_t)f * e->L, e->L)) applied++;
+  if (applied == 0) return;
+  // k-frame fused apply (stc_apply_frames): ONE pass per target regardless
+  // of k — no delta buffer (the old k>1 path paid k read-modify-write
+  // passes over a total*4 delta before touching any target; at 16 Mi that
+  // was k*128 MiB of traffic). Residual targets refresh their scale-
+  // partials cache in the same pass (see ELink::pvalid).
+  stc_apply_frames(e->values.data(), e->values.data(), e->off.data(),
+                   e->ns.data(), e->padded.data(), e->L, e->W, k, scales,
+                   words, nullptr, nullptr, nullptr);
+  for (auto& kv : e->links) {
+    if (kv.first == src_link) continue;
+    ELink& lk = kv.second;
+    if ((int64_t)lk.pamax.size() != e->L) {
+      lk.pamax.resize((size_t)e->L);
+      lk.pss.resize((size_t)e->L);
+      lk.psabs.resize((size_t)e->L);
     }
-  } else {
-    std::vector<float> delta((size_t)e->total, 0.0f);
-    for (int32_t f = 0; f < k; f++) {
-      const float* row = scales + (size_t)f * e->L;
-      if (!any_nonzero(row, e->L)) continue;
-      applied++;
-      stc_accumulate_delta(delta.data(), e->off.data(), e->ns.data(),
-                           e->padded.data(), e->L, row,
-                           words + (size_t)f * e->W);
-    }
-    stc_add_to(e->values.data(), e->values.data(), delta.data(), e->total);
-    for (auto& kv : e->links) {
-      if (kv.first == src_link) continue;
-      stc_add_to(kv.second.resid.data(), kv.second.resid.data(), delta.data(),
-                 e->total);
-      kv.second.dirty = true;
-    }
-    if (e->has_carry)
-      stc_add_to(e->carry.data(), e->carry.data(), delta.data(), e->total);
+    stc_apply_frames(lk.resid.data(), lk.resid.data(), e->off.data(),
+                     e->ns.data(), e->padded.data(), e->L, e->W, k, scales,
+                     words, lk.pamax.data(), lk.pss.data(), lk.psabs.data());
+    lk.pvalid = true;
+    lk.dirty = true;
   }
-  if (k == 1 && e->has_carry) {
-    stc_apply_frame(e->carry.data(), e->carry.data(), e->off.data(),
-                    e->ns.data(), e->padded.data(), e->L, scales, words);
-  }
+  if (e->has_carry)
+    stc_apply_frames(e->carry.data(), e->carry.data(), e->off.data(),
+                     e->ns.data(), e->padded.data(), e->L, e->W, k, scales,
+                     words, nullptr, nullptr, nullptr);
   e->frames_in += applied;
 }
 
@@ -319,13 +327,29 @@ void sender_loop(Engine* e) {
         ELink& lk2 = it->second;
         if (!lk2.dirty) continue;
         // quantize up to `burst` successive halvings of the residual,
-        // stopping at the first all-zero-scale frame (idle). Frame b's
-        // quantize pass accumulates the scale partials frame b+1 needs
-        // (stc_quantize_ef_partials) — one memory pass per frame instead
-        // of quantize-then-rescan; only frame 0 pays a standalone scan.
+        // stopping at the first all-zero-scale frame (idle). EVERY quantize
+        // pass accumulates the residual's scale partials fused
+        // (stc_quantize_ef_partials) — one memory pass per frame instead of
+        // quantize-then-rescan. Frame 0's partials come from the link's
+        // cache when valid (refreshed by the fused add/flood passes), so
+        // the standalone stc_scale_partials scan only runs after the rare
+        // writes that bypass the fused kernels (rollback, restore) — at
+        // 16 Mi / burst cap 1 that scan was a full 64 MiB read per message.
         msg.nframes = 0;
-        stc_scale_partials(lk2.resid.data(), e->off.data(), e->ns.data(),
-                           e->L, amax.data(), ss.data(), sabs.data());
+        if ((int64_t)lk2.pamax.size() != e->L) {
+          lk2.pamax.resize((size_t)e->L);
+          lk2.pss.resize((size_t)e->L);
+          lk2.psabs.resize((size_t)e->L);
+          lk2.pvalid = false;
+        }
+        if (lk2.pvalid) {
+          std::copy(lk2.pamax.begin(), lk2.pamax.end(), amax.begin());
+          std::copy(lk2.pss.begin(), lk2.pss.end(), ss.begin());
+          std::copy(lk2.psabs.begin(), lk2.psabs.end(), sabs.begin());
+        } else {
+          stc_scale_partials(lk2.resid.data(), e->off.data(), e->ns.data(),
+                             e->L, amax.data(), ss.data(), sabs.data());
+        }
         for (int b = 0; b < e->burst; b++) {
           scales_from_partials(e, amax, ss, sabs, scales.data());
           if (!any_nonzero(scales.data(), e->L)) {
@@ -337,20 +361,20 @@ void sender_loop(Engine* e) {
           msg.words.resize(base_w + (size_t)e->W);
           std::memcpy(msg.scales.data() + base_s, scales.data(),
                       (size_t)e->L * 4);
-          if (b + 1 < e->burst) {
-            stc_quantize_ef_partials(
-                lk2.resid.data(), lk2.resid.data(), e->off.data(),
-                e->ns.data(), e->padded.data(), e->L, scales.data(),
-                msg.words.data() + base_w, amax.data(), ss.data(),
-                sabs.data());
-          } else {
-            // last frame of the burst: nobody consumes its partials
-            stc_quantize(lk2.resid.data(), lk2.resid.data(), e->off.data(),
-                         e->ns.data(), e->padded.data(), e->L, scales.data(),
-                         msg.words.data() + base_w);
-          }
+          stc_quantize_ef_partials(
+              lk2.resid.data(), lk2.resid.data(), e->off.data(),
+              e->ns.data(), e->padded.data(), e->L, scales.data(),
+              msg.words.data() + base_w, amax.data(), ss.data(),
+              sabs.data());
           msg.nframes++;
         }
+        // amax/ss/sabs now hold the post-quantize residual's partials
+        // (whether any frame was emitted or not): seed the cache for the
+        // next message.
+        std::copy(amax.begin(), amax.end(), lk2.pamax.begin());
+        std::copy(ss.begin(), ss.end(), lk2.pss.begin());
+        std::copy(sabs.begin(), sabs.end(), lk2.psabs.begin());
+        lk2.pvalid = true;
         if (msg.nframes == 0) continue;
         e->frames_out += (uint64_t)msg.nframes;
         // ledger entry BEFORE the send: the receiver's ACK must never race
@@ -426,6 +450,7 @@ void sender_loop(Engine* e) {
                               e->ns.data(), e->padded.data(), e->L,
                               msg.scales.data() + (size_t)f * e->L,
                               msg.words.data() + (size_t)f * e->W);
+            it->second.pvalid = false;  // inline rollback bypasses the cache
           } else {
             rollback_unacked(e, it->second);
           }
@@ -673,12 +698,21 @@ __attribute__((visibility("default"))) void st_engine_add(void* h,
                              e->off.data(), e->ns.data(), e->padded.data(),
                              e->L);
     // dead links included: their residual is the re-graft carry (see
-    // apply_batch)
+    // apply_batch). The fused-partials form refreshes each link's scale
+    // cache in the same pass (ELink::pvalid).
     for (auto& kv : e->links) {
-      stc_accumulate_update_to(kv.second.resid.data(), kv.second.resid.data(),
-                               u, e->off.data(), e->ns.data(),
-                               e->padded.data(), e->L);
-      kv.second.dirty = true;
+      ELink& lk2 = kv.second;
+      if ((int64_t)lk2.pamax.size() != e->L) {
+        lk2.pamax.resize((size_t)e->L);
+        lk2.pss.resize((size_t)e->L);
+        lk2.psabs.resize((size_t)e->L);
+      }
+      stc_accumulate_update_to_partials(
+          lk2.resid.data(), lk2.resid.data(), u, e->off.data(), e->ns.data(),
+          e->padded.data(), e->L, lk2.pamax.data(), lk2.pss.data(),
+          lk2.psabs.data());
+      lk2.pvalid = true;
+      lk2.dirty = true;
     }
     if (e->has_carry)
       stc_accumulate_update_to(e->carry.data(), e->carry.data(), u,
@@ -910,6 +944,7 @@ __attribute__((visibility("default"))) void st_engine_restore(
       std::memcpy(it->second.resid.data(), resids + (size_t)i * e->total,
                   (size_t)e->total * 4);
       it->second.dirty = true;
+      it->second.pvalid = false;  // restore bypasses the fused kernels
     }
   }
   ((Engine*)h)->wake();

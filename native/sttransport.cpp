@@ -754,6 +754,15 @@ int32_t st_node_listen_port(void* h) {
 int32_t st_node_send(void* h, int32_t link_id, const uint8_t* data,
                      int32_t len, double timeout_sec) {
   auto* node = (Node*)h;
+  // Compat payload contract: K >= 1 whole reference frames, exactly
+  // K * compat_frame_bytes. The sender loop's frames_out accounting
+  // divides by compat_frame_bytes (integer), and the receiver re-frames
+  // the stream in fixed-size chunks — a non-multiple payload would both
+  // undercount silently and shear every later frame boundary on the
+  // receiver, so reject it at the enqueue boundary.
+  if (node->cfg.wire_compat && node->cfg.compat_frame_bytes > 0 &&
+      (len <= 0 || len % node->cfg.compat_frame_bytes != 0))
+    return -1;
   std::shared_ptr<Link> link;
   {
     std::lock_guard<std::mutex> lk(node->mu);

@@ -760,153 +760,170 @@ class SharedTensorPeer:
         evs = self.node.poll_events(timeout=0.0)
         for ev in evs:
             if ev.kind == EventKind.LINK_UP:
-                if ev.is_uplink:
-                    self._uplink = ev.link_id
-                    # a re-grafted uplink supersedes any earlier isolation
-                    # verdict (REJOIN_FAILED is a status, not a sentence —
-                    # the native layer keeps retrying and may heal)
-                    self._error = None
-                    if self.config.transport.wire_compat:
-                        # reference protocol has no handshake: start
-                        # streaming at once — into the carried residual
-                        # when re-grafting (our undelivered mass), else
-                        # zero. A re-grafting leaf resets its replica NOW
-                        # to EXACTLY the carry (fresh-joiner semantics: a
-                        # true fresh joiner with pending adds holds them in
-                        # values AND residual; the parent's re-seed then
-                        # refills tree state additively on top). Resetting
-                        # to zero instead would desync this node by the
-                        # carry forever: the carry floods to every OTHER
-                        # peer, and split horizon never returns it here —
-                        # see the LINK_DOWN comment.
-                        if self._compat_reset_on_regraft:
-                            self._compat_reset_on_regraft = False
-                            if self._engine is not None:
-                                self._engine.compat_regraft(ev.link_id)
-                            else:
-                                self.st.regraft_reset_to_carry(
-                                    CARRY_LINK, ev.link_id
-                                )
-                        elif self._engine is not None:
-                            # interior re-graft (or first join): residual =
-                            # carry + anything added since the consume —
-                            # attach-by-diff recomputes against live values,
-                            # so the two-step consume/attach loses nothing
-                            carry, snap = self._engine.take_carry_and_snapshot()
-                            if carry is not None:
-                                self._engine.new_link_diff(
-                                    ev.link_id, np.asarray(snap - carry, "<f4")
-                                )
-                            else:
-                                self._engine.new_link(ev.link_id, seed=False)
-                        else:
-                            carry, _ = self.st.take_link_and_snapshot(
-                                CARRY_LINK
-                            )
-                            self.st.new_link(
-                                ev.link_id, seed=False, residual=carry
-                            )
-                        if self._engine is not None:
-                            self._engine_links.add(ev.link_id)
-                    else:
-                        self._start_join(ev.link_id)
-                else:
-                    if self.config.transport.wire_compat:
-                        # reference join: seed the child with the full replica
-                        # through the codec stream (src/sharedtensor.c:379-381)
-                        if self._engine is not None:
-                            self._engine.new_link(ev.link_id, seed=True)
-                            self._engine_links.add(ev.link_id)
-                        else:
-                            self.st.new_link(ev.link_id, seed=True)
-                    else:
-                        # native: wait for the child's SYNC snapshot before
-                        # opening the codec link
-                        self._pending[ev.link_id] = bytearray()
-            elif ev.kind == EventKind.LINK_DOWN:
-                self._pending.pop(ev.link_id, None)
-                self._engine_links.discard(ev.link_id)
-                with self._ack_mu:
-                    self._unacked.pop(ev.link_id, None)
-                    self._acked.pop(ev.link_id, None)
-                    self._rx_count.pop(ev.link_id, None)
-                    self._ack_sent.pop(ev.link_id, None)
-                if ev.is_uplink:
-                    # Keep undelivered upward updates for the re-grafted
-                    # uplink — in a LIVE carry slot that continues to absorb
-                    # add()/flood mass while we are orphaned (see
-                    # CARRY_LINK). If the parent died mid-handshake the
-                    # codec link never existed; everything we owe the tree
-                    # is then replica - sent_snapshot, computed LAZILY at
-                    # re-join time so orphan-period adds are included.
-                    if self._engine is not None:
-                        stashed = self._engine.stash_carry(ev.link_id)
-                    else:
-                        # one lock: a concurrent add() must find either the
-                        # dying link or the carry slot, never neither
-                        stashed = self.st.stash_carry(ev.link_id, CARRY_LINK)
-                    if not stashed and self._sent_snapshot is not None:
-                        self._mid_handshake_base = self._sent_snapshot
-                    self._sent_snapshot = None
-                    self._uplink = None
-                    if self.config.transport.wire_compat:
-                        # The reference protocol cannot express a stateful
-                        # re-graft: the new parent will re-seed us with its
-                        # FULL replica (no diff handshake exists), so
-                        # retained state would double. A LEAF therefore
-                        # zeroes its replica — but only AT the re-graft
-                        # (LINK_UP below), never here: rejoin may instead
-                        # end in BECAME_MASTER, where our retained state IS
-                        # the authoritative seed and zeroing it would serve
-                        # an empty tree. With children the reset would
-                        # double THEM (their state stays while our
-                        # seed-refill floods down), so an interior node
-                        # keeps state and accepts the documented
-                        # double-count — still strictly better than the
-                        # reference, which kills the whole tree (quirk Q8).
-                        # (the carry pseudo-slot is not a real link)
-                        real = [l for l in self.st.link_ids if l >= 0]
-                        if not real:
-                            self._compat_reset_on_regraft = True
-                        else:
-                            log.warning(
-                                "wire-compat interior node lost its uplink:"
-                                " re-seeded state may double (the reference"
-                                " protocol has no diff handshake)"
-                            )
-                else:
-                    self.st.drop_link(ev.link_id)
-            elif ev.kind == EventKind.BECAME_MASTER:
-                # our parent died and rejoin found nobody: we claimed the
-                # rendezvous and are the new root (native master failover);
-                # whatever state we hold is now the authoritative seed —
-                # including in wire-compat, where a pending re-graft reset
-                # must be cancelled (zeroing the new root would serve an
-                # empty tree). The carry is DROPPED: its mass is already in
-                # our (now-authoritative) replica, a root never re-joins
-                # upward, and a live-but-unconsumable carry would cost an
-                # extra O(total) pass on every add/apply forever.
-                if self._engine is not None:
-                    self._engine.drop_carry()
-                else:
-                    self.st.take_link_and_snapshot(CARRY_LINK)
-                self._mid_handshake_base = None
-                self._compat_reset_on_regraft = False
-                self._uplink = None
-                self.is_master = True
-                self._error = None
-                self._ready.set()
-            elif ev.kind == EventKind.REJOIN_FAILED:
-                # Status, not a sentence: the native layer keeps cycling
-                # join-then-claim-rendezvous forever; under detection skew a
-                # sibling may claim the rendezvous seconds after this fires,
-                # and the next LINK_UP/BECAME_MASTER clears the error.
-                self._error = ConnectionError(
-                    "uplink lost and rejoin failed; node is isolated "
-                    "(still retrying in the background)"
-                )
-                self._ready.set()  # unblock wait_ready, which re-raises
+                try:
+                    self._on_link_up(ev)
+                except ValueError:
+                    # A duplicate link id (e.g. a LINK_UP replayed across a
+                    # transport hiccup) must be a logged no-op: this runs on
+                    # the daemon recv thread, and an escaped raise would
+                    # silently kill it and wedge the peer — the link is
+                    # already attached, which is the state the event asks
+                    # for anyway.
+                    log.warning(
+                        "duplicate LINK_UP for link %d ignored", ev.link_id
+                    )
+            else:
+                self._on_membership_event(ev)
         return bool(evs)
+
+    def _on_link_up(self, ev) -> None:
+        if ev.is_uplink:
+            self._uplink = ev.link_id
+            # a re-grafted uplink supersedes any earlier isolation
+            # verdict (REJOIN_FAILED is a status, not a sentence —
+            # the native layer keeps retrying and may heal)
+            self._error = None
+            if self.config.transport.wire_compat:
+                # reference protocol has no handshake: start
+                # streaming at once — into the carried residual
+                # when re-grafting (our undelivered mass), else
+                # zero. A re-grafting leaf resets its replica NOW
+                # to EXACTLY the carry (fresh-joiner semantics: a
+                # true fresh joiner with pending adds holds them in
+                # values AND residual; the parent's re-seed then
+                # refills tree state additively on top). Resetting
+                # to zero instead would desync this node by the
+                # carry forever: the carry floods to every OTHER
+                # peer, and split horizon never returns it here —
+                # see the LINK_DOWN comment.
+                if self._compat_reset_on_regraft:
+                    self._compat_reset_on_regraft = False
+                    if self._engine is not None:
+                        self._engine.compat_regraft(ev.link_id)
+                    else:
+                        self.st.regraft_reset_to_carry(
+                            CARRY_LINK, ev.link_id
+                        )
+                elif self._engine is not None:
+                    # interior re-graft (or first join): residual =
+                    # carry + anything added since the consume —
+                    # attach-by-diff recomputes against live values,
+                    # so the two-step consume/attach loses nothing
+                    carry, snap = self._engine.take_carry_and_snapshot()
+                    if carry is not None:
+                        self._engine.new_link_diff(
+                            ev.link_id, np.asarray(snap - carry, "<f4")
+                        )
+                    else:
+                        self._engine.new_link(ev.link_id, seed=False)
+                else:
+                    carry, _ = self.st.take_link_and_snapshot(
+                        CARRY_LINK
+                    )
+                    self.st.new_link(
+                        ev.link_id, seed=False, residual=carry
+                    )
+                if self._engine is not None:
+                    self._engine_links.add(ev.link_id)
+            else:
+                self._start_join(ev.link_id)
+        else:
+            if self.config.transport.wire_compat:
+                # reference join: seed the child with the full replica
+                # through the codec stream (src/sharedtensor.c:379-381)
+                if self._engine is not None:
+                    self._engine.new_link(ev.link_id, seed=True)
+                    self._engine_links.add(ev.link_id)
+                else:
+                    self.st.new_link(ev.link_id, seed=True)
+            else:
+                # native: wait for the child's SYNC snapshot before
+                # opening the codec link
+                self._pending[ev.link_id] = bytearray()
+    def _on_membership_event(self, ev) -> None:
+        if ev.kind == EventKind.LINK_DOWN:
+            self._pending.pop(ev.link_id, None)
+            self._engine_links.discard(ev.link_id)
+            with self._ack_mu:
+                self._unacked.pop(ev.link_id, None)
+                self._acked.pop(ev.link_id, None)
+                self._rx_count.pop(ev.link_id, None)
+                self._ack_sent.pop(ev.link_id, None)
+            if ev.is_uplink:
+                # Keep undelivered upward updates for the re-grafted
+                # uplink — in a LIVE carry slot that continues to absorb
+                # add()/flood mass while we are orphaned (see
+                # CARRY_LINK). If the parent died mid-handshake the
+                # codec link never existed; everything we owe the tree
+                # is then replica - sent_snapshot, computed LAZILY at
+                # re-join time so orphan-period adds are included.
+                if self._engine is not None:
+                    stashed = self._engine.stash_carry(ev.link_id)
+                else:
+                    # one lock: a concurrent add() must find either the
+                    # dying link or the carry slot, never neither
+                    stashed = self.st.stash_carry(ev.link_id, CARRY_LINK)
+                if not stashed and self._sent_snapshot is not None:
+                    self._mid_handshake_base = self._sent_snapshot
+                self._sent_snapshot = None
+                self._uplink = None
+                if self.config.transport.wire_compat:
+                    # The reference protocol cannot express a stateful
+                    # re-graft: the new parent will re-seed us with its
+                    # FULL replica (no diff handshake exists), so
+                    # retained state would double. A LEAF therefore
+                    # zeroes its replica — but only AT the re-graft
+                    # (LINK_UP below), never here: rejoin may instead
+                    # end in BECAME_MASTER, where our retained state IS
+                    # the authoritative seed and zeroing it would serve
+                    # an empty tree. With children the reset would
+                    # double THEM (their state stays while our
+                    # seed-refill floods down), so an interior node
+                    # keeps state and accepts the documented
+                    # double-count — still strictly better than the
+                    # reference, which kills the whole tree (quirk Q8).
+                    # (the carry pseudo-slot is not a real link)
+                    real = [l for l in self.st.link_ids if l >= 0]
+                    if not real:
+                        self._compat_reset_on_regraft = True
+                    else:
+                        log.warning(
+                            "wire-compat interior node lost its uplink:"
+                            " re-seeded state may double (the reference"
+                            " protocol has no diff handshake)"
+                        )
+            else:
+                self.st.drop_link(ev.link_id)
+        elif ev.kind == EventKind.BECAME_MASTER:
+            # our parent died and rejoin found nobody: we claimed the
+            # rendezvous and are the new root (native master failover);
+            # whatever state we hold is now the authoritative seed —
+            # including in wire-compat, where a pending re-graft reset
+            # must be cancelled (zeroing the new root would serve an
+            # empty tree). The carry is DROPPED: its mass is already in
+            # our (now-authoritative) replica, a root never re-joins
+            # upward, and a live-but-unconsumable carry would cost an
+            # extra O(total) pass on every add/apply forever.
+            if self._engine is not None:
+                self._engine.drop_carry()
+            else:
+                self.st.take_link_and_snapshot(CARRY_LINK)
+            self._mid_handshake_base = None
+            self._compat_reset_on_regraft = False
+            self._uplink = None
+            self.is_master = True
+            self._error = None
+            self._ready.set()
+        elif ev.kind == EventKind.REJOIN_FAILED:
+            # Status, not a sentence: the native layer keeps cycling
+            # join-then-claim-rendezvous forever; under detection skew a
+            # sibling may claim the rendezvous seconds after this fires,
+            # and the next LINK_UP/BECAME_MASTER clears the error.
+            self._error = ConnectionError(
+                "uplink lost and rejoin failed; node is isolated "
+                "(still retrying in the background)"
+            )
+            self._ready.set()  # unblock wait_ready, which re-raises
 
     def _attach_diff(self, link: int, snap) -> None:
         """Open the codec link with residual = replica - snap. In engine mode

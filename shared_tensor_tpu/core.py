@@ -613,7 +613,16 @@ class SharedTensor:
         """Apply an incoming frame to the replica and to every *other* link's
         residual (split-horizon flood with per-hop re-quantization, reference
         sync_in src/sharedtensor.c:124-127). ``link_id`` may be unknown
-        (already-dropped peer): the frame still applies to the replica."""
+        (already-dropped peer): the frame still applies to the replica.
+
+        Corruption-zeroed (all-zero-scale) frames apply as no-ops and count
+        NOWHERE — the same taxonomy rule the engine tier enforces
+        (stengine.cpp apply_batch): a quiesced pair must satisfy
+        sender.frames_out == receiver.frames_in on every tier, or the
+        divergence reads as a phantom discrepancy exactly when an operator
+        is debugging a corrupt link."""
+        if not np.asarray(frame.scales).any():
+            return
         with self._lock:
             others = tuple(i for i in self._links if i != link_id)
             arrays = (self.values, *(self._links[i] for i in others))
@@ -645,6 +654,11 @@ class SharedTensor:
             return
         if len(frames) == 1:
             return self.receive_frame(link_id, frames[0])
+        # all-zero-scale frames apply as no-ops and count nowhere (the
+        # engine tier's taxonomy rule — see receive_frame)
+        applied = sum(1 for f in frames if np.asarray(f.scales).any())
+        if applied == 0:
+            return
         if self._np:
             scales = np.stack([np.asarray(f.scales) for f in frames])
             words = np.stack([np.asarray(f.words) for f in frames])
@@ -657,7 +671,7 @@ class SharedTensor:
                 self.values = out[0]
                 for i, r in zip(others, out[1:]):
                     self._links[i] = r
-                self.frames_in += len(frames)
+                self.frames_in += applied
             return
         k = 1
         while k < len(frames):
@@ -675,7 +689,7 @@ class SharedTensor:
             self.values = out[0]
             for i, r in zip(others, out[1:]):
                 self._links[i] = r
-            self.frames_in += len(frames)
+            self.frames_in += applied
 
     # -- introspection -----------------------------------------------------
 

@@ -106,6 +106,21 @@ def _native() -> Optional[ctypes.CDLL]:
             _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64, _f32p, _u32p,
             _f64p, _f64p, _f64p,
         ]
+        # k-frame fused apply: one pass over the target regardless of k
+        # (replaces the delta-buffer path; bit-identical to it — see
+        # stcodec.c). Trailing partials pointers may be None.
+        _f64p_opt = ctypes.POINTER(ctypes.c_double)
+        lib.stc_apply_frames.restype = None
+        lib.stc_apply_frames.argtypes = [
+            _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32, _f32p, _u32p,
+            _f64p_opt, _f64p_opt, _f64p_opt,
+        ]
+        lib.stc_accumulate_update_to_partials.restype = None
+        lib.stc_accumulate_update_to_partials.argtypes = [
+            _f32p, _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64,
+            _f64p, _f64p, _f64p,
+        ]
         _LIB = lib
     except Exception:  # no toolchain / build failure: numpy fallback
         _LIB = None
@@ -335,21 +350,22 @@ def apply_table_batch_np(
                 )
                 out.append(dst)
             return tuple(out)
-        delta = np.zeros(spec.total, np.float32)
-        for i in range(k):
-            row = np.ascontiguousarray(scales[i], np.float32)
-            if not row.any():
-                continue
-            lib.stc_accumulate_delta(
-                delta, offs, ns, padded, spec.num_leaves, row,
-                np.ascontiguousarray(words[i], np.uint32),
-            )
+        # k-frame fused apply (stc_apply_frames): one pass over each target
+        # regardless of k — reads the k PACKED word rows (total/8 bytes
+        # each) instead of building a total*4 delta buffer with k
+        # read-modify-write passes. Bit-identical to the delta path by
+        # construction (same per-element +/-s summation order, same final
+        # clip(a + delta)).
+        srows = np.ascontiguousarray(scales, np.float32)
+        wrows = np.ascontiguousarray(words, np.uint32)
         out = []
         for a in arrays:
-            # functional update, one pass: dst = clip(a + delta)
             src = np.ascontiguousarray(a, np.float32)
             dst = np.empty(spec.total, np.float32)
-            lib.stc_add_to(dst, src, delta, spec.total)
+            lib.stc_apply_frames(
+                src, dst, offs, ns, padded, spec.num_leaves,
+                spec.total // 32, k, srows, wrows, None, None, None,
+            )
             out.append(dst)
         return tuple(out)
     delta = np.zeros(spec.total, np.float32)

@@ -1,0 +1,267 @@
+"""The native codec's worker pool and the k-frame fused apply kernel.
+
+Contracts pinned here (stcodec.c "worker pool" + "k-frame fused apply"
+headers):
+  - every elementwise op (quantize, apply, add) is BIT-exact under any
+    ST_CODEC_THREADS value — chunk boundaries never change results;
+  - scale partials are deterministic per layout (fixed 2 Mi-element chunk
+    grouping) and within the documented ~1-ulp tier tolerance of the
+    serial pass; with the production POW2_RMS policy the resulting scales
+    are exactly equal in practice;
+  - stc_apply_frames is bit-identical to BOTH legacy receive paths: the
+    k = 1 fused single-frame apply and the k > 1 accumulate-delta + add
+    pipeline (same per-element summation order by construction), and its
+    fused partials match a standalone rescan of its output.
+
+The thread-count cases run in subprocesses because the pool caches
+ST_CODEC_THREADS at first use (one pool per process for its lifetime).
+"""
+
+import ctypes
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from shared_tensor_tpu.ops import codec_np as NP
+from shared_tensor_tpu.ops import table as T
+
+pytestmark = pytest.mark.skipif(
+    NP._native() is None, reason="native codec unavailable"
+)
+
+
+def _layout_arrays(spec):
+    return NP._layout(spec)
+
+
+def _big_tree(seed):
+    # one leaf above the 4 Mi parallel threshold + an odd-sized straggler,
+    # so chunked dispatch, partial words, and padding all engage
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.standard_normal(5 * 1024 * 1024 + 17).astype(np.float32),
+        "b": rng.standard_normal(1000).astype(np.float32),
+    }
+
+
+_CHILD = r"""
+import json, sys
+import numpy as np
+from shared_tensor_tpu.ops import codec_np as NP
+from shared_tensor_tpu.ops import table as T
+
+rng = np.random.default_rng(7)
+tree = {
+    "w": rng.standard_normal(5 * 1024 * 1024 + 17).astype(np.float32),
+    "b": rng.standard_normal(1000).astype(np.float32),
+}
+spec = T.make_spec(tree)
+flat = NP.flatten_np(tree, spec)
+lib = NP._native()
+assert lib is not None
+offs, ns, padded = NP._layout(spec)
+L = spec.num_leaves
+
+s = NP.compute_scales_np(flat, spec)
+out = np.empty(spec.total, np.float32)
+words = np.zeros(spec.total // 32, np.uint32)
+lib.stc_quantize(flat, out, offs, ns, padded, L, s, words)
+ap = np.empty(spec.total, np.float32)
+lib.stc_apply_frame(flat, ap, offs, ns, padded, L, s, words)
+am = np.zeros(L); ss = np.zeros(L); sb = np.zeros(L)
+lib.stc_scale_partials(out, offs, ns, L, am, ss, sb)
+au = np.empty(spec.total, np.float32)
+lib.stc_accumulate_update_to(au, flat, out, offs, ns, padded, L)
+
+import hashlib
+def h(a):
+    return hashlib.sha256(np.ascontiguousarray(a).tobytes()).hexdigest()
+print(json.dumps({
+    "scales": s.tolist(),
+    "h_out": h(out), "h_words": h(words), "h_ap": h(ap), "h_au": h(au),
+    "ss": ss.tolist(), "sabs": sb.tolist(), "amax": am.tolist(),
+}))
+"""
+
+
+def _run_child(threads: int) -> dict:
+    env = dict(os.environ, ST_CODEC_THREADS=str(threads), JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-c", _CHILD],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert r.returncode == 0, r.stderr
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_threaded_codec_matches_serial_bitwise():
+    serial = _run_child(1)
+    threaded = _run_child(4)  # forced: correctness is core-count-independent
+    # elementwise outputs: bit-exact under any split
+    for key in ("h_out", "h_words", "h_ap", "h_au"):
+        assert serial[key] == threaded[key], key
+    # production scales: pow2 floor absorbs the ~1-ulp partial difference
+    assert serial["scales"] == threaded["scales"]
+    # partials: deterministic chunk grouping, tier tolerance vs serial
+    np.testing.assert_allclose(serial["ss"], threaded["ss"], rtol=1e-9)
+    np.testing.assert_allclose(serial["sabs"], threaded["sabs"], rtol=1e-9)
+    np.testing.assert_allclose(serial["amax"], threaded["amax"], rtol=0)
+
+
+def _quantize_frames(flat, spec, k):
+    """k successive error-feedback frames off one residual."""
+    lib = NP._native()
+    offs, ns, padded = _layout_arrays(spec)
+    L = spec.num_leaves
+    r = flat.copy()
+    scales = np.zeros((k, L), np.float32)
+    words = np.zeros((k, spec.total // 32), np.uint32)
+    for f in range(k):
+        s = NP.compute_scales_np(r, spec)
+        out = np.empty(spec.total, np.float32)
+        lib.stc_quantize(r, out, offs, ns, padded, L, s, words[f])
+        scales[f] = s
+        r = out
+    return scales, words
+
+
+@pytest.mark.parametrize("k", [1, 2, 5])
+def test_apply_frames_bitwise_matches_legacy_paths(k):
+    tree = {
+        "a": np.linspace(-3, 3, 30 * 50, dtype=np.float32).reshape(30, 50),
+        "b": (np.arange(257, dtype=np.float32) - 128) / 7,
+    }
+    spec = T.make_spec(tree)
+    flat = NP.flatten_np(tree, spec)
+    lib = NP._native()
+    offs, ns, padded = _layout_arrays(spec)
+    L = spec.num_leaves
+    scales, words = _quantize_frames(flat, spec, k)
+    # zero one frame's scales entirely (idle/corruption-zeroed frame) and,
+    # for k > 1, one single leaf of another frame (per-leaf idle)
+    if k > 1:
+        scales[1] = 0.0
+        scales[0][1] = 0.0
+
+    target = NP.flatten_np(
+        {
+            "a": np.full((30, 50), 0.25, np.float32),
+            "b": np.full(257, -1.5, np.float32),
+        },
+        spec,
+    )
+
+    # legacy delta path
+    delta = np.zeros(spec.total, np.float32)
+    for f in range(k):
+        if not scales[f].any():
+            continue
+        lib.stc_accumulate_delta(
+            delta, offs, ns, padded, L, scales[f], words[f]
+        )
+    want = np.empty(spec.total, np.float32)
+    lib.stc_add_to(want, target, delta, spec.total)
+
+    got = np.empty(spec.total, np.float32)
+    lib.stc_apply_frames(
+        target, got, offs, ns, padded, L, spec.total // 32, k,
+        np.ascontiguousarray(scales), np.ascontiguousarray(words),
+        None, None, None,
+    )
+    np.testing.assert_array_equal(got, want)
+
+    if k == 1:
+        # also bit-identical to the k=1 fused single-frame apply
+        want1 = np.empty(spec.total, np.float32)
+        lib.stc_apply_frame(
+            target, want1, offs, ns, padded, L, scales[0], words[0]
+        )
+        np.testing.assert_array_equal(got, want1)
+
+    # fused partials == standalone rescan of the output
+    am = np.zeros(L)
+    ssq = np.zeros(L)
+    sab = np.zeros(L)
+    got2 = np.empty(spec.total, np.float32)
+    lib.stc_apply_frames(
+        target, got2, offs, ns, padded, L, spec.total // 32, k,
+        np.ascontiguousarray(scales), np.ascontiguousarray(words),
+        am.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ssq.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        sab.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    np.testing.assert_array_equal(got2, got)
+    am2 = np.zeros(L)
+    ss2 = np.zeros(L)
+    sb2 = np.zeros(L)
+    lib.stc_scale_partials(got, offs, ns, L, am2, ss2, sb2)
+    np.testing.assert_allclose(ssq, ss2, rtol=1e-12)
+    np.testing.assert_allclose(sab, sb2, rtol=1e-12)
+    np.testing.assert_array_equal(am, am2)
+
+
+def test_accumulate_update_to_partials_matches_rescan():
+    tree = _big_tree(11)
+    spec = T.make_spec(tree)
+    flat = NP.flatten_np(tree, spec)
+    upd = NP.flatten_np(_big_tree(12), spec)
+    # poison the update with the sanitizer's cases
+    upd[3] = np.nan
+    upd[70] = np.inf
+    upd[71] = -np.inf
+    lib = NP._native()
+    offs, ns, padded = _layout_arrays(spec)
+    L = spec.num_leaves
+    am = np.zeros(L)
+    ssq = np.zeros(L)
+    sab = np.zeros(L)
+    got = np.empty(spec.total, np.float32)
+    lib.stc_accumulate_update_to_partials(
+        got, flat, upd, offs, ns, padded, L, am, ssq, sab
+    )
+    want = np.empty(spec.total, np.float32)
+    lib.stc_accumulate_update_to(want, flat, upd, offs, ns, padded, L)
+    np.testing.assert_array_equal(got, want)
+    am2 = np.zeros(L)
+    ss2 = np.zeros(L)
+    sb2 = np.zeros(L)
+    lib.stc_scale_partials(got, offs, ns, L, am2, ss2, sb2)
+    np.testing.assert_allclose(ssq, ss2, rtol=1e-9)
+    np.testing.assert_allclose(sab, sb2, rtol=1e-9)
+    np.testing.assert_array_equal(am, am2)
+
+
+def test_host_tier_batch_apply_uses_fused_kernel():
+    """apply_table_batch_np's k>1 result is unchanged by the kernel swap
+    (regression pin: fused kernel vs the numpy semantic reference)."""
+    tree = _tree_small(3)
+    spec = T.make_spec(tree)
+    flat = NP.flatten_np(tree, spec)
+    scales, words = _quantize_frames(flat, spec, 3)
+    arrays = tuple(
+        NP.flatten_np(_tree_small(20 + i), spec) for i in range(2)
+    )
+    got = NP.apply_table_batch_np(arrays, scales, words, spec)
+    # numpy semantic reference (force the no-native path)
+    lib, NP._LIB = NP._LIB, None
+    try:
+        want = NP.apply_table_batch_np(arrays, scales, words, spec)
+    finally:
+        NP._LIB = lib
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=0, atol=0)
+
+
+def _tree_small(seed):
+    rng = np.random.default_rng(seed)
+    return {
+        "a": rng.standard_normal((30, 50)).astype(np.float32),
+        "b": rng.standard_normal(257).astype(np.float32),
+    }

@@ -214,6 +214,32 @@ def test_metrics_counters():
     assert a.residual_rms(1) >= 0.0
 
 
+def test_zero_scale_frames_count_nowhere():
+    """Corruption-zeroed (all-zero-scale) frames are no-ops that must not
+    bump frames_in on ANY tier — the engine's taxonomy rule (stengine.cpp
+    apply_batch), now pinned for the Python tier too (ADVICE r04 item 1):
+    a quiesced pair satisfies sender.frames_out == receiver.frames_in."""
+    from shared_tensor_tpu.ops.table import TableFrame
+
+    t = _tree(16)
+    a = SharedTensor(t, seed_values=True)
+    a.new_link(1, seed=True)
+    real = a.make_frame(1)
+    assert real is not None
+    zero = TableFrame(
+        np.zeros_like(np.asarray(real.scales)),
+        np.asarray(real.words),  # bits without scales decode to nothing
+    )
+    before = np.asarray(a.snapshot_flat()).copy()
+    a.receive_frame(1, zero)
+    assert a.frames_in == 0
+    np.testing.assert_array_equal(np.asarray(a.snapshot_flat()), before)
+    # batched path: zero frames inside a batch are applied-as-nothing and
+    # excluded from the count
+    a.receive_frames(1, [real, zero, zero])
+    assert a.frames_in == 1
+
+
 def test_receive_frames_backlog_contract(monkeypatch):
     """The batched receive path's contract (round-2 verdict item 8): a burst
     of K frames from one link lands in exactly ONE batched device dispatch

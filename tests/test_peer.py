@@ -597,3 +597,59 @@ def test_device_tier_burst_path(monkeypatch):
     finally:
         a.close()
         b.close()
+
+
+def test_duplicate_link_up_is_logged_noop(caplog):
+    """A replayed/duplicate LINK_UP must not kill the daemon recv thread
+    (ADVICE r04 item 2): the attach entry points raise ValueError on a
+    duplicate link id, and _handle_events runs on the recv thread with no
+    other guard — the event is a logged no-op because the link being
+    attached is already the state the event asks for."""
+    import logging
+
+    from shared_tensor_tpu.comm.transport import Event, EventKind
+
+    port = _free_port()
+    seed = jnp.full((64,), 1.0, jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed)
+    a = create_or_fetch("127.0.0.1", port, jnp.zeros_like(seed))
+    try:
+        _wait_converged([a], seed)
+        up = a._uplink
+        assert up is not None
+        dup = Event(EventKind.LINK_UP, up, True)
+        # the raw entry point does raise on the duplicate id...
+        with pytest.raises(ValueError):
+            if a._engine is not None:
+                a._engine.new_link(up, seed=False)
+            else:
+                a.st.new_link(up, seed=False)
+        # ...but the event path swallows it as a logged warning. Note a
+        # duplicate *uplink* LINK_UP in native mode goes through
+        # _start_join (handshake restart), so exercise the guard with the
+        # raise itself: stub poll_events to replay the event and the
+        # compat-style direct-attach body to hit the facade.
+        orig = a._on_link_up
+
+        def raising(ev):
+            raise ValueError(f"link {ev.link_id} already exists")
+
+        a._on_link_up = raising
+        try:
+            a.node.poll_events = lambda timeout=0.0: [dup]
+            with caplog.at_level(
+                logging.WARNING, logger="shared_tensor_tpu.peer"
+            ):
+                assert a._handle_events() is True  # no raise escapes
+            assert any(
+                "duplicate LINK_UP" in r.message for r in caplog.records
+            )
+        finally:
+            a._on_link_up = orig
+            a.node.poll_events = type(a.node).poll_events.__get__(a.node)
+        # peer still functional after the duplicate event
+        m.add(jnp.ones((64,), jnp.float32))
+        _wait_converged([a], seed + 1.0)
+    finally:
+        a.close()
+        m.close()
