@@ -27,6 +27,14 @@ SIZES = [
     ).split(",")
 ]
 MEASURE_S = float(os.environ.get("ST_ENGINE_BENCH_S", "8"))
+#: Master add() cadence. An add is O(n) host work (values + residual, ~2
+#: full-table passes); a fixed 2 ms period at 16 Mi saturates the core on
+#: adds and measures add-flooded — not steady-state — codec throughput.
+#: Scale with n like e2e_sync.py: fast enough that residual mass never
+#: quiesces (drain needs ~30 successive halvings), slow enough that the
+#: codec stream owns the core.
+def _add_period(n: int) -> float:
+    return max(0.002, n / (1 << 20) * 0.02)
 #: ST_ENGINE_BENCH_COMPAT=1 runs both peers on the reference's raw wire
 #: protocol (engine compat data plane, K-frame compat bursts) — the
 #: saturation measurement behind the "faster than the reference at its own
@@ -70,9 +78,12 @@ def _master(n, port, q, done: "mp.Event"):
     # wall budget understates fps when child spawn/join runs long on a
     # loaded box (the master would exit mid-measurement)
     t_bail = time.time() + MEASURE_S + 120
+    period = float(
+        os.environ.get("ST_ENGINE_BENCH_ADD_PERIOD", str(_add_period(n)))
+    )
     while not done.is_set() and time.time() < t_bail:
         peer.add(delta)
-        time.sleep(0.002)
+        time.sleep(period)
     q.put(("master", peer._engine is not None))
     peer.close()
 

@@ -1034,6 +1034,55 @@ EXPORT void stc_accumulate_update(float *a, const float *u, int64_t total) {
   accumulate_update_range(a, u, 0, total);
 }
 
+#ifdef ST_AVX512
+/* clip(a + sanitize(u)) + result partials, 16 lanes at a time over
+ * elements [j0, j0+16k) (k maximal with j0+16k <= n). The scalar loop's
+ * NaN/clamp/partials mix defeats autovectorization (measured 1.2 GB/s vs
+ * 12.5 for the partial-less op at 16 Mi — a 10x cliff on the add path);
+ * this kernel restores it. Returns the stopping element. */
+ST_TARGET_AVX512
+static int64_t accumulate_update_leaf_avx512(float *op, const float *ap,
+                                             const float *up, int64_t n,
+                                             int64_t j0, int do_part,
+                                             double *amax, double *ss,
+                                             double *sabs) {
+  const __m512 vmax = _mm512_set1_ps(3.0e38f);
+  const __m512 vmin = _mm512_set1_ps(-3.0e38f);
+  const __m512i vabsmask = _mm512_set1_epi32(0x7FFFFFFF);
+  __m512 vamax = _mm512_setzero_ps();
+  __m512d vss0 = _mm512_setzero_pd(), vss1 = _mm512_setzero_pd();
+  __m512d vsa0 = _mm512_setzero_pd(), vsa1 = _mm512_setzero_pd();
+  int64_t j = j0;
+  for (; j + 16 <= n; j += 16) {
+    __m512 u = _mm512_loadu_ps(up + j);
+    __mmask16 ord = _mm512_cmp_ps_mask(u, u, _CMP_ORD_Q);
+    u = _mm512_maskz_mov_ps(ord, u); /* NaN -> 0 */
+    u = _mm512_max_ps(_mm512_min_ps(u, vmax), vmin);
+    __m512 s = _mm512_add_ps(_mm512_loadu_ps(ap + j), u);
+    s = _mm512_max_ps(_mm512_min_ps(s, vmax), vmin);
+    _mm512_storeu_ps(op + j, s);
+    if (do_part) {
+      __m512 a = _mm512_castsi512_ps(
+          _mm512_and_epi32(_mm512_castps_si512(s), vabsmask));
+      vamax = _mm512_max_ps(vamax, a);
+      __m512d lo = _mm512_cvtps_pd(_mm512_castps512_ps256(s));
+      __m512d hi = _mm512_cvtps_pd(_mm512_extractf32x8_ps(s, 1));
+      vss0 = _mm512_fmadd_pd(lo, lo, vss0);
+      vss1 = _mm512_fmadd_pd(hi, hi, vss1);
+      vsa0 = _mm512_add_pd(vsa0, _mm512_cvtps_pd(_mm512_castps512_ps256(a)));
+      vsa1 = _mm512_add_pd(vsa1,
+                           _mm512_cvtps_pd(_mm512_extractf32x8_ps(a, 1)));
+    }
+  }
+  if (do_part) {
+    *amax = _mm512_reduce_max_ps(vamax);
+    *ss = _mm512_reduce_add_pd(vss0) + _mm512_reduce_add_pd(vss1);
+    *sabs = _mm512_reduce_add_pd(vsa0) + _mm512_reduce_add_pd(vsa1);
+  }
+  return j;
+}
+#endif
+
 /* out = clip(a + sanitize(u)) on live lanes of elements [e0, e1) of one
  * leaf (e0/e1 in padded coordinates); padding lanes in range copy from a.
  * Optional partials of the RESULT (live lanes in range) — fusing them here
@@ -1046,7 +1095,20 @@ static void accumulate_update_to_range(float *op, const float *ap,
                                        double *out_ss, double *out_sabs) {
   double amax = 0, ssum = 0, sabs = 0;
   int64_t live = n < e1 ? n : e1;
-  for (int64_t j = e0; j < live; j++) {
+  int64_t j = e0;
+#ifdef ST_AVX512
+  if (st_has_avx512() && j < live) {
+    double a2 = 0, s2 = 0, b2 = 0;
+    j = accumulate_update_leaf_avx512(op, ap, up, live, j,
+                                      out_amax != NULL, &a2, &s2, &b2);
+    if (out_amax) {
+      amax = a2;
+      ssum = s2;
+      sabs = b2;
+    }
+  }
+#endif
+  for (; j < live; j++) {
     float x = up[j];
     if (x != x) x = 0.0f; /* NaN */
     if (x > 3.0e38f) x = 3.0e38f;

@@ -254,7 +254,7 @@ class EngineTensor:
             self._h, link_id, None, 1 if seed else 0, rx_init
         )
         if r == 0:
-            raise ValueError(f"link {link_id} already exists")
+            raise DuplicateLink(f"link {link_id} already exists")
 
     def new_link_diff(
         self, link_id: int, peer_snapshot: np.ndarray, rx_init: int = 0
@@ -272,7 +272,7 @@ class EngineTensor:
             rx_init,
         )
         if r == 0:
-            raise ValueError(f"link {link_id} already exists")
+            raise DuplicateLink(f"link {link_id} already exists")
 
     def stash_carry(self, link_id: int) -> bool:
         """Park a dead uplink's residual in the engine's LIVE carry slot —
@@ -286,7 +286,7 @@ class EngineTensor:
         uplink residual = carry (core.SharedTensor.regraft_reset_to_carry's
         engine analog — see that docstring for why zero would desync)."""
         if self._lib.st_engine_compat_regraft(self._h, link_id) == 0:
-            raise ValueError(f"link {link_id} already exists")
+            raise DuplicateLink(f"link {link_id} already exists")
 
     def take_carry_and_snapshot(
         self,

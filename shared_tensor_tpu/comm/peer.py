@@ -762,13 +762,15 @@ class SharedTensorPeer:
             if ev.kind == EventKind.LINK_UP:
                 try:
                     self._on_link_up(ev)
-                except ValueError:
+                except DuplicateLink:
                     # A duplicate link id (e.g. a LINK_UP replayed across a
                     # transport hiccup) must be a logged no-op: this runs on
                     # the daemon recv thread, and an escaped raise would
                     # silently kill it and wedge the peer — the link is
                     # already attached, which is the state the event asks
-                    # for anyway.
+                    # for anyway. Only the dedicated duplicate type is
+                    # caught: any other attach-path error must surface, not
+                    # be misread as a replay.
                     log.warning(
                         "duplicate LINK_UP for link %d ignored", ev.link_id
                     )

@@ -71,7 +71,15 @@ CHUNK_BYTES = 1 << 22
 #: and the engine's frame-0 scale scan; device: amortizes the device-link
 #: round trip) — the K for a spec comes from burst_frames_cap below.
 BURST_MAX_FRAMES = 255
-BURST_MAX_BYTES = 1 << 22
+#: 16 MiB: at 16 Mi elements a frame's wire body is ~2 MiB, so this budget
+#: gives burst caps of ~7 there — and the k-frame fused receive
+#: (stc_apply_frames) then touches the 64 MiB target ONCE per burst
+#: instead of once per frame, the difference between 2.6 and >3 GB/s
+#: equiv on the measured 16 Mi loopback (ENGINE_SWEEP_r05). Worst-case
+#: transport memory is bounded by queue_depth (8) x this budget per
+#: direction per link (~128 MiB at the largest tables) — host-RAM class,
+#: like every buffer at that table size.
+BURST_MAX_BYTES = 1 << 24
 
 
 def burst_frames_cap(spec: TableSpec) -> int:

@@ -105,6 +105,13 @@ def host_tier_active() -> bool:
     return jax.default_backend() == "cpu"
 
 
+class DuplicateLink(ValueError):
+    """A link id that is already attached. Its own type so the recv-thread
+    event loop can treat a replayed LINK_UP as a logged no-op WITHOUT
+    swallowing unrelated ValueErrors from the attach path (a masked real
+    error there silently desyncs the peer — ADVICE r04 item 2 follow-up)."""
+
+
 class SharedTensor:
     """Replica + per-link residuals for one shared table of tensors.
 
@@ -233,7 +240,7 @@ class SharedTensor:
         survive its parent's death instead of being lost."""
         with self._lock:
             if link_id in self._links:
-                raise ValueError(f"link {link_id} already exists")
+                raise DuplicateLink(f"link {link_id} already exists")
             if residual is not None:
                 if residual.shape != (self.spec.total,):
                     raise ValueError(
@@ -255,7 +262,7 @@ class SharedTensor:
         cannot re-graft at all, quirk Q8)."""
         with self._lock:
             if link_id in self._links:
-                raise ValueError(f"link {link_id} already exists")
+                raise DuplicateLink(f"link {link_id} already exists")
             snap = self._asarray(peer_snapshot)
             if snap.shape != (self.spec.total,):
                 raise ValueError(
@@ -392,7 +399,7 @@ class SharedTensor:
         partially."""
         with self._lock:
             if new_link_id in self._links:
-                raise ValueError(f"link {new_link_id} already exists")
+                raise DuplicateLink(f"link {new_link_id} already exists")
             carry = self._links.pop(carry_id, None)
             if carry is None:
                 self.values = self._zeros()
