@@ -1,0 +1,273 @@
+"""Chaos soak: peers training under a randomized fault schedule (r06).
+
+The deterministic fault layer (comm/faults.py) drives every recovery path
+this framework claims over the reference's exit(-1) — in one continuous
+run, on BOTH data planes:
+
+- **python arm** — a master plus three Python-tier joiners, each with a
+  seeded :class:`FaultConfig` drawn from a randomized (but seeded, so the
+  whole soak replays) schedule: one link drops/duplicates/delays frames,
+  one bit-corrupts and truncates them, one stalls and then severs its
+  uplink mid-stream (forced carry re-graft).
+- **native arm** — a master plus two native-engine joiners, one created
+  under the ``ST_FAULT_PLAN`` env hook table so the C transport's sender
+  loop injects the same fault classes (drop, stall, sever) below Python.
+
+Every peer "trains": it adds structured deltas on its own cadence for the
+whole window while the chaos runs; the chaos window ends WITH training
+(injection is then disabled, like soak.py stopping its churn), and the
+recovery machinery must repair everything the chaos stranded. Because the
+soak is in-process, the exact expected state (seed + every delta) is
+known, so the final check is the delivery contract itself, not a
+statistical smell test:
+
+- **convergence-within-bound**: with the r06 go-back-N wire discipline
+  (comm/wire.py tx_seq), drop / duplicate / truncate / stall / delay and
+  sever-into-carry all recover EXACTLY; the only fault class that may
+  leave a residue is bit-corruption, which mis-applies at most one
+  element by 2*scale per corrupted message (the flip lands in the sign
+  words; scales for these unit-range deltas stay O(1)). The documented
+  bound is therefore ``atol + 4.0 * corrupted_messages`` per element —
+  chaos-proportional, not a fudge factor: a schedule that corrupts
+  nothing must converge to float exactness.
+- **zero wedged threads**: after drain + close of every peer, no ``st-*``
+  daemon thread may survive — the round-5 failure mode (a dead recv
+  thread wedging a peer forever) is exactly what this asserts away.
+
+Emits one JSON line. Run:  python benchmarks/chaos_soak.py > CHAOS_r06.json
+Knobs: ST_CHAOS_SECONDS (per arm, default 40), ST_CHAOS_SEED (default 6).
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N = int(os.environ.get("ST_CHAOS_N", "512"))
+SECONDS = float(os.environ.get("ST_CHAOS_SECONDS", "40"))
+SEED = int(os.environ.get("ST_CHAOS_SEED", "6"))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _st_threads() -> set:
+    return {
+        t for t in threading.enumerate()
+        if t.name.startswith("st-") and t.is_alive()
+    }
+
+
+def _train(peer, np, jnp, rng, stop, contrib_lock, contrib):
+    """One peer's training loop: structured deltas (linspace converges
+    exactly; Gaussian tails oscillate forever at the +/-scale floor),
+    tracked exactly under the lock so the soak knows the true sum."""
+    while not stop.is_set():
+        lo, hi = sorted(rng.uniform(-0.5, 0.5, size=2))
+        d = np.linspace(lo, hi, N, dtype=np.float32)
+        peer.add(jnp.asarray(d))
+        with contrib_lock:
+            contrib += d.astype(np.float64)
+        stop.wait(0.1)
+    return contrib
+
+
+def _run_arm(arm: str, np, jnp, rng) -> dict:
+    from shared_tensor_tpu.comm import faults
+    from shared_tensor_tpu.comm.peer import SharedTensorPeer, create_or_fetch
+    from shared_tensor_tpu.config import Config, FaultConfig, TransportConfig
+
+    native = arm == "native"
+
+    def cfg(fault=None):
+        return Config(
+            transport=TransportConfig(
+                peer_timeout_sec=30.0, ack_timeout_sec=1.0
+            ),
+            faults=fault or FaultConfig(),
+            native_engine=native,
+        )
+
+    port = _free_port()
+    seed_state = jnp.zeros((N,), jnp.float32)
+    master = create_or_fetch("127.0.0.1", port, seed_state, cfg())
+    peers = [master]
+    plans = []
+    env_schedule = None
+    if native:
+        # chaotic C-tier joiner: the env table is parsed per st_node_create,
+        # so only this node's transport injects (drop + stall + sever on
+        # its first uplink -> go-back-N retransmission, then black-hole
+        # teardown / sever -> rollback -> carry -> re-graft, all in C)
+        env = faults.to_env(FaultConfig(
+            enabled=True, seed=SEED, drop_pct=float(rng.uniform(0.1, 0.3)),
+            stall_after_frames=int(rng.integers(20, 40)),
+            sever_after_frames=int(rng.integers(45, 60)), only_link=1,
+        ))
+        env_schedule = env["ST_FAULT_PLAN"]
+        os.environ.update(env)
+        try:
+            peers.append(SharedTensorPeer(
+                "127.0.0.1", port, jnp.zeros((N,), jnp.float32), cfg()
+            ))
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+        peers.append(SharedTensorPeer(
+            "127.0.0.1", port, jnp.zeros((N,), jnp.float32), cfg()
+        ))
+    else:
+        schedules = [
+            FaultConfig(  # lossy link: drop + duplicate + delay
+                enabled=True, seed=SEED + 1,
+                drop_pct=float(rng.uniform(0.1, 0.3)),
+                dup_pct=float(rng.uniform(0.05, 0.2)),
+                delay_pct=float(rng.uniform(0.1, 0.3)), delay_sec=0.003,
+            ),
+            FaultConfig(  # corrupting link: bit flips + truncation
+                enabled=True, seed=SEED + 2,
+                corrupt_pct=float(rng.uniform(0.05, 0.15)),
+                truncate_pct=float(rng.uniform(0.05, 0.15)),
+            ),
+            FaultConfig(  # stalled-then-severed uplink: forced carry
+                enabled=True, seed=SEED + 3,
+                stall_after_frames=int(rng.integers(10, 25)),
+                sever_after_frames=int(rng.integers(30, 45)), only_link=1,
+            ),
+        ]
+        for fc in schedules:
+            p = SharedTensorPeer(
+                "127.0.0.1", port, jnp.zeros((N,), jnp.float32), cfg(fc)
+            )
+            peers.append(p)
+            plans.append(p._faults)
+    for p in peers[1:]:
+        p.wait_ready(60.0)
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    contribs = [np.zeros(N, np.float64) for _ in peers]
+    trainers = [
+        threading.Thread(
+            target=_train,
+            args=(p, np, jnp, np.random.default_rng(SEED + 10 + i), stop,
+                  lock, contribs[i]),
+            daemon=True, name=f"chaos-train-{i}",
+        )
+        for i, p in enumerate(peers)
+    ]
+    for t in trainers:
+        t.start()
+    time.sleep(SECONDS)
+    stop.set()
+    for t in trainers:
+        t.join(timeout=30.0)
+    trainers_ok = all(not t.is_alive() for t in trainers)
+
+    # End of the chaos window: harvest each plan's injected-event tallies,
+    # then disable injection before the quiesce (soak.py stops its churn
+    # the same way). The recovery machinery must now repair EVERYTHING the
+    # chaos stranded — under NONSTOP injection a drain-to-zero would race
+    # the fault schedule itself (each repair round can be re-faulted, with
+    # go-back-N backoff stretching the tail), which tests the schedule's
+    # patience, not the delivery contract.
+    injected = {
+        k: int(sum(pl.counts[k] for pl in plans if pl is not None))
+        for k in (
+            "dropped", "duplicated", "delayed", "corrupted", "truncated",
+            "stalled", "severed",
+        )
+    }
+    corrupted = sum(
+        int(pl.counts["corrupted"]) for pl in plans if pl is not None
+    )
+    for p in peers:
+        p._faults = None
+    # quiesce: every peer drains what it still owes (retransmission clears
+    # fault-stranded ledgers; severed links re-graft and redeliver)
+    drains_ok = sum(1 for p in peers if p.drain(timeout=120.0, tol=1e-30))
+    # settle: flood until the tree stops changing
+    settle_end = time.time() + 30.0
+    prev = None
+    while time.time() < settle_end:
+        cur = np.asarray(master.read()).copy()
+        if prev is not None and np.array_equal(cur, prev):
+            break
+        prev = cur
+        time.sleep(1.0)
+
+    expected = sum(contribs)
+    # documented +/-scale bound (module docstring): only corruption leaves
+    # a residue, <= 2*scale per corrupted message with O(1) scales here
+    bound = 0.05 + 4.0 * corrupted
+    dev = 0.0
+    spread = 0.0
+    base = np.asarray(master.read(), np.float64)
+    for p in peers:
+        v = np.asarray(p.read(), np.float64)
+        dev = max(dev, float(np.abs(v - expected).max()))
+        spread = max(spread, float(np.abs(v - base).max()))
+
+    for p in peers:
+        p.close()
+    deadline = time.time() + 15.0
+    while time.time() < deadline and _st_threads():
+        time.sleep(0.2)
+    wedged = sorted(t.name for t in _st_threads())
+
+    result = {
+        "peers": len(peers),
+        # python arm: per-class event tallies from the FaultPlans; native
+        # arm: the injection runs in the C transport below Python (no
+        # counters exported), so the configured ST_FAULT_PLAN schedule is
+        # recorded instead
+        "faults_injected": injected if plans else None,
+        "native_env_schedule": env_schedule,
+        "trainers_joined": trainers_ok,
+        "final_drains_ok": f"{drains_ok}/{len(peers)}",
+        "max_dev_vs_expected": dev,
+        "cross_replica_spread": spread,
+        "dev_bound": bound,
+        "wedged_threads": wedged,
+        "pass": bool(
+            trainers_ok
+            and drains_ok == len(peers)
+            and dev <= bound
+            and spread <= bound
+            and not wedged
+        ),
+    }
+    return result
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(SEED)
+    arms = {arm: _run_arm(arm, np, jnp, rng) for arm in ("python", "native")}
+    out = {
+        "bench": "chaos_soak",
+        "n": N,
+        "seconds_per_arm": SECONDS,
+        "seed": SEED,
+        "arms": arms,
+        "pass": all(a["pass"] for a in arms.values()),
+    }
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

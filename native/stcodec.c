@@ -102,7 +102,16 @@ static struct {
   stc_seg_fn fn;
   void *ctx;
   int64_t nseg;
-  _Atomic int64_t next;
+  /* generation-tagged work counter: (gen & 0xffffffff) << 32 | next_index.
+   * The tag closes a straggler race: a worker that woke for job G and
+   * snapshotted fn/ctx/nseg can be preempted BEFORE its first pop while
+   * the other threads finish all of G; the submitter then returns, frees
+   * G's chunks (a stack ctx), and publishes job G+1 — an untagged counter
+   * would hand the stale worker G+1's chunk indices to run with G's dead
+   * fn/ctx (use-after-free) while G+1 silently loses those chunks. With
+   * the tag, a pop whose generation no longer matches fails and the
+   * straggler falls through to re-wait (ADVICE r05 finding 2). */
+  _Atomic uint64_t next;
   int64_t finished;
 } g_pool = {PTHREAD_MUTEX_INITIALIZER, PTHREAD_COND_INITIALIZER,
             PTHREAD_COND_INITIALIZER,  PTHREAD_MUTEX_INITIALIZER,
@@ -110,6 +119,19 @@ static struct {
             0,                         0,
             0,                         0,
             0,                         0};
+
+/* Pop one chunk index for generation `gen`, or -1 when the job is exhausted
+ * OR the counter now belongs to a different generation (stale worker). */
+static int64_t stc_pool_pop(uint64_t gen, int64_t nseg) {
+  uint64_t cur = atomic_load(&g_pool.next);
+  for (;;) {
+    if ((uint32_t)(cur >> 32) != (uint32_t)gen) return -1; /* stale gen */
+    int64_t s = (int64_t)(cur & 0xffffffffu);
+    if (s >= nseg) return -1; /* job exhausted */
+    /* on failure `cur` is refreshed; re-check gen before retrying */
+    if (atomic_compare_exchange_weak(&g_pool.next, &cur, cur + 1)) return s;
+  }
+}
 
 static void *stc_pool_worker(void *arg) {
   (void)arg;
@@ -124,14 +146,22 @@ static void *stc_pool_worker(void *arg) {
     pthread_mutex_unlock(&g_pool.mu);
     int64_t done = 0;
     for (;;) {
-      int64_t s = atomic_fetch_add(&g_pool.next, 1);
-      if (s >= nseg) break;
+      int64_t s = stc_pool_pop(seen, nseg);
+      if (s < 0) break;
       fn(ctx, s);
       done++;
     }
     pthread_mutex_lock(&g_pool.mu);
-    g_pool.finished += done;
-    if (g_pool.finished >= nseg) pthread_cond_signal(&g_pool.cv_done);
+    /* `done` only counts chunks of OUR generation (stc_pool_pop refuses
+     * cross-generation pops), so finished can never be polluted by a
+     * straggler of an older job. A straggler that popped nothing reports
+     * done == 0 and immediately re-waits — if a newer job is already
+     * published (g_pool.gen != seen), the wait falls through and it joins
+     * that job with the CURRENT fn/ctx. */
+    if (g_pool.gen == seen) {
+      g_pool.finished += done;
+      if (g_pool.finished >= nseg) pthread_cond_signal(&g_pool.cv_done);
+    }
     pthread_mutex_unlock(&g_pool.mu);
   }
   return NULL;
@@ -185,21 +215,24 @@ static int stc_pool_up(void) {
  * too. Returns 1 if the job ran on the pool, 0 if the caller must run the
  * whole loop inline (pool busy / dead / tiny job). */
 static int stc_pool_run(stc_seg_fn fn, void *ctx, int64_t nseg) {
-  if (nseg < 2 || !stc_pool_up()) return 0;
+  if (nseg < 2 || nseg >= (int64_t)1 << 32 || !stc_pool_up()) return 0;
   if (pthread_mutex_trylock(&g_pool.job_mu) != 0) return 0;
   pthread_mutex_lock(&g_pool.mu);
   g_pool.fn = fn;
   g_pool.ctx = ctx;
   g_pool.nseg = nseg;
-  atomic_store(&g_pool.next, 0);
   g_pool.finished = 0;
   g_pool.gen++;
+  uint64_t gen = g_pool.gen; /* ours until job_mu is released */
+  /* publish the generation-tagged counter (index 0) with the new gen: any
+   * straggler still holding the previous gen can no longer pop from it */
+  atomic_store(&g_pool.next, (uint64_t)(uint32_t)gen << 32);
   pthread_cond_broadcast(&g_pool.cv_job);
   pthread_mutex_unlock(&g_pool.mu);
   int64_t done = 0;
   for (;;) {
-    int64_t s = atomic_fetch_add(&g_pool.next, 1);
-    if (s >= nseg) break;
+    int64_t s = stc_pool_pop(gen, nseg);
+    if (s < 0) break;
     fn(ctx, s);
     done++;
   }
@@ -1243,6 +1276,18 @@ EXPORT void stc_accumulate_update_to_partials(
  * Leaves where every frame's scale is zero are copied verbatim (the k == 1
  * path's idle-leaf memcpy).
  *
+ * EXCEPTION — the malloc-failure fallback below is NOT bit-identical for
+ * k > 1: when the active-frame table cannot be allocated it applies frames
+ * one at a time via stc_apply_frame, which clamps after EVERY frame and
+ * rounds (in+d1)+d2 instead of in+(d1+...+dk) — up to ~1 ulp per element
+ * off the fused path (more if intermediate sums hit the +/-3e38 clamp).
+ * Rerouting through the provably-identical accumulate_delta+add_to
+ * pipeline is not possible there: it needs a total*4-byte delta buffer,
+ * and this branch exists precisely because allocation just failed. The
+ * divergence only occurs under OOM and stays inside the ~1-ulp tier
+ * tolerance every consumer of these arrays already accepts
+ * (ADVICE r05 finding 4).
+ *
  * Optional out_amax/out_ss/out_sabs (NULL ok): scale partials of the result,
  * fused like stc_quantize_ef_partials — for residual targets whose next
  * quantize needs them (stengine.cpp partials cache). */
@@ -1474,7 +1519,9 @@ EXPORT void stc_apply_frames(const float *vin, float *vout, const int64_t *off,
       (const uint32_t **)malloc((size_t)n_leaves * k * sizeof(uint32_t *));
   float *svals = (float *)malloc((size_t)n_leaves * k * sizeof(float));
   int32_t *am = (int32_t *)malloc((size_t)n_leaves * sizeof(int32_t));
-  if (!wps || !svals || !am) { /* OOM: fall back to frame-at-a-time */
+  if (!wps || !svals || !am) {
+    /* OOM: frame-at-a-time fallback — ~1 ulp off the fused path for k > 1
+     * (per-frame clamp + rounding; see the kernel header's EXCEPTION) */
     free(wps);
     free(svals);
     free(am);

@@ -17,7 +17,7 @@
 //
 // Two wire modes:
 //  - native (default): length-prefixed frames [u32le len][payload]; len==0 is
-//    a keepalive. Join handshake: client sends "STT2" + u32le payload_hint;
+//    a keepalive. Join handshake: client sends "STT3" + u32le payload_hint;
 //    server replies 'Y' (accept) or 'N' + 16-byte IPv4 sockaddr redirect.
 //  - wire-compat: byte-exact reference protocol for interop with C peers
 //    (SURVEY.md §2.3): no hello, fixed-size frames [f32 scale][ceil(n/8) bit
@@ -27,9 +27,11 @@
 // Exposed as a plain C ABI for ctypes (no pybind11 in this image).
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -37,6 +39,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <map>
@@ -46,12 +49,120 @@
 #include <thread>
 #include <vector>
 
+// Process-wide crash point (ST_FAULT_CRASH="name:N"): _exit(17) on the Nth
+// arrival at the named point. Parsed once; thread-safe countdown. Defined
+// ONCE for the whole .so and shared with stengine.cpp's protocol points
+// (mid-burst, between-apply-and-ack) — a per-translation-unit copy would
+// split the parse/countdown state, so a point name served by both files
+// would fire at the wrong Nth arrival.
+extern "C" __attribute__((visibility("default"))) void st_fault_crash_point(
+    const char* name) {
+  // Hot path first: every engine/transport data loop in the process calls
+  // this per message, so the UNARMED case (production default) must be a
+  // single relaxed atomic load — never the shared mutex, which would be a
+  // process-global serialization point across all nodes' threads.
+  static std::atomic<int> armed{-1};  // -1 unparsed, 0 unarmed, 1 armed
+  int a = armed.load(std::memory_order_relaxed);
+  if (a == 0) return;
+  static std::mutex mu;
+  static std::string point;
+  static long remaining = 0;
+  std::lock_guard<std::mutex> lk(mu);
+  if (armed.load(std::memory_order_relaxed) < 0) {
+    const char* env = getenv("ST_FAULT_CRASH");
+    if (env && *env) {
+      std::string s(env);
+      size_t c = s.find(':');
+      point = c == std::string::npos ? s : s.substr(0, c);
+      remaining = c == std::string::npos ? 1 : atol(s.c_str() + c + 1);
+      if (remaining < 1) remaining = 1;
+    }
+    armed.store(point.empty() ? 0 : 1, std::memory_order_relaxed);
+  }
+  if (point.empty() || point != name) return;
+  if (--remaining <= 0) _exit(17);
+}
+
 namespace {
 
 using Clock = std::chrono::steady_clock;
 
 constexpr uint32_t kMaxPayload = 1u << 30;  // 1 GiB sanity cap
-constexpr char kMagic[4] = {'S', 'T', 'T', '2'};
+// 'STT3' since r06: DATA/BURST payloads gained a u32 tx_seq after the kind
+// byte (go-back-N, comm/wire.py). The framing change is handshake-breaking
+// by design — a pre-seq peer pairing with a post-seq peer would silently
+// mis-ack (old rule: undecodable still counts) or discard-and-churn; the
+// magic bump turns both into an explicit join rejection.
+constexpr char kMagic[4] = {'S', 'T', 'T', '3'};
+
+// ---- fault injection (env-gated hook table; comm/faults.py to_env) -------
+//
+// ST_FAULT_PLAN="seed=N,drop=P,dup=P,trunc=P,corrupt=P,delay_pct=P,
+// delay_ms=M,stall_after=K,sever_after=K,only_link=L" installs deterministic
+// wire faults on every node CREATED while the variable is set (parsed per
+// st_node_create, so a test can make exactly one node chaotic). Faults
+// apply only to DATA frames on the sender side — native framing kind 0/7,
+// or any non-keepalive payload in wire-compat mode — never to handshake or
+// ACK traffic, so injected chaos drives the recovery machinery (ledger
+// rollback, carry, re-graft) instead of wedging a join. This is the native
+// twin of the Python tier's FaultPlan (comm/faults.py): both tiers face
+// the same fault classes from the same config.
+//
+// ST_FAULT_CRASH="point:N" additionally arms a process-wide kill at a
+// named protocol point (here: "mid-join-walk"); see also stengine.cpp's
+// points. The process dies with _exit(17) — no destructors, no drain:
+// the whole point is that nothing below the point runs.
+struct FaultPlan {
+  int enabled = 0;
+  uint64_t seed = 0;
+  double drop = 0, dup = 0, trunc = 0, corrupt = 0, delay_pct = 0;
+  double delay_ms = 0;
+  int64_t stall_after = -1;  // >=0: swallow data frames past the Nth, per link
+  int64_t sever_after = 0;   // >0: hard-kill the link at its Nth data frame
+  int32_t only_link = 0;     // >0: restrict ALL faults to this one link id
+};
+
+FaultPlan parse_fault_plan() {
+  FaultPlan p;
+  const char* env = getenv("ST_FAULT_PLAN");
+  if (!env || !*env) return p;
+  p.enabled = 1;
+  std::string s(env);
+  size_t i = 0;
+  while (i <= s.size()) {
+    size_t j = s.find(',', i);
+    if (j == std::string::npos) j = s.size();
+    std::string kv = s.substr(i, j - i);
+    size_t eq = kv.find('=');
+    if (eq != std::string::npos) {
+      std::string k = kv.substr(0, eq);
+      double v = atof(kv.c_str() + eq + 1);
+      if (k == "seed") p.seed = (uint64_t)v;
+      else if (k == "drop") p.drop = v;
+      else if (k == "dup") p.dup = v;
+      else if (k == "trunc") p.trunc = v;
+      else if (k == "corrupt") p.corrupt = v;
+      else if (k == "delay_pct") p.delay_pct = v;
+      else if (k == "delay_ms") p.delay_ms = v;
+      else if (k == "stall_after") p.stall_after = (int64_t)v;
+      else if (k == "sever_after") p.sever_after = (int64_t)v;
+      else if (k == "only_link") p.only_link = (int32_t)v;
+    }
+    i = j + 1;
+  }
+  return p;
+}
+
+// xorshift64: deterministic per-link stream (seeded seed ^ f(link id)),
+// uniform in [0, 1). Never zero-state (the splat constant guards it).
+inline double frand64(uint64_t* st) {
+  uint64_t x = *st ? *st : 0x9e3779b97f4a7c15ull;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  *st = x;
+  return (double)(x >> 11) / (double)(1ull << 53);
+}
 
 struct Config {
   int32_t wire_compat = 0;
@@ -65,6 +176,12 @@ struct Config {
   int32_t queue_depth = 8;
   int32_t max_rejoin_attempts = 8;
   double rejoin_backoff_sec = 0.2;
+  // Bounded joins (TransportConfig twins): per-attempt connect()/reply
+  // bound and the total create-time join budget. 0 = legacy behavior
+  // (blocking connect / fixed attempt count).
+  double connect_timeout_sec = 5.0;
+  double join_timeout_sec = 30.0;
+  FaultPlan fault;  // env-gated wire chaos (parse_fault_plan)
 };
 
 struct Event {
@@ -143,6 +260,10 @@ struct Link {
   // addressing trick, src/sharedtensor.c:292-316), this doubles as the
   // child's listen address for redirects.
   sockaddr_in peer_addr{};
+  // fault-injection state (only touched when the node's plan is enabled;
+  // sender-loop-thread-local in practice)
+  uint64_t fault_rng = 0;
+  int64_t fault_frames = 0;  // data frames seen at this wire boundary
 
   Link(size_t qdepth) : sendq(qdepth), recvq(qdepth) {}
 };
@@ -185,6 +306,7 @@ struct Node {
   sockaddr_in rendezvous{};
   bool is_master = false;
   std::string last_error;
+  uint64_t jrng = 0;  // rejoin-backoff jitter stream (rejoin_loop only)
 
   void notify_data() {
     {
@@ -245,6 +367,34 @@ void set_recv_timeout(int fd, double sec) {
   tv.tv_sec = (time_t)sec;
   tv.tv_usec = (suseconds_t)((sec - (double)tv.tv_sec) * 1e6);
   setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+}
+
+// Bounded connect: nonblocking connect + poll, restoring blocking mode on
+// the way out. The reference's blocking connect() hangs FOREVER against a
+// rendezvous that drops packets (no RST) — the join walk needs a per-hop
+// bound so a dead target fails in bounded time instead (ISSUE r06
+// tentpole). timeout <= 0 keeps the legacy blocking behavior.
+bool connect_with_timeout(int fd, const sockaddr_in* addr,
+                          double timeout_sec) {
+  if (timeout_sec <= 0)
+    return ::connect(fd, (const sockaddr*)addr, sizeof *addr) == 0;
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  int r = ::connect(fd, (const sockaddr*)addr, sizeof *addr);
+  bool ok = r == 0;
+  if (!ok && errno == EINPROGRESS) {
+    pollfd pfd{};
+    pfd.fd = fd;
+    pfd.events = POLLOUT;
+    if (::poll(&pfd, 1, (int)(timeout_sec * 1000.0)) == 1) {
+      int err = 0;
+      socklen_t len = sizeof err;
+      getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+      ok = err == 0;
+    }
+  }
+  fcntl(fd, F_SETFL, flags);
+  return ok;
 }
 
 // ---- link lifecycle ------------------------------------------------------
@@ -319,6 +469,58 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
         frame.clear();
       }
     }
+    // ---- fault injection at the wire boundary (Config::fault; the
+    // Python tier injects the identical classes in peer._send_blocking).
+    // Data frames only: native kind 0/7, or any queued payload in compat
+    // mode (compat has no control plane on the wire). A keepalive (!have)
+    // is liveness, not data — chaos never silences liveness.
+    size_t write_len = frame.size();
+    int write_reps = 1;
+    const FaultPlan& fp = node->cfg.fault;
+    if (fp.enabled && have) {
+      bool is_data =
+          node->cfg.wire_compat ||
+          (!frame.empty() && (frame[0] == 0 || frame[0] == 7));
+      if (is_data && (fp.only_link <= 0 || link->id == fp.only_link)) {
+        if (!link->fault_rng)
+          link->fault_rng =
+              (fp.seed + 1) * 0x9e3779b97f4a7c15ull + (uint64_t)link->id;
+        int64_t nf = ++link->fault_frames;
+        if (fp.sever_after > 0 && nf >= fp.sever_after) break;  // kill_link
+        if (fp.stall_after >= 0 && nf > fp.stall_after)
+          continue;  // swallowed: sender layers believe it was delivered
+        if (fp.delay_pct > 0 && frand64(&link->fault_rng) < fp.delay_pct)
+          std::this_thread::sleep_for(
+              std::chrono::duration<double>(fp.delay_ms / 1000.0));
+        if (fp.drop > 0 && frand64(&link->fault_rng) < fp.drop) continue;
+        if (fp.corrupt > 0 && frame.size() > 1 &&
+            frand64(&link->fault_rng) < fp.corrupt) {
+          // flip one bit past the kind byte: lands in scales/words, the
+          // receiver's decode-guard trust boundary
+          size_t i =
+              1 + (size_t)(frand64(&link->fault_rng) * (frame.size() - 1));
+          if (i >= frame.size()) i = frame.size() - 1;
+          frame[i] ^= (uint8_t)(1u << (int)(frand64(&link->fault_rng) * 8));
+        }
+        if (fp.trunc > 0 && !node->cfg.wire_compat && frame.size() > 2 &&
+            frand64(&link->fault_rng) < fp.trunc) {
+          // well-framed SHORT message (header announces the truncated
+          // length): the receiver decodes, rejects, and ACKs it —
+          // bounded per-frame loss, not a stream shear. Compat framing
+          // is fixed-size, so truncation there would desync every later
+          // frame; disabled.
+          write_len = 1 + (size_t)(frand64(&link->fault_rng) *
+                                   (frame.size() - 1));
+          if (write_len > frame.size()) write_len = frame.size();
+        }
+        // dup gated off compat like trunc: the reference protocol has no
+        // seq dedup, so a duplicated compat frame would double-apply with
+        // no recovery path (comm/faults.py FaultPlan.wire_compat)
+        if (fp.dup > 0 && !node->cfg.wire_compat &&
+            frand64(&link->fault_rng) < fp.dup)
+          write_reps = 2;
+      }
+    }
     if (cap > 0 && !frame.empty()) {
       auto now = Clock::now();
       tokens += std::chrono::duration<double>(now - last).count() * (double)cap;
@@ -335,15 +537,18 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
         tokens -= (double)frame.size();
       }
     }
-    bool ok;
-    if (node->cfg.wire_compat) {
-      ok = write_full(link->fd, frame.data(), frame.size());
-    } else {
-      uint32_t len = (uint32_t)frame.size();
-      uint8_t hdr[4] = {(uint8_t)len, (uint8_t)(len >> 8), (uint8_t)(len >> 16),
-                        (uint8_t)(len >> 24)};
-      ok = write_full(link->fd, hdr, 4) &&
-           (frame.empty() || write_full(link->fd, frame.data(), frame.size()));
+    bool ok = true;
+    for (int rep = 0; rep < write_reps && ok; rep++) {
+      if (node->cfg.wire_compat) {
+        ok = write_full(link->fd, frame.data(), write_len);
+      } else {
+        uint32_t len = (uint32_t)write_len;
+        uint8_t hdr[4] = {(uint8_t)len, (uint8_t)(len >> 8),
+                          (uint8_t)(len >> 16), (uint8_t)(len >> 24)};
+        ok = write_full(link->fd, hdr, 4) &&
+             (write_len == 0 ||
+              write_full(link->fd, frame.data(), write_len));
+      }
     }
     if (!ok) break;
     if (have) {
@@ -483,7 +688,10 @@ int join_walk(Node* node, sockaddr_in target, bool allow_master,
     int fd = ::socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -1;
     set_common_sockopts(fd);
-    if (::connect(fd, (sockaddr*)&target, sizeof target) < 0) {
+    // bounded per-hop connect (see connect_with_timeout): a dead or
+    // silently-dropping target fails this hop after the bound instead of
+    // hanging the join forever
+    if (!connect_with_timeout(fd, &target, node->cfg.connect_timeout_sec)) {
       ::close(fd);
       if (hops == 0 && allow_master) {
         // nobody home at the rendezvous: we are the master (the reference's
@@ -503,8 +711,14 @@ int join_walk(Node* node, sockaddr_in target, bool allow_master,
         return -1;
       }
     }
+    // crash point: connected + hello'd, membership not yet granted
+    st_fault_crash_point("mid-join-walk");
     uint8_t reply;
-    set_recv_timeout(fd, 10.0);
+    // the reply read gets the same per-hop bound: an accepting-but-silent
+    // peer (half-dead redirect target) must not wedge the walk
+    set_recv_timeout(fd, node->cfg.connect_timeout_sec > 0
+                             ? node->cfg.connect_timeout_sec
+                             : 10.0);
     if (!read_full(fd, &reply, 1)) {
       ::close(fd);
       return -1;
@@ -561,8 +775,12 @@ void rejoin_loop(Node* node) {
     bool rejoined = false;
     for (int attempt = 0;
          attempt < node->cfg.max_rejoin_attempts && !node->closing; attempt++) {
+      // exponential backoff with +/-50% jitter: orphaned siblings of a dead
+      // interior node all start this loop at the same instant; jitter
+      // de-synchronizes their walks (and their master-failover bind races)
       std::this_thread::sleep_for(std::chrono::duration<double>(
-          node->cfg.rejoin_backoff_sec * (double)(1 << std::min(attempt, 6))));
+          node->cfg.rejoin_backoff_sec * (double)(1 << std::min(attempt, 6)) *
+          (0.5 + frand64(&node->jrng))));
       bool became_master = false;
       sockaddr_in local{};
       int fd = join_walk(node, node->rendezvous, /*allow_master=*/false,
@@ -637,6 +855,8 @@ struct StConfigC {
   int32_t queue_depth;
   int32_t max_rejoin_attempts;
   double rejoin_backoff_sec;
+  double connect_timeout_sec;  // per-hop connect/reply bound (0 = blocking)
+  double join_timeout_sec;     // total create-time join budget (0 = 30 s)
 };
 
 struct StEventC {
@@ -669,6 +889,11 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
   cfg.queue_depth = cfg_c->queue_depth;
   cfg.max_rejoin_attempts = cfg_c->max_rejoin_attempts;
   cfg.rejoin_backoff_sec = cfg_c->rejoin_backoff_sec;
+  cfg.connect_timeout_sec = cfg_c->connect_timeout_sec;
+  cfg.join_timeout_sec = cfg_c->join_timeout_sec;
+  cfg.fault = parse_fault_plan();  // env hook table, per-node at create
+  node->jrng = (uint64_t)::getpid() * 0x9e3779b97f4a7c15ull +
+               (uint64_t)Clock::now().time_since_epoch().count();
 
   hostent* server = gethostbyname(host);
   if (!server) {
@@ -694,10 +919,28 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
   bool became_master = false;
   int up_fd = -1;
   int listen_fd = -1;
-  for (int attempt = 0; attempt < 50 && !listen_fd_ok(listen_fd); attempt++) {
+  // Bounded join-or-become-master: a TOTAL deadline (join_timeout_sec)
+  // replaces the old fixed 50-attempt loop, and retries back off
+  // exponentially with +/-50% jitter — a herd of simultaneous joiners (or
+  // the two election races above) must not re-collide in lockstep. Before
+  // r06, an unreachable-but-not-refusing rendezvous hung the first
+  // connect() forever; now every hop is bounded (connect_with_timeout)
+  // and the whole loop gives up at the deadline, surfacing a
+  // ConnectionError to Python instead of a wedged constructor.
+  double budget = cfg.join_timeout_sec > 0 ? cfg.join_timeout_sec : 30.0;
+  auto deadline = Clock::now() + std::chrono::duration<double>(budget);
+  uint64_t jrng = node->jrng;
+  for (int attempt = 0; attempt < 1000 && !listen_fd_ok(listen_fd);
+       attempt++) {
     if (attempt > 0) {
-      std::this_thread::sleep_for(
-          std::chrono::milliseconds(10 * std::min(attempt, 10)));
+      if (Clock::now() >= deadline) break;
+      double base = 0.01 * (double)(1 << std::min(attempt - 1, 7));
+      if (base > 2.0) base = 2.0;
+      double sleep_s = base * (0.5 + frand64(&jrng));
+      double rem =
+          std::chrono::duration<double>(deadline - Clock::now()).count();
+      if (sleep_s > rem) sleep_s = rem > 0 ? rem : 0;
+      std::this_thread::sleep_for(std::chrono::duration<double>(sleep_s));
     }
     became_master = false;
     sockaddr_in listen_addr{};

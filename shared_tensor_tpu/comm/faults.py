@@ -1,0 +1,261 @@
+"""Deterministic fault injection at the wire boundary (both tiers).
+
+The reference's entire failure story is ``exit(-1)`` on any socket error
+(quirk Q8); this framework instead claims ledger rollback, re-graft carry,
+master failover, quarantine and bounded-time joins — claims that are only
+worth anything if the paths are *exercised deterministically*, not just
+described. This module is the chaos source that does so:
+
+- :class:`FaultPlan` wraps a frozen
+  :class:`~shared_tensor_tpu.config.FaultConfig` with a seeded RNG and
+  per-link frame counters. The peer engine consults it at its send boundary
+  (``peer._send_blocking``) and at named protocol points
+  (``peer._fault_point``). Everything is a pure function of
+  (seed, per-link frame sequence): the same plan over the same traffic
+  replays the same chaos.
+- :func:`to_env` renders the same config into the ``ST_FAULT_PLAN`` /
+  ``ST_FAULT_CRASH`` environment strings the NATIVE tier parses
+  (sttransport.cpp's per-link fault table; stengine.cpp / sttransport.cpp
+  crash points), so both data planes face identical fault classes. The env
+  table is read per ``st_node_create`` — set it before creating one node's
+  transport and only that node is chaotic.
+
+Fault classes and which recovery path each drives:
+
+==================  =======================================================
+fault               recovery path exercised
+==================  =======================================================
+drop / stall        sender's unacked ledger grows; the go-back-N delivery
+                    timer retransmits the tail byte-identical (exact
+                    recovery), or link death rolls it into the re-graft
+                    carry (at-least-once)
+duplicate           receiver's wire-seq dedup discards the echo —
+                    exactly-once (wire.py tx_seq discipline)
+truncate            receiver decode guard rejects the sheared message
+                    WITHOUT consuming its seq; retransmission re-delivers
+                    it whole — exact recovery
+corrupt             receiver decode guard (non-finite scales zeroed) —
+                    bounded per-frame loss
+delay               reordering pressure on drain()/ACK retry logic
+sever               transport LINK_DOWN -> rollback -> carry -> re-graft
+crash points        process death at the worst instants: mid-join-walk,
+                    mid-burst (ledgered, unsent), between apply and ACK
+                    (the two-generals window)
+quarantine (cfg)    a stalled-but-open peer is torn down after N
+                    consecutive failed sends instead of retried hot
+==================  =======================================================
+
+Frames only: faults never touch handshake (SYNC/CHUNK/WELCOME/REJECT) or
+ACK traffic, so injected chaos exercises recovery instead of wedging a
+join the protocol has no retry for.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from collections import Counter
+from typing import Callable, Optional
+
+from ..config import FaultConfig
+
+log = logging.getLogger("shared_tensor_tpu.faults")
+
+#: Exit status used by default crash actions (native tier uses the same via
+#: _exit(17)), so a soak harness can tell an injected kill from a real one.
+CRASH_EXIT_CODE = 17
+
+#: The named protocol points a plan may kill a peer at.
+CRASH_POINTS = ("mid-join-walk", "mid-burst", "between-apply-and-ack")
+
+
+class FaultPlan:
+    """One peer's live fault state: the frozen config + seeded RNG +
+    per-link counters. Thread-safe (the peer's send and recv threads both
+    consult it). ``counts`` tallies every injected event for soak-bound
+    accounting (a convergence bound must scale with the chaos actually
+    injected, not the probabilities requested)."""
+
+    def __init__(
+        self,
+        config: FaultConfig,
+        on_crash: Optional[Callable[[str], None]] = None,
+        scale_bytes: int = 0,
+        wire_compat: bool = False,
+    ):
+        if config.crash_point and config.crash_point not in CRASH_POINTS:
+            raise ValueError(
+                f"unknown crash point {config.crash_point!r} "
+                f"(valid: {CRASH_POINTS})"
+            )
+        self.cfg = config
+        #: Bytes of scale prefix per frame (4 * num_leaves) — lets corrupt()
+        #: land its bit flips in the packed sign words of DATA *and* BURST
+        #: payloads (a burst interleaves scales between frames, so a
+        #: geometry-blind flip could hit a later frame's scale exponent —
+        #: unbounded chaos; see corrupt()). 0 = geometry unknown.
+        self.scale_bytes = scale_bytes
+        #: Wire-compat links carry the reference's fixed-size raw frames:
+        #: no seqs, no ACKs, no retransmission. Truncation would shear the
+        #: fixed-size re-framing (every later frame misparsed) and a
+        #: duplicate would double-apply with no dedup — chaos with NO
+        #: recovery path, which this layer never injects (module
+        #: docstring); both classes are skipped on compat links. The C
+        #: injector gates identically (sttransport.cpp link_sender_loop).
+        self.wire_compat = wire_compat
+        self._rng = random.Random(config.seed)
+        self._sent: dict[int, int] = {}  # link -> data frames seen
+        self._point_hits: dict[str, int] = {}
+        self._mu = threading.Lock()
+        self._on_crash = on_crash
+        self.counts: Counter = Counter()
+
+    @property
+    def active(self) -> bool:
+        return self.cfg.enabled
+
+    def on_send(
+        self, link: int, payload: bytes
+    ) -> tuple[list[bytes], float, bool]:
+        """Decide one outgoing DATA/BURST message's fate. Returns
+        ``(payloads, delay_sec, sever)``: the caller sleeps ``delay_sec``,
+        sends each payload in order (possibly none — the frame vanished on
+        the wire, exactly what the ledger exists to survive — or two), and
+        tears the link down after when ``sever`` is set."""
+        cfg = self.cfg
+        if not cfg.enabled:
+            return [payload], 0.0, False
+        if cfg.only_link > 0 and link != cfg.only_link:
+            return [payload], 0.0, False
+        with self._mu:
+            n = self._sent[link] = self._sent.get(link, 0) + 1
+            r = self._rng
+            if cfg.sever_after_frames > 0 and n >= cfg.sever_after_frames:
+                self.counts["severed"] += 1
+                return [], 0.0, True
+            if cfg.stall_after_frames >= 0 and n > cfg.stall_after_frames:
+                self.counts["stalled"] += 1
+                return [], 0.0, False
+            delay = 0.0
+            if cfg.delay_pct > 0 and r.random() < cfg.delay_pct:
+                self.counts["delayed"] += 1
+                delay = cfg.delay_sec
+            if cfg.drop_pct > 0 and r.random() < cfg.drop_pct:
+                self.counts["dropped"] += 1
+                return [], delay, False
+            out = payload
+            if (
+                cfg.corrupt_pct > 0
+                and len(payload) > 1
+                and r.random() < cfg.corrupt_pct
+            ):
+                self.counts["corrupted"] += 1
+                out = corrupt(out, r, self.scale_bytes)
+            if (
+                cfg.truncate_pct > 0
+                and not self.wire_compat  # would shear the fixed framing
+                and len(out) > 2
+                and r.random() < cfg.truncate_pct
+            ):
+                self.counts["truncated"] += 1
+                out = out[: r.randrange(1, len(out))]
+            if (
+                cfg.dup_pct > 0
+                and not self.wire_compat  # compat has no dedup
+                and r.random() < cfg.dup_pct
+            ):
+                self.counts["duplicated"] += 1
+                return [out, out], delay, False
+            return [out], delay, False
+
+    def point(self, name: str) -> None:
+        """A named protocol point was reached; kill the peer here when the
+        plan says so. Default action is ``os._exit`` — the point of a
+        crash fault is that NOTHING below it runs (no drain, no seal, no
+        destructor), exactly like SIGKILL. Tests pass ``on_crash`` to
+        observe the hit in-process instead."""
+        cfg = self.cfg
+        if not cfg.enabled or cfg.crash_point != name:
+            return
+        with self._mu:
+            hits = self._point_hits[name] = self._point_hits.get(name, 0) + 1
+            if hits < max(1, cfg.crash_after):
+                return
+            self.counts["crashed"] += 1
+        if self._on_crash is not None:
+            self._on_crash(name)
+            return
+        log.warning("fault plan killing peer at protocol point %r", name)
+        os._exit(CRASH_EXIT_CODE)
+
+
+def corrupt(
+    payload: bytes, rng: random.Random, scale_bytes: int = 0
+) -> bytes:
+    """Flip one random bit in the packed SIGN WORDS of one frame: past the
+    kind byte (the message still routes as DATA/BURST) and past every
+    scale prefix. A flipped sign bit mis-applies one element by 2*scale —
+    bounded, which is what lets the chaos soak assert
+    convergence-within-bound. A flipped scale-EXPONENT bit would instead
+    multiply a whole frame's mass by up to 2^127 while remaining
+    protocol-legal (finite scales up to 2^127 are inside the wire's trust
+    domain — see wire.decode_frame), i.e. chaos no recovery path can
+    bound; the codec has no scale authentication by design. Bursts
+    interleave a scale prefix before EVERY frame, so the word spans are
+    computed from the payload's own framing (``scale_bytes`` = 4 *
+    num_leaves, from the peer's spec); with the geometry unknown
+    (scale_bytes=0) the flip falls back to the last 3/4 of the payload —
+    sign words for single-frame DATA, best-effort otherwise."""
+    b = bytearray(payload)
+    lo, hi = 0, 0
+    if scale_bytes > 0 and b[0] == 0 and len(b) > 5 + scale_bytes:
+        lo, hi = 5 + scale_bytes, len(b)  # DATA: one frame after the seq
+    elif scale_bytes > 0 and b[0] == 7 and len(b) > 6:
+        k = b[5]
+        per = (len(b) - 6) // k if k else 0
+        if k and per > scale_bytes and 6 + k * per == len(b):
+            f = rng.randrange(k)  # one frame's words span
+            lo, hi = 6 + f * per + scale_bytes, 6 + (f + 1) * per
+    if not lo:
+        lo, hi = max(1, len(b) // 4), len(b)
+    i = rng.randrange(lo, hi)
+    b[i] ^= 1 << rng.randrange(8)
+    return bytes(b)
+
+
+def to_env(cfg: FaultConfig) -> dict[str, str]:
+    """Render a FaultConfig into the native tier's environment hook table:
+    ``ST_FAULT_PLAN`` (per-link wire faults, parsed by st_node_create — set
+    it around ONE node's creation to make only that node chaotic) and
+    ``ST_FAULT_CRASH`` (process-wide crash point, parsed once per process
+    by the .so). Keys whose value is the default are omitted, so an
+    all-default config renders to {} (no injection). Caveat: the native
+    injector's ``corrupt`` is geometry-blind (FaultConfig.corrupt_pct) —
+    unlike this module's :func:`corrupt` it may hit seq/scale bytes, so
+    treat native corruption as survival chaos, not bounded chaos."""
+    if not cfg.enabled:
+        return {}
+    parts = [f"seed={cfg.seed}"]
+    if cfg.drop_pct > 0:
+        parts.append(f"drop={cfg.drop_pct}")
+    if cfg.dup_pct > 0:
+        parts.append(f"dup={cfg.dup_pct}")
+    if cfg.truncate_pct > 0:
+        parts.append(f"trunc={cfg.truncate_pct}")
+    if cfg.corrupt_pct > 0:
+        parts.append(f"corrupt={cfg.corrupt_pct}")
+    if cfg.delay_pct > 0:
+        parts.append(f"delay_pct={cfg.delay_pct}")
+        parts.append(f"delay_ms={cfg.delay_sec * 1000.0}")
+    if cfg.stall_after_frames >= 0:
+        parts.append(f"stall_after={cfg.stall_after_frames}")
+    if cfg.sever_after_frames > 0:
+        parts.append(f"sever_after={cfg.sever_after_frames}")
+    if cfg.only_link > 0:
+        parts.append(f"only_link={cfg.only_link}")
+    env = {"ST_FAULT_PLAN": ",".join(parts)}
+    if cfg.crash_point:
+        env["ST_FAULT_CRASH"] = f"{cfg.crash_point}:{max(1, cfg.crash_after)}"
+    return env

@@ -40,9 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
-from ..core import SharedTensor
+from ..core import DuplicateLink, SharedTensor
 from ..ops.table import make_spec
-from . import wire
+from . import faults, wire
 from .transport import EventKind, TransportNode
 
 log = logging.getLogger("shared_tensor_tpu.peer")
@@ -57,6 +57,21 @@ log = logging.getLogger("shared_tensor_tpu.peer")
 #: src/sharedtensor.c:124-126/:338-342). Never a transport link id
 #: (transport ids start at 1); the send loop and drain skip it.
 CARRY_LINK = -1
+
+#: Go-back-N send window: max unacked DATA/BURST messages per link before
+#: the send loop stops producing new frames for it. Bounds the retained
+#: retransmission payloads (a stalled link would otherwise grow its ledger
+#: — and the retransmittable tail — without limit until teardown) while
+#: leaving a healthy link's pipeline far deeper than its ms-scale ACK
+#: latency ever needs. The native engine enforces the same window.
+SEND_WINDOW = 32
+
+#: Max messages re-sent per retransmission round: go-back-N only needs the
+#: HEAD of the unacked tail to restore in-order progress at the receiver
+#: (everything behind a hole is discarded until the hole fills); resending
+#: a short prefix repairs it without re-shipping the whole window's bytes
+#: every round. Ditto in the native engine.
+RETX_PREFIX = 4
 
 
 def _python_tier_auto_burst(spec) -> int:
@@ -95,6 +110,23 @@ class SharedTensorPeer:
         codec = self.config.codec
         tcfg = self.config.transport
         spec = make_spec(template)
+        # Python-tier fault injection (Config.faults): consulted at the
+        # send boundary and at named protocol points. None when disabled —
+        # the production path pays one None-check per send. The NATIVE data
+        # planes (transport sender loop, engine) read the same schedule
+        # from the ST_FAULT_PLAN/ST_FAULT_CRASH env table instead
+        # (faults.to_env), parsed at node-create time. scale_bytes hands
+        # the plan the frame geometry so corrupt() flips land in sign
+        # words, not scale exponents (the bounded fault class).
+        self._faults: Optional[faults.FaultPlan] = (
+            faults.FaultPlan(
+                self.config.faults,
+                scale_bytes=4 * spec.num_leaves,
+                wire_compat=tcfg.wire_compat,
+            )
+            if self.config.faults.enabled
+            else None
+        )
         from ..core import host_tier_active
 
         # Burst sizing (Config.frame_burst): host tier only — the device
@@ -210,8 +242,37 @@ class SharedTensorPeer:
                     # compat: the engine speaks the reference's raw frames
                     # directly (no ACK ledger — the protocol has none)
                     compat_frame_bytes=frame_bytes if tcfg.wire_compat else 0,
+                    quarantine_send_failures=tcfg.quarantine_send_failures,
+                    ack_timeout_sec=tcfg.ack_timeout_sec,
+                    ack_retry_limit=tcfg.ack_retry_limit,
                 )
                 self._engine = self.st
+                # Vacuous-chaos guard: Config.faults WIRE knobs inject in
+                # the PYTHON tier's send path, which engine links never
+                # traverse — on this tier the same classes come from the
+                # ST_FAULT_PLAN env table (faults.to_env), parsed by
+                # st_node_create above. A chaos test that forgot the env
+                # render would pass green having injected nothing.
+                import os as _os
+
+                fcfg = self.config.faults
+                if (
+                    fcfg.enabled
+                    and not _os.environ.get("ST_FAULT_PLAN")
+                    and any((
+                        fcfg.drop_pct, fcfg.dup_pct, fcfg.truncate_pct,
+                        fcfg.corrupt_pct, fcfg.delay_pct,
+                        fcfg.stall_after_frames >= 0,
+                        fcfg.sever_after_frames,
+                    ))
+                ):
+                    log.warning(
+                        "FaultConfig wire faults are configured but the "
+                        "NATIVE engine owns this peer's data plane — they "
+                        "will inject NOTHING on engine links; render them "
+                        "into the env with faults.to_env() around node "
+                        "creation (crash_point still fires)"
+                    )
             except Exception as e:
                 log.warning("native engine unavailable, using python tier: %s", e)
         if self._engine is None:
@@ -248,14 +309,24 @@ class SharedTensorPeer:
         self._compat_reset_on_regraft = False
         self._sealed = False  # leave() in progress: discard unACKed ingress
         self._uplink: Optional[int] = None
-        # delivery accounting (see _send_loop): sent-but-unacked frame seqs
-        # per link (send thread appends, recv thread pops on wire.ACK), and
-        # cumulative RX/ACK counters per link
+        # delivery accounting (see _send_loop): per link, the in-order list
+        # of sent-but-unacked messages as (ledger_seq, wire_seq, payload)
+        # — the payload is kept so an ACK timeout can retransmit it
+        # byte-identical (go-back-N; wire.py tx_seq docstring). Send thread
+        # appends, recv thread pops on wire.ACK (entries with
+        # wire_seq <= ack count). Plus cumulative TX/RX/ACK counters and
+        # the per-link retransmission timer state.
         self._ack_mu = threading.Lock()
-        self._unacked: dict[int, list[int]] = {}
+        self._unacked: dict[int, list[tuple[int, int, bytes]]] = {}
+        self._tx_seq: dict[int, int] = {}  # wire seq of last data msg sent
         self._acked: dict[int, int] = {}
         self._rx_count: dict[int, int] = {}
         self._ack_sent: dict[int, int] = {}  # highest ACK actually delivered
+        # time.monotonic() of the link's last delivery progress (ACK moved,
+        # or the unacked list became non-empty), and fruitless
+        # retransmission rounds since — both guarded by _ack_mu
+        self._ack_progress: dict[int, float] = {}
+        self._retx_rounds: dict[int, int] = {}
         self._recv_thread = threading.Thread(
             target=self._recv_loop, daemon=True, name="st-recv"
         )
@@ -483,6 +554,14 @@ class SharedTensorPeer:
                 del pipe[stale]  # LINK_DOWN already rolled their ledger back
                 hot.discard(stale)
             for link in links:
+                if not compat and self._window_full(link):
+                    # go-back-N send window: a link whose unacked ledger is
+                    # full (stalled peer, black hole in progress) produces
+                    # no new frames — bounds both the retained-payload
+                    # memory and the retransmittable tail; residual mass
+                    # keeps accumulating and quantizes once ACKs reopen
+                    # the window (or teardown rolls it into the carry)
+                    continue
                 if self._burst > 1:
                     # Host-tier burst path: K residual halvings quantized in
                     # one synchronous call, ONE message, ONE ledger entry,
@@ -496,10 +575,16 @@ class SharedTensorPeer:
                         hot.discard(link)
                         continue
                     hot.add(link)
-                    payload = wire.encode_burst(burst, self.st.spec)
-                    with self._ack_mu:
-                        self._unacked.setdefault(link, []).append(seq)
-                    if self._send_blocking(link, payload):
+                    payload = self._register_data(
+                        link,
+                        seq,
+                        lambda s: wire.encode_burst(burst, self.st.spec, s),
+                    )
+                    # crash point: frames ledgered + error feedback applied,
+                    # message NOT yet on the wire — death here must roll the
+                    # whole burst into the re-graft carry
+                    self._fault_point("mid-burst")
+                    if self._send_blocking(link, payload, data=True):
                         sent_any = True
                     else:
                         self.st.nack_frame(link)
@@ -562,18 +647,23 @@ class SharedTensorPeer:
                 if frame is None:
                     continue
                 hot.add(link)
-                if dev_burst:
-                    payload = wire.encode_burst(frame, self.st.spec)
-                elif compat:
+                # registered (with its wire seq) BEFORE sending: the
+                # receiver's ACK must never race ahead of the ledger entry
+                # it acknowledges
+                if compat:
                     payload = wire.encode_compat_frame(frame, self.st.spec)
+                elif dev_burst:
+                    payload = self._register_data(
+                        link,
+                        seq,
+                        lambda s: wire.encode_burst(frame, self.st.spec, s),
+                    )
                 else:
-                    payload = wire.encode_frame(frame)
-                if not compat:
-                    # register BEFORE sending: the receiver's ACK must never
-                    # race ahead of the ledger entry it acknowledges
-                    with self._ack_mu:
-                        self._unacked.setdefault(link, []).append(seq)
-                if self._send_blocking(link, payload):
+                    payload = self._register_data(
+                        link, seq, lambda s: wire.encode_frame(frame, s)
+                    )
+                self._fault_point("mid-burst")  # ledgered, not yet sent
+                if self._send_blocking(link, payload, data=True):
                     if compat:
                         self.st.ack_frame(link, seq)  # no ACK in the protocol
                     sent_any = True
@@ -584,6 +674,7 @@ class SharedTensorPeer:
                     pipe.pop(link, None)
                     hot.discard(link)
                     self.st.nack_frame(link)
+            self._check_retransmit(links)
             if self._stop.is_set():
                 return
             if interval > 0:
@@ -594,15 +685,161 @@ class SharedTensorPeer:
                 self._wake.wait(0.05)
                 self._wake.clear()
 
-    def _send_blocking(self, link: int, payload: bytes) -> bool:
+    def _register_data(self, link: int, ledger_seq: int, encode) -> bytes:
+        """Allocate the link's next wire seq, encode the outgoing DATA/BURST
+        message with it, and append (ledger_seq, wire_seq, payload) to the
+        unacked retransmission ledger — the payload is kept verbatim so a
+        delivery timeout can resend it byte-identical (go-back-N;
+        wire.py tx_seq docstring). The encode itself (multi-MB numpy
+        serialization for big bursts) runs OUTSIDE _ack_mu so it never
+        stalls the recv thread's ACK pops; this thread is the link's only
+        seq allocator and appender, and the peer cannot ACK a seq before
+        the send that follows the append, so the two lock windows cannot
+        misorder the ledger."""
+        with self._ack_mu:
+            txs = self._tx_seq.get(link, 0) + 1
+            self._tx_seq[link] = txs
+        payload = encode(txs)
+        with self._ack_mu:
+            if link not in self._tx_seq:
+                # LINK_DOWN raced between the two lock windows and purged
+                # this link's ledger state; appending now would recreate
+                # the dict entry for a dead link (ids are never reused)
+                # and pin the payload until close()
+                return payload
+            q = self._unacked.setdefault(link, [])
+            if not q:
+                self._ack_progress[link] = time.monotonic()
+            q.append((ledger_seq, txs, payload))
+        return payload
+
+    def _window_full(self, link: int) -> bool:
+        with self._ack_mu:
+            return len(self._unacked.get(link, ())) >= SEND_WINDOW
+
+    def _check_retransmit(self, links) -> None:
+        """Go-back-N delivery timer (TransportConfig.ack_timeout_sec): when
+        a link's oldest unacked message has waited past the timeout, resend
+        the HEAD of the unacked tail byte-identical (RETX_PREFIX messages —
+        same wire seqs, so the receiver's dedup makes a spurious retransmit
+        harmless, and in-order acceptance means only the head can restore
+        progress anyway). After ack_retry_limit rounds with zero ACK
+        progress the link is a black hole (accepts writes, acknowledges
+        nothing): tear it down so LINK_DOWN -> rollback -> carry ->
+        re-graft recovers every undelivered frame on a fresh link instead
+        of retrying forever."""
+        tcfg = self.config.transport
+        # Sweep ledger state whose link is gone (runs even with the timer
+        # disabled): _register_data's first lock window can recreate
+        # _tx_seq for a link whose LINK_DOWN purge already ran, pinning the
+        # payload forever — link ids are never reused, so anything not in
+        # the live set is garbage. Only this thread appends, so a link
+        # attached after `links` was snapshotted cannot have entries yet.
+        with self._ack_mu:
+            live = set(links)
+            for stale in [l for l in self._unacked if l not in live]:
+                self._unacked.pop(stale, None)
+                self._tx_seq.pop(stale, None)
+                self._acked.pop(stale, None)
+                self._ack_progress.pop(stale, None)
+                self._retx_rounds.pop(stale, None)
+        if tcfg.ack_timeout_sec <= 0 or tcfg.wire_compat:
+            return
+        now = time.monotonic()
+        for link in links:
+            with self._ack_mu:
+                q = self._unacked.get(link)
+                # per-round exponential backoff (capped 8x): the timer
+                # measures time since ledger append, so on a
+                # bandwidth-capped link a big burst can legitimately wait
+                # out several timeouts while still queued locally — a flat
+                # timer would retransmit (and eventually tear down) a
+                # healthy saturated link; backoff keeps spurious rounds
+                # from compounding while a true black hole still hits the
+                # retry limit in bounded time
+                wait = tcfg.ack_timeout_sec * min(
+                    1 << self._retx_rounds.get(link, 0), 8
+                )
+                if not q or now - self._ack_progress.get(link, now) < wait:
+                    continue
+                rounds = self._retx_rounds.get(link, 0) + 1
+                self._retx_rounds[link] = rounds
+                self._ack_progress[link] = now
+                tail = [p for (_, _, p) in q[:RETX_PREFIX]]
+            if rounds > max(1, tcfg.ack_retry_limit):
+                log.warning(
+                    "link %d: no ACK progress after %d retransmission "
+                    "rounds — tearing down for re-graft",
+                    link, rounds - 1,
+                )
+                self.node.drop_link(link)
+                continue
+            log.info(
+                "link %d: retransmitting %d unacked message(s), round %d",
+                link, len(tail), rounds,
+            )
+            for payload in tail:
+                if not self._send_blocking(link, payload, data=True):
+                    break
+
+    def _fault_point(self, name: str) -> None:
+        """Named protocol point for the fault plan's kill schedule."""
+        if self._faults is not None:
+            self._faults.point(name)
+
+    def _send_blocking(
+        self, link: int, payload: bytes, data: bool = False
+    ) -> bool:
         """Deliver one frame, riding out backpressure. On a dead link the
         frame is dropped — its content is still in our replica, and the
-        re-graft handshake re-derives exactly the missing delta."""
+        re-graft handshake re-derives exactly the missing delta.
+
+        ``data=True`` marks DATA/BURST payloads: the fault plan (when one
+        is installed) may drop, delay, duplicate, truncate, bit-corrupt,
+        stall or sever them here — the Python tier's wire boundary.
+        Handshake and ACK traffic never goes through the chaos."""
+        if self._faults is not None and data:
+            payloads, delay, sever = self._faults.on_send(link, payload)
+            if delay > 0:
+                time.sleep(delay)
+            ok = True
+            for p in payloads:
+                ok = self._send_raw(link, p)
+                if not ok:
+                    break
+            if sever:
+                self.node.drop_link(link)
+                return False
+            # a dropped/stalled frame reports success: the sender must
+            # believe it delivered (that is the fault) — its ledger entry
+            # stays unacked, which is exactly what rollback recovers
+            return ok
+        return self._send_raw(link, payload)
+
+    def _send_raw(self, link: int, payload: bytes) -> bool:
+        quarantine = self.config.transport.quarantine_send_failures
+        fails = 0
         while not self._stop.is_set():
             try:
                 if self.node.send(link, payload, timeout=0.1):
                     return True
             except BrokenPipeError:
+                return False
+            fails += 1
+            if quarantine > 0 and fails >= quarantine:
+                # Per-link quarantine: ~quarantine/10 seconds of a full
+                # send queue with zero drained bytes means the peer has
+                # stopped consuming but kept its socket open. Retrying hot
+                # pins this thread (and the frames) on a dead-in-practice
+                # link until peer_timeout_sec; tearing it down routes
+                # through LINK_DOWN -> rollback -> carry -> re-graft, the
+                # path that loses nothing.
+                log.warning(
+                    "quarantining link %d after %d consecutive send "
+                    "failures (~%.0fs stalled): tearing down for re-graft",
+                    link, fails, fails * 0.1,
+                )
+                self.node.drop_link(link)
                 return False
         return False
 
@@ -670,12 +907,33 @@ class SharedTensorPeer:
                                 # leaving: discard unACKed — the sender's
                                 # ledger re-delivers after our departure
                                 continue
-                            # counted BEFORE decode: an undecodable DATA was
-                            # still a received wire message, and the sender's
-                            # in-flight ledger pops one entry per message —
-                            # skipping it would permanently misalign the
-                            # cumulative ACK count and strand ledger entries
-                            msgs += 1
+                            # Go-back-N acceptance (wire.py tx_seq): only
+                            # the next in-order, decodable message is
+                            # applied and counted. A duplicate (seq <= rx:
+                            # injected, or a retransmit racing our ACK) and
+                            # anything after a gap (seq > rx+1: a message
+                            # vanished at the wire) are discarded unapplied
+                            # — the sender retransmits the hole
+                            # byte-identical, so nothing is lost, nothing
+                            # applies twice, and the cumulative ACK is
+                            # always exactly the last accepted seq. An
+                            # undecodable message (truncated/garbled) is
+                            # likewise discarded WITHOUT consuming its seq;
+                            # its retransmission arrives whole.
+                            # expected seq masked to u32: the wire field
+                            # wraps at 2^32 while rx_count counts on
+                            # (matching the native engine's compare)
+                            seq = wire.data_seq(payload)
+                            want = (
+                                self._rx_count.get(link, 0) + msgs + 1
+                            ) & 0xFFFFFFFF
+                            if seq != want:
+                                log.debug(
+                                    "link %d: discarding out-of-order "
+                                    "data message (seq %d, expected %d)",
+                                    link, seq, want,
+                                )
+                                continue
                             if payload[0] == wire.DATA:
                                 batch.append(
                                     wire.decode_frame(payload, self.st.spec)
@@ -684,6 +942,7 @@ class SharedTensorPeer:
                                 batch.extend(
                                     wire.decode_burst(payload, self.st.spec)
                                 )
+                            msgs += 1
                             continue
                     except Exception as e:  # a bad frame must not kill the node
                         log.warning("dropping bad frame on link %d: %s", link, e)
@@ -725,11 +984,16 @@ class SharedTensorPeer:
                     except Exception as e:
                         log.warning("dropping bad frame on link %d: %s", link, e)
             self._wake.set()  # flood refills other links' residuals
-        # ACK counts wire MESSAGES (one ledger entry each), not frames: a
-        # burst message carries many frames but rolls back / acks whole. An
-        # undecodable DATA/BURST still counts (batch may be empty, msgs > 0)
-        # — the message was received, and the sender's ledger pops per
-        # message.
+        # crash point: mass applied + flooded, ACK not yet sent — the
+        # two-generals window; the sender re-delivers (at-least-once)
+        if n_ack:
+            self._fault_point("between-apply-and-ack")
+        # ACK counts ACCEPTED wire MESSAGES (one ledger entry each), not
+        # frames: a burst message carries many frames but rolls back / acks
+        # whole. With the tx_seq discipline (recv loop) the cumulative count
+        # is exactly the last in-order seq applied — undecodable or
+        # out-of-order messages were never counted and will be
+        # retransmitted by their sender.
         if n_ack:
             self._ack_received(link, n_ack)
 
@@ -768,14 +1032,44 @@ class SharedTensorPeer:
                     # the daemon recv thread, and an escaped raise would
                     # silently kill it and wedge the peer — the link is
                     # already attached, which is the state the event asks
-                    # for anyway. Only the dedicated duplicate type is
-                    # caught: any other attach-path error must surface, not
-                    # be misread as a replay.
+                    # for anyway.
                     log.warning(
                         "duplicate LINK_UP for link %d ignored", ev.link_id
                     )
+                except Exception:
+                    # Any OTHER attach-path error must surface loudly (it is
+                    # NOT a replay and may mean the link never attached) —
+                    # but never by killing the daemon recv thread: a dead
+                    # recv loop wedges the whole peer, the exact
+                    # exit(-1)-class failure this framework exists to
+                    # delete. The link CANNOT be left up either: a
+                    # half-attached link still ACKs every message by count
+                    # while the apply path drops its frames (unknown link),
+                    # so the sender would clear error feedback for mass
+                    # that never landed — silent permanent divergence. Tear
+                    # it down instead: LINK_DOWN -> rollback -> carry ->
+                    # re-graft re-delivers everything on a fresh link.
+                    log.exception(
+                        "LINK_UP handling failed for link %d — tearing the "
+                        "link down for re-graft (recv thread continues)",
+                        ev.link_id,
+                    )
+                    try:
+                        self.node.drop_link(ev.link_id)
+                    except Exception:
+                        log.exception(
+                            "teardown of half-attached link %d failed",
+                            ev.link_id,
+                        )
             else:
-                self._on_membership_event(ev)
+                try:
+                    self._on_membership_event(ev)
+                except Exception:
+                    # same thread-survival rule as LINK_UP above
+                    log.exception(
+                        "membership event %s for link %d failed "
+                        "(recv thread continues)", ev.kind, ev.link_id
+                    )
         return bool(evs)
 
     def _on_link_up(self, ev) -> None:
@@ -848,9 +1142,12 @@ class SharedTensorPeer:
             self._engine_links.discard(ev.link_id)
             with self._ack_mu:
                 self._unacked.pop(ev.link_id, None)
+                self._tx_seq.pop(ev.link_id, None)
                 self._acked.pop(ev.link_id, None)
                 self._rx_count.pop(ev.link_id, None)
                 self._ack_sent.pop(ev.link_id, None)
+                self._ack_progress.pop(ev.link_id, None)
+                self._retx_rounds.pop(ev.link_id, None)
             if ev.is_uplink:
                 # Keep undelivered upward updates for the re-grafted
                 # uplink — in a LIVE carry slot that continues to absorb
@@ -975,6 +1272,9 @@ class SharedTensorPeer:
             # lands during the handshake (the live slot keeps absorbing)
         self._sent_snapshot = snap
         self._send_blocking(uplink, wire.encode_sync(self.st.spec))
+        # crash point: SYNC sent, snapshot not — the parent holds a pending
+        # handshake buffer for a child that just died mid-walk
+        self._fault_point("mid-join-walk")
         for chunk in wire.encode_snapshot_chunks(np.asarray(snap, dtype="<f4")):
             if not self._send_blocking(uplink, chunk):
                 return  # uplink died mid-handshake; LINK_DOWN re-derives carry
@@ -983,16 +1283,30 @@ class SharedTensorPeer:
     def _on_message(self, link: int, payload: bytes) -> None:
         kind = payload[0]
         if kind == wire.DATA:
+            # same go-back-N acceptance as the recv-loop data path (this
+            # branch serves stray DATA routed through the control plane);
+            # expected seq masked to the wire field's u32 wrap
+            if wire.data_seq(payload) != (
+                self._rx_count.get(link, 0) + 1
+            ) & 0xFFFFFFFF:
+                return  # dup/gap: discard unapplied, await retransmission
             self.st.receive_frame(link, wire.decode_frame(payload, self.st.spec))
             self._ack_received(link, 1)
             self._wake.set()  # flood refills other links' residuals
         elif kind == wire.ACK:
+            # cumulative ACK = last in-order wire seq the peer accepted;
+            # every unacked entry at or below it is delivered
             count = wire.decode_ack(payload)
             with self._ack_mu:
-                done = count - self._acked.get(link, 0)
                 self._acked[link] = count
-                seqs = self._unacked.get(link, [])
-                acked, self._unacked[link] = seqs[:done], seqs[done:]
+                q = self._unacked.get(link, [])
+                acked = []
+                while q and q[0][1] <= count:
+                    acked.append(q.pop(0)[0])
+                if acked:
+                    # delivery progressed: reset the go-back-N timer
+                    self._ack_progress[link] = time.monotonic()
+                    self._retx_rounds.pop(link, None)
             for seq in acked:
                 self.st.ack_frame(link, seq)
         elif kind == wire.SYNC:

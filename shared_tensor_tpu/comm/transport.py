@@ -35,6 +35,8 @@ class _StConfigC(ctypes.Structure):
         ("queue_depth", ctypes.c_int32),
         ("max_rejoin_attempts", ctypes.c_int32),
         ("rejoin_backoff_sec", ctypes.c_double),
+        ("connect_timeout_sec", ctypes.c_double),
+        ("join_timeout_sec", ctypes.c_double),
     ]
 
 
@@ -187,14 +189,22 @@ class TransportNode:
             queue_depth=queue_depth,
             max_rejoin_attempts=cfg.max_rejoin_attempts,
             rejoin_backoff_sec=0.2,
+            connect_timeout_sec=cfg.connect_timeout_sec,
+            join_timeout_sec=cfg.join_timeout_sec,
         )
         is_master = ctypes.c_int32(0)
         self._h = self._lib.st_node_create(
             host.encode(), port, ctypes.byref(c), ctypes.byref(is_master)
         )
         if not self._h:
+            # bounded-time failure (join_timeout_sec of backed-off attempts,
+            # each hop bounded by connect_timeout_sec) — before r06 a dead
+            # rendezvous could block the constructor forever instead
             raise ConnectionError(
-                f"could not join or become master at {host}:{port}"
+                f"could not join or become master at {host}:{port} "
+                # 0 is the documented use-the-default sentinel; the native
+                # layer coerces it to 30 s, so print the real budget
+                f"within {cfg.join_timeout_sec or 30.0:.0f}s"
             )
         self.is_master = bool(is_master.value)
         self._recv_buf = ctypes.create_string_buffer(max(frame_bytes, 1 << 20))

@@ -25,7 +25,37 @@ implicit parts explicit, because they are exactly where the reference breaks
   stream framing).
 
 Data frames carry per-leaf scales ("table sync", reference README.md:41) +
-the LSB-first packed sign bits produced by ops/packing.py.
+the LSB-first packed sign bits produced by ops/packing.py — prefixed (r06)
+with the sender's per-link message sequence number (``tx_seq``, u32 LE):
+the cumulative count of DATA/BURST messages sent on the link, starting at
+1. The tag costs 4 bytes and makes the cumulative-count ACK protocol sound
+under message loss: without it, delivery must be a prefix of what was sent
+(true on a raw TCP stream, violated by anything that can swallow or repeat
+one message — fault injection here, a dying proxy/peer in production), or
+the sender acks the WRONG ledger entries and rollback re-delivers frames
+the peer already applied. With it, both tiers run go-back-N:
+
+- the receiver applies a DATA/BURST message only when it decodes AND
+  ``seq == rx + 1`` (in order); its cumulative ACK is then exactly the
+  last accepted seq;
+- ``seq <= rx`` is a duplicate (injected, or a retransmit racing our ACK):
+  discarded without applying or counting — exactly-once under dup faults;
+- ``seq > rx + 1`` means a message vanished: the gap and everything after
+  it is discarded unapplied, so nothing is ever mis-acked;
+- the sender keeps every unacked message's frames in its ledger (capped by
+  a send window — peer.SEND_WINDOW — so a stalled link cannot grow it
+  unboundedly) and, when the oldest goes unacked past
+  ``TransportConfig.ack_timeout_sec``, retransmits the HEAD of the unacked
+  tail BYTE-IDENTICAL (same seqs; only the head can restore in-order
+  progress) with per-round exponential backoff — safe to repeat because
+  the receiver dedups by seq. After ``ack_retry_limit`` fruitless rounds
+  the link is torn down into the LINK_DOWN -> rollback -> carry ->
+  re-graft path instead of retrying forever.
+
+Net effect: drop / duplicate / truncate / reorder faults on data frames
+converge EXACTLY (no lost and no double-counted mass); the only remaining
+at-least-once window is a peer dying between apply and ACK, which the
+ledger re-delivers (documented crash point "between-apply-and-ack").
 
 ``encode_compat_frame``/``decode_compat_frame`` speak the reference's exact
 frame bytes for wire-compat interop with C peers (SURVEY.md §2.3 wire spec).
@@ -82,10 +112,16 @@ BURST_MAX_FRAMES = 255
 BURST_MAX_BYTES = 1 << 24
 
 
+#: Wire overhead of a DATA message before the frame body: kind byte +
+#: u32 tx_seq. BURST adds one more byte (the frame count).
+DATA_HDR = 5
+BURST_HDR = 6
+
+
 def burst_frames_cap(spec: TableSpec) -> int:
     """Most frames one BURST message may carry for this spec (>= 1)."""
     per = frame_payload_bytes(spec)
-    return max(1, min(BURST_MAX_FRAMES, (BURST_MAX_BYTES - 2) // per))
+    return max(1, min(BURST_MAX_FRAMES, (BURST_MAX_BYTES - BURST_HDR) // per))
 
 
 def compat_burst_frames_cap(n: int) -> int:
@@ -106,32 +142,46 @@ def frame_payload_bytes(spec: TableSpec) -> int:
 
 def burst_wire_bytes(spec: TableSpec) -> int:
     """Max BURST message size for this spec."""
-    return 2 + burst_frames_cap(spec) * frame_payload_bytes(spec)
+    return BURST_HDR + burst_frames_cap(spec) * frame_payload_bytes(spec)
 
 
 def frame_wire_bytes(spec: TableSpec) -> int:
     """Max payload size of any native-mode message for this spec."""
-    data = 1 + frame_payload_bytes(spec)
+    data = DATA_HDR + frame_payload_bytes(spec)
     chunk = 1 + struct.calcsize(_CHUNK_HDR) + CHUNK_BYTES
     return max(data, chunk, burst_wire_bytes(spec))
 
 
-def encode_frame(frame: TableFrame) -> bytes:
+def data_seq(payload: bytes) -> int:
+    """The per-link tx_seq of a DATA/BURST payload (module docstring)."""
+    if len(payload) < DATA_HDR:
+        raise ValueError(
+            f"{len(payload)}-byte data message is too short to carry a seq"
+        )
+    return struct.unpack_from("<I", payload, 1)[0]
+
+
+def encode_frame(frame: TableFrame, seq: int) -> bytes:
     scales = np.asarray(frame.scales, dtype="<f4")
     words = np.asarray(frame.words, dtype="<u4")
-    return b"\x00" + scales.tobytes() + words.tobytes()
+    return (
+        bytes([DATA])
+        + struct.pack("<I", seq & 0xFFFFFFFF)
+        + scales.tobytes()
+        + words.tobytes()
+    )
 
 
 def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
     k = spec.num_leaves
     w = spec.total // 32
-    want = 1 + frame_payload_bytes(spec)
+    want = DATA_HDR + frame_payload_bytes(spec)
     if len(payload) != want:
         raise ValueError(
             f"DATA frame is {len(payload)} bytes, spec wants {want} "
             f"(k={k}, words={w}) — peer table layout mismatch"
         )
-    scales = np.frombuffer(payload, "<f4", count=k, offset=1)
+    scales = np.frombuffer(payload, "<f4", count=k, offset=DATA_HDR)
     # Corruption guard at the trust boundary: a non-finite scale would NaN
     # the replica and flood the poison tree-wide (reference quirk Q9 — the
     # receive-path analog of add()'s sanitization). Zeroing makes the leaf a
@@ -148,7 +198,7 @@ def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
             int(np.count_nonzero(~np.isfinite(scales))),
         )
         scales = np.where(np.isfinite(scales), scales, np.float32(0.0))
-    words = np.frombuffer(payload, "<u4", count=w, offset=1 + 4 * k)
+    words = np.frombuffer(payload, "<u4", count=w, offset=DATA_HDR + 4 * k)
     # numpy, NOT jnp: a host-tier peer must never initialize a jax backend
     # (thread-pool contention with its C codec loops); device tiers convert
     # on entry to their jitted applies. COPIES, not views: the frombuffer
@@ -158,8 +208,8 @@ def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
     return TableFrame(scales.copy(), words.copy())
 
 
-def encode_burst(frames, spec: TableSpec) -> bytes:
-    """K frames in one message: [BURST][u8 k][k x (scales || words)].
+def encode_burst(frames, spec: TableSpec, seq: int) -> bytes:
+    """K frames in one message: [BURST][u32 seq][u8 k][k x (scales||words)].
     Successive frames of one link are successive halvings of its residual;
     shipping them together amortizes the per-message engine cost that
     dominates at small table sizes (see Config.frame_burst)."""
@@ -169,17 +219,22 @@ def encode_burst(frames, spec: TableSpec) -> bytes:
             f"burst of {len(frames)} frames (this spec allows 1..{cap} — "
             f"the bound peers sized their receive buffers for)"
         )
-    parts = [bytes([BURST, len(frames)])]
+    parts = [
+        bytes([BURST])
+        + struct.pack("<I", seq & 0xFFFFFFFF)
+        + bytes([len(frames)])
+    ]
     for f in frames:
         parts.append(np.asarray(f.scales, dtype="<f4").tobytes())
         parts.append(np.asarray(f.words, dtype="<u4").tobytes())
     out = b"".join(parts)
     # hard check, not assert (would vanish under python -O): an encoder that
     # emits a mis-sized burst silently desyncs every downstream decoder
-    if len(out) != 2 + len(frames) * frame_payload_bytes(spec):
+    if len(out) != BURST_HDR + len(frames) * frame_payload_bytes(spec):
         raise ValueError(
             f"encoded burst is {len(out)} bytes, layout wants "
-            f"{2 + len(frames) * frame_payload_bytes(spec)} — frame/spec mismatch"
+            f"{BURST_HDR + len(frames) * frame_payload_bytes(spec)} — "
+            f"frame/spec mismatch"
         )
     return out
 
@@ -187,13 +242,15 @@ def encode_burst(frames, spec: TableSpec) -> bytes:
 def decode_burst(payload: bytes, spec: TableSpec) -> list[TableFrame]:
     """Inverse of :func:`encode_burst`, with the same per-frame corruption
     guard as decode_frame (non-finite scales zeroed)."""
-    k_frames = payload[1]
+    if len(payload) < BURST_HDR:
+        raise ValueError(f"BURST message of {len(payload)} bytes has no header")
+    k_frames = payload[BURST_HDR - 1]
     if k_frames == 0:
         # encode_burst never emits k=0; accepting one would ACK a message
-        # that delivered nothing (a 2-byte frame-less BURST is corruption)
+        # that delivered nothing (a frame-less BURST is corruption)
         raise ValueError("BURST with k_frames == 0")
     per = frame_payload_bytes(spec)
-    want = 2 + k_frames * per
+    want = BURST_HDR + k_frames * per
     if len(payload) != want:
         raise ValueError(
             f"BURST of {k_frames} frames is {len(payload)} bytes, "
@@ -201,7 +258,7 @@ def decode_burst(payload: bytes, spec: TableSpec) -> list[TableFrame]:
         )
     out = []
     for i in range(k_frames):
-        off = 2 + i * per
+        off = BURST_HDR + i * per
         scales = np.frombuffer(payload, "<f4", count=spec.num_leaves, offset=off)
         if not np.isfinite(scales).all():
             log.warning(

@@ -86,12 +86,124 @@ class TransportConfig:
     #: joiner THROUGH an interior node this way); 1..16 (0 would silently
     #: close every join; >16 would be silently clamped by the native layer).
     max_children: int = 2
+    #: Per-attempt bound on connect() AND on the join-walk reply read. The
+    #: reference (and this framework before r06) used a blocking connect: a
+    #: rendezvous that silently drops packets — or accepts and never speaks —
+    #: blocked the joiner FOREVER. 0 = legacy blocking connect.
+    connect_timeout_sec: float = 5.0
+    #: Total budget for the create-time join-or-become-master loop
+    #: (exponential backoff with +/-50% jitter between attempts, so joiner
+    #: herds and the two master-election races don't re-collide in
+    #: lockstep). Past the budget, creation fails with a ConnectionError
+    #: instead of retrying forever. 0 = default (30 s).
+    join_timeout_sec: float = 30.0
+    #: Go-back-N delivery timer (native framing only; see comm/wire.py's
+    #: tx_seq docstring). When the OLDEST unacked DATA/BURST message on a
+    #: live link goes unacknowledged this long, the sender retransmits the
+    #: whole unacked tail byte-identical (same seqs — the receiver dedups,
+    #: so a spurious retransmit is harmless). On a healthy TCP link ACKs
+    #: arrive in milliseconds and this never fires; it exists for
+    #: boundaries that can swallow a message whole (fault injection, dying
+    #: proxies). After ``ack_retry_limit`` fruitless rounds the link is
+    #: torn down into the LINK_DOWN -> rollback -> carry -> re-graft path.
+    #: 0 = disabled (a silently-lost message then strands its ledger
+    #: entries until the link dies).
+    ack_timeout_sec: float = 5.0
+    #: Retransmission rounds with zero ACK progress before the link is
+    #: declared a black hole and torn down for re-graft. Values <= 0
+    #: coerce to 1 round, identically on both data planes.
+    ack_retry_limit: int = 8
+    #: Per-link send quarantine: after this many CONSECUTIVE failed send
+    #: attempts (~0.1 s each — i.e. ~N/10 seconds of a full send queue with
+    #: zero drained bytes) the link is torn down and re-grafted instead of
+    #: retried hot. A peer that stops draining but keeps its socket open
+    #: would otherwise wedge our sender until peer_timeout_sec with frames
+    #: pinned in its dead queue; quarantine converts the stall into the
+    #: LINK_DOWN -> carry -> re-graft path the ledger already handles
+    #: losslessly. 0 = never quarantine (retry until liveness timeout).
+    quarantine_send_failures: int = 100
 
     def __post_init__(self):
         if not 1 <= self.max_children <= 16:
             raise ValueError(
                 f"max_children must be in 1..16, got {self.max_children}"
             )
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Deterministic, seedable fault injection at the wire boundary
+    (``comm/faults.py``; disabled by default — production pays only a
+    None-check per send).
+
+    The same fault classes exist on BOTH tiers, with tier-specific
+    injection: on the Python wire tier this config injects directly (the
+    peer consults a :class:`~shared_tensor_tpu.comm.faults.FaultPlan` in
+    its send path); on the native-engine tier the engine's C send path
+    never traverses that boundary, so the WIRE knobs must be rendered into
+    the ``ST_FAULT_PLAN`` / ``ST_FAULT_CRASH`` environment hook table
+    around node creation
+    (:func:`~shared_tensor_tpu.comm.faults.to_env` renders this config
+    into those strings; the peer logs a loud warning if wire faults are
+    configured on an engine-tier peer with no env table set). The crash
+    points fire on both tiers either way. Faults apply to DATA/BURST frames only — handshake and
+    ACK traffic stays clean, so every injected fault exercises the recovery
+    machinery (ledger rollback, carry, re-graft, quarantine) rather than
+    wedging a join. The reference's only failure story is exit(-1) on any
+    socket error; this layer exists to drive every recovery path this
+    framework claims, deterministically, in tests and the chaos soak.
+    """
+
+    #: Master switch; False = zero injection, identical to no plan at all.
+    enabled: bool = False
+    #: RNG seed — the whole schedule is a pure function of (seed, per-link
+    #: frame sequence), so runs are reproducible.
+    seed: int = 0
+    #: Probability a data frame is silently dropped at the wire (sender
+    #: believes it delivered; its ledger entry stays unacked).
+    drop_pct: float = 0.0
+    #: Probability a data frame is sent twice (the receiver's tx_seq dedup
+    #: discards the echo — exactly-once; see comm/wire.py).
+    dup_pct: float = 0.0
+    #: Probability a data frame is truncated to a random shorter length
+    #: (well-framed short message: the receiver's decode rejects it without
+    #: consuming its seq, and the sender's go-back-N retransmit re-delivers
+    #: it whole — exact recovery). Native framing only; compat framing is
+    #: fixed-size and would shear.
+    truncate_pct: float = 0.0
+    #: Probability a payload bit is flipped. PYTHON tier: the flip is
+    #: geometry-aware (faults.corrupt) and lands in a frame's packed sign
+    #: words — mis-applies ONE element by 2*scale, the bounded fault class
+    #: convergence bounds are built on. NATIVE tier: the C injector is
+    #: geometry-blind (it can hit seq/scale bytes, and a flipped finite
+    #: scale EXPONENT rescales a whole frame by up to 2^127) — survival /
+    #: decode-guard chaos only, never use it under a convergence-bound
+    #: assertion.
+    corrupt_pct: float = 0.0
+    #: Probability a data frame send is delayed by ``delay_sec``.
+    delay_pct: float = 0.0
+    delay_sec: float = 0.005
+    #: >= 0: every data frame past the Nth (per link) is silently swallowed
+    #: — a stalled link whose sender keeps ledgering. Deterministic; the
+    #: rollback/carry tests are built on this.
+    stall_after_frames: int = -1
+    #: > 0: hard-kill the link at its Nth data frame (transport-level sever
+    #: -> LINK_DOWN -> carry -> re-graft).
+    sever_after_frames: int = 0
+    #: > 0: restrict ALL faults to this one link id — "stall or sever an
+    #: individual link". Link ids are per-node and allocated from 1, so a
+    #: joiner's first uplink is link 1; a re-grafted uplink gets a fresh id
+    #: and runs clean, which is how the deterministic carry tests let the
+    #: recovery path prove itself. 0 = every link.
+    only_link: int = 0
+    #: Named protocol point at which to kill the peer process (os._exit):
+    #: "mid-join-walk" (SYNC sent, snapshot not), "mid-burst" (frames
+    #: ledgered, message not yet on the wire), "between-apply-and-ack"
+    #: (mass applied + flooded, ACK not sent — the at-least-once window).
+    #: "" = never. Tests may override the kill action via FaultPlan(on_crash=...).
+    crash_point: str = ""
+    #: Fire the crash on the Nth arrival at the point (1 = first).
+    crash_after: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,6 +229,8 @@ class Config:
     codec: CodecConfig = dataclasses.field(default_factory=CodecConfig)
     transport: TransportConfig = dataclasses.field(default_factory=TransportConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    #: Deterministic fault injection (tests / chaos soak); disabled default.
+    faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
     #: Background sync frame pacing: target seconds between frames per link;
     #: 0 = free-running (reference behavior: fill all bandwidth, README.md:31).
     sync_interval_sec: float = 0.0

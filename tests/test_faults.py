@@ -1,0 +1,555 @@
+"""Deterministic fault-injection tests (ISSUE r06 tentpole evidence).
+
+The recovery machinery this framework claims over the reference —
+ledger rollback, re-graft carry, bounded-time joins, per-link
+quarantine — is exercised here under *deterministic, seeded* chaos from
+`comm/faults.py`, on BOTH data planes:
+
+- the Python wire tier (``Config(native_engine=False)``): the
+  :class:`FaultPlan` consulted in ``peer._send_blocking``;
+- the native tier (engine + C transport): the identical schedule via the
+  ``ST_FAULT_PLAN`` / ``ST_FAULT_CRASH`` env hook table, parsed per
+  ``st_node_create``.
+
+Every convergence assertion doubles as a no-lost-state proof: after the
+injected chaos and its recovery, each replica must equal seed + the exact
+sum of every add — the delivery contract the reference's ``exit(-1)``
+cannot even state."""
+
+import logging
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shared_tensor_tpu.comm import faults
+from shared_tensor_tpu.comm.faults import CRASH_EXIT_CODE, FaultPlan
+from shared_tensor_tpu.comm.peer import SharedTensorPeer, create_or_fetch
+from shared_tensor_tpu.comm.transport import build_native
+from shared_tensor_tpu.config import Config, FaultConfig, TransportConfig
+
+from tests._ports import free_port as _free_port
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    build_native()
+
+
+def _cfg(fault: FaultConfig | None = None, engine: bool = True, **tkw):
+    tkw.setdefault("peer_timeout_sec", 10.0)
+    return Config(
+        transport=TransportConfig(**tkw),
+        faults=fault or FaultConfig(),
+        native_engine=engine,
+    )
+
+
+def _wait_converged(peers, expect, tol=1e-6, timeout=90.0):
+    """Same bar as test_peer: convergence is exact in finitely many frames;
+    the window is sized for a loaded box, not for the convergence itself."""
+    expect_leaves = jax.tree.leaves(expect)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        ok = True
+        for p in peers:
+            got = jax.tree.leaves(p.read())
+            if not all(
+                np.allclose(g, e, rtol=1e-4, atol=tol)
+                for g, e in zip(got, expect_leaves)
+            ):
+                ok = False
+                break
+        if ok:
+            return
+        time.sleep(0.05)
+    for i, p in enumerate(peers):
+        got = jax.tree.leaves(p.read())
+        for g, e in zip(got, expect_leaves):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(e), rtol=1e-4, atol=tol,
+                err_msg=f"peer {i} did not converge",
+            )
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan unit behavior (no network)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_is_deterministic():
+    """The whole schedule is a pure function of (seed, per-link frame
+    sequence): two plans over the same traffic make identical decisions,
+    a different seed makes different ones."""
+    cfg = FaultConfig(
+        enabled=True, seed=42, drop_pct=0.3, dup_pct=0.2, corrupt_pct=0.2,
+    )
+    payload = bytes(range(64)) * 4
+
+    def schedule(plan, n=200):
+        return [plan.on_send(1, payload) for _ in range(n)]
+
+    a = schedule(FaultPlan(cfg))
+    b = schedule(FaultPlan(cfg))
+    assert a == b
+    c = schedule(FaultPlan(FaultConfig(
+        enabled=True, seed=43, drop_pct=0.3, dup_pct=0.2, corrupt_pct=0.2,
+    )))
+    assert a != c
+    # and the chaos actually happened (counts drive soak bounds)
+    plan = FaultPlan(cfg)
+    schedule(plan)
+    assert plan.counts["dropped"] > 0
+    assert plan.counts["duplicated"] > 0
+    assert plan.counts["corrupted"] > 0
+
+
+def test_fault_plan_disabled_is_identity():
+    plan = FaultPlan(FaultConfig())  # enabled=False
+    payload = b"\x00payload"
+    assert plan.on_send(1, payload) == ([payload], 0.0, False)
+    plan.point("mid-burst")  # never fires
+    assert not plan.counts
+
+
+def test_fault_plan_only_link_filters():
+    cfg = FaultConfig(enabled=True, seed=1, stall_after_frames=0, only_link=3)
+    plan = FaultPlan(cfg)
+    payload = b"\x00payload"
+    # link 3 is stalled from the first frame; every other link runs clean
+    assert plan.on_send(3, payload)[0] == []
+    assert plan.on_send(1, payload)[0] == [payload]
+    assert plan.on_send(2, payload)[0] == [payload]
+
+
+def test_fault_plan_stall_and_sever_are_deterministic():
+    cfg = FaultConfig(enabled=True, seed=0, stall_after_frames=2)
+    plan = FaultPlan(cfg)
+    p = b"\x00x" * 8
+    assert plan.on_send(1, p)[0] == [p]  # frame 1
+    assert plan.on_send(1, p)[0] == [p]  # frame 2
+    assert plan.on_send(1, p)[0] == []   # frame 3+: swallowed
+    assert plan.on_send(2, p)[0] == [p]  # per-link counters
+    sev = FaultPlan(FaultConfig(enabled=True, sever_after_frames=2))
+    assert sev.on_send(1, p) == ([p], 0.0, False)
+    assert sev.on_send(1, p) == ([], 0.0, True)
+
+
+def test_fault_plan_corrupt_preserves_kind_byte():
+    rng_cfg = FaultConfig(enabled=True, seed=9, corrupt_pct=1.0)
+    plan = FaultPlan(rng_cfg)
+    payload = bytes([0]) + bytes(255)
+    for _ in range(64):
+        (out,), _, _ = plan.on_send(1, payload)
+        assert out[0] == 0  # still routes as DATA
+        assert len(out) == len(payload)
+        diff = [i for i in range(len(out)) if out[i] != payload[i]]
+        assert len(diff) == 1 and diff[0] >= len(payload) // 4
+
+
+def test_fault_plan_corrupt_targets_sign_words():
+    """With the frame geometry known (scale_bytes, as the peer passes it),
+    every corrupt flip must land in a frame's packed sign words — never a
+    scale byte: a flipped sign mis-applies ONE element by 2*scale (the
+    bounded fault class the chaos soak's bound is built on), while a
+    flipped scale exponent would rescale a whole frame by up to 2^127."""
+    import struct
+
+    sb = 8  # two leaves -> 8 scale bytes per frame
+    wb = 16  # four sign words per frame
+    plan = FaultPlan(
+        FaultConfig(enabled=True, seed=4, corrupt_pct=1.0), scale_bytes=sb
+    )
+    data = bytes([0]) + struct.pack("<I", 1) + bytes(sb) + bytes(wb)
+    burst = (
+        bytes([7]) + struct.pack("<I", 1) + bytes([3]) + bytes(3 * (sb + wb))
+    )
+    for payload, hdr in ((data, 5), (burst, 6)):
+        for _ in range(128):
+            (out,), _, _ = plan.on_send(1, payload)
+            diff = [i for i in range(len(out)) if out[i] != payload[i]]
+            assert len(diff) == 1
+            off = diff[0] - hdr
+            if hdr == 6:
+                off %= sb + wb  # position within its frame
+            assert off >= sb, f"flip at {diff[0]} hit a scale byte"
+
+
+def test_fault_plan_crash_point_callback_and_counting():
+    hits = []
+    plan = FaultPlan(
+        FaultConfig(enabled=True, crash_point="mid-burst", crash_after=3),
+        on_crash=hits.append,
+    )
+    for _ in range(5):
+        plan.point("mid-join-walk")  # wrong point: never fires
+    assert hits == []
+    plan.point("mid-burst")
+    plan.point("mid-burst")
+    assert hits == []  # crash_after=3: first two arrivals survive
+    plan.point("mid-burst")
+    assert hits == ["mid-burst"]
+    assert plan.counts["crashed"] == 1
+
+
+def test_fault_plan_rejects_unknown_crash_point():
+    with pytest.raises(ValueError, match="unknown crash point"):
+        FaultPlan(FaultConfig(enabled=True, crash_point="mid-lunch"))
+
+
+def test_to_env_round_trip():
+    assert faults.to_env(FaultConfig()) == {}  # disabled: no injection
+    env = faults.to_env(FaultConfig(
+        enabled=True, seed=5, drop_pct=0.1, sever_after_frames=7,
+        only_link=1, crash_point="mid-join-walk", crash_after=2,
+    ))
+    assert env["ST_FAULT_PLAN"] == "seed=5,drop=0.1,sever_after=7,only_link=1"
+    assert env["ST_FAULT_CRASH"] == "mid-join-walk:2"
+    # all-default knobs are omitted, so the native parser sees only what
+    # the config actually asked for
+    assert "stall_after" not in faults.to_env(
+        FaultConfig(enabled=True, seed=1)
+    )["ST_FAULT_PLAN"]
+
+
+# ---------------------------------------------------------------------------
+# Demo (a), Python tier: a severed link rolls unacked frames into the
+# re-graft carry with no lost state
+# ---------------------------------------------------------------------------
+
+
+def test_python_tier_sever_rolls_unacked_into_carry():
+    """Python wire tier: the joiner's fault plan stalls its uplink (frames
+    silently swallowed while the sender believes it delivered — the exact
+    failure the ACK ledger exists for), then severs it. The rolled-back
+    unacked mass must ride the re-graft carry: after the automatic rejoin,
+    every replica equals seed + the full delta. only_link pins the chaos to
+    the first uplink (link 1); the re-grafted uplink gets a fresh id and
+    runs clean, which is what lets the recovery path prove itself."""
+    port = _free_port()
+    seed = jnp.full((256,), 2.0, jnp.float32)
+    fault = FaultConfig(
+        enabled=True, seed=11,
+        stall_after_frames=1,  # messages 2+ vanish on the wire
+        sever_after_frames=4,  # then the link dies mid-stream (the
+        # go-back-N retransmission rounds walk the per-link counter up to
+        # this threshold even when the original traffic is only a couple
+        # of burst messages)
+        only_link=1,
+    )
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg(engine=False))
+    j = SharedTensorPeer(
+        "127.0.0.1", port, jnp.zeros_like(seed),
+        _cfg(fault, engine=False, ack_timeout_sec=1.0),
+    )
+    try:
+        j.wait_ready(60.0)
+        assert j._engine is None, "this test pins the Python wire tier"
+        _wait_converged([j], seed)
+        delta = jnp.asarray(
+            np.random.default_rng(7).normal(size=(256,)).astype(np.float32)
+        )
+        j.add(delta)
+        # chaos: message 1 delivers, later messages are swallowed
+        # (ledgered, unacked); the retransmission rounds push the plan's
+        # counter to the sever threshold and the link dies; the rejoin
+        # re-grafts with residual = carry = everything unacked. No lost
+        # state:
+        _wait_converged([m, j], seed + delta, tol=1e-5)
+        assert j._faults is not None
+        assert j._faults.counts["severed"] >= 1
+        assert j._faults.counts["stalled"] >= 1
+    finally:
+        j.close()
+        m.close()
+
+
+def test_python_tier_drop_faults_recovered_by_retransmission():
+    """Random drops (seeded, heavy): every dropped message's ledger entry
+    stays unacked, the go-back-N delivery timer retransmits the tail
+    byte-identical, and the receiver's seq discipline applies each message
+    exactly once — EXACT convergence with the link still up (no sever
+    needed; wire.py tx_seq docstring's central claim)."""
+    port = _free_port()
+    seed = jnp.zeros((128,), jnp.float32)
+    fault = FaultConfig(
+        enabled=True, seed=3, drop_pct=0.5, only_link=1,
+    )
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg(engine=False))
+    j = SharedTensorPeer(
+        "127.0.0.1", port, seed,
+        _cfg(fault, engine=False, ack_timeout_sec=1.0),
+    )
+    try:
+        j.wait_ready(60.0)
+        delta = jnp.full((128,), 0.75, jnp.float32)
+        j.add(delta)
+        _wait_converged([m, j], seed + delta, tol=1e-5)
+        assert j._faults.counts["dropped"] >= 1
+    finally:
+        j.close()
+        m.close()
+
+
+def test_python_tier_duplicate_is_deduped_exactly_once():
+    """Documented dup semantics (r06, wire.py tx_seq): a duplicated
+    DATA/BURST message carries the SAME wire seq, so the receiver's
+    go-back-N acceptance discards the echo — exactly-once under dup
+    faults, deterministic with dup_pct=1. (Before the seq prefix the
+    protocol had no receive-side dedup and every duplicate double-counted;
+    the ledger could not even represent the difference.)"""
+    port = _free_port()
+    seed = jnp.zeros((64,), jnp.float32)
+    fault = FaultConfig(enabled=True, seed=1, dup_pct=1.0, only_link=1)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg(engine=False))
+    j = SharedTensorPeer(
+        "127.0.0.1", port, seed, _cfg(fault, engine=False)
+    )
+    try:
+        j.wait_ready(60.0)
+        delta = jnp.full((64,), 0.5, jnp.float32)
+        j.add(delta)
+        # EXACTLY seed + delta on both ends: each echoed message was
+        # discarded by seq, none double-applied, none lost
+        _wait_converged([m, j], seed + delta, tol=1e-5)
+        assert j._faults.counts["duplicated"] >= 1
+    finally:
+        j.close()
+        m.close()
+
+
+# ---------------------------------------------------------------------------
+# Demo (a), native tier: same sever-into-carry path through the C transport
+# ---------------------------------------------------------------------------
+
+
+def test_native_tier_sever_rolls_unacked_into_carry(monkeypatch):
+    """Native tier: the identical fault class injected in the C transport's
+    sender loop (ST_FAULT_PLAN, parsed at st_node_create — set around ONE
+    node's creation so only the joiner is chaotic). The engine's ACK ledger
+    must roll the severed link's unacked frames into its carry and the
+    native rejoin must re-graft them: exact convergence, no lost state."""
+    port = _free_port()
+    seed = jnp.full((256,), 1.0, jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg())
+    if m._engine is None:
+        m.close()
+        pytest.skip("native engine unavailable on this tier")
+    env = faults.to_env(FaultConfig(
+        enabled=True, seed=5, stall_after_frames=4, sever_after_frames=16,
+        only_link=1,
+    ))
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    j = SharedTensorPeer("127.0.0.1", port, jnp.zeros_like(seed), _cfg())
+    for k in env:
+        monkeypatch.delenv(k)
+    try:
+        j.wait_ready(60.0)
+        assert j._engine is not None
+        _wait_converged([j], seed)
+        delta = jnp.asarray(
+            np.random.default_rng(13).normal(size=(256,)).astype(np.float32)
+        )
+        j.add(delta)
+        # the C sender loop swallows data frames 5..15 on link 1 and kills
+        # the link at frame 16; engine stash_carry + rejoin recover all
+        _wait_converged([m, j], seed + delta, tol=1e-5)
+    finally:
+        j.close()
+        m.close()
+
+
+def test_native_tier_crash_point_mid_join_walk():
+    """Native crash point: a joiner subprocess armed with
+    ST_FAULT_CRASH="mid-join-walk:1" must die with _exit(17) at the exact
+    protocol instant (connected + hello'd, membership not granted) — and
+    the master must shrug it off and keep serving (the reference's tree
+    would be taken down by its exit(-1) instead)."""
+    port = _free_port()
+    seed = jnp.full((64,), 3.0, jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg())
+    script = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu';"
+        "import jax.numpy as jnp;"
+        "from shared_tensor_tpu.comm.peer import SharedTensorPeer;"
+        "from shared_tensor_tpu.config import Config, TransportConfig;"
+        f"SharedTensorPeer('127.0.0.1', {port}, jnp.zeros(64, jnp.float32),"
+        "Config(transport=TransportConfig(peer_timeout_sec=10.0)))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            env={
+                **__import__("os").environ,
+                "ST_FAULT_CRASH": "mid-join-walk:1",
+                "JAX_PLATFORMS": "cpu",
+            },
+            timeout=120,
+            capture_output=True,
+        )
+        assert proc.returncode == CRASH_EXIT_CODE, (
+            proc.returncode, proc.stderr[-2000:],
+        )
+        # the master survived its child dying mid-walk: still serves joins
+        j = create_or_fetch("127.0.0.1", port, jnp.zeros_like(seed), _cfg())
+        try:
+            _wait_converged([j], seed)
+        finally:
+            j.close()
+    finally:
+        m.close()
+
+
+def test_python_tier_crash_points_fire_at_named_instants():
+    """Python-tier protocol points: install a plan whose kill action is a
+    recorder (FaultPlan(on_crash=...)) and verify each named point is
+    actually reached where documented — mid-burst on the send path,
+    between-apply-and-ack on the receive path."""
+    port = _free_port()
+    seed = jnp.zeros((64,), jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg(engine=False))
+    j = create_or_fetch("127.0.0.1", port, seed, _cfg(engine=False))
+    hits_j, hits_m = [], []
+    try:
+        j._faults = FaultPlan(
+            FaultConfig(enabled=True, crash_point="mid-burst"),
+            on_crash=hits_j.append,
+        )
+        m._faults = FaultPlan(
+            FaultConfig(enabled=True, crash_point="between-apply-and-ack"),
+            on_crash=hits_m.append,
+        )
+        delta = jnp.full((64,), 0.25, jnp.float32)
+        j.add(delta)
+        _wait_converged([m, j], seed + delta, tol=1e-5)
+        assert hits_j and hits_j[0] == "mid-burst"
+        assert hits_m and hits_m[0] == "between-apply-and-ack"
+    finally:
+        j.close()
+        m.close()
+
+
+# ---------------------------------------------------------------------------
+# Demo (b): a dead rendezvous / join target fails in bounded time
+# ---------------------------------------------------------------------------
+
+
+def test_dead_rendezvous_fails_in_bounded_time():
+    """An accepting-but-silent rendezvous (listen backlog holds the
+    connect, nobody ever speaks) used to block the joiner FOREVER in a
+    blocking connect/read. With per-hop connect_timeout_sec and the total
+    join_timeout_sec budget (exponential backoff + jitter between
+    attempts), creation must fail with ConnectionError in bounded time."""
+    silent = socket.socket()
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(1)  # accepts into backlog; never reads, never replies
+    port = silent.getsockname()[1]
+    cfg = _cfg(connect_timeout_sec=0.5, join_timeout_sec=2.0)
+    t0 = time.time()
+    try:
+        with pytest.raises(ConnectionError, match="within 2s"):
+            SharedTensorPeer(
+                "127.0.0.1", port, jnp.zeros((32,), jnp.float32), cfg
+            )
+    finally:
+        silent.close()
+    elapsed = time.time() - t0
+    # budget 2 s + a few bounded hops of slack on a loaded box — the point
+    # is "bounded", not "instant"; before r06 this hung until SIGKILL
+    assert elapsed < 30.0, f"join took {elapsed:.1f}s against a 2s budget"
+
+
+def test_dead_join_reply_does_not_hang_python_tier():
+    """Same bound through the Python tier (the transport is shared, but the
+    ConnectionError must propagate out of SharedTensorPeer.__init__ on
+    this path too, with no threads left behind)."""
+    silent = socket.socket()
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(1)
+    port = silent.getsockname()[1]
+    before = {t.name for t in threading.enumerate()}
+    try:
+        with pytest.raises(ConnectionError):
+            SharedTensorPeer(
+                "127.0.0.1", port, jnp.zeros((32,), jnp.float32),
+                _cfg(engine=False, connect_timeout_sec=0.5,
+                     join_timeout_sec=1.5),
+            )
+    finally:
+        silent.close()
+    leaked = {
+        t.name for t in threading.enumerate()
+        if t.name.startswith("st-")
+    } - before
+    assert not leaked, f"join failure leaked threads: {leaked}"
+
+
+# ---------------------------------------------------------------------------
+# Quarantine: a stalled-but-open link is torn down and re-grafted
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_tears_down_stalled_link(caplog):
+    """A peer that stops draining but keeps its socket open must not pin
+    our sender forever: after quarantine_send_failures consecutive
+    backpressure failures the link is torn down (LINK_DOWN -> rollback ->
+    carry) and re-grafted, and the stalled frames arrive after all."""
+    port = _free_port()
+    seed = jnp.zeros((64,), jnp.float32)
+    cfg = _cfg(engine=False, quarantine_send_failures=5)
+    m = create_or_fetch("127.0.0.1", port, seed, cfg)
+    j = create_or_fetch("127.0.0.1", port, seed, cfg)
+    try:
+        up = j._uplink
+        assert up is not None
+        real_send = j.node.send
+
+        def stalled_send(link, payload, timeout=0.1):
+            if link == up:
+                time.sleep(0.01)  # a full queue that never drains
+                return False
+            return real_send(link, payload, timeout=timeout)
+
+        j.node.send = stalled_send
+        with caplog.at_level(logging.WARNING, "shared_tensor_tpu.peer"):
+            delta = jnp.full((64,), 1.5, jnp.float32)
+            j.add(delta)
+            deadline = time.time() + 60.0
+            while time.time() < deadline and j._uplink == up:
+                time.sleep(0.05)
+        j.node.send = real_send
+        assert j._uplink != up, "stalled link was never quarantined"
+        assert any("quarantining link" in r.message for r in caplog.records)
+        # the re-grafted link delivers everything the stalled one owed
+        _wait_converged([m, j], seed + delta, tol=1e-5)
+    finally:
+        j.close()
+        m.close()
+
+
+def test_handshake_traffic_is_never_faulted():
+    """Chaos applies to DATA/BURST only: a plan that swallows EVERY data
+    frame from the first send must still complete the join handshake
+    (SYNC/CHUNK/WELCOME run clean) — injected faults exercise recovery,
+    never wedge a join the protocol has no retry for."""
+    port = _free_port()
+    seed = jnp.full((64,), 4.0, jnp.float32)
+    fault = FaultConfig(enabled=True, seed=2, stall_after_frames=0)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg(engine=False))
+    j = SharedTensorPeer(
+        "127.0.0.1", port, jnp.zeros_like(seed), _cfg(fault, engine=False)
+    )
+    try:
+        j.wait_ready(60.0)  # the handshake itself completed under chaos
+        # the joiner still RECEIVES fine (its plan governs only its sends):
+        _wait_converged([j], seed)
+    finally:
+        j.close()
+        m.close()

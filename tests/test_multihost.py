@@ -38,7 +38,7 @@ WORKER = textwrap.dedent(
 
     # the pod mesh spans both processes; psum over the peer axis must sum
     # contributions from devices this process cannot address directly
-    from jax import shard_map
+    from shared_tensor_tpu.parallel.ici import shard_map  # version-shimmed
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -601,13 +601,14 @@ def test_device_tier_burst_path(monkeypatch):
 
 def test_duplicate_link_up_is_logged_noop(caplog):
     """A replayed/duplicate LINK_UP must not kill the daemon recv thread
-    (ADVICE r04 item 2): the attach entry points raise ValueError on a
-    duplicate link id, and _handle_events runs on the recv thread with no
-    other guard — the event is a logged no-op because the link being
+    (ADVICE r04 item 2 / r05 item 1): the attach entry points raise
+    DuplicateLink on a duplicate link id, and _handle_events runs on the
+    recv thread — the event is a logged no-op because the link being
     attached is already the state the event asks for."""
     import logging
 
     from shared_tensor_tpu.comm.transport import Event, EventKind
+    from shared_tensor_tpu.core import DuplicateLink
 
     port = _free_port()
     seed = jnp.full((64,), 1.0, jnp.float32)
@@ -618,21 +619,22 @@ def test_duplicate_link_up_is_logged_noop(caplog):
         up = a._uplink
         assert up is not None
         dup = Event(EventKind.LINK_UP, up, True)
-        # the raw entry point does raise on the duplicate id...
-        with pytest.raises(ValueError):
+        # the raw entry point does raise the dedicated type (a ValueError
+        # subclass) on the duplicate id...
+        with pytest.raises(DuplicateLink):
             if a._engine is not None:
                 a._engine.new_link(up, seed=False)
             else:
                 a.st.new_link(up, seed=False)
-        # ...but the event path swallows it as a logged warning. Note a
-        # duplicate *uplink* LINK_UP in native mode goes through
-        # _start_join (handshake restart), so exercise the guard with the
-        # raise itself: stub poll_events to replay the event and the
-        # compat-style direct-attach body to hit the facade.
+        # ...and the event path swallows exactly that type as a logged
+        # warning. Note a duplicate *uplink* LINK_UP in native mode goes
+        # through _start_join (handshake restart), so exercise the guard
+        # with the raise itself: stub poll_events to replay the event and
+        # the compat-style direct-attach body to hit the narrow catch.
         orig = a._on_link_up
 
         def raising(ev):
-            raise ValueError(f"link {ev.link_id} already exists")
+            raise DuplicateLink(f"link {ev.link_id} already exists")
 
         a._on_link_up = raising
         try:
@@ -653,3 +655,80 @@ def test_duplicate_link_up_is_logged_noop(caplog):
     finally:
         a.close()
         m.close()
+
+
+def test_non_duplicate_link_up_error_keeps_recv_thread_alive(caplog):
+    """A NON-DuplicateLink error escaping _on_link_up must be logged loudly
+    — it is a real attach failure, not a replay — but must NOT kill the
+    daemon recv thread (ADVICE r05 item 1: at HEAD any attach-path error
+    raised NameError on the recv thread and wedged the peer, the exact
+    failure the duplicate guard was meant to prevent)."""
+    import logging
+
+    from shared_tensor_tpu.comm.transport import Event, EventKind
+
+    port = _free_port()
+    seed = jnp.full((64,), 1.0, jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed)
+    a = create_or_fetch("127.0.0.1", port, jnp.zeros_like(seed))
+    try:
+        _wait_converged([a], seed)
+        up = a._uplink
+        assert up is not None
+        ev = Event(EventKind.LINK_UP, up, True)
+        orig = a._on_link_up
+
+        def raising(_ev):
+            raise RuntimeError("attach blew up for a non-duplicate reason")
+
+        a._on_link_up = raising
+        try:
+            a.node.poll_events = lambda timeout=0.0: [ev]
+            with caplog.at_level(
+                logging.ERROR, logger="shared_tensor_tpu.peer"
+            ):
+                assert a._handle_events() is True  # no raise escapes
+            assert any(
+                "LINK_UP handling failed" in r.message
+                for r in caplog.records
+            )
+        finally:
+            a._on_link_up = orig
+            a.node.poll_events = type(a.node).poll_events.__get__(a.node)
+        # the recv thread survived: the peer still applies new frames
+        assert a._recv_thread.is_alive()
+        m.add(jnp.ones((64,), jnp.float32))
+        _wait_converged([a], seed + 1.0)
+    finally:
+        a.close()
+        m.close()
+
+
+def test_engine_repr_after_destroy_is_string():
+    """repr() of a destroyed EngineTensor must be a plain string, never a
+    native call on a NULL handle: pytest's failure reporting (saferepr)
+    walks whatever locals a failing test left behind, and an unguarded
+    st_engine_counters(NULL) SIGSEGV'd the entire suite process at report
+    time (VERDICT r05 Weak #2)."""
+    port = _free_port()
+    seed = jnp.full((64,), 1.0, jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed)
+    try:
+        if m._engine is None:
+            pytest.skip("native engine unavailable on this tier")
+        eng = m._engine
+        assert "destroyed" not in repr(eng)
+        m.close()  # destroys the engine
+        r = repr(eng)
+        assert isinstance(r, str) and "destroyed" in r
+        # counters after destroy: zeros, not a crash
+        assert eng._counters().tolist() == [0, 0, 0, 0, 0]
+        assert eng.link_ids == ()
+        assert eng.inflight_total() == 0
+        # mutating calls raise a Python error instead of faulting
+        with pytest.raises(RuntimeError):
+            eng.add(jnp.zeros((64,), jnp.float32))
+        with pytest.raises(RuntimeError):
+            eng.snapshot_flat()
+    finally:
+        m.close()  # idempotent

@@ -60,19 +60,23 @@ def test_garbage_injection_survival_and_convergence():
             noise_words = rng.integers(0, 256, 4 * w, dtype=np.uint8).tobytes()
             # noise first byte pinned off SYNC: a random SYNC would draw a
             # legitimate REJECT + link drop, which is not what this test pins
+            seq = lambda n: struct.pack("<I", n)  # wire tx_seq (in order)
             payloads = [
                 b"\xff" + b"\x00" * 16,  # unknown message kind
                 bytes([wire.DATA]) + b"\x01\x02\x03",  # truncated DATA
                 bytes([wire.ACK]),  # ACK with missing body
                 b"\xfe" + rng.integers(0, 256, 511, dtype=np.uint8).tobytes(),
-                # well-formed DATA frame carrying NaN scales + random bits:
-                # must decode to a no-op, not poison the replica (Q9/Q11)
-                bytes([wire.DATA]) + nan_scales + noise_words,
+                # well-formed, in-order DATA frame carrying NaN scales +
+                # random bits: must decode to a no-op, not poison the
+                # replica (Q9/Q11)
+                bytes([wire.DATA]) + seq(1) + nan_scales + noise_words,
                 bytes([wire.CHUNK]) + struct.pack("<Q", 1 << 60) + b"\xee",
                 # BURST with a count that does not match the payload length
-                bytes([wire.BURST, 9]) + b"\x00" * 40,
+                bytes([wire.BURST]) + seq(2) + b"\x09" + b"\x00" * 40,
                 # BURST of 1 frame with NaN scales: zeroed, applied as no-op
-                bytes([wire.BURST, 1]) + nan_scales + noise_words,
+                # (seq 2: the mis-sized BURST above must NOT have consumed
+                # its seq — undecodable messages await retransmission)
+                bytes([wire.BURST]) + seq(2) + b"\x01" + nan_scales + noise_words,
             ]
             for p in payloads:
                 assert evil.send(link, p, timeout=2.0)
@@ -116,14 +120,15 @@ def test_native_nonfinite_scales_zeroed():
     tpl = {"a": jnp.zeros((8, 128), jnp.float32), "b": jnp.zeros((128,), jnp.float32)}
     spec = make_spec(tpl)
     k, w = spec.num_leaves, spec.total // 32
+    hdr = bytes([wire.DATA]) + struct.pack("<I", 1)  # kind + wire tx_seq
     scales = struct.pack("<ff", float("nan"), 0.25)
-    payload = bytes([wire.DATA]) + scales + b"\x00" * (4 * w)
+    payload = hdr + scales + b"\x00" * (4 * w)
     frame = wire.decode_frame(payload, spec)
     np.testing.assert_array_equal(
         np.asarray(frame.scales), np.asarray([0.0, 0.25], np.float32)
     )
     scales = struct.pack("<ff", 2.0**120, 1.5)
-    frame = wire.decode_frame(bytes([wire.DATA]) + scales + b"\x00" * (4 * w), spec)
+    frame = wire.decode_frame(hdr + scales + b"\x00" * (4 * w), spec)
     np.testing.assert_array_equal(
         np.asarray(frame.scales), np.asarray([2.0**120, 1.5], np.float32)
     )
@@ -144,7 +149,10 @@ def test_apply_saturates_no_absorbing_inf():
     # scale 2^127 (the largest a legal residual can produce), all bits clear
     # => +scale everywhere
     payload = (
-        bytes([wire.DATA]) + struct.pack("<f", 2.0**127) + b"\x00" * (4 * w)
+        bytes([wire.DATA])
+        + struct.pack("<I", 1)  # wire tx_seq
+        + struct.pack("<f", 2.0**127)
+        + b"\x00" * (4 * w)
     )
     st.receive_frame(1, wire.decode_frame(payload, spec))
     got = np.asarray(st.snapshot_flat())
