@@ -392,9 +392,14 @@ def main() -> None:
         if backend is not None:
             # Backend is fine; the Pallas path itself failed (e.g. Mosaic
             # rejection). Do NOT re-enter Pallas — try the XLA codec on the
-            # same backend. If the ambient backend resolved to CPU (no TPU
-            # plugin registered at all), the result must carry the degraded
-            # label — it is NOT an on-chip number.
+            # SAME (TPU) backend. If the ambient backend instead resolved
+            # to CPU (no TPU plugin registered at all), skip straight to
+            # Phase B: its ladder puts the native-engine E2E first and
+            # XLA-CPU LAST — before r07 this branch ran XLA-CPU here and
+            # its ~2.6 GB/s short-circuited the ~6x-better engine arm
+            # whenever the backend came up as CPU instead of hanging.
+            if not _tpu_like(backend):
+                break
             budget_left = _remaining() - CPU_RESERVE_S
             if budget_left >= 75:
                 parsed, backend, outcome, err = _run_arm(
@@ -403,11 +408,6 @@ def main() -> None:
                 note(None, "xla", outcome, err)
                 if parsed is not None:
                     best = parsed
-                    if not _tpu_like(backend):
-                        best["detail"]["degraded"] = (
-                            "ambient backend resolved to "
-                            f"{backend[0]} (no TPU)"
-                        )
             break
         tries += 1
         backoff = min(20.0 * tries, max(0.0, _remaining() - CPU_RESERVE_S - 75))

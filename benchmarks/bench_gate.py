@@ -1,0 +1,100 @@
+"""Perf-floor gate for the loaded-suite harness (suite_load.sh, r07).
+
+Runs bench.py once, records the JSON result as the round's BENCH artifact
+(argv[1]), and exits nonzero when ``sync_bandwidth_equiv_fp32_per_link``
+regressed more than the tolerance (default 10%, ST_BENCH_GATE_PCT) against
+the newest *committed* BENCH_r*.json — so a data-plane refactor that
+passes every functional test but halves throughput turns the suite red.
+
+The comparison value is the best prior round's ``parsed.value`` (the
+driver's artifact shape) or top-level ``value`` (raw bench.py output);
+with no prior artifact the reference baseline (1.01 GB/s, BASELINE.md)
+is the floor's base. Caveat recorded in the artifact: bench.py's arm
+ladder means a round measured on a degraded arm (chip wedged worse than
+usual) can trip the gate spuriously — the artifact keeps the arm trail
+(detail.attempts) so a red gate is diagnosable at a glance.
+"""
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE_GBPS = 1.01  # BASELINE.md E2E yardstick (bench.py BASELINE_GBPS)
+
+
+def _prior_value(exclude: str):
+    """(value, source_path) from the newest committed BENCH_r*.json."""
+    best = None
+    for p in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        name = os.path.basename(p)
+        if name == os.path.basename(exclude):
+            continue  # never ratchet against our own output
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        if best is None or rnd > best[0]:
+            best = (rnd, p)
+    if best is None:
+        return REFERENCE_GBPS, "BASELINE.md reference"
+    try:
+        with open(best[1]) as f:
+            doc = json.load(f)
+        parsed = doc.get("parsed", doc)
+        v = float(parsed["value"])
+        return v, os.path.basename(best[1])
+    except Exception:
+        return REFERENCE_GBPS, "BASELINE.md reference (prior unparseable)"
+
+
+def main() -> int:
+    art_path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_gate.json"
+    if not os.path.isabs(art_path):
+        art_path = os.path.join(REPO, art_path)
+    pct = float(os.environ.get("ST_BENCH_GATE_PCT", "10"))
+    prior, source = _prior_value(art_path)
+    floor = prior * (1.0 - pct / 100.0)
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    result = None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                result = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    value = float(result.get("value", 0.0)) if result else 0.0
+    ok = result is not None and value >= floor
+
+    artifact = {
+        "gate": "suite_load perf floor",
+        "metric": "sync_bandwidth_equiv_fp32_per_link",
+        "floor_gbps": round(floor, 3),
+        "floor_from": f"{source} * (1 - {pct}%)",
+        "pass": ok,
+        "parsed": result,
+    }
+    with open(art_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(
+        f"bench gate: {value:.2f} GB/s vs floor {floor:.2f} GB/s "
+        f"({source}) -> {'PASS' if ok else 'FAIL'} "
+        f"[artifact {os.path.basename(art_path)}]"
+    )
+    if not ok and proc.stderr:
+        print(proc.stderr[-1000:])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,7 +35,9 @@ statistical smell test:
   thread wedging a peer forever) is exactly what this asserts away.
 
 Emits one JSON line. Run:  python benchmarks/chaos_soak.py > CHAOS_r06.json
-Knobs: ST_CHAOS_SECONDS (per arm, default 40), ST_CHAOS_SEED (default 6).
+Knobs: ST_CHAOS_SECONDS (per arm, default 40), ST_CHAOS_SEED (default 6),
+ST_CHAOS_ARMS (comma list, default "python,native" — the sanitizer harness
+runs a single arm under ASan+UBSan, tests/test_sanitizers.py).
 """
 
 import json
@@ -52,6 +54,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 N = int(os.environ.get("ST_CHAOS_N", "512"))
 SECONDS = float(os.environ.get("ST_CHAOS_SECONDS", "40"))
 SEED = int(os.environ.get("ST_CHAOS_SEED", "6"))
+ARMS = tuple(
+    a.strip()
+    for a in os.environ.get("ST_CHAOS_ARMS", "python,native").split(",")
+    if a.strip()
+)
 
 
 def _free_port() -> int:
@@ -257,7 +264,7 @@ def main() -> None:
     import numpy as np
 
     rng = np.random.default_rng(SEED)
-    arms = {arm: _run_arm(arm, np, jnp, rng) for arm in ("python", "native")}
+    arms = {arm: _run_arm(arm, np, jnp, rng) for arm in ARMS}
     out = {
         "bench": "chaos_soak",
         "n": N,

@@ -36,4 +36,18 @@ if [ "$FAILED" -eq 0 ]; then
 else
   echo "FAIL: at least one run red (see above)" >>"$OUT"
 fi
+
+# Perf-floor gate (r07): a green suite is necessary but not sufficient — a
+# refactor that silently halves the data plane's throughput passes every
+# functional test. After the green runs, run bench.py ONCE and fail if the
+# headline metric (sync_bandwidth_equiv_fp32_per_link) regressed more than
+# 10% against the newest committed BENCH_r*.json (fallback: the reference
+# baseline, 1.01 GB/s). The run is recorded as an artifact for the round
+# (ST_SUITE_BENCH_OUT, default BENCH_r07.json — later rounds pass their
+# own name). ST_SUITE_BENCH=0 skips the gate (e.g. a red-suite debug loop).
+if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_BENCH:-1}" = "1" ]; then
+  BENCH_OUT="${ST_SUITE_BENCH_OUT:-BENCH_r07.json}"
+  ST_BENCH_BUDGET_S="${ST_BENCH_BUDGET_S:-240}" \
+    python benchmarks/bench_gate.py "$BENCH_OUT" >>"$OUT" 2>&1 || FAILED=1
+fi
 exit "$FAILED"

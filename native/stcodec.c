@@ -85,10 +85,36 @@ static int st_has_avx512(void) {
 #include <stdlib.h>
 #include <unistd.h>
 
-/* chunk granularity: 2 Mi elements = 8 MiB of f32 (multiple of 32, so a
- * chunk boundary never splits a packed word); parallel threshold below. */
-#define ST_CHUNK_ELEMS ((int64_t)2 * 1024 * 1024)
-#define ST_PAR_MIN_ELEMS ((int64_t)4 * 1024 * 1024)
+/* chunk granularity: 128 Ki elements = 512 KiB of f32 (multiple of 32, so
+ * a chunk boundary never splits a packed word); parallel threshold below.
+ * r07: was 2 Mi / 4 Mi — that left every table below 4 Mi elements (the
+ * 1 Mi headline bench among them) single-threaded; 512 KiB chunks keep
+ * the per-chunk work far above the pool handoff cost (~µs vs ~50 µs of
+ * memory traffic) while letting mid-size tables use the pool. The
+ * decomposition stays a pure function of the layout (NOT of the thread
+ * count), so partials grouping remains deterministic for any
+ * ST_CODEC_THREADS — only the grouping constant changed, moving scale
+ * partials within the same ~1-ulp summation-order tolerance the tier
+ * contract already accepts. */
+#define ST_CHUNK_ELEMS ((int64_t)128 * 1024)
+#define ST_PAR_MIN_ELEMS ((int64_t)256 * 1024)
+
+/* Bounded spin (in pause-loop iterations) a worker burns watching for the
+ * next job before it blocks on the condvar, and the submitter burns
+ * watching for completion before it blocks on cv_done. The steady-state
+ * burst loop submits one quantize job per frame back-to-back (~0.1-0.3 ms
+ * apart at 1 Mi); a condvar sleep/wake on every one of those costs tens of
+ * µs per worker per job — comparable to the per-chunk work itself at 512 KiB
+ * chunks, which is exactly why the old 2 Mi chunking saw no speedup below
+ * 4 Mi elements. The spin window catches the back-to-back case; an idle
+ * process pays it once per quiesce, then sleeps as before. */
+#define ST_SPIN_ITERS 20000
+
+#if defined(__x86_64__)
+#define stc_cpu_relax() __builtin_ia32_pause()
+#else
+#define stc_cpu_relax() ((void)0)
+#endif
 
 typedef void (*stc_seg_fn)(void *ctx, int64_t seg);
 
@@ -113,8 +139,16 @@ static struct {
    * straggler falls through to re-wait (ADVICE r05 finding 2). */
   _Atomic uint64_t next;
   int64_t finished;
+  /* lock-free mirrors for the spin phases: agen is published (with the
+   * job fields already visible, release order) just before the condvar
+   * broadcast; afinished mirrors `finished` so the submitter can watch
+   * completion without the mutex. The mutex/condvar protocol is unchanged
+   * and remains the fallback once a spin window expires. */
+  _Atomic uint64_t agen;
+  _Atomic int64_t afinished;
 } g_pool = {PTHREAD_MUTEX_INITIALIZER, PTHREAD_COND_INITIALIZER,
             PTHREAD_COND_INITIALIZER,  PTHREAD_MUTEX_INITIALIZER,
+            0,                         0,
             0,                         0,
             0,                         0,
             0,                         0,
@@ -137,6 +171,14 @@ static void *stc_pool_worker(void *arg) {
   (void)arg;
   uint64_t seen = 0;
   for (;;) {
+    /* spin phase: the steady-state sender submits jobs back-to-back, and
+     * a condvar sleep/wake per job costs more than a whole 512 KiB chunk —
+     * watch the lock-free generation mirror briefly before sleeping. */
+    for (int i = 0; i < ST_SPIN_ITERS; i++) {
+      if (atomic_load_explicit(&g_pool.agen, memory_order_acquire) != seen)
+        break;
+      stc_cpu_relax();
+    }
     pthread_mutex_lock(&g_pool.mu);
     while (g_pool.gen == seen) pthread_cond_wait(&g_pool.cv_job, &g_pool.mu);
     seen = g_pool.gen;
@@ -160,6 +202,8 @@ static void *stc_pool_worker(void *arg) {
      * that job with the CURRENT fn/ctx. */
     if (g_pool.gen == seen) {
       g_pool.finished += done;
+      atomic_store_explicit(&g_pool.afinished, g_pool.finished,
+                            memory_order_release);
       if (g_pool.finished >= nseg) pthread_cond_signal(&g_pool.cv_done);
     }
     pthread_mutex_unlock(&g_pool.mu);
@@ -222,11 +266,16 @@ static int stc_pool_run(stc_seg_fn fn, void *ctx, int64_t nseg) {
   g_pool.ctx = ctx;
   g_pool.nseg = nseg;
   g_pool.finished = 0;
+  atomic_store_explicit(&g_pool.afinished, 0, memory_order_release);
   g_pool.gen++;
   uint64_t gen = g_pool.gen; /* ours until job_mu is released */
   /* publish the generation-tagged counter (index 0) with the new gen: any
    * straggler still holding the previous gen can no longer pop from it */
   atomic_store(&g_pool.next, (uint64_t)(uint32_t)gen << 32);
+  /* release-publish the spin mirror AFTER every job field above: a worker
+   * that leaves its spin loop on agen == gen sees fn/ctx/nseg/next (it
+   * still re-reads them under mu, so this is belt and braces) */
+  atomic_store_explicit(&g_pool.agen, gen, memory_order_release);
   pthread_cond_broadcast(&g_pool.cv_job);
   pthread_mutex_unlock(&g_pool.mu);
   int64_t done = 0;
@@ -236,10 +285,27 @@ static int stc_pool_run(stc_seg_fn fn, void *ctx, int64_t nseg) {
     fn(ctx, s);
     done++;
   }
+  /* completion: count our own chunks in, then spin-watch the lock-free
+   * finished mirror before falling back to the condvar sleep — the tail
+   * chunk usually lands within a few µs of ours. */
   pthread_mutex_lock(&g_pool.mu);
   g_pool.finished += done;
-  while (g_pool.finished < nseg) pthread_cond_wait(&g_pool.cv_done, &g_pool.mu);
+  atomic_store_explicit(&g_pool.afinished, g_pool.finished,
+                        memory_order_release);
+  int64_t fin = g_pool.finished;
   pthread_mutex_unlock(&g_pool.mu);
+  if (fin < nseg) {
+    for (int i = 0; i < ST_SPIN_ITERS; i++) {
+      if (atomic_load_explicit(&g_pool.afinished, memory_order_acquire) >=
+          nseg)
+        break;
+      stc_cpu_relax();
+    }
+    pthread_mutex_lock(&g_pool.mu);
+    while (g_pool.finished < nseg)
+      pthread_cond_wait(&g_pool.cv_done, &g_pool.mu);
+    pthread_mutex_unlock(&g_pool.mu);
+  }
   pthread_mutex_unlock(&g_pool.job_mu);
   return 1;
 }

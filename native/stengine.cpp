@@ -85,6 +85,11 @@ void stc_apply_frames(const float*, float*, const int64_t*, const int64_t*,
                       const uint32_t*, double*, double*, double*);
 // sttransport.cpp
 int32_t st_node_send(void*, int32_t, const uint8_t*, int32_t, double);
+// zero-copy enqueue: the transport borrows the payload (no copy) and calls
+// release(ctx) exactly once after the socket write / at teardown; on a
+// non-1 return it took no ownership (see sttransport.cpp st_node_send_zc)
+int32_t st_node_send_zc(void*, int32_t, const uint8_t*, int32_t, double,
+                        void (*)(void*), void*);
 int32_t st_node_recv(void*, int32_t, uint8_t*, int32_t, double);
 int32_t st_node_drop_link(void*, int32_t);
 uint64_t st_node_data_seq(void*);
@@ -118,12 +123,108 @@ constexpr size_t kRetxPrefix = 4;
 // scale policies (config.ScalePolicy)
 enum Policy { kPow2Rms = 0, kRms = 1, kAbsMean = 2 };
 
+// ---- tx slot ring (r07 zero-copy data plane) ------------------------------
+//
+// A TxSlot is one preallocated wire-message buffer shared by every stage
+// that used to copy: the codec threads QUANTIZE DIRECTLY into it (scales +
+// sign words land at their final wire offsets), the go-back-N ledger entry
+// IS the slot (retransmission is trivially byte-identical — the bytes are
+// never re-encoded), and the transport sends it zero-copy (st_node_send_zc
+// + writev: length prefix and slot body gather in one syscall). The old
+// path built msg vectors, encoded them into a payload vector, and
+// st_node_send copied that again — three full-message copies plus a fresh
+// multi-MB allocation per message, all gone.
+//
+// Layout: buf[8..] is the frame body (frame f's scales at f*per, words at
+// f*per + 4L — per = 4L + 4W is a multiple of 4, so with the body
+// 8-aligned every codec pointer the kernels receive is properly aligned;
+// UBSan-clean). The wire header is packed immediately BEFORE the body:
+// BURST [kind][u32 seq][u8 k] at offset 2, DATA [kind][u32 seq] at offset
+// 3, so wire_off + header + body are contiguous without moving the body.
+//
+// Lifecycle is a refcount: the ledger holds one reference from encode
+// until ACK/rollback; each in-flight transport enqueue (first send AND
+// every retransmit) holds another, dropped by the transport's release
+// callback after the socket write. SEND_WINDOW times out-of-order ACKs
+// bound the live slots per link; the free list keeps a few buffers warm
+// and frees the rest, so a burst's high-water mark doesn't pin memory.
+struct TxPool;
+
+struct TxSlot {
+  std::vector<uint8_t> buf;
+  uint32_t wire_off = 0, wire_len = 0;
+  std::atomic<int32_t> refs{0};
+  TxPool* pool = nullptr;
+};
+
+struct TxPool {
+  std::mutex mu;
+  std::vector<TxSlot*> free_;
+  std::vector<std::unique_ptr<TxSlot>> all_;
+  size_t slot_bytes = 0;   // 8 + burst * frame_bytes
+  size_t keep_warm = 4;    // free slots retained with their buffer intact
+  std::atomic<uint64_t> acquires{0}, alloc_events{0};
+
+  TxSlot* acquire() {
+    acquires++;
+    TxSlot* s;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (!free_.empty()) {
+        s = free_.back();
+        free_.pop_back();
+      } else {
+        all_.emplace_back(new TxSlot());
+        s = all_.back().get();
+        s->pool = this;
+      }
+    }
+    if (s->buf.size() != slot_bytes) {
+      alloc_events++;  // fresh slot, or re-grow after an idle shrink
+      s->buf.resize(slot_bytes);
+    }
+    s->refs.store(1, std::memory_order_relaxed);  // the caller's reference
+    return s;
+  }
+
+  void unref(TxSlot* s) {
+    // the decrement happens UNDER the pool mutex: st_engine_destroy's
+    // drain loop checks all refs under the same mutex, so it can never
+    // observe "all drained" while a releaser sits between its decrement
+    // and the free-list push (it would then free the pool under us)
+    std::lock_guard<std::mutex> lk(mu);
+    if (s->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      if (free_.size() >= keep_warm) {
+        // bound idle memory: keep the slot object, drop its buffer — and
+        // park it at the COLD end of the list so acquire() (which pops
+        // the back) keeps hitting the warm buffers; pushed at the back,
+        // one shrunk slot would be re-popped (and re-allocated,
+        // multi-MB) on every message once the high-water exceeded
+        // keep_warm, silently defeating the zero-allocation steady state
+        s->buf.clear();
+        s->buf.shrink_to_fit();
+        free_.insert(free_.begin(), s);
+      } else {
+        free_.push_back(s);
+      }
+    }
+  }
+};
+
+// transport release callback: one in-flight reference returned
+void tx_slot_release(void* ctx) {
+  auto* s = (TxSlot*)ctx;
+  s->pool->unref(s);
+}
+
 struct SentMsg {
   // one wire message = 1..k frames; rolls back / acks whole
   int32_t nframes;
-  uint64_t seq = 0;             // per-link wire seq (comm/wire.py tx_seq)
-  std::vector<float> scales;    // nframes * L
-  std::vector<uint32_t> words;  // nframes * W
+  uint64_t seq = 0;      // per-link wire seq (comm/wire.py tx_seq)
+  TxSlot* slot = nullptr;  // native framing: the encoded wire bytes
+                           // (this ledger entry owns one pool reference)
+  std::vector<float> scales;    // compat path only: nframes * L
+  std::vector<uint32_t> words;  // compat path only: nframes * W
 };
 
 using EClock = std::chrono::steady_clock;
@@ -186,6 +287,8 @@ struct Engine {
   // ACKs (so no ledger: the reference protocol cannot acknowledge).
   // 0 = native framing.
   int32_t compat_bytes = 0;
+
+  TxPool txpool;  // native-framing tx slot ring (see TxSlot)
 
   std::vector<float> values;
   std::map<int32_t, ELink> links;
@@ -279,15 +382,28 @@ bool any_nonzero(const float* s, int64_t L) {
 
 // Roll every unacked message's error feedback back into the residual
 // (core.SharedTensor._unapply: re-applying a frame to the residual restores
-// the pre-quantize state bit-for-bit). Caller holds e->mu.
+// the pre-quantize state bit-for-bit). Native-framing entries read their
+// frames straight out of the ledgered tx slot (the slot body offsets are
+// 4-aligned by construction — see TxSlot) and drop the ledger's pool
+// reference. Caller holds e->mu.
 void rollback_unacked(Engine* e, ELink& lk) {
+  size_t per = (size_t)e->L * 4 + (size_t)e->W * 4;
   for (auto& msg : lk.unacked) {
     for (int32_t f = 0; f < msg.nframes; f++) {
+      const float* fs;
+      const uint32_t* fw;
+      if (msg.slot) {
+        const uint8_t* body = msg.slot->buf.data() + 8 + (size_t)f * per;
+        fs = (const float*)body;
+        fw = (const uint32_t*)(body + (size_t)e->L * 4);
+      } else {
+        fs = msg.scales.data() + (size_t)f * e->L;
+        fw = msg.words.data() + (size_t)f * e->W;
+      }
       stc_apply_frame(lk.resid.data(), lk.resid.data(), e->off.data(),
-                      e->ns.data(), e->padded.data(), e->L,
-                      msg.scales.data() + (size_t)f * e->L,
-                      msg.words.data() + (size_t)f * e->W);
+                      e->ns.data(), e->padded.data(), e->L, fs, fw);
     }
+    if (msg.slot) e->txpool.unref(msg.slot);
   }
   lk.unacked.clear();
   lk.pvalid = false;  // rollback bypasses the fused-partials kernels
@@ -348,48 +464,19 @@ size_t frame_bytes(const Engine* e) {
   return (size_t)e->L * 4 + (size_t)e->W * 4;
 }
 
-// Native framing (comm/wire.py): DATA = [0][u32 seq][scales||words],
-// BURST = [7][u32 seq][u8 k][k x (scales||words)]. Pure function of the
-// SentMsg, so a go-back-N retransmit re-encodes BYTE-IDENTICAL payloads
-// (same seqs — the receiver's dedup makes repeats harmless).
-void encode_native_msg(const Engine* e, const SentMsg& msg,
-                       std::vector<uint8_t>& payload) {
-  size_t per = frame_bytes(e);
-  uint32_t seq32 = (uint32_t)msg.seq;
-  if (e->burst > 1) {
-    payload.resize(6 + (size_t)msg.nframes * per);
-    payload[0] = kBurst;
-    std::memcpy(payload.data() + 1, &seq32, 4);  // LE host assumed
-    payload[5] = (uint8_t)msg.nframes;
-    uint8_t* p = payload.data() + 6;
-    for (int32_t f = 0; f < msg.nframes; f++) {
-      std::memcpy(p, msg.scales.data() + (size_t)f * e->L, (size_t)e->L * 4);
-      p += (size_t)e->L * 4;
-      std::memcpy(p, msg.words.data() + (size_t)f * e->W, (size_t)e->W * 4);
-      p += (size_t)e->W * 4;
-    }
-  } else {
-    payload.resize(5 + per);
-    payload[0] = kData;
-    std::memcpy(payload.data() + 1, &seq32, 4);
-    std::memcpy(payload.data() + 5, msg.scales.data(), (size_t)e->L * 4);
-    std::memcpy(payload.data() + 5 + (size_t)e->L * 4, msg.words.data(),
-                (size_t)e->W * 4);
-  }
-}
-
 // Go-back-N retransmission pass (Engine::ack_timeout; the native twin of
 // comm/peer.py _check_retransmit). For every live link whose oldest
-// unacked message has waited past the timeout, resend the whole unacked
-// tail byte-identical; after ack_retry_limit fruitless rounds tear the
-// link down (rollback -> dead -> drop) so LINK_DOWN -> carry -> re-graft
-// recovers every undelivered frame on a fresh link instead of retrying
-// forever.
-void retransmit_pass(Engine* e, const std::vector<int32_t>& ids,
-                     std::vector<uint8_t>& payload) {
+// unacked message has waited past the timeout, resend the HEAD of the
+// unacked tail BYTE-IDENTICAL — with the r07 slot ring that is literal:
+// the ledger entry IS the wire bytes, so a retransmit is a new zero-copy
+// reference on the same slot, never a re-encode. After ack_retry_limit
+// fruitless rounds tear the link down (rollback -> dead -> drop) so
+// LINK_DOWN -> carry -> re-graft recovers every undelivered frame on a
+// fresh link instead of retrying forever.
+void retransmit_pass(Engine* e, const std::vector<int32_t>& ids) {
   auto now = EClock::now();
   for (int32_t id : ids) {
-    std::vector<SentMsg> tail;
+    std::vector<TxSlot*> tail;
     bool teardown = false;
     {
       std::lock_guard<std::mutex> lk(e->mu);
@@ -411,23 +498,36 @@ void retransmit_pass(Engine* e, const std::vector<int32_t>& ids,
         lk2.dead = true;
         teardown = true;
       } else {
-        // head prefix only: bounded copy under e->mu (a full-window tail
-        // of big bursts would stall the whole data plane for the copy),
-        // and only the head can restore the receiver's in-order progress
+        // head prefix only: O(kRetxPrefix) pointer grabs under e->mu (the
+        // old path deep-copied the messages' frame vectors here), and
+        // only the head can restore the receiver's in-order progress.
+        // Each grabbed slot takes an in-flight reference NOW, under the
+        // lock, so a racing ACK pop cannot recycle it mid-send.
         size_t k = lk2.unacked.size() < kRetxPrefix ? lk2.unacked.size()
                                                     : kRetxPrefix;
-        tail.assign(lk2.unacked.begin(), lk2.unacked.begin() + k);
+        for (size_t i = 0; i < k; i++) {
+          TxSlot* s = lk2.unacked[i].slot;
+          s->refs.fetch_add(1, std::memory_order_relaxed);
+          tail.push_back(s);
+        }
       }
     }
     if (teardown) {
       st_node_drop_link(e->node, id);
       continue;
     }
-    for (const SentMsg& m : tail) {
-      encode_native_msg(e, m, payload);
-      if (st_node_send(e->node, id, payload.data(), (int32_t)payload.size(),
-                       0.1) != 1)
-        break;  // backpressure/death: the next pass (or LINK_DOWN) handles it
+    for (size_t i = 0; i < tail.size(); i++) {
+      TxSlot* s = tail[i];
+      int32_t r =
+          st_node_send_zc(e->node, id, s->buf.data() + s->wire_off,
+                          (int32_t)s->wire_len, 0.1, tx_slot_release, s);
+      if (r != 1) {
+        // not enqueued: the transport took no ownership — drop our
+        // reference for this and every remaining tail slot, and let the
+        // next pass (or LINK_DOWN) handle it
+        for (size_t j = i; j < tail.size(); j++) e->txpool.unref(tail[j]);
+        break;
+      }
     }
   }
 }
@@ -453,6 +553,8 @@ void sender_loop(Engine* e) {
     for (int32_t id : ids) {
       if (e->stop.load()) return;
       SentMsg msg;
+      TxSlot* slot = nullptr;
+      size_t per = frame_bytes(e);
       {
         std::lock_guard<std::mutex> lk(e->mu);
         auto it = e->links.find(id);
@@ -461,7 +563,9 @@ void sender_loop(Engine* e) {
         if (!lk2.dirty) continue;
         // go-back-N send window: a full unacked ledger (stalled peer)
         // stops NEW production on this link; the residual keeps
-        // accumulating and quantizes once ACKs reopen the window
+        // accumulating and quantizes once ACKs reopen the window — and,
+        // with the ledger-as-slot design, bounds the live tx ring slots
+        // per link at kSendWindow (the pool cannot grow past it)
         if (!e->compat_bytes && lk2.unacked.size() >= kSendWindow) continue;
         // quantize up to `burst` successive halvings of the residual,
         // stopping at the first all-zero-scale frame (idle). EVERY quantize
@@ -472,7 +576,18 @@ void sender_loop(Engine* e) {
         // the standalone stc_scale_partials scan only runs after the rare
         // writes that bypass the fused kernels (rollback, restore) — at
         // 16 Mi / burst cap 1 that scan was a full 64 MiB read per message.
+        //
+        // r07 zero-copy: on the native framing the quantize target IS the
+        // wire message — scales and sign words land at their final offsets
+        // in a pooled tx slot (TxSlot layout), which then serves as ledger
+        // entry, retransmission source, and scatter-gather send buffer
+        // with no further copies.
         msg.nframes = 0;
+        uint8_t* body = nullptr;
+        if (!e->compat_bytes) {
+          slot = e->txpool.acquire();
+          body = slot->buf.data() + 8;
+        }
         if ((int64_t)lk2.pamax.size() != e->L) {
           lk2.pamax.resize((size_t)e->L);
           lk2.pss.resize((size_t)e->L);
@@ -493,16 +608,25 @@ void sender_loop(Engine* e) {
             if (b == 0) lk2.dirty = false;  // nothing to say at all
             break;
           }
-          size_t base_s = msg.scales.size(), base_w = msg.words.size();
-          msg.scales.resize(base_s + (size_t)e->L);
-          msg.words.resize(base_w + (size_t)e->W);
-          std::memcpy(msg.scales.data() + base_s, scales.data(),
-                      (size_t)e->L * 4);
-          stc_quantize_ef_partials(
-              lk2.resid.data(), lk2.resid.data(), e->off.data(),
-              e->ns.data(), e->padded.data(), e->L, scales.data(),
-              msg.words.data() + base_w, amax.data(), ss.data(),
-              sabs.data());
+          float* fscales;
+          uint32_t* fwords;
+          if (slot) {
+            uint8_t* fb = body + (size_t)msg.nframes * per;
+            fscales = (float*)fb;
+            fwords = (uint32_t*)(fb + (size_t)e->L * 4);
+          } else {
+            size_t base_s = msg.scales.size(), base_w = msg.words.size();
+            msg.scales.resize(base_s + (size_t)e->L);
+            msg.words.resize(base_w + (size_t)e->W);
+            fscales = msg.scales.data() + base_s;
+            fwords = msg.words.data() + base_w;
+          }
+          std::memcpy(fscales, scales.data(), (size_t)e->L * 4);
+          stc_quantize_ef_partials(lk2.resid.data(), lk2.resid.data(),
+                                   e->off.data(), e->ns.data(),
+                                   e->padded.data(), e->L, scales.data(),
+                                   fwords, amax.data(), ss.data(),
+                                   sabs.data());
           msg.nframes++;
         }
         // amax/ss/sabs now hold the post-quantize residual's partials
@@ -512,7 +636,10 @@ void sender_loop(Engine* e) {
         std::copy(ss.begin(), ss.end(), lk2.pss.begin());
         std::copy(sabs.begin(), sabs.end(), lk2.psabs.begin());
         lk2.pvalid = true;
-        if (msg.nframes == 0) continue;
+        if (msg.nframes == 0) {
+          if (slot) e->txpool.unref(slot);
+          continue;
+        }
         e->frames_out += (uint64_t)msg.nframes;
         // ledger entry BEFORE the send: the receiver's ACK must never race
         // ahead of the entry it acknowledges (comm/peer.py _send_loop).
@@ -521,11 +648,36 @@ void sender_loop(Engine* e) {
         // docstring); a failed send rolls back THIS message inline below.
         if (!e->compat_bytes) {
           msg.seq = ++lk2.tx_seq;
+          // wire header, packed flush against the 8-aligned body: BURST
+          // [kind][u32 seq][u8 k] from offset 2, DATA [kind][u32 seq]
+          // from offset 3 (comm/wire.py framing; LE host assumed)
+          uint32_t seq32 = (uint32_t)msg.seq;
+          if (e->burst > 1) {
+            slot->wire_off = 2;
+            slot->buf[2] = kBurst;
+            std::memcpy(slot->buf.data() + 3, &seq32, 4);
+            slot->buf[7] = (uint8_t)msg.nframes;
+            slot->wire_len = 6 + (uint32_t)((size_t)msg.nframes * per);
+          } else {
+            slot->wire_off = 3;
+            slot->buf[3] = kData;
+            std::memcpy(slot->buf.data() + 4, &seq32, 4);
+            slot->wire_len = 5 + (uint32_t)per;
+          }
+          msg.slot = slot;  // the ledger entry owns the acquire reference
           if (lk2.unacked.empty()) lk2.ack_progress = EClock::now();
           it->second.unacked.push_back(msg);
+          // in-flight reference for the send below, taken UNDER e->mu:
+          // after the lock drops, a concurrent detach/stash_carry can
+          // rollback_unacked and drop the ledger reference — taken
+          // outside the lock, the slot could hit zero refs and be
+          // recycled before our send even starts (use-after-free read +
+          // a double free-list push). retransmit_pass refs under the
+          // lock for the same reason.
+          slot->refs.fetch_add(1, std::memory_order_relaxed);
         }
       }
-      // encode + send outside the lock
+      // send outside the lock
       if (e->compat_bytes) {
         // reference raw frames, nframes of them back-to-back (see the
         // compat-burst note in st_engine_create): each is
@@ -539,8 +691,6 @@ void sender_loop(Engine* e) {
           std::memcpy(p + 4, msg.words.data() + (size_t)f * e->W,
                       (size_t)e->compat_bytes - 4);
         }
-      } else {
-        encode_native_msg(e, msg, payload);
       }
       // crash point: frames quantized + error feedback applied + ledger
       // entry pushed, message NOT yet on the wire — death here must roll
@@ -548,9 +698,16 @@ void sender_loop(Engine* e) {
       st_fault_crash_point("mid-burst");
       bool delivered = false;
       int32_t fails = 0;
+      // (the in-flight slot reference for this send was taken under e->mu
+      // at ledger-push time — see above)
       while (!e->stop.load()) {
-        int32_t r = st_node_send(e->node, id, payload.data(),
-                                 (int32_t)payload.size(), 0.1);
+        int32_t r =
+            slot ? st_node_send_zc(e->node, id,
+                                   slot->buf.data() + slot->wire_off,
+                                   (int32_t)slot->wire_len, 0.1,
+                                   tx_slot_release, slot)
+                 : st_node_send(e->node, id, payload.data(),
+                                (int32_t)payload.size(), 0.1);
         if (r == 1) {
           delivered = true;
           break;
@@ -564,6 +721,8 @@ void sender_loop(Engine* e) {
           break;
         }
       }
+      if (slot && !delivered)
+        e->txpool.unref(slot);  // transport took no ownership
       if (delivered) {
         // compat: every frame IS a protocol message (the reference wire has
         // no message framing beyond the fixed frame size), keeping the
@@ -596,7 +755,7 @@ void sender_loop(Engine* e) {
     // go-back-N delivery timer: retransmit stranded unacked tails (and
     // tear down black-hole links) — runs every pass, dirty links or not
     if (!e->compat_bytes && e->ack_timeout > 0 && !e->stop.load())
-      retransmit_pass(e, ids, payload);
+      retransmit_pass(e, ids);
     if (!sent_any && !e->stop.load()) {
       std::unique_lock<std::mutex> lk(e->wmu);
       if (e->wseq <= seq_before) {
@@ -758,9 +917,13 @@ void receiver_loop(Engine* e) {
             ELink& lk2 = it->second;
             lk2.acked_cum = count;
             // cumulative ACK = last in-order wire seq the peer accepted;
-            // every ledger entry at or below it is delivered
+            // every ledger entry at or below it is delivered — its tx slot
+            // drops the ledger reference and returns to the ring once any
+            // in-flight (re)send reference drains too
             bool progressed = false;
             while (!lk2.unacked.empty() && lk2.unacked.front().seq <= count) {
+              if (lk2.unacked.front().slot)
+                e->txpool.unref(lk2.unacked.front().slot);
               lk2.unacked.pop_front();
               progressed = true;
             }
@@ -839,6 +1002,11 @@ __attribute__((visibility("default"))) void* st_engine_create(
   e->values.assign((size_t)total, 0.0f);
   if (init_values)
     std::memcpy(e->values.data(), init_values, (size_t)total * 4);
+  // tx ring slot size: 8 bytes of header room (body 8-aligned for the
+  // codec kernels) + the largest message this engine can emit. The window
+  // (kSendWindow) bounds live slots per link; keep_warm bounds idle memory.
+  e->txpool.slot_bytes =
+      8 + (size_t)e->burst * ((size_t)e->L * 4 + (size_t)e->W * 4);
   return e;
 }
 
@@ -872,7 +1040,42 @@ __attribute__((visibility("default"))) void st_engine_stop(void* h) {
 }
 
 __attribute__((visibility("default"))) void st_engine_destroy(void* h) {
-  delete (Engine*)h;
+  auto* e = (Engine*)h;
+  if (!e) return;
+  // Drop the ledger references still held by attached links' unacked
+  // entries (no rollback — the engine is dying, there is no residual left
+  // to repair; Python detached/stashed everything it wanted first).
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    for (auto& kv : e->links) {
+      for (auto& msg : kv.second.unacked)
+        if (msg.slot) e->txpool.unref(msg.slot);
+      kv.second.unacked.clear();
+    }
+  }
+  // Transport release callbacks can still be in flight for a moment after
+  // st_node_close returns: a link's queues are destroyed on its detached
+  // I/O threads' exit path, AFTER the node's thread accounting is
+  // decremented — so a queued zero-copy message's release(ctx) may fire
+  // microseconds from now. Freeing the pool those callbacks point into
+  // would be a use-after-free; wait for every slot reference to drain
+  // (normally instantaneous), and prefer leaking to freeing under a live
+  // thread if a wedged peer keeps one pinned.
+  for (int i = 0;; i++) {
+    bool busy = false;
+    {
+      std::lock_guard<std::mutex> lk(e->txpool.mu);
+      for (auto& s : e->txpool.all_)
+        if (s->refs.load(std::memory_order_acquire) != 0) {
+          busy = true;
+          break;
+        }
+    }
+    if (!busy) break;
+    if (i >= 2000) return;  // ~2 s: leak rather than free under a live thread
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  delete e;
 }
 
 // values += sanitize(u), every residual += sanitize(u)
@@ -1095,19 +1298,29 @@ __attribute__((visibility("default"))) int64_t st_engine_inflight(void* h) {
   return n;
 }
 
-// counters: [frames_out, frames_in, updates, msgs_out, msgs_in]
+// counters: [frames_out, frames_in, updates, msgs_out, msgs_in,
+//            tx_slot_acquires, tx_slot_alloc_events, tx_slots_allocated]
+// The last three are the r07 tx-ring stats the zero-allocation assertion
+// reads: steady state shows acquires growing while alloc_events stays
+// flat (every acquire reuses a warm slot).
 __attribute__((visibility("default"))) void st_engine_counters(
-    void* h, uint64_t* out5) {
+    void* h, uint64_t* out8) {
   if (!h) {  // the SIGSEGV that aborted the whole suite (r05 Weak #2)
-    for (int i = 0; i < 5; i++) out5[i] = 0;
+    for (int i = 0; i < 8; i++) out8[i] = 0;
     return;
   }
   auto* e = (Engine*)h;
-  out5[0] = e->frames_out.load();
-  out5[1] = e->frames_in.load();
-  out5[2] = e->updates.load();
-  out5[3] = e->msgs_out.load();
-  out5[4] = e->msgs_in.load();
+  out8[0] = e->frames_out.load();
+  out8[1] = e->frames_in.load();
+  out8[2] = e->updates.load();
+  out8[3] = e->msgs_out.load();
+  out8[4] = e->msgs_in.load();
+  out8[5] = e->txpool.acquires.load();
+  out8[6] = e->txpool.alloc_events.load();
+  {
+    std::lock_guard<std::mutex> lk(e->txpool.mu);
+    out8[7] = (uint64_t)e->txpool.all_.size();
+  }
 }
 
 // Pop one control-plane message; returns its length (0 = none). link_out

@@ -13,10 +13,21 @@ from __future__ import annotations
 
 import contextlib
 import fcntl
+import os
 import pathlib
 import subprocess
 
-NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+#: ST_NATIVE_DIR redirects every loader (transport, codec, engine) to an
+#: alternate prebuilt library directory — e.g. ``native/san`` for the
+#: ASan+UBSan builds (``make -C native sanitize``; tests/test_sanitizers.py).
+#: When set, run_make() is a no-op: the alternate directory is built by its
+#: owner and has no Makefile of its own.
+_OVERRIDE = os.environ.get("ST_NATIVE_DIR")
+NATIVE_DIR = (
+    pathlib.Path(_OVERRIDE).resolve()
+    if _OVERRIDE
+    else pathlib.Path(__file__).resolve().parent.parent / "native"
+)
 
 
 @contextlib.contextmanager
@@ -32,7 +43,10 @@ def build_lock():
 
 
 def run_make(target: str | None = None, force: bool = False) -> None:
-    """make -C native/ [target], serialized across processes."""
+    """make -C native/ [target], serialized across processes. No-op under
+    ST_NATIVE_DIR (prebuilt alternate directory — see module docstring)."""
+    if _OVERRIDE:
+        return
     cmd = ["make", "-C", str(NATIVE_DIR)]
     if force:
         cmd.append("-B")

@@ -430,11 +430,27 @@ class EngineTensor:
         segfault: pytest's failure reporting (saferepr) calls __repr__ →
         here on whatever locals a failing test left behind, including
         closed engines — an unguarded NULL call here aborted the entire
-        suite process at report time (VERDICT r05 Weak #2)."""
-        out = np.zeros(5, np.uint64)
+        suite process at report time (VERDICT r05 Weak #2).
+
+        Layout (st_engine_counters): [frames_out, frames_in, updates,
+        msgs_out, msgs_in, tx_slot_acquires, tx_slot_alloc_events,
+        tx_slots_allocated] — the last three are the r07 tx-ring pool
+        stats (steady state: acquires grow, alloc_events stay flat)."""
+        out = np.zeros(8, np.uint64)
         if self._h:
             self._lib.st_engine_counters(self._h, out)
         return out
+
+    def pool_stats(self) -> dict:
+        """Tx-ring slot stats for metrics()/tests: zero per-message heap
+        allocation in steady state means ``acquires`` grows while
+        ``alloc_events`` stays flat."""
+        c = self._counters()
+        return {
+            "tx_slot_acquires": int(c[5]),
+            "tx_slot_alloc_events": int(c[6]),
+            "tx_slots_allocated": int(c[7]),
+        }
 
     @property
     def frames_out(self) -> int:

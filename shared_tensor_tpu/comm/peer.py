@@ -165,8 +165,9 @@ class SharedTensorPeer:
             if engine_ok:
                 # auto (engine): FILL the wire message budget — throughput
                 # is monotone in K up to the per-spec cap at every measured
-                # size (4 Ki: 352 k f/s at K=255 vs 300 k at 128; 64 Ki:
-                # +50% over K=8; 1 Mi: +38% at its 31-frame cap). The
+                # size (ENGINE_SWEEP_r07.json, the committed re-measure the
+                # round-5 verdict asked for: 710 k f/s at 4 Ki, 52 k at
+                # 64 Ki, 7.2 k at 1 Mi — all at their per-spec caps). The
                 # engine's fused quantize+partials makes marginal frames
                 # one memory pass, and a burst is one ledger entry/ACK.
                 self._burst = wire.burst_frames_cap(spec)
@@ -317,7 +318,31 @@ class SharedTensorPeer:
         # wire_seq <= ack count). Plus cumulative TX/RX/ACK counters and
         # the per-link retransmission timer state.
         self._ack_mu = threading.Lock()
-        self._unacked: dict[int, list[tuple[int, int, bytes]]] = {}
+        # (ledger_seq, wire_seq, payload, pool_slot) — payload is a
+        # memoryview over pool_slot's pooled buffer (r07: the ledger entry
+        # IS its send buffer; pool_slot is None only for legacy bytes
+        # payloads), released back to _tx_pool when the entry pops
+        self._unacked: dict[int, list[tuple[int, int, Any, Any]]] = {}
+        # r07 zero-copy send plane (native framing only): encode writes
+        # into a pooled wire-sized slot; the slot then serves as ledger
+        # payload and byte-identical retransmission source. Slots are
+        # allocated lazily on first acquire, so an engine-tier peer (whose
+        # C data plane has its own tx ring) never pays for this pool.
+        self._tx_pool: Optional[wire.FramePool] = None
+        if not tcfg.wire_compat:
+            per = wire.frame_payload_bytes(spec)
+            self._tx_pool = wire.FramePool(
+                max(
+                    wire.DATA_HDR + per,
+                    wire.BURST_HDR
+                    + max(self._burst, self._burst_device, 1) * per,
+                ),
+                keep=max(1, int(self.config.frame_pool_keep)),
+            )
+        # per-link decode destination pools (r07 satellite): steady-state
+        # decode reuses (scales, words) arrays; recycled after each applied
+        # batch, dropped on LINK_DOWN
+        self._rx_scratch: dict[int, wire.DecodeScratch] = {}
         self._tx_seq: dict[int, int] = {}  # wire seq of last data msg sent
         self._acked: dict[int, int] = {}
         self._rx_count: dict[int, int] = {}
@@ -488,6 +513,18 @@ class SharedTensorPeer:
                     len(v) for v in self._unacked.values()
                 )
                 msgs_in = sum(self._rx_count.values())
+        # r07 buffer-pool stats — the zero-per-message-allocation assertion:
+        # in steady state the acquire counters grow while the alloc/miss
+        # counters stay flat (every buffer is a reuse). "tx_slot_*" is the
+        # frame-slot ring (engine tx ring, or wire.FramePool on the Python
+        # tier); "transport" is the C transport's per-link tx/rx recycling.
+        if self._engine is not None:
+            pool = self._engine.pool_stats()
+        elif self._tx_pool is not None:
+            pool = self._tx_pool.stats()
+        else:
+            pool = {}
+        pool["transport"] = self.node.pool_stats()
         out = {
             "frames_out": frames_out,
             "frames_in": frames_in,
@@ -497,6 +534,7 @@ class SharedTensorPeer:
                 "msgs_in": msgs_in,
                 "inflight_msgs": self.st.inflight_total(),
             },
+            "pool": pool,
             "links": {},
         }
         for link in self.node.links:
@@ -541,6 +579,14 @@ class SharedTensorPeer:
         # wire-compat mode the reference protocol has no ACK, so delivery
         # degrades to ack-on-enqueue (the C peer loses everything on death
         # anyway, quirk Q8).
+        # r07 double-buffered encode/drain: node.send() copies the pooled
+        # slot into the C transport's (recycled) tx buffer and returns the
+        # moment it is QUEUED — the C sender thread drains the socket while
+        # this loop encodes the next batch into a fresh slot. The pool
+        # makes that overlap allocation-free: encode k+1 and the socket
+        # write of k proceed concurrently with zero per-message heap
+        # traffic on either side (wire.FramePool here, the transport's
+        # BufPool below).
         # numpy host tier: quantize is synchronous host work — pipelining
         # just hoards the SharedTensor lock; depth only pays on device tiers
         # where dispatch/transfer are async.
@@ -578,7 +624,9 @@ class SharedTensorPeer:
                     payload = self._register_data(
                         link,
                         seq,
-                        lambda s: wire.encode_burst(burst, self.st.spec, s),
+                        lambda buf, s: wire.encode_burst_into(
+                            burst, self.st.spec, s, buf
+                        ),
                     )
                     # crash point: frames ledgered + error feedback applied,
                     # message NOT yet on the wire — death here must roll the
@@ -656,11 +704,15 @@ class SharedTensorPeer:
                     payload = self._register_data(
                         link,
                         seq,
-                        lambda s: wire.encode_burst(frame, self.st.spec, s),
+                        lambda buf, s: wire.encode_burst_into(
+                            frame, self.st.spec, s, buf
+                        ),
                     )
                 else:
                     payload = self._register_data(
-                        link, seq, lambda s: wire.encode_frame(frame, s)
+                        link,
+                        seq,
+                        lambda buf, s: wire.encode_frame_into(frame, s, buf),
                     )
                 self._fault_point("mid-burst")  # ledgered, not yet sent
                 if self._send_blocking(link, payload, data=True):
@@ -685,32 +737,44 @@ class SharedTensorPeer:
                 self._wake.wait(0.05)
                 self._wake.clear()
 
-    def _register_data(self, link: int, ledger_seq: int, encode) -> bytes:
+    def _register_data(self, link: int, ledger_seq: int, encode_into):
         """Allocate the link's next wire seq, encode the outgoing DATA/BURST
-        message with it, and append (ledger_seq, wire_seq, payload) to the
-        unacked retransmission ledger — the payload is kept verbatim so a
-        delivery timeout can resend it byte-identical (go-back-N;
-        wire.py tx_seq docstring). The encode itself (multi-MB numpy
-        serialization for big bursts) runs OUTSIDE _ack_mu so it never
-        stalls the recv thread's ACK pops; this thread is the link's only
-        seq allocator and appender, and the peer cannot ACK a seq before
-        the send that follows the append, so the two lock windows cannot
-        misorder the ledger."""
+        message with it INTO a pooled slot (r07: ``encode_into(buf, seq)``
+        writes the wire bytes in place and returns the length), and append
+        (ledger_seq, wire_seq, payload, slot) to the unacked retransmission
+        ledger — the slot's filled prefix IS the payload, kept verbatim so
+        a delivery timeout can resend it byte-identical (go-back-N; wire.py
+        tx_seq docstring), and it returns to the pool when the entry pops.
+        The encode itself (multi-MB numpy serialization for big bursts)
+        runs OUTSIDE _ack_mu so it never stalls the recv thread's ACK pops;
+        this thread is the link's only seq allocator and appender, and the
+        peer cannot ACK a seq before the send that follows the append, so
+        the two lock windows cannot misorder the ledger.
+
+        Slot reuse is single-writer-safe: only this (send) thread acquires
+        slots, so a slot released by the recv thread's ACK pop cannot be
+        overwritten while any in-flight payload view of it is still being
+        sent — the next acquire happens on this thread, after that send."""
         with self._ack_mu:
             txs = self._tx_seq.get(link, 0) + 1
             self._tx_seq[link] = txs
-        payload = encode(txs)
+        slot = self._tx_pool.acquire()
+        n = encode_into(slot, txs)
+        payload = slot[:n]
         with self._ack_mu:
             if link not in self._tx_seq:
                 # LINK_DOWN raced between the two lock windows and purged
                 # this link's ledger state; appending now would recreate
                 # the dict entry for a dead link (ids are never reused)
-                # and pin the payload until close()
+                # and pin the payload until close(). The slot goes back to
+                # the pool at once — safe to send the view first, because
+                # only this thread can re-acquire it (docstring above).
+                self._tx_pool.release(slot)
                 return payload
             q = self._unacked.setdefault(link, [])
             if not q:
                 self._ack_progress[link] = time.monotonic()
-            q.append((ledger_seq, txs, payload))
+            q.append((ledger_seq, txs, payload, slot))
         return payload
 
     def _window_full(self, link: int) -> bool:
@@ -735,14 +799,16 @@ class SharedTensorPeer:
         # payload forever — link ids are never reused, so anything not in
         # the live set is garbage. Only this thread appends, so a link
         # attached after `links` was snapshotted cannot have entries yet.
+        purged = []
         with self._ack_mu:
             live = set(links)
             for stale in [l for l in self._unacked if l not in live]:
-                self._unacked.pop(stale, None)
+                purged.extend(self._unacked.pop(stale, ()))
                 self._tx_seq.pop(stale, None)
                 self._acked.pop(stale, None)
                 self._ack_progress.pop(stale, None)
                 self._retx_rounds.pop(stale, None)
+        self._release_slots(purged)
         if tcfg.ack_timeout_sec <= 0 or tcfg.wire_compat:
             return
         now = time.monotonic()
@@ -765,7 +831,11 @@ class SharedTensorPeer:
                 rounds = self._retx_rounds.get(link, 0) + 1
                 self._retx_rounds[link] = rounds
                 self._ack_progress[link] = now
-                tail = [p for (_, _, p) in q[:RETX_PREFIX]]
+                # payload views over ledger-held slots: safe to send after
+                # the lock drops even if an ACK pops them mid-send — a
+                # released slot can only be REUSED by this same (send)
+                # thread, after these sends (see _register_data)
+                tail = [p for (_, _, p, _) in q[:RETX_PREFIX]]
             if rounds > max(1, tcfg.ack_retry_limit):
                 log.warning(
                     "link %d: no ACK progress after %d retransmission "
@@ -781,6 +851,17 @@ class SharedTensorPeer:
             for payload in tail:
                 if not self._send_blocking(link, payload, data=True):
                     break
+
+    def _release_slots(self, entries) -> None:
+        """Return popped ledger entries' pool slots (r07 slot lifecycle:
+        acked/purged -> free). Entries are (ledger_seq, wire_seq, payload,
+        slot) tuples; legacy bytes payloads carry slot=None."""
+        if self._tx_pool is None:
+            return
+        for entry in entries:
+            slot = entry[3]
+            if slot is not None:
+                self._tx_pool.release(slot)
 
     def _fault_point(self, name: str) -> None:
         """Named protocol point for the fault plan's kill schedule."""
@@ -888,6 +969,15 @@ class SharedTensorPeer:
                 # acknowledge); a burst message carries many frames.
                 batch: list = []
                 msgs = 0
+                # host tier only: its applies are synchronous numpy/C work,
+                # so recycling after the flush cannot race anything. A
+                # device tier's jitted apply may consume the arrays
+                # asynchronously (H2D transfer) — it keeps fresh copies.
+                scratch = self._rx_scratch.get(link)
+                if scratch is None and not compat and self.st.host_tier:
+                    scratch = self._rx_scratch.setdefault(
+                        link, wire.DecodeScratch(self.st.spec)
+                    )
                 for _ in range(256):  # bounded so other links aren't starved
                     try:
                         payload = self.node.recv(link, timeout=0.0)
@@ -936,11 +1026,15 @@ class SharedTensorPeer:
                                 continue
                             if payload[0] == wire.DATA:
                                 batch.append(
-                                    wire.decode_frame(payload, self.st.spec)
+                                    wire.decode_frame(
+                                        payload, self.st.spec, scratch
+                                    )
                                 )
                             else:
                                 batch.extend(
-                                    wire.decode_burst(payload, self.st.spec)
+                                    wire.decode_burst(
+                                        payload, self.st.spec, scratch
+                                    )
                                 )
                             msgs += 1
                             continue
@@ -950,7 +1044,7 @@ class SharedTensorPeer:
                     # control message: flush queued frames first (order), and
                     # never let a flush failure swallow the control message —
                     # a dropped WELCOME/DONE would hang the join handshake
-                    self._flush_frames(link, batch, msgs)
+                    self._flush_frames(link, batch, msgs, scratch)
                     batch, msgs = [], 0
                     try:
                         self._on_message(link, payload)
@@ -962,12 +1056,18 @@ class SharedTensorPeer:
                         # the engine's (and its rx accounting took over at
                         # the attach-time count)
                         break
-                self._flush_frames(link, batch, msgs)
+                self._flush_frames(link, batch, msgs, scratch)
                 self._flush_acks(link)  # retry any backpressure-dropped ACK
             if not busy:
                 time.sleep(0.002)
 
-    def _flush_frames(self, link: int, batch: list, msgs: int | None = None) -> None:
+    def _flush_frames(
+        self,
+        link: int,
+        batch: list,
+        msgs: int | None = None,
+        scratch: Optional[wire.DecodeScratch] = None,
+    ) -> None:
         n_ack = len(batch) if msgs is None else msgs
         if batch:
             try:
@@ -983,6 +1083,10 @@ class SharedTensorPeer:
                         self.st.receive_frame(link, f)
                     except Exception as e:
                         log.warning("dropping bad frame on link %d: %s", link, e)
+            if scratch is not None:
+                # frames applied (receive_frames is synchronous on every
+                # tier): their pooled decode arrays are reusable now
+                scratch.recycle()
             self._wake.set()  # flood refills other links' residuals
         # crash point: mass applied + flooded, ACK not yet sent — the
         # two-generals window; the sender re-delivers (at-least-once)
@@ -1140,14 +1244,16 @@ class SharedTensorPeer:
         if ev.kind == EventKind.LINK_DOWN:
             self._pending.pop(ev.link_id, None)
             self._engine_links.discard(ev.link_id)
+            self._rx_scratch.pop(ev.link_id, None)
             with self._ack_mu:
-                self._unacked.pop(ev.link_id, None)
+                purged = self._unacked.pop(ev.link_id, ())
                 self._tx_seq.pop(ev.link_id, None)
                 self._acked.pop(ev.link_id, None)
                 self._rx_count.pop(ev.link_id, None)
                 self._ack_sent.pop(ev.link_id, None)
                 self._ack_progress.pop(ev.link_id, None)
                 self._retx_rounds.pop(ev.link_id, None)
+            self._release_slots(purged)
             if ev.is_uplink:
                 # Keep undelivered upward updates for the re-grafted
                 # uplink — in a LIVE carry slot that continues to absorb
@@ -1295,20 +1401,22 @@ class SharedTensorPeer:
             self._wake.set()  # flood refills other links' residuals
         elif kind == wire.ACK:
             # cumulative ACK = last in-order wire seq the peer accepted;
-            # every unacked entry at or below it is delivered
+            # every unacked entry at or below it is delivered — its pool
+            # slot returns to the ring (slot lifecycle: acked -> free)
             count = wire.decode_ack(payload)
+            popped = []
             with self._ack_mu:
                 self._acked[link] = count
                 q = self._unacked.get(link, [])
-                acked = []
                 while q and q[0][1] <= count:
-                    acked.append(q.pop(0)[0])
-                if acked:
+                    popped.append(q.pop(0))
+                if popped:
                     # delivery progressed: reset the go-back-N timer
                     self._ack_progress[link] = time.monotonic()
                     self._retx_rounds.pop(link, None)
-            for seq in acked:
-                self.st.ack_frame(link, seq)
+            self._release_slots(popped)
+            for entry in popped:
+                self.st.ack_frame(link, entry[0])
         elif kind == wire.SYNC:
             k, n, digest = wire.decode_sync(payload)
             mine = self.st.spec

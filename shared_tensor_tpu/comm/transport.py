@@ -120,9 +120,17 @@ def _load() -> ctypes.CDLL:
     lib.st_node_send.argtypes = [
         ctypes.c_void_p,
         ctypes.c_int32,
-        ctypes.c_char_p,
+        # c_void_p, not c_char_p: accepts bytes AND zero-copy c_char views
+        # over the peer tier's pooled frame slots (wire.FramePool) — a
+        # c_char_p argtype would force a bytes() copy per message
+        ctypes.c_void_p,
         ctypes.c_int32,
         ctypes.c_double,
+    ]
+    lib.st_node_pool_stats.restype = None
+    lib.st_node_pool_stats.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64),
     ]
     lib.st_node_recv.restype = ctypes.c_int32
     lib.st_node_recv.argtypes = [
@@ -211,10 +219,21 @@ class TransportNode:
 
     # -- wire ---------------------------------------------------------------
 
-    def send(self, link_id: int, payload: bytes, timeout: float = 1.0) -> bool:
+    def send(self, link_id: int, payload, timeout: float = 1.0) -> bool:
         """Enqueue a frame; False = backpressure (retry), raises on dead
-        link."""
-        r = self._lib.st_node_send(self._h, link_id, payload, len(payload), timeout)
+        link. ``payload`` may be bytes OR any buffer (memoryview over a
+        pooled frame slot — the r07 zero-copy encode path): either way the
+        bytes cross the ABI once, into the transport's recycled tx buffer,
+        so the caller's buffer is free for reuse the moment this returns."""
+        n = len(payload)
+        if isinstance(payload, bytes):
+            arg = payload
+        else:
+            # writable-buffer view without copying (bytes() would copy);
+            # the ctypes array keeps the underlying buffer alive for the
+            # duration of the call
+            arg = (ctypes.c_char * n).from_buffer(payload)
+        r = self._lib.st_node_send(self._h, link_id, arg, n, timeout)
         if r < 0:
             raise BrokenPipeError(f"link {link_id} is down")
         return r == 1
@@ -255,6 +274,20 @@ class TransportNode:
     @property
     def listen_port(self) -> int:
         return self._lib.st_node_listen_port(self._h)
+
+    def pool_stats(self) -> dict:
+        """Transport buffer-pool counters (r07 data plane): tx/rx buffer
+        acquires vs misses (fresh allocations) and zero-copy sends. Steady
+        state shows acquires growing while misses stay flat."""
+        out = (ctypes.c_uint64 * 5)()
+        self._lib.st_node_pool_stats(self._h, out)
+        return {
+            "tx_acquires": out[0],
+            "tx_misses": out[1],
+            "rx_acquires": out[2],
+            "rx_misses": out[3],
+            "zc_msgs": out[4],
+        }
 
     def stats(self, link_id: int) -> Optional[LinkStats]:
         s = _StStatsC()

@@ -65,6 +65,7 @@ from __future__ import annotations
 
 import logging
 import struct
+import threading
 from typing import Iterator, Optional
 
 import numpy as np
@@ -104,11 +105,11 @@ BURST_MAX_FRAMES = 255
 #: 16 MiB: at 16 Mi elements a frame's wire body is ~2 MiB, so this budget
 #: gives burst caps of ~7 there — and the k-frame fused receive
 #: (stc_apply_frames) then touches the 64 MiB target ONCE per burst
-#: instead of once per frame, the difference between 2.6 and >3 GB/s
-#: equiv on the measured 16 Mi loopback (ENGINE_SWEEP_r05). Worst-case
-#: transport memory is bounded by queue_depth (8) x this budget per
-#: direction per link (~128 MiB at the largest tables) — host-RAM class,
-#: like every buffer at that table size.
+#: instead of once per frame (measured r07, 16 Mi loopback through the
+#: zero-copy plane: 737 f/s = 49.5 GB/s equiv — ENGINE_SWEEP_r07.json).
+#: Worst-case transport memory is bounded by queue_depth (8) x this budget
+#: per direction per link (~128 MiB at the largest tables) — host-RAM
+#: class, like every buffer at that table size.
 BURST_MAX_BYTES = 1 << 24
 
 
@@ -161,6 +162,84 @@ def data_seq(payload: bytes) -> int:
     return struct.unpack_from("<I", payload, 1)[0]
 
 
+class FramePool:
+    """Ring of wire-sized send-buffer slots (r07 zero-copy data plane).
+
+    Slot lifecycle: ``acquire`` -> encode in place (encode_frame_into /
+    encode_burst_into) -> the slot view is the ledger's retransmission
+    payload (in-flight) -> ``release`` when the receiver's ACK pops the
+    ledger entry (or the link dies) -> free list, capacity warm. The send
+    window (peer.SEND_WINDOW) bounds live slots per link, so steady-state
+    sends allocate nothing per message: ``acquires`` grows while
+    ``alloc_events`` stays flat (the assertion peer.metrics() exposes).
+    ``keep`` bounds how many free slots retain their buffer, so an idle
+    peer's high-water mark doesn't pin memory.
+
+    Thread-safety: acquire runs only on the peer's send thread; release
+    runs on the recv thread (ACK pops) — the lock covers the free list.
+    A released slot's buffer may still be referenced by an in-flight
+    retransmission VIEW, which is safe here because only the send thread
+    ever writes slot buffers (reuse cannot overwrite bytes another thread
+    is still sending)."""
+
+    def __init__(self, slot_bytes: int, keep: int = 4):
+        self._slot_bytes = int(slot_bytes)
+        self._keep = keep
+        self._free: list[memoryview] = []
+        self._mu = threading.Lock()
+        self.acquires = 0
+        self.alloc_events = 0
+
+    @property
+    def slot_bytes(self) -> int:
+        return self._slot_bytes
+
+    def acquire(self) -> memoryview:
+        """A writable slot_bytes-sized memoryview (contents undefined)."""
+        with self._mu:
+            self.acquires += 1
+            if self._free:
+                return self._free.pop()
+            self.alloc_events += 1
+        return memoryview(bytearray(self._slot_bytes))
+
+    def release(self, slot: memoryview) -> None:
+        with self._mu:
+            if len(self._free) < self._keep:
+                self._free.append(slot)
+            # else: drop — bounded idle memory, GC frees the buffer
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "tx_slot_acquires": self.acquires,
+                "tx_slot_alloc_events": self.alloc_events,
+                "tx_slots_free": len(self._free),
+            }
+
+
+def _write_frame_body(buf: memoryview, off: int, frame: TableFrame) -> int:
+    """Copy one frame's scales+words into ``buf`` at ``off`` (little-endian
+    wire layout) straight from the numpy buffers — no intermediate bytes
+    objects. Returns the new offset."""
+    scales = np.ascontiguousarray(frame.scales, "<f4")
+    words = np.ascontiguousarray(frame.words, "<u4")
+    sb, wb = scales.nbytes, words.nbytes
+    buf[off : off + sb] = memoryview(scales).cast("B")
+    buf[off + sb : off + sb + wb] = memoryview(words).cast("B")
+    return off + sb + wb
+
+
+def encode_frame_into(frame: TableFrame, seq: int, buf: memoryview) -> int:
+    """encode_frame writing into a pooled slot (FramePool) instead of
+    building bytes: header + scales + sign words land at their final wire
+    offsets, and the filled prefix doubles as the ledger's byte-identical
+    retransmission payload. Returns the message length."""
+    buf[0] = DATA
+    struct.pack_into("<I", buf, 1, seq & 0xFFFFFFFF)
+    return _write_frame_body(buf, DATA_HDR, frame)
+
+
 def encode_frame(frame: TableFrame, seq: int) -> bytes:
     scales = np.asarray(frame.scales, dtype="<f4")
     words = np.asarray(frame.words, dtype="<u4")
@@ -172,7 +251,30 @@ def encode_frame(frame: TableFrame, seq: int) -> bytes:
     )
 
 
-def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
+def decode_frame(
+    payload: bytes, spec: TableSpec, scratch: Optional[DecodeScratch] = None
+) -> TableFrame:
+    """Decode one DATA message.
+
+    Corruption guard at the trust boundary: a non-finite scale would NaN
+    the replica and flood the poison tree-wide (reference quirk Q9 — the
+    receive-path analog of add()'s sanitization). Zeroing makes the leaf a
+    no-op; the mass that frame carried is lost (the sender's error
+    feedback already debited it), bounded to the corrupted frames
+    themselves — strictly better than the reference, which loses the
+    whole tree. Huge-but-finite scales pass: every f32 below inf is
+    inside the protocol's legal domain (residuals clamp at +/-3e38, so
+    legitimate scales range up to 2^127), and the apply paths clamp to
+    +/-3e38 so even those cannot create an absorbing inf/NaN state.
+
+    Destination arrays are numpy, NOT jnp: a host-tier peer must never
+    initialize a jax backend (thread-pool contention with its C codec
+    loops); device tiers convert on entry to their jitted applies. COPIES,
+    not views: the frombuffer views start at payload offset 5, i.e.
+    4-byte-misaligned pointers, which the native C kernels must never
+    receive (UB; faults on strict-alignment targets) — with ``scratch``
+    (the per-link DecodeScratch pool) the copy lands in recycled arrays,
+    so steady-state decode allocates nothing per frame."""
     k = spec.num_leaves
     w = spec.total // 32
     want = DATA_HDR + frame_payload_bytes(spec)
@@ -181,31 +283,7 @@ def decode_frame(payload: bytes, spec: TableSpec) -> TableFrame:
             f"DATA frame is {len(payload)} bytes, spec wants {want} "
             f"(k={k}, words={w}) — peer table layout mismatch"
         )
-    scales = np.frombuffer(payload, "<f4", count=k, offset=DATA_HDR)
-    # Corruption guard at the trust boundary: a non-finite scale would NaN
-    # the replica and flood the poison tree-wide (reference quirk Q9 — the
-    # receive-path analog of add()'s sanitization). Zeroing makes the leaf a
-    # no-op; the mass that frame carried is lost (the sender's error
-    # feedback already debited it), bounded to the corrupted frames
-    # themselves — strictly better than the reference, which loses the
-    # whole tree. Huge-but-finite scales pass: every f32 below inf is
-    # inside the protocol's legal domain (residuals clamp at +/-3e38, so
-    # legitimate scales range up to 2^127), and the apply paths clamp to
-    # +/-3e38 so even those cannot create an absorbing inf/NaN state.
-    if not np.isfinite(scales).all():
-        log.warning(
-            "zeroing %d non-finite scale(s) in received frame (corrupt link?)",
-            int(np.count_nonzero(~np.isfinite(scales))),
-        )
-        scales = np.where(np.isfinite(scales), scales, np.float32(0.0))
-    words = np.frombuffer(payload, "<u4", count=w, offset=DATA_HDR + 4 * k)
-    # numpy, NOT jnp: a host-tier peer must never initialize a jax backend
-    # (thread-pool contention with its C codec loops); device tiers convert
-    # on entry to their jitted applies. COPIES, not views: the frombuffer
-    # views start at payload offset 1, i.e. 4-byte-misaligned pointers,
-    # which the native C kernels must never receive (UB; faults on
-    # strict-alignment targets). ascontiguousarray would no-op on a view.
-    return TableFrame(scales.copy(), words.copy())
+    return _decode_one_frame(payload, DATA_HDR, spec, scratch)
 
 
 def encode_burst(frames, spec: TableSpec, seq: int) -> bytes:
@@ -239,7 +317,107 @@ def encode_burst(frames, spec: TableSpec, seq: int) -> bytes:
     return out
 
 
-def decode_burst(payload: bytes, spec: TableSpec) -> list[TableFrame]:
+def encode_burst_into(
+    frames, spec: TableSpec, seq: int, buf: memoryview
+) -> int:
+    """encode_burst writing into a pooled slot (FramePool): same layout and
+    the same hard size check, zero intermediate bytes objects. Returns the
+    message length."""
+    cap = burst_frames_cap(spec)
+    if not 1 <= len(frames) <= cap:
+        raise ValueError(
+            f"burst of {len(frames)} frames (this spec allows 1..{cap} — "
+            f"the bound peers sized their receive buffers for)"
+        )
+    buf[0] = BURST
+    struct.pack_into("<I", buf, 1, seq & 0xFFFFFFFF)
+    buf[BURST_HDR - 1] = len(frames)
+    off = BURST_HDR
+    for f in frames:
+        off = _write_frame_body(buf, off, f)
+    # hard check, not assert (see encode_burst): a mis-sized burst silently
+    # desyncs every downstream decoder
+    if off != BURST_HDR + len(frames) * frame_payload_bytes(spec):
+        raise ValueError(
+            f"encoded burst is {off} bytes, layout wants "
+            f"{BURST_HDR + len(frames) * frame_payload_bytes(spec)} — "
+            f"frame/spec mismatch"
+        )
+    return off
+
+
+class DecodeScratch:
+    """Per-link pool of decode destination arrays (r07 satellite): steady-
+    state decode_frame/decode_burst copy into recycled (scales, words)
+    arrays instead of allocating fresh ones per frame (the old
+    ``.copy()``-per-frame path — ~n/8 bytes of fresh heap per frame).
+
+    Frames handed out stay valid until :meth:`recycle`, which the peer's
+    recv loop calls after the batch has been APPLIED (receive_frames is
+    synchronous on every tier, so nothing references the arrays after the
+    flush). Single-consumer: only the recv loop touches a link's scratch."""
+
+    def __init__(self, spec: TableSpec, keep: int = 16):
+        self._k = spec.num_leaves
+        self._w = spec.total // 32
+        self._keep = keep
+        self._free: list[tuple[np.ndarray, np.ndarray]] = []
+        self._out: list[tuple[np.ndarray, np.ndarray]] = []
+
+    def frame(self) -> tuple[np.ndarray, np.ndarray]:
+        """A (scales, words) destination pair, reused when possible."""
+        if self._free:
+            pair = self._free.pop()
+        else:
+            pair = (
+                np.empty(self._k, np.float32),
+                np.empty(self._w, np.uint32),
+            )
+        self._out.append(pair)
+        return pair
+
+    def recycle(self) -> None:
+        """Return every handed-out pair to the free list — call ONLY after
+        the decoded frames have been applied."""
+        if self._out:
+            free = self._free
+            for pair in self._out:
+                if len(free) < self._keep:
+                    free.append(pair)
+            self._out.clear()
+
+
+def _decode_one_frame(
+    payload, off: int, spec: TableSpec, scratch: Optional[DecodeScratch]
+) -> TableFrame:
+    """Shared body of decode_frame/decode_burst: views into the payload,
+    copied into pooled (scratch) or fresh destination arrays, with the
+    non-finite-scale corruption guard applied IN PLACE on the copy."""
+    k = spec.num_leaves
+    w = spec.total // 32
+    scales_v = np.frombuffer(payload, "<f4", count=k, offset=off)
+    words_v = np.frombuffer(payload, "<u4", count=w, offset=off + 4 * k)
+    # COPIES, not views (alignment + lifetime: see decode_frame docstring);
+    # the scratch pool makes the steady-state copy land in recycled arrays
+    if scratch is not None:
+        scales, words = scratch.frame()
+        np.copyto(scales, scales_v)
+        np.copyto(words, words_v)
+    else:
+        scales, words = scales_v.copy(), words_v.copy()
+    bad = ~np.isfinite(scales)
+    if bad.any():
+        log.warning(
+            "zeroing %d non-finite scale(s) in received frame (corrupt link?)",
+            int(np.count_nonzero(bad)),
+        )
+        scales[bad] = np.float32(0.0)
+    return TableFrame(scales, words)
+
+
+def decode_burst(
+    payload: bytes, spec: TableSpec, scratch: Optional[DecodeScratch] = None
+) -> list[TableFrame]:
     """Inverse of :func:`encode_burst`, with the same per-frame corruption
     guard as decode_frame (non-finite scales zeroed)."""
     if len(payload) < BURST_HDR:
@@ -256,24 +434,10 @@ def decode_burst(payload: bytes, spec: TableSpec) -> list[TableFrame]:
             f"BURST of {k_frames} frames is {len(payload)} bytes, "
             f"layout wants {want} — peer table layout mismatch"
         )
-    out = []
-    for i in range(k_frames):
-        off = BURST_HDR + i * per
-        scales = np.frombuffer(payload, "<f4", count=spec.num_leaves, offset=off)
-        if not np.isfinite(scales).all():
-            log.warning(
-                "zeroing %d non-finite scale(s) in burst frame (corrupt link?)",
-                int(np.count_nonzero(~np.isfinite(scales))),
-            )
-            scales = np.where(np.isfinite(scales), scales, np.float32(0.0))
-        else:
-            scales = scales.copy()  # aligned, owned (see decode_frame)
-        words = np.frombuffer(
-            payload, "<u4", count=spec.total // 32,
-            offset=off + 4 * spec.num_leaves,
-        )
-        out.append(TableFrame(scales, words.copy()))
-    return out
+    return [
+        _decode_one_frame(payload, BURST_HDR + i * per, spec, scratch)
+        for i in range(k_frames)
+    ]
 
 
 def encode_sync(spec: TableSpec) -> bytes:

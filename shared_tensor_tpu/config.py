@@ -241,6 +241,14 @@ class Config:
     #: device link (PCIe queue, TPU tunnel) throughput is bounded by
     #: bandwidth instead of round-trip latency. 1 = plain double buffering.
     send_pipeline_depth: int = 8
+    #: Warm slots the r07 zero-copy frame pool keeps per peer (wire.FramePool
+    #: ``keep``): released send slots beyond this are freed, bounding an
+    #: idle peer's high-water memory while keeping steady-state sends
+    #: allocation-free. The pool itself is bounded by the go-back-N send
+    #: window (peer.SEND_WINDOW live slots per link, worst case); slots are
+    #: wire-message-sized (up to ~16 MiB at the largest burst), so ``keep``
+    #: trades idle memory against re-allocation on bursty duty cycles.
+    frame_pool_keep: int = 4
     #: Frames per wire message on the host (CPU) tier, native mode only.
     #: Successive codec frames are successive halvings of the same residual,
     #: so a sender can quantize K frames back-to-back and ship them as ONE

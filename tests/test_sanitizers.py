@@ -1,0 +1,91 @@
+"""ASan + UBSan over the native ring-buffer data plane (r07 CI satellite).
+
+The r07 zero-copy plane moved real lifetime management into C: tx slots
+shared by codec threads, the go-back-N ledger, and the transport's
+scatter-gather sender via refcounts and release callbacks (stengine.cpp
+TxSlot, sttransport.cpp OutMsg). A use-after-free or misaligned access
+there is silent on x86 until it corrupts a heap — exactly what the
+sanitizers catch deterministically. This test builds the whole native trio
+with -fsanitize=address,undefined (``make -C native sanitize``) and runs
+one chaos_soak arm against it: injected drop/stall/sever chaos drives slot
+refs through every path (send, retransmit, rollback, teardown) while ASan
+watches every byte.
+
+Slow-marked: tier-1 runs ``-m 'not slow'``; this is the nightly/CI arm
+(ARTIFACTS.md). Run directly with
+``pytest tests/test_sanitizers.py -m slow``.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+NATIVE = REPO / "native"
+
+
+def _runtime(name: str):
+    """Path to the compiler's sanitizer runtime, or None. The PRELOADed
+    runtime must match the compiler that built the .so's, which is why
+    this asks the same gcc the Makefile uses instead of globbing /usr."""
+    try:
+        out = subprocess.run(
+            ["gcc", f"-print-file-name={name}"],
+            capture_output=True, text=True, timeout=30,
+        ).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    p = pathlib.Path(out)
+    return p if p.is_absolute() and p.exists() else None
+
+
+@pytest.mark.slow
+def test_chaos_soak_native_arm_under_asan_ubsan():
+    asan = _runtime("libasan.so")
+    ubsan = _runtime("libubsan.so")
+    if asan is None or ubsan is None:
+        pytest.skip("gcc sanitizer runtimes unavailable")
+    build = subprocess.run(
+        ["make", "-C", str(NATIVE), "sanitize"],
+        capture_output=True, text=True, timeout=300,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"sanitize build failed: {build.stderr[-500:]}")
+
+    env = dict(os.environ)
+    env.update(
+        {
+            # the python binary is uninstrumented: the ASan runtime must be
+            # the first thing the dynamic loader maps
+            "LD_PRELOAD": f"{asan} {ubsan}",
+            # CPython leaks by design at interpreter exit; halt hard on
+            # everything the sanitizers CAN attribute
+            "ASAN_OPTIONS": "detect_leaks=0,abort_on_error=1",
+            "UBSAN_OPTIONS": "print_stacktrace=1,halt_on_error=1",
+            # route every ctypes loader at the sanitizer builds
+            "ST_NATIVE_DIR": str(NATIVE / "san"),
+            "JAX_PLATFORMS": "cpu",
+            # one native arm, short window: the chaos classes (drop, stall,
+            # sever -> rollback -> carry -> re-graft) all fire within
+            # seconds; ASan costs ~2-5x wall clock on top
+            "ST_CHAOS_ARMS": "native",
+            "ST_CHAOS_SECONDS": "6",
+        }
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "chaos_soak.py")],
+        env=env, capture_output=True, text=True, timeout=540, cwd=str(REPO),
+    )
+    err_tail = proc.stderr[-4000:]
+    assert "AddressSanitizer" not in proc.stderr, err_tail
+    assert "runtime error:" not in proc.stderr, err_tail  # UBSan findings
+    assert proc.returncode == 0, (proc.returncode, err_tail)
+    # the soak's own delivery-contract verdict must hold under sanitizers
+    # (chaos_soak prints ONE indented JSON document)
+    stdout = proc.stdout
+    out = json.loads(stdout[stdout.index("{"):])
+    assert out["arms"]["native"]["pass"], out
