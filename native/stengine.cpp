@@ -102,6 +102,12 @@ uint64_t st_node_wait_data(void*, uint64_t, double);
 // comm/faults.py documents the schedule format and renders FaultConfig
 // into it (to_env).
 void st_fault_crash_point(const char*);
+// r08 obs event ring (defined once in sttransport.cpp; codes are ABI —
+// obs/events.py CODE_NAMES is the authoritative mirror). Engine-side
+// events: retransmit(10), black-hole teardown(11), quarantine(12),
+// send-window stall(13, edge-triggered), dedup/gap discard(14), seal(15).
+void st_obs_emit(uint32_t node_id, uint32_t code, int32_t link, uint64_t arg);
+uint32_t st_node_obs_id(void*);
 }
 
 namespace {
@@ -217,10 +223,23 @@ void tx_slot_release(void* ctx) {
   s->pool->unref(s);
 }
 
+// obs event codes the engine emits (mirror of sttransport.cpp stobs::kEv*)
+constexpr uint32_t kEvRetransmit = 10;
+constexpr uint32_t kEvBlackhole = 11;
+constexpr uint32_t kEvQuarantine = 12;
+constexpr uint32_t kEvWindowStall = 13;
+constexpr uint32_t kEvDedupDiscard = 14;
+constexpr uint32_t kEvSeal = 15;
+
 struct SentMsg {
   // one wire message = 1..k frames; rolls back / acks whole
   int32_t nframes;
   uint64_t seq = 0;      // per-link wire seq (comm/wire.py tx_seq)
+  // ledger-append time: ACK-pop minus this is the delivery round trip the
+  // r08 RTT counters aggregate (st_engine_counters[10..11]); includes any
+  // retransmission rounds, which is what an operator debugging a slow link
+  // wants the number to include
+  std::chrono::steady_clock::time_point sent_at{};
   TxSlot* slot = nullptr;  // native framing: the encoded wire bytes
                            // (this ledger entry owns one pool reference)
   std::vector<float> scales;    // compat path only: nframes * L
@@ -242,6 +261,10 @@ struct ELink {
   // last delivery progress, and fruitless retransmission rounds since
   EClock::time_point ack_progress{};
   int32_t retx_rounds = 0;
+  // edge detector for the send-window stall event (kEvWindowStall): emit
+  // once per blocked episode, not once per sender pass (a stalled link
+  // would otherwise spam the ring at wake frequency)
+  bool window_blocked = false;
   bool dirty = true;       // residual may quantize to something nonzero
   bool dead = false;       // transport reported death; stop touching
   // Scale-partials cache for this residual: every pass that already walks
@@ -323,6 +346,13 @@ struct Engine {
   std::atomic<bool> sealed{false};
   std::atomic<uint64_t> frames_out{0}, frames_in{0}, updates{0};
   std::atomic<uint64_t> msgs_out{0}, msgs_in{0};
+  // r08 obs counters (st_engine_counters[8..11]): go-back-N retransmitted
+  // messages, dup/gap discards at the receive acceptance check, and the
+  // ACK round-trip aggregate (sum of ns + sample count — the C hot path
+  // keeps no buckets; Python renders mean / exports sum+count).
+  std::atomic<uint64_t> retx_msgs{0}, dedup_discards{0};
+  std::atomic<uint64_t> rtt_ns_total{0}, rtt_msgs{0};
+  uint32_t obs_id = 0;  // the node's process-unique obs id (event tag)
   std::thread send_thread, recv_thread;
 
   void wake() {
@@ -513,8 +543,13 @@ void retransmit_pass(Engine* e, const std::vector<int32_t>& ids) {
       }
     }
     if (teardown) {
+      st_obs_emit(e->obs_id, kEvBlackhole, id, (uint64_t)e->ack_retry_limit);
       st_node_drop_link(e->node, id);
       continue;
+    }
+    if (!tail.empty()) {
+      e->retx_msgs += (uint64_t)tail.size();
+      st_obs_emit(e->obs_id, kEvRetransmit, id, (uint64_t)tail.size());
     }
     for (size_t i = 0; i < tail.size(); i++) {
       TxSlot* s = tail[i];
@@ -566,7 +601,15 @@ void sender_loop(Engine* e) {
         // accumulating and quantizes once ACKs reopen the window — and,
         // with the ledger-as-slot design, bounds the live tx ring slots
         // per link at kSendWindow (the pool cannot grow past it)
-        if (!e->compat_bytes && lk2.unacked.size() >= kSendWindow) continue;
+        if (!e->compat_bytes && lk2.unacked.size() >= kSendWindow) {
+          if (!lk2.window_blocked) {
+            lk2.window_blocked = true;
+            st_obs_emit(e->obs_id, kEvWindowStall, id,
+                        (uint64_t)lk2.unacked.size());
+          }
+          continue;
+        }
+        lk2.window_blocked = false;
         // quantize up to `burst` successive halvings of the residual,
         // stopping at the first all-zero-scale frame (idle). EVERY quantize
         // pass accumulates the residual's scale partials fused
@@ -665,7 +708,8 @@ void sender_loop(Engine* e) {
             slot->wire_len = 5 + (uint32_t)per;
           }
           msg.slot = slot;  // the ledger entry owns the acquire reference
-          if (lk2.unacked.empty()) lk2.ack_progress = EClock::now();
+          msg.sent_at = EClock::now();
+          if (lk2.unacked.empty()) lk2.ack_progress = msg.sent_at;
           it->second.unacked.push_back(msg);
           // in-flight reference for the send below, taken UNDER e->mu:
           // after the lock drops, a concurrent detach/stash_carry can
@@ -717,6 +761,7 @@ void sender_loop(Engine* e) {
           // quarantine: tear the stalled link down; the failed-send
           // rollback below + Python's LINK_DOWN -> carry -> re-graft
           // recover every undelivered frame
+          st_obs_emit(e->obs_id, kEvQuarantine, id, (uint64_t)fails);
           st_node_drop_link(e->node, id);
           break;
         }
@@ -878,7 +923,11 @@ void receiver_loop(Engine* e) {
           if (n < 5) continue;  // too short to carry a seq: undecodable
           uint32_t seq;
           std::memcpy(&seq, buf.data() + 1, 4);
-          if (seq != (uint32_t)(rx_base + msgs + 1)) continue;  // dup/gap
+          if (seq != (uint32_t)(rx_base + msgs + 1)) {  // dup/gap: discard
+            e->dedup_discards++;
+            st_obs_emit(e->obs_id, kEvDedupDiscard, id, (uint64_t)seq);
+            continue;
+          }
           int32_t k = 0;
           const uint8_t* p = nullptr;
           if (kind == kData && (size_t)n == 5 + per) {
@@ -921,9 +970,16 @@ void receiver_loop(Engine* e) {
             // drops the ledger reference and returns to the ring once any
             // in-flight (re)send reference drains too
             bool progressed = false;
+            auto ack_at = EClock::now();
             while (!lk2.unacked.empty() && lk2.unacked.front().seq <= count) {
-              if (lk2.unacked.front().slot)
-                e->txpool.unref(lk2.unacked.front().slot);
+              SentMsg& m = lk2.unacked.front();
+              // delivery round trip: ledger append -> cumulative-ACK pop
+              e->rtt_ns_total += (uint64_t)std::chrono::duration_cast<
+                                     std::chrono::nanoseconds>(
+                                     ack_at - m.sent_at)
+                                     .count();
+              e->rtt_msgs++;
+              if (m.slot) e->txpool.unref(m.slot);
               lk2.unacked.pop_front();
               progressed = true;
             }
@@ -977,6 +1033,7 @@ __attribute__((visibility("default"))) void* st_engine_create(
     return nullptr;  // compat: one flat tensor, mask must fit the words
   auto* e = new Engine();
   e->node = node;
+  e->obs_id = st_node_obs_id(node);  // tag engine events with the node
   e->L = n_leaves;
   e->total = total;
   e->total_n = total_n;
@@ -1025,7 +1082,9 @@ __attribute__((visibility("default"))) void st_engine_start(void* h) {
 // Seal ingress for a graceful leave (see Engine::sealed).
 __attribute__((visibility("default"))) void st_engine_seal(void* h) {
   if (!h) return;
-  ((Engine*)h)->sealed.store(true);
+  auto* e = (Engine*)h;
+  e->sealed.store(true);
+  st_obs_emit(e->obs_id, kEvSeal, -1, 0);
 }
 
 // Stop the engine threads. MUST be called before st_node_close (the threads
@@ -1299,28 +1358,34 @@ __attribute__((visibility("default"))) int64_t st_engine_inflight(void* h) {
 }
 
 // counters: [frames_out, frames_in, updates, msgs_out, msgs_in,
-//            tx_slot_acquires, tx_slot_alloc_events, tx_slots_allocated]
-// The last three are the r07 tx-ring stats the zero-allocation assertion
-// reads: steady state shows acquires growing while alloc_events stays
-// flat (every acquire reuses a warm slot).
+//            tx_slot_acquires, tx_slot_alloc_events, tx_slots_allocated,
+//            retx_msgs, dedup_discards, rtt_ns_total, rtt_msgs]
+// [5..7] are the r07 tx-ring pool stats (steady state: acquires grow,
+// alloc_events flat); [8..11] are the r08 obs aggregates (go-back-N
+// retransmitted messages, dup/gap discards, and the ACK round-trip
+// sum-of-ns + sample count — obs/schema.py names them canonically).
 __attribute__((visibility("default"))) void st_engine_counters(
-    void* h, uint64_t* out8) {
+    void* h, uint64_t* out12) {
   if (!h) {  // the SIGSEGV that aborted the whole suite (r05 Weak #2)
-    for (int i = 0; i < 8; i++) out8[i] = 0;
+    for (int i = 0; i < 12; i++) out12[i] = 0;
     return;
   }
   auto* e = (Engine*)h;
-  out8[0] = e->frames_out.load();
-  out8[1] = e->frames_in.load();
-  out8[2] = e->updates.load();
-  out8[3] = e->msgs_out.load();
-  out8[4] = e->msgs_in.load();
-  out8[5] = e->txpool.acquires.load();
-  out8[6] = e->txpool.alloc_events.load();
+  out12[0] = e->frames_out.load();
+  out12[1] = e->frames_in.load();
+  out12[2] = e->updates.load();
+  out12[3] = e->msgs_out.load();
+  out12[4] = e->msgs_in.load();
+  out12[5] = e->txpool.acquires.load();
+  out12[6] = e->txpool.alloc_events.load();
   {
     std::lock_guard<std::mutex> lk(e->txpool.mu);
-    out8[7] = (uint64_t)e->txpool.all_.size();
+    out12[7] = (uint64_t)e->txpool.all_.size();
   }
+  out12[8] = e->retx_msgs.load();
+  out12[9] = e->dedup_discards.load();
+  out12[10] = e->rtt_ns_total.load();
+  out12[11] = e->rtt_msgs.load();
 }
 
 // Pop one control-plane message; returns its length (0 = none). link_out

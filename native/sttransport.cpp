@@ -34,6 +34,7 @@
 #include <poll.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -82,6 +83,168 @@ extern "C" __attribute__((visibility("default"))) void st_fault_crash_point(
   }
   if (point.empty() || point != name) return;
   if (--remaining <= 0) _exit(17);
+}
+
+// ---- obs event ring (r08 tentpole) ---------------------------------------
+//
+// Lock-free per-thread rings of 32-byte timestamped protocol events, the
+// native half of the cross-tier timeline (shared_tensor_tpu/obs/events.py
+// defines the code names; the numeric codes here are ABI). Design:
+//
+//  - each EMITTING thread owns one SPSC ring (thread_local holder): the
+//    writer touches only its own head (release store), the drainer only
+//    tails (release store) — no locks, no CAS on the hot path. A full
+//    ring DROPS the event and counts the drop (g_dropped), so a stalled
+//    drainer degrades accounting, never the data plane.
+//  - rings are registered in a global list under a mutex taken only at
+//    thread birth and at drain time (both rare); rings are never freed —
+//    a ring whose thread exited is marked retired and re-adopted by the
+//    next new thread after its leftover events drain.
+//  - timestamps are CLOCK_MONOTONIC ns, the same clock CPython's
+//    time.monotonic_ns() reads on Linux, so native and Python events merge
+//    by plain sort (st_obs_now_ns exports the clock for agreement checks).
+//  - ST_OBS=0 in the environment (or st_obs_set_enabled(0)) turns emission
+//    into one relaxed atomic load — the production-off cost.
+//
+// Shared with stengine.cpp (which imports st_obs_emit/st_node_obs_id):
+// defined ONCE here for the same reason as st_fault_crash_point above.
+namespace stobs {
+
+constexpr uint32_t kEvRingCap = 2048;  // events per thread ring
+
+struct EventRec {  // the 32-byte drain ABI record (obs/events.py _EVENT_FMT)
+  uint64_t t_ns;
+  uint32_t node_id;
+  uint32_t code;
+  int32_t link;
+  uint32_t reserved;
+  uint64_t arg;
+};
+static_assert(sizeof(EventRec) == 32, "obs event record is 32-byte ABI");
+
+struct Ring {
+  std::atomic<uint64_t> head{0};  // writer-owned
+  std::atomic<uint64_t> tail{0};  // drainer-owned
+  std::atomic<bool> live{false};  // owned by a running thread
+  EventRec ev[kEvRingCap];
+};
+
+std::mutex g_reg_mu;         // ring registration + drain (rare paths only)
+std::vector<Ring*> g_rings;  // never freed; retired rings are re-adopted
+std::atomic<int> g_enabled{[] {
+  const char* e = getenv("ST_OBS");
+  return (e && e[0] == '0' && !e[1]) ? 0 : 1;
+}()};
+std::atomic<uint64_t> g_dropped{0};
+std::atomic<uint32_t> g_next_node_id{1};
+
+inline uint64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+// Thread-local ring ownership: adopt a retired ring (its undrained tail is
+// preserved) or register a fresh one; retire at thread exit. Registration
+// is once per thread lifetime — never on the emit path.
+struct RingHolder {
+  Ring* r;
+  RingHolder() {
+    std::lock_guard<std::mutex> lk(g_reg_mu);
+    for (Ring* cand : g_rings) {
+      // acquire pairs with the dead owner's release store in ~RingHolder:
+      // the adopter must observe the old thread's final head/record
+      // stores before writing its own events, or a stale head could
+      // overwrite undrained records (a relaxed load has no such edge)
+      if (!cand->live.load(std::memory_order_acquire)) {
+        cand->live.store(true, std::memory_order_relaxed);
+        r = cand;
+        return;
+      }
+    }
+    r = new Ring();
+    r->live.store(true, std::memory_order_relaxed);
+    g_rings.push_back(r);
+  }
+  ~RingHolder() { r->live.store(false, std::memory_order_release); }
+};
+
+// event codes (ABI; obs/events.py CODE_NAMES is the authoritative mirror).
+// 1..4 reuse the membership Event kinds verbatim.
+constexpr uint32_t kEvRetransmit = 10;
+constexpr uint32_t kEvBlackhole = 11;
+constexpr uint32_t kEvQuarantine = 12;
+constexpr uint32_t kEvWindowStall = 13;
+constexpr uint32_t kEvDedupDiscard = 14;
+constexpr uint32_t kEvSeal = 15;
+constexpr uint32_t kEvFaultDrop = 20;
+constexpr uint32_t kEvFaultDup = 21;
+constexpr uint32_t kEvFaultCorrupt = 22;
+constexpr uint32_t kEvFaultTruncate = 23;
+constexpr uint32_t kEvFaultDelay = 24;
+constexpr uint32_t kEvFaultStall = 25;
+constexpr uint32_t kEvFaultSever = 26;
+
+}  // namespace stobs
+
+extern "C" __attribute__((visibility("default"))) uint64_t st_obs_now_ns() {
+  return stobs::now_ns();
+}
+
+extern "C" __attribute__((visibility("default"))) void st_obs_set_enabled(
+    int32_t on) {
+  stobs::g_enabled.store(on ? 1 : 0, std::memory_order_relaxed);
+}
+
+extern "C" __attribute__((visibility("default"))) uint64_t st_obs_dropped() {
+  return stobs::g_dropped.load(std::memory_order_relaxed);
+}
+
+// Record one event on the calling thread's ring. Cheap enough to leave on
+// in production (one relaxed load when disabled; one clock read + one
+// 32-byte store when armed) — and RARE by design: every call site is a
+// protocol/recovery/fault event, never a per-element loop.
+extern "C" __attribute__((visibility("default"))) void st_obs_emit(
+    uint32_t node_id, uint32_t code, int32_t link, uint64_t arg) {
+  if (!stobs::g_enabled.load(std::memory_order_relaxed)) return;
+  thread_local stobs::RingHolder tl;
+  stobs::Ring* r = tl.r;
+  uint64_t h = r->head.load(std::memory_order_relaxed);
+  if (h - r->tail.load(std::memory_order_acquire) >= stobs::kEvRingCap) {
+    stobs::g_dropped.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  stobs::EventRec& e = r->ev[h % stobs::kEvRingCap];
+  e.t_ns = stobs::now_ns();
+  e.node_id = node_id;
+  e.code = code;
+  e.link = link;
+  e.reserved = 0;
+  e.arg = arg;
+  r->head.store(h + 1, std::memory_order_release);
+}
+
+// Drain every thread's ring into buf (whole 32-byte records only); returns
+// bytes written. Leftovers stay ring-buffered for the next drain. The
+// registration mutex serializes concurrent drainers (Python side calls
+// this from peers' recv loops); writers never touch it.
+extern "C" __attribute__((visibility("default"))) int32_t st_obs_drain(
+    uint8_t* buf, int32_t cap_bytes) {
+  int32_t written = 0;
+  std::lock_guard<std::mutex> lk(stobs::g_reg_mu);
+  for (stobs::Ring* r : stobs::g_rings) {
+    uint64_t t = r->tail.load(std::memory_order_relaxed);
+    uint64_t h = r->head.load(std::memory_order_acquire);
+    while (t < h &&
+           cap_bytes - written >= (int32_t)sizeof(stobs::EventRec)) {
+      std::memcpy(buf + written, &r->ev[t % stobs::kEvRingCap],
+                  sizeof(stobs::EventRec));
+      written += (int32_t)sizeof(stobs::EventRec);
+      t++;
+    }
+    r->tail.store(t, std::memory_order_release);
+  }
+  return written;
 }
 
 namespace {
@@ -374,6 +537,9 @@ void rejoin_loop(Node* node);
 
 struct Node {
   Config cfg;
+  // process-unique obs id: tags this node's events on the shared per-thread
+  // rings so a multi-peer process still yields per-node timelines
+  uint32_t obs_id = 0;
   std::atomic<bool> closing{false};
   std::atomic<int> active_threads{0};  // all detached; close() drains to 0
   int listen_fd = -1;
@@ -422,6 +588,8 @@ struct Node {
   }
 
   void emit(int32_t kind, int32_t link_id, int32_t is_uplink) {
+    // membership events double as timeline events (codes 1..4 == kinds)
+    st_obs_emit(obs_id, (uint32_t)kind, link_id, (uint64_t)is_uplink);
     std::lock_guard<std::mutex> lk(ev_mu);
     events.push_back({kind, link_id, is_uplink});
     ev_cv.notify_all();
@@ -624,17 +792,28 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
           link->fault_rng =
               (fp.seed + 1) * 0x9e3779b97f4a7c15ull + (uint64_t)link->id;
         int64_t nf = ++link->fault_frames;
-        if (fp.sever_after > 0 && nf >= fp.sever_after) break;  // kill_link
+        if (fp.sever_after > 0 && nf >= fp.sever_after) {  // kill_link below
+          st_obs_emit(node->obs_id, stobs::kEvFaultSever, link->id,
+                      (uint64_t)nf);
+          break;
+        }
         if (fp.stall_after >= 0 && nf > fp.stall_after) {
           // swallowed: sender layers believe it was delivered (a borrowed
           // slot is still released — via msg's reuse/destruction)
+          st_obs_emit(node->obs_id, stobs::kEvFaultStall, link->id,
+                      (uint64_t)nf);
           msg.reset();
           continue;
         }
-        if (fp.delay_pct > 0 && frand64(&link->fault_rng) < fp.delay_pct)
+        if (fp.delay_pct > 0 && frand64(&link->fault_rng) < fp.delay_pct) {
+          st_obs_emit(node->obs_id, stobs::kEvFaultDelay, link->id,
+                      (uint64_t)fp.delay_ms);
           std::this_thread::sleep_for(
               std::chrono::duration<double>(fp.delay_ms / 1000.0));
+        }
         if (fp.drop > 0 && frand64(&link->fault_rng) < fp.drop) {
+          st_obs_emit(node->obs_id, stobs::kEvFaultDrop, link->id,
+                      (uint64_t)nf);
           msg.reset();
           continue;
         }
@@ -656,6 +835,8 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
           if (i >= msg.owned.size()) i = msg.owned.size() - 1;
           msg.owned[i] ^=
               (uint8_t)(1u << (int)(frand64(&link->fault_rng) * 8));
+          st_obs_emit(node->obs_id, stobs::kEvFaultCorrupt, link->id,
+                      (uint64_t)i);
         }
         if (fp.trunc > 0 && !node->cfg.wire_compat && msg.size() > 2 &&
             frand64(&link->fault_rng) < fp.trunc) {
@@ -667,13 +848,18 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
           write_len =
               1 + (size_t)(frand64(&link->fault_rng) * (msg.size() - 1));
           if (write_len > msg.size()) write_len = msg.size();
+          st_obs_emit(node->obs_id, stobs::kEvFaultTruncate, link->id,
+                      (uint64_t)write_len);
         }
         // dup gated off compat like trunc: the reference protocol has no
         // seq dedup, so a duplicated compat frame would double-apply with
         // no recovery path (comm/faults.py FaultPlan.wire_compat)
         if (fp.dup > 0 && !node->cfg.wire_compat &&
-            frand64(&link->fault_rng) < fp.dup)
+            frand64(&link->fault_rng) < fp.dup) {
           write_reps = 2;
+          st_obs_emit(node->obs_id, stobs::kEvFaultDup, link->id,
+                      (uint64_t)nf);
+        }
       }
     }
     if (cap > 0 && msg.size() > 0) {
@@ -1059,6 +1245,8 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
     return nullptr;  // compat frames are [f32 scale][>=1 bitmask byte]
   }
   auto* node = new Node();
+  node->obs_id =
+      stobs::g_next_node_id.fetch_add(1, std::memory_order_relaxed);
   Config& cfg = node->cfg;
   cfg.wire_compat = cfg_c->wire_compat;
   cfg.compat_frame_bytes = cfg_c->compat_frame_bytes;
@@ -1163,6 +1351,12 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
   if (is_master) *is_master = became_master ? 1 : 0;
   if (became_master) node->emit(3, 0, 0);
   return node;
+}
+
+// The node's process-unique obs id (tags its events on the shared rings).
+uint32_t st_node_obs_id(void* h) {
+  auto* node = (Node*)h;
+  return node ? node->obs_id : 0;
 }
 
 int32_t st_node_listen_port(void* h) {

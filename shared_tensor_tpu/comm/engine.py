@@ -434,9 +434,12 @@ class EngineTensor:
 
         Layout (st_engine_counters): [frames_out, frames_in, updates,
         msgs_out, msgs_in, tx_slot_acquires, tx_slot_alloc_events,
-        tx_slots_allocated] — the last three are the r07 tx-ring pool
-        stats (steady state: acquires grow, alloc_events stay flat)."""
-        out = np.zeros(8, np.uint64)
+        tx_slots_allocated, retx_msgs, dedup_discards, rtt_ns_total,
+        rtt_msgs] — [5..7] are the r07 tx-ring pool stats (steady state:
+        acquires grow, alloc_events stay flat); [8..11] the r08 obs
+        aggregates (go-back-N retransmits, dup/gap discards, ACK
+        round-trip ns sum + sample count)."""
+        out = np.zeros(12, np.uint64)
         if self._h:
             self._lib.st_engine_counters(self._h, out)
         return out
@@ -450,6 +453,19 @@ class EngineTensor:
             "tx_slot_acquires": int(c[5]),
             "tx_slot_alloc_events": int(c[6]),
             "tx_slots_allocated": int(c[7]),
+        }
+
+    def obs_stats(self) -> dict:
+        """r08 delivery-observability aggregates (canonical names per
+        obs/schema.py): go-back-N retransmitted messages, dup/gap discards
+        at the receive acceptance check, and the engine-tier ACK round
+        trip as a sum/count pair (the C hot path keeps no buckets)."""
+        c = self._counters()
+        return {
+            "st_retransmit_msgs_total": int(c[8]),
+            "st_dedup_discards_total": int(c[9]),
+            "st_ack_rtt_seconds_sum": int(c[10]) / 1e9,
+            "st_ack_rtt_seconds_count": int(c[11]),
         }
 
     @property

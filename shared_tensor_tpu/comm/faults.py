@@ -59,6 +59,7 @@ import threading
 from collections import Counter
 from typing import Callable, Optional
 
+from .. import obs as _obs
 from ..config import FaultConfig
 
 log = logging.getLogger("shared_tensor_tpu.faults")
@@ -111,6 +112,15 @@ class FaultPlan:
         self._mu = threading.Lock()
         self._on_crash = on_crash
         self.counts: Counter = Counter()
+        # every injected event also lands on the cross-tier timeline (the
+        # r08 flight recorder) under the same names the NATIVE injector
+        # emits (obs/events.py fault codes) — a chaos run's timeline must
+        # account for every hit, whichever tier injected it
+        self._hub = _obs.hub() if _obs.obs_enabled() else None
+
+    def _event(self, name: str, link: int, arg: int = 0) -> None:
+        if self._hub is not None:
+            self._hub.emit(name, link=link, arg=arg)
 
     @property
     def active(self) -> bool:
@@ -134,16 +144,20 @@ class FaultPlan:
             r = self._rng
             if cfg.sever_after_frames > 0 and n >= cfg.sever_after_frames:
                 self.counts["severed"] += 1
+                self._event("fault_sever", link, n)
                 return [], 0.0, True
             if cfg.stall_after_frames >= 0 and n > cfg.stall_after_frames:
                 self.counts["stalled"] += 1
+                self._event("fault_stall", link, n)
                 return [], 0.0, False
             delay = 0.0
             if cfg.delay_pct > 0 and r.random() < cfg.delay_pct:
                 self.counts["delayed"] += 1
+                self._event("fault_delay", link, int(cfg.delay_sec * 1e3))
                 delay = cfg.delay_sec
             if cfg.drop_pct > 0 and r.random() < cfg.drop_pct:
                 self.counts["dropped"] += 1
+                self._event("fault_drop", link, n)
                 return [], delay, False
             out = payload
             if (
@@ -152,6 +166,7 @@ class FaultPlan:
                 and r.random() < cfg.corrupt_pct
             ):
                 self.counts["corrupted"] += 1
+                self._event("fault_corrupt", link, n)
                 out = corrupt(out, r, self.scale_bytes)
             if (
                 cfg.truncate_pct > 0
@@ -160,6 +175,7 @@ class FaultPlan:
                 and r.random() < cfg.truncate_pct
             ):
                 self.counts["truncated"] += 1
+                self._event("fault_truncate", link, n)
                 out = out[: r.randrange(1, len(out))]
             if (
                 cfg.dup_pct > 0
@@ -167,6 +183,7 @@ class FaultPlan:
                 and r.random() < cfg.dup_pct
             ):
                 self.counts["duplicated"] += 1
+                self._event("fault_dup", link, n)
                 return [out, out], delay, False
             return [out], delay, False
 
@@ -184,10 +201,19 @@ class FaultPlan:
             if hits < max(1, cfg.crash_after):
                 return
             self.counts["crashed"] += 1
+        self._event("crash_point", 0, hits)
         if self._on_crash is not None:
             self._on_crash(name)
             return
         log.warning("fault plan killing peer at protocol point %r", name)
+        # last act before the kill: dump the flight recorder (merged
+        # native+Python timeline + registry snapshots), so the "worst
+        # instant" chaos leaves an explainable trace instead of just a
+        # corpse. os._exit follows REGARDLESS of the dump's fate — the
+        # crash semantics (nothing below the point runs) stay exact.
+        if self._hub is not None:
+            self._hub.poll_native()
+            self._hub.dump(f"crash_point:{name}")
         os._exit(CRASH_EXIT_CODE)
 
 

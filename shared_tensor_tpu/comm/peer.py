@@ -39,8 +39,10 @@ from typing import Any, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs as _obs
 from ..config import Config
 from ..core import DuplicateLink, SharedTensor
+from ..obs import schema as _schema
 from ..ops.table import make_spec
 from . import faults, wire
 from .transport import EventKind, TransportNode
@@ -81,6 +83,71 @@ def _python_tier_auto_burst(spec) -> int:
     if spec.total <= (1 << 15):
         return max(24, min(128, (1 << 19) // max(1, spec.total)))
     return 1
+
+
+class _PeerObs:
+    """One peer's observability bundle (r08 tentpole): a metrics registry
+    publishing the canonical schema (obs/schema.py) — live histograms for
+    the Python tier's per-message latencies, everything else sampled at
+    snapshot time via a collector — plus the peer's handle on the process
+    hub (flight recorder, native event-ring drain, postmortems).
+
+    Hot-path cost when enabled: one ``time.monotonic()`` pair + one
+    histogram observe per wire message on the PYTHON tier only; the native
+    engine's data plane exports aggregates through the counters ABI and
+    never calls into Python. Disabled (Config.obs.enabled=False or
+    ST_OBS=0): the peer holds ``_obs = None`` and pays one None-check."""
+
+    def __init__(self, peer: "SharedTensorPeer"):
+        self.hub = _obs.hub()
+        self.registry = _obs.Registry()
+        h = self.registry.histogram
+        self.ack_rtt = h(
+            "st_ack_rtt_seconds",
+            help="ledger-append to cumulative-ACK-pop round trip",
+        )
+        self.encode = h(
+            "st_encode_seconds", help="wire-encode latency per DATA/BURST"
+        )
+        self.apply = h(
+            "st_apply_seconds", help="decode+apply latency per received batch"
+        )
+        # Delivery counters exist as LIVE instruments only on the Python
+        # tier: an engine peer's retransmit/dedup truth lives in the C
+        # counters ABI and arrives via the collector — registering a
+        # never-incremented instrument under the same name would shadow
+        # the collector's real value in every snapshot/scrape (instrument
+        # values take precedence), reporting 0 while a link black-holes.
+        self.retransmits = self.dedup = None
+        if peer._engine is None:
+            self.retransmits = self.registry.counter(
+                "st_retransmit_msgs_total",
+                help="go-back-N messages re-sent byte-identical",
+            )
+            self.dedup = self.registry.counter(
+                "st_dedup_discards_total",
+                help="duplicate/out-of-order data messages discarded unapplied",
+            )
+        self.registry.register_collector(peer._obs_collect)
+        self.label = f"peer-{peer.node.obs_id}"
+        self.hub.register_registry(self.label, self.registry)
+        ocfg = peer.config.obs
+        self.drain_interval = ocfg.native_drain_interval_sec
+        if ocfg.jsonl_path:
+            self.registry.start_jsonl_sink(
+                ocfg.jsonl_path, ocfg.jsonl_interval_sec
+            )
+
+    def event(
+        self, name: str, node: int = 0, link: int = 0, arg: int = 0,
+        detail: str = "",
+    ) -> None:
+        self.hub.emit(name, node=node, link=link, arg=arg, detail=detail)
+
+    def close(self) -> None:
+        self.registry.stop_jsonl_sink()
+        self.hub.poll_native()  # final drain: close() must not strand events
+        self.hub.unregister_registry(self.label)
 
 
 class SpecMismatch(ConnectionError):
@@ -318,11 +385,12 @@ class SharedTensorPeer:
         # wire_seq <= ack count). Plus cumulative TX/RX/ACK counters and
         # the per-link retransmission timer state.
         self._ack_mu = threading.Lock()
-        # (ledger_seq, wire_seq, payload, pool_slot) — payload is a
-        # memoryview over pool_slot's pooled buffer (r07: the ledger entry
-        # IS its send buffer; pool_slot is None only for legacy bytes
-        # payloads), released back to _tx_pool when the entry pops
-        self._unacked: dict[int, list[tuple[int, int, Any, Any]]] = {}
+        # (ledger_seq, wire_seq, payload, pool_slot, sent_at) — payload is
+        # a memoryview over pool_slot's pooled buffer (r07: the ledger
+        # entry IS its send buffer; pool_slot is None only for legacy
+        # bytes payloads), released back to _tx_pool when the entry pops;
+        # sent_at (r08) feeds the st_ack_rtt_seconds histogram at ACK pop
+        self._unacked: dict[int, list[tuple[int, int, Any, Any, float]]] = {}
         # r07 zero-copy send plane (native framing only): encode writes
         # into a pooled wire-sized slot; the slot then serves as ledger
         # payload and byte-identical retransmission source. Slots are
@@ -352,6 +420,16 @@ class SharedTensorPeer:
         # retransmission rounds since — both guarded by _ack_mu
         self._ack_progress: dict[int, float] = {}
         self._retx_rounds: dict[int, int] = {}
+        # r08 observability: per-peer registry + the process hub (flight
+        # recorder, native event-ring drain). None when disabled — every
+        # hot-path call site pays one None-check, like the fault plan.
+        # Created LAST, after every attribute the registry collector reads
+        # exists and nothing below can raise: registering a half-built
+        # peer with the process hub would leak its registry (and a JSONL
+        # sink thread) if __init__ died before close() became reachable.
+        self._obs: Optional[_PeerObs] = None
+        if _obs.obs_enabled() and self.config.obs.enabled:
+            self._obs = _PeerObs(self)
         self._recv_thread = threading.Thread(
             target=self._recv_loop, daemon=True, name="st-recv"
         )
@@ -437,7 +515,9 @@ class SharedTensorPeer:
         protocol's lossy semantics.) ``tol`` defaults just above the
         subnormal-dust floor (see :meth:`drain`)."""
         if self._engine is not None:
-            self._engine.seal()
+            self._engine.seal()  # emits the engine-tier seal event itself
+        elif self._obs is not None:
+            self._obs.event("seal", self.node.obs_id)
         self._sealed = True
         ok = self.drain(timeout=timeout, tol=tol)
         self.close()
@@ -454,6 +534,10 @@ class SharedTensorPeer:
             # engine threads block inside the node's queues/condvars: they
             # must stop BEFORE the node is torn down
             self._engine.stop()
+        if self._obs is not None:
+            # final native-ring drain + sink/registry teardown, BEFORE the
+            # node closes so the close-path events still merge in
+            self._obs.close()
         self.node.close()
         if self._engine is not None:
             self._engine.destroy()
@@ -464,8 +548,32 @@ class SharedTensorPeer:
     def ready(self) -> bool:
         return self._ready.is_set()
 
-    def metrics(self) -> dict:
+    def _obs_collect(self) -> dict:
+        """Registry collector: the canonical-schema view of everything this
+        peer can report that is not a live histogram — sampled once per
+        snapshot/scrape (obs/schema.py is the name authority)."""
+        out = _schema.canonicalize(self.metrics())
+        if self._engine is not None:
+            out.update(self._engine.obs_stats())
+        out["st_corrupt_scales_zeroed_total"] = wire.corrupt_scales_zeroed()
+        from ..obs import events as _events
+
+        out["st_obs_events_dropped_total"] = _events.native_dropped()
+        for link in self.node.links:
+            s = self.node.stats(link)
+            if s is not None:
+                out[_schema.link_key("st_link_send_queue", link)] = s.send_queue
+                out[_schema.link_key("st_link_recv_queue", link)] = s.recv_queue
+        return out
+
+    def metrics(self, canonical: bool = False) -> dict:
         """Observability the reference entirely lacks (SURVEY.md §5.5).
+
+        ``canonical=True`` returns the r08 flat canonical-schema view
+        (obs/schema.py): every key below plus the engine delivery
+        aggregates and queue-depth gauges, under ``st_*`` names. The
+        legacy nested shape below remains the DEPRECATED alias surface for
+        one release (schema.DEPRECATED_ALIASES documents the mapping).
 
         Counter taxonomy (ONE definition per number, reconcilable across
         layers — round-3 verdict Weak #6):
@@ -493,6 +601,14 @@ class SharedTensorPeer:
           RECEIVE-side wire count includes idle-period keepalives there
           (the send side still excludes them).
         """
+        if canonical:
+            # the registry snapshot merges the collector (this peer's
+            # sampled counters) with the LIVE instruments (histograms,
+            # python-tier delivery counters); with obs disabled the
+            # collector view alone still serves the schema
+            if self._obs is not None:
+                return self._obs.registry.snapshot()
+            return self._obs_collect()
         if self._engine is not None:
             # ONE snapshot for every engine counter: separate reads would
             # mix instants and could show e.g. msgs_in > frames_in mid-run
@@ -755,11 +871,15 @@ class SharedTensorPeer:
         slots, so a slot released by the recv thread's ACK pop cannot be
         overwritten while any in-flight payload view of it is still being
         sent — the next acquire happens on this thread, after that send."""
+        obs = self._obs
         with self._ack_mu:
             txs = self._tx_seq.get(link, 0) + 1
             self._tx_seq[link] = txs
         slot = self._tx_pool.acquire()
+        t0 = time.monotonic()
         n = encode_into(slot, txs)
+        if obs is not None:
+            obs.encode.observe(time.monotonic() - t0)
         payload = slot[:n]
         with self._ack_mu:
             if link not in self._tx_seq:
@@ -772,9 +892,13 @@ class SharedTensorPeer:
                 self._tx_pool.release(slot)
                 return payload
             q = self._unacked.setdefault(link, [])
+            now = time.monotonic()
             if not q:
-                self._ack_progress[link] = time.monotonic()
-            q.append((ledger_seq, txs, payload, slot))
+                self._ack_progress[link] = now
+            # 5th field: ledger-append time, consumed by the ACK-pop RTT
+            # histogram (st_ack_rtt_seconds; includes retransmission
+            # rounds by construction — same definition as the engine tier)
+            q.append((ledger_seq, txs, payload, slot, now))
         return payload
 
     def _window_full(self, link: int) -> bool:
@@ -835,19 +959,32 @@ class SharedTensorPeer:
                 # the lock drops even if an ACK pops them mid-send — a
                 # released slot can only be REUSED by this same (send)
                 # thread, after these sends (see _register_data)
-                tail = [p for (_, _, p, _) in q[:RETX_PREFIX]]
+                tail = [e[2] for e in q[:RETX_PREFIX]]
             if rounds > max(1, tcfg.ack_retry_limit):
                 log.warning(
                     "link %d: no ACK progress after %d retransmission "
                     "rounds — tearing down for re-graft",
                     link, rounds - 1,
                 )
+                if self._obs is not None:
+                    # the black-hole verdict is exactly what a postmortem
+                    # should explain: dump the merged timeline around it
+                    self._obs.event(
+                        "blackhole_teardown", self.node.obs_id, link,
+                        rounds - 1,
+                    )
+                    self._obs.hub.dump("goback_teardown")
                 self.node.drop_link(link)
                 continue
             log.info(
                 "link %d: retransmitting %d unacked message(s), round %d",
                 link, len(tail), rounds,
             )
+            if self._obs is not None:
+                self._obs.retransmits.inc(len(tail))
+                self._obs.event(
+                    "retransmit", self.node.obs_id, link, len(tail)
+                )
             for payload in tail:
                 if not self._send_blocking(link, payload, data=True):
                     break
@@ -855,7 +992,7 @@ class SharedTensorPeer:
     def _release_slots(self, entries) -> None:
         """Return popped ledger entries' pool slots (r07 slot lifecycle:
         acked/purged -> free). Entries are (ledger_seq, wire_seq, payload,
-        slot) tuples; legacy bytes payloads carry slot=None."""
+        slot, sent_at) tuples; legacy bytes payloads carry slot=None."""
         if self._tx_pool is None:
             return
         for entry in entries:
@@ -879,8 +1016,14 @@ class SharedTensorPeer:
         is installed) may drop, delay, duplicate, truncate, bit-corrupt,
         stall or sever them here — the Python tier's wire boundary.
         Handshake and ACK traffic never goes through the chaos."""
-        if self._faults is not None and data:
-            payloads, delay, sever = self._faults.on_send(link, payload)
+        # ONE load of the plan: the chaos soak detaches it mid-run
+        # (p._faults = None) from another thread, and a re-load between
+        # the None-check and the call would AttributeError — killing this
+        # daemon send thread silently, the exact wedge class r06 hardened
+        # the recv thread against
+        plan = self._faults
+        if plan is not None and data:
+            payloads, delay, sever = plan.on_send(link, payload)
             if delay > 0:
                 time.sleep(delay)
             ok = True
@@ -920,6 +1063,10 @@ class SharedTensorPeer:
                     "failures (~%.0fs stalled): tearing down for re-graft",
                     link, fails, fails * 0.1,
                 )
+                if self._obs is not None:
+                    self._obs.event(
+                        "quarantine", self.node.obs_id, link, fails
+                    )
                 self.node.drop_link(link)
                 return False
         return False
@@ -927,8 +1074,37 @@ class SharedTensorPeer:
     # -- receive side ---------------------------------------------------------
 
     def _recv_loop(self) -> None:
+        """Guard shell around the real loop: an UNHANDLED exception here
+        used to kill the daemon thread silently and wedge the peer (the
+        r05/r06 failure class). Now it dumps a flight-recorder postmortem
+        (merged native+Python timeline + registry snapshots) and restarts
+        the loop — bounded retries so a hot crash loop still surfaces."""
+        failures = 0
+        while not self._stop.is_set():
+            try:
+                self._recv_loop_inner()
+                return  # clean exit: stop was set
+            except Exception:
+                failures += 1
+                log.exception(
+                    "recv thread hit an unhandled exception (restart %d/3)",
+                    failures,
+                )
+                if self._obs is not None:
+                    self._obs.hub.poll_native()
+                    self._obs.hub.dump("recv_thread_exception")
+                if failures >= 3:
+                    raise
+                time.sleep(0.1)
+
+    def _recv_loop_inner(self) -> None:
         compat = self.config.transport.wire_compat
         while not self._stop.is_set():
+            if self._obs is not None:
+                # drain the native event ring into the flight recorder on
+                # the peer's own thread (never a background thread racing
+                # node teardown); rate-limited inside poll_native
+                self._obs.hub.poll_native(self._obs.drain_interval)
             busy = self._handle_events()
             if (
                 compat
@@ -1023,6 +1199,17 @@ class SharedTensorPeer:
                                     "data message (seq %d, expected %d)",
                                     link, seq, want,
                                 )
+                                if self._obs is not None:
+                                    # dedup instrument is None on engine
+                                    # peers; this path is still reachable
+                                    # there pre-attach (handshake-window
+                                    # DATA), so guard it
+                                    if self._obs.dedup is not None:
+                                        self._obs.dedup.inc()
+                                    self._obs.event(
+                                        "dedup_discard", self.node.obs_id,
+                                        link, seq,
+                                    )
                                 continue
                             if payload[0] == wire.DATA:
                                 batch.append(
@@ -1070,6 +1257,7 @@ class SharedTensorPeer:
     ) -> None:
         n_ack = len(batch) if msgs is None else msgs
         if batch:
+            t0 = time.monotonic()
             try:
                 self.st.receive_frames(link, batch)
             except Exception:
@@ -1083,6 +1271,8 @@ class SharedTensorPeer:
                         self.st.receive_frame(link, f)
                     except Exception as e:
                         log.warning("dropping bad frame on link %d: %s", link, e)
+            if self._obs is not None:
+                self._obs.apply.observe(time.monotonic() - t0)
             if scratch is not None:
                 # frames applied (receive_frames is synchronous on every
                 # tier): their pooled decode arrays are reusable now
@@ -1124,9 +1314,24 @@ class SharedTensorPeer:
         except BrokenPipeError:
             self._ack_sent[link] = count  # link dead; nothing left to ack
 
+    #: EventKind -> timeline event name (matches the native codes 1..4, so
+    #: every native membership event pairs with a later "py"-tier twin —
+    #: the handled-at timestamp the cross-tier ordering test leans on)
+    _EVENT_NAMES = {
+        EventKind.LINK_UP: "link_up",
+        EventKind.LINK_DOWN: "link_down",
+        EventKind.BECAME_MASTER: "became_master",
+        EventKind.REJOIN_FAILED: "isolated",
+    }
+
     def _handle_events(self) -> bool:
         evs = self.node.poll_events(timeout=0.0)
         for ev in evs:
+            if self._obs is not None:
+                self._obs.event(
+                    self._EVENT_NAMES[ev.kind], self.node.obs_id,
+                    ev.link_id, int(ev.is_uplink),
+                )
             if ev.kind == EventKind.LINK_UP:
                 try:
                     self._on_link_up(ev)
@@ -1415,6 +1620,11 @@ class SharedTensorPeer:
                     self._ack_progress[link] = time.monotonic()
                     self._retx_rounds.pop(link, None)
             self._release_slots(popped)
+            if self._obs is not None and popped:
+                now = time.monotonic()
+                for entry in popped:
+                    # entry[4] = ledger-append time (see _register_data)
+                    self._obs.ack_rtt.observe(now - entry[4])
             for entry in popped:
                 self.st.ack_frame(link, entry[0])
         elif kind == wire.SYNC:

@@ -165,6 +165,23 @@ def _load() -> ctypes.CDLL:
     lib.st_node_drop_link.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.st_node_close.restype = None
     lib.st_node_close.argtypes = [ctypes.c_void_p]
+    # r08 obs event ring (process-wide, defined in the transport .so)
+    lib.st_node_obs_id.restype = ctypes.c_uint32
+    lib.st_node_obs_id.argtypes = [ctypes.c_void_p]
+    lib.st_obs_drain.restype = ctypes.c_int32
+    lib.st_obs_drain.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.st_obs_now_ns.restype = ctypes.c_uint64
+    lib.st_obs_now_ns.argtypes = []
+    lib.st_obs_dropped.restype = ctypes.c_uint64
+    lib.st_obs_dropped.argtypes = []
+    lib.st_obs_set_enabled.restype = None
+    lib.st_obs_set_enabled.argtypes = [ctypes.c_int32]
+    from .. import obs
+
+    if not obs.obs_enabled():
+        # the .so also parses ST_OBS itself; this covers obs having been
+        # disabled programmatically before the first native load
+        lib.st_obs_set_enabled(0)
     _lib = lib
     return lib
 
@@ -215,6 +232,9 @@ class TransportNode:
                 f"within {cfg.join_timeout_sec or 30.0:.0f}s"
             )
         self.is_master = bool(is_master.value)
+        #: Process-unique obs id tagging this node's events on the shared
+        #: native event ring (obs/events.py; 0 only if the ABI is absent).
+        self.obs_id = int(self._lib.st_node_obs_id(self._h))
         self._recv_buf = ctypes.create_string_buffer(max(frame_bytes, 1 << 20))
 
     # -- wire ---------------------------------------------------------------
@@ -262,12 +282,20 @@ class TransportNode:
 
     @property
     def links(self) -> list[int]:
+        # empty after close(), never a NULL-handle native call: the r08
+        # metrics collectors (registry snapshot, postmortem dump) can race
+        # a closing peer, and this introspection path must degrade to
+        # nothing rather than SIGSEGV (the r05 st_engine_counters lesson)
+        if not self._h:
+            return []
         arr = (ctypes.c_int32 * 64)()
         n = self._lib.st_node_links(self._h, arr, 64)
         return [arr[i] for i in range(n)]
 
     @property
     def uplink(self) -> Optional[int]:
+        if not self._h:
+            return None
         u = self._lib.st_node_uplink(self._h)
         return None if u < 0 else u
 
@@ -280,7 +308,10 @@ class TransportNode:
         acquires vs misses (fresh allocations) and zero-copy sends. Steady
         state shows acquires growing while misses stay flat."""
         out = (ctypes.c_uint64 * 5)()
-        self._lib.st_node_pool_stats(self._h, out)
+        # st_node_pool_stats NULL-checks natively; skip the call anyway
+        # when closed so the zeros are explicit
+        if self._h:
+            self._lib.st_node_pool_stats(self._h, out)
         return {
             "tx_acquires": out[0],
             "tx_misses": out[1],
@@ -290,6 +321,8 @@ class TransportNode:
         }
 
     def stats(self, link_id: int) -> Optional[LinkStats]:
+        if not self._h:
+            return None  # closed node: no stats, never a NULL native call
         s = _StStatsC()
         if self._lib.st_node_stats(self._h, link_id, ctypes.byref(s)) < 0:
             return None

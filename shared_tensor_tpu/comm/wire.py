@@ -74,6 +74,26 @@ log = logging.getLogger("shared_tensor_tpu.wire")
 
 from ..ops.table import TableFrame, TableSpec
 
+# Process-wide count of non-finite scales zeroed at the decode trust
+# boundary (r08 obs satellite; canonical name
+# st_corrupt_scales_zeroed_total in obs/schema.py). Process-wide, not
+# per-peer: the zeroing happens inside stateless decode helpers — peers'
+# registries sample it via a collector, and a nonzero DELTA during a run
+# means a link is feeding garbage (each hit also logs a warning).
+_corrupt_mu = threading.Lock()
+_corrupt_scales_zeroed = 0
+
+
+def corrupt_scales_zeroed() -> int:
+    with _corrupt_mu:
+        return _corrupt_scales_zeroed
+
+
+def _count_corrupt_scales(n: int) -> None:
+    global _corrupt_scales_zeroed
+    with _corrupt_mu:
+        _corrupt_scales_zeroed += n
+
 # message kinds (first payload byte, native mode)
 DATA = 0  # codec frame: scales + packed sign bits
 SYNC = 1  # child -> parent: join request header
@@ -407,10 +427,12 @@ def _decode_one_frame(
         scales, words = scales_v.copy(), words_v.copy()
     bad = ~np.isfinite(scales)
     if bad.any():
+        nbad = int(np.count_nonzero(bad))
         log.warning(
             "zeroing %d non-finite scale(s) in received frame (corrupt link?)",
-            int(np.count_nonzero(bad)),
+            nbad,
         )
+        _count_corrupt_scales(nbad)
         scales[bad] = np.float32(0.0)
     return TableFrame(scales, words)
 
@@ -538,6 +560,7 @@ def decode_compat_frame(payload: bytes, spec: TableSpec) -> Optional[TableFrame]
             # corrupt, not idle: don't poison the replica (Q9; see
             # decode_frame's corruption guard)
             log.warning("dropping compat frame with non-finite scale")
+            _count_corrupt_scales(1)
         return None
     nwords = spec.total // 32
     raw = payload[4:].ljust(nwords * 4, b"\x00")

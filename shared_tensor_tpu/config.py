@@ -207,6 +207,29 @@ class FaultConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Unified telemetry (r08; ``shared_tensor_tpu/obs``). The subsystem is
+    ON by default — the native event ring only records rare protocol /
+    recovery / fault events and the OBS_r08 gate holds the hot-path cost
+    under 2% — and ``ST_OBS=0`` in the environment force-disables it
+    process-wide regardless of this config (the bench's A/B knob)."""
+
+    #: Master switch for THIS peer's Python-tier instrumentation (registry
+    #: histograms, event emission, native-ring draining). The native ring
+    #: itself is process-wide (env ST_OBS).
+    enabled: bool = True
+    #: How often this peer's recv loop drains the native event ring into
+    #: the process flight recorder. Small enough that a 2048-event
+    #: per-thread ring survives chaos bursts; large enough to stay off the
+    #: drain mutex.
+    native_drain_interval_sec: float = 0.2
+    #: Background JSONL metrics sink: one snapshot line per interval
+    #: appended to this path ("" = no sink).
+    jsonl_path: str = ""
+    jsonl_interval_sec: float = 5.0
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Pod-tier (intra-slice) configuration: how the shared array is laid out
     across the local device mesh and which collective strategy syncs it."""
@@ -231,6 +254,9 @@ class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     #: Deterministic fault injection (tests / chaos soak); disabled default.
     faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
+    #: Unified telemetry (metrics registry + event timeline + flight
+    #: recorder); enabled default, <2% hot-path cost (OBS_r08 gate).
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     #: Background sync frame pacing: target seconds between frames per link;
     #: 0 = free-running (reference behavior: fill all bandwidth, README.md:31).
     sync_interval_sec: float = 0.0

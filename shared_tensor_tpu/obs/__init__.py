@@ -1,0 +1,63 @@
+"""Unified cross-tier telemetry (r08 tentpole).
+
+Three pieces, one timeline:
+
+- :mod:`~shared_tensor_tpu.obs.registry` — metrics registry (counters /
+  gauges / fixed-bucket histograms) with dict snapshots, Prometheus text
+  exposition and a background JSONL sink; canonical key names come from
+  :mod:`~shared_tensor_tpu.obs.schema` (the old per-layer dicts survive as
+  deprecated aliases in ``peer.metrics()``).
+- :mod:`~shared_tensor_tpu.obs.events` — the native event ring drain
+  (``st_obs_drain`` over lock-free per-thread rings in sttransport.cpp)
+  merged with Python-tier events on the shared CLOCK_MONOTONIC timebase.
+- :mod:`~shared_tensor_tpu.obs.recorder` — the process flight recorder:
+  last-N merged events + registry snapshots dumped to a postmortem file on
+  crash-point fires, recv-thread exceptions and go-back-N teardowns.
+
+``ST_OBS=0`` disables the whole subsystem (native ring emission included);
+the production default is ON — the native events are rare (link churn,
+recovery, injected faults) and the OBS_r08 gate proves the hot-path cost
+is <2% (benchmarks/obs_overhead.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .recorder import FlightRecorder, ObsHub, hub  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS,
+    Registry,
+)
+
+_ENABLED: bool | None = None
+
+
+def obs_enabled() -> bool:
+    """Process-wide obs switch (env ``ST_OBS``, default on). Cached: the
+    peers' hot paths gate on this via a bound attribute, and flipping it
+    mid-process is a bench-only move (:func:`set_enabled`)."""
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = os.environ.get("ST_OBS", "1") != "0"
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    """Flip obs at runtime — for A/B overhead measurement
+    (benchmarks/obs_overhead.py), not production use. Also flips the native
+    ring's emission flag when the transport library is loaded. Peers
+    created before the flip keep their construction-time wiring."""
+    global _ENABLED
+    _ENABLED = bool(on)
+    try:
+        from ..comm import transport
+
+        lib = transport._lib
+        if lib is not None:
+            lib.st_obs_set_enabled(1 if on else 0)
+    except Exception:
+        pass

@@ -1,0 +1,150 @@
+"""Cross-tier event model + the native event-ring drain (r08 tentpole).
+
+The native trio (sttransport.cpp / stengine.cpp) records protocol events
+into lock-free per-thread rings of 32-byte timestamped records; this module
+drains them over the ``st_obs_drain`` ABI and decodes them into the same
+:class:`Event` shape the Python tier emits directly — ONE timeline type
+spanning both tiers.
+
+Common clock: the native ring stamps CLOCK_MONOTONIC nanoseconds and
+CPython's ``time.monotonic_ns()`` reads the same clock on Linux, so native
+and Python timestamps merge by plain sort with no calibration pass
+(``st_obs_now_ns`` is exported anyway so tests can prove the clocks agree).
+
+Event codes are defined ONCE here and mirrored as constants in
+sttransport.cpp (``kEv*``); the numeric values are ABI — changing one
+requires changing both files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import time
+from typing import Optional
+
+#: Native event record: u64 t_ns, u32 node_id, u32 code, i32 link,
+#: u32 reserved, u64 arg — 32 bytes, matching sttransport.cpp's EventRec.
+_EVENT_FMT = "<QIIiIQ"
+EVENT_BYTES = struct.calcsize(_EVENT_FMT)
+assert EVENT_BYTES == 32
+
+#: code -> name. 1..4 are the transport's membership event kinds (same
+#: numbers as transport.EventKind); 10..15 protocol/recovery events;
+#: 20..26 fault-injection hits (mirroring comm/faults.py's classes).
+CODE_NAMES: dict[int, str] = {
+    1: "link_up",
+    2: "link_down",
+    3: "became_master",
+    4: "isolated",
+    10: "retransmit",
+    11: "blackhole_teardown",
+    12: "quarantine",
+    13: "send_window_stall",
+    14: "dedup_discard",
+    15: "seal",
+    20: "fault_drop",
+    21: "fault_dup",
+    22: "fault_corrupt",
+    23: "fault_truncate",
+    24: "fault_delay",
+    25: "fault_stall",
+    26: "fault_sever",
+    27: "crash_point",
+}
+NAME_CODES = {v: k for k, v in CODE_NAMES.items()}
+
+#: Names the flight recorder treats as fault-injection hits (timeline
+#: accounting in the chaos soak keys on these).
+FAULT_EVENT_NAMES = frozenset(
+    n for c, n in CODE_NAMES.items() if 20 <= c <= 26
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One timeline entry. ``tier`` is "c" (drained from the native ring)
+    or "py" (emitted by the Python tier); ``node`` is the transport node's
+    process-unique obs id (0 = not node-scoped); ``arg`` is the event's
+    numeric payload (is_uplink for membership, message count for
+    retransmit, wire seq for dedup_discard, ...)."""
+
+    t_ns: int
+    tier: str
+    name: str
+    node: int = 0
+    link: int = 0
+    arg: int = 0
+    detail: str = ""
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if not d["detail"]:
+            del d["detail"]
+        return d
+
+
+def py_event(
+    name: str, node: int = 0, link: int = 0, arg: int = 0, detail: str = ""
+) -> Event:
+    return Event(time.monotonic_ns(), "py", name, node, link, arg, detail)
+
+
+def _lib():
+    """The transport .so (which owns the process-wide ring); built/loaded
+    lazily so importing obs never forces a native build."""
+    from ..comm import transport
+
+    return transport._load()
+
+
+def drain_native(cap_events: int = 8192, lib=None) -> list[Event]:
+    """Drain up to ``cap_events`` native events (all threads' rings).
+    Leftovers stay ring-buffered for the next drain. Returns [] when the
+    native library is unavailable (pure-Python environments)."""
+    try:
+        lib = lib if lib is not None else _lib()
+    except Exception:
+        return []
+    import ctypes
+
+    buf = bytearray(cap_events * EVENT_BYTES)
+    n = lib.st_obs_drain(
+        (ctypes.c_char * len(buf)).from_buffer(buf), len(buf)
+    )
+    out: list[Event] = []
+    for off in range(0, int(n), EVENT_BYTES):
+        t_ns, node, code, link, _res, arg = struct.unpack_from(
+            _EVENT_FMT, buf, off
+        )
+        out.append(
+            Event(
+                t_ns,
+                "c",
+                CODE_NAMES.get(code, f"code_{code}"),
+                node,
+                link,
+                arg,
+            )
+        )
+    return out
+
+
+def native_now_ns(lib=None) -> Optional[int]:
+    """The native ring's clock, for clock-agreement checks; None when the
+    native library is unavailable."""
+    try:
+        lib = lib if lib is not None else _lib()
+    except Exception:
+        return None
+    return int(lib.st_obs_now_ns())
+
+
+def native_dropped(lib=None) -> int:
+    """Events lost to ring overflow since process start (accounting stays
+    honest: a timeline with drops says so)."""
+    try:
+        lib = lib if lib is not None else _lib()
+    except Exception:
+        return 0
+    return int(lib.st_obs_dropped())

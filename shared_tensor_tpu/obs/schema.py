@@ -1,0 +1,124 @@
+"""Canonical metric-key schema (r08 satellite): ONE name per number.
+
+Before r08 the same quantity had a different name at every layer —
+``frames_out`` in ``peer.metrics()``, slot 0 of the ``st_engine_counters``
+ABI, ``frames_out`` again (but meaning wire MESSAGES) in the transport's
+``LinkStats`` — and the r07 pool stats added two more ad-hoc dicts. This
+module is the single source of truth: every telemetry surface (registry
+snapshots, the Prometheus exposition, the flight recorder's postmortem
+header) speaks these names; the legacy keys survive one release as
+documented aliases (:data:`DEPRECATED_ALIASES`, consumed by
+``peer.metrics()``'s default legacy shape).
+
+Naming rules (Prometheus conventions):
+
+- ``st_`` prefix; ``_total`` suffix on monotone counters; unit suffixes
+  (``_seconds``, ``_bytes``) on measured quantities;
+- per-link series carry a ``{link="N"}`` label rendered into the key
+  (snapshots are flat dicts; the exposition format parses it natively);
+- histograms export as ``{"sum":..,"count":..,"buckets":{le: cum}}`` dicts
+  in snapshots and the standard ``_bucket/_sum/_count`` series in
+  Prometheus text.
+"""
+
+from __future__ import annotations
+
+#: name -> (kind, help). The contract: anything a peer exports uses a name
+#: from this table (per-link names via :func:`link_key`).
+SCHEMA: dict[str, tuple[str, str]] = {
+    # codec-frame taxonomy (peer.metrics() docstring, unchanged semantics)
+    "st_frames_out_total": ("counter", "non-idle codec frames handed toward the wire"),
+    "st_frames_in_total": ("counter", "codec frames applied from the wire"),
+    "st_updates_total": ("counter", "local add() calls merged into the replica"),
+    # delivery / go-back-N ledger
+    "st_msgs_out_total": ("counter", "wire DATA/BURST messages sent (ACK-ledgered)"),
+    "st_msgs_in_total": ("counter", "wire DATA/BURST messages accepted in order"),
+    "st_inflight_msgs": ("gauge", "sent-but-unacked messages (0 after drain)"),
+    "st_retransmit_msgs_total": ("counter", "go-back-N messages re-sent byte-identical"),
+    "st_dedup_discards_total": ("counter", "duplicate/out-of-order data messages discarded unapplied"),
+    "st_corrupt_scales_zeroed_total": ("counter", "non-finite scales zeroed at the decode trust boundary"),
+    # latency (python tier: true histograms; engine tier: sum/count from the
+    # counters ABI — mean-only, the C hot path keeps no buckets)
+    "st_ack_rtt_seconds": ("histogram", "ledger-append to cumulative-ACK-pop round trip"),
+    "st_ack_rtt_seconds_sum": ("counter", "engine-tier ACK RTT aggregate (seconds)"),
+    "st_ack_rtt_seconds_count": ("counter", "engine-tier ACK RTT sample count"),
+    "st_encode_seconds": ("histogram", "wire-encode latency per DATA/BURST message"),
+    "st_apply_seconds": ("histogram", "decode+apply latency per received batch"),
+    # r07 pool occupancy (zero-allocation steady-state assertion)
+    "st_tx_slot_acquires_total": ("counter", "frame-slot ring acquires (engine tx ring or wire.FramePool)"),
+    "st_tx_slot_alloc_events_total": ("counter", "frame-slot ring fresh allocations (flat in steady state)"),
+    "st_tx_slots_allocated": ("gauge", "frame slots currently allocated (engine) / free (python pool)"),
+    "st_transport_tx_acquires_total": ("counter", "transport tx buffer acquires"),
+    "st_transport_tx_misses_total": ("counter", "transport tx buffer pool misses"),
+    "st_transport_rx_acquires_total": ("counter", "transport rx buffer acquires"),
+    "st_transport_rx_misses_total": ("counter", "transport rx buffer pool misses"),
+    "st_transport_zc_msgs_total": ("counter", "zero-copy (borrowed-slot) sends enqueued"),
+    # native event ring health
+    "st_obs_events_dropped_total": ("counter", "native ring events lost to overflow (undrained)"),
+    # per-link series (rendered via link_key)
+    "st_link_bytes_out_total": ("counter", "wire bytes sent on the link (incl. framing/keepalives)"),
+    "st_link_bytes_in_total": ("counter", "wire bytes received on the link"),
+    "st_link_wire_msgs_out_total": ("counter", "transport messages sent (data AND control, no keepalives)"),
+    "st_link_wire_msgs_in_total": ("counter", "transport messages received"),
+    "st_link_send_queue": ("gauge", "transport send-queue depth"),
+    "st_link_recv_queue": ("gauge", "transport recv-queue depth"),
+    "st_link_residual_rms": ("gauge", "outgoing residual RMS (0 = quiesced)"),
+}
+
+#: Legacy ``peer.metrics()`` key -> canonical name, kept ONE release as
+#: deprecated aliases. Paths are dotted into the legacy nested dict;
+#: ``links.*`` paths map per-link with the link id as the {link=} label.
+DEPRECATED_ALIASES: dict[str, str] = {
+    "frames_out": "st_frames_out_total",
+    "frames_in": "st_frames_in_total",
+    "updates": "st_updates_total",
+    "delivery.msgs_out": "st_msgs_out_total",
+    "delivery.msgs_in": "st_msgs_in_total",
+    "delivery.inflight_msgs": "st_inflight_msgs",
+    "pool.tx_slot_acquires": "st_tx_slot_acquires_total",
+    "pool.tx_slot_alloc_events": "st_tx_slot_alloc_events_total",
+    "pool.tx_slots_allocated": "st_tx_slots_allocated",
+    "pool.tx_slots_free": "st_tx_slots_allocated",
+    "pool.transport.tx_acquires": "st_transport_tx_acquires_total",
+    "pool.transport.tx_misses": "st_transport_tx_misses_total",
+    "pool.transport.rx_acquires": "st_transport_rx_acquires_total",
+    "pool.transport.rx_misses": "st_transport_rx_misses_total",
+    "pool.transport.zc_msgs": "st_transport_zc_msgs_total",
+    "links.*.bytes_out": "st_link_bytes_out_total",
+    "links.*.bytes_in": "st_link_bytes_in_total",
+    "links.*.wire_msgs_out": "st_link_wire_msgs_out_total",
+    "links.*.wire_msgs_in": "st_link_wire_msgs_in_total",
+    "links.*.residual_rms": "st_link_residual_rms",
+}
+
+
+def link_key(name: str, link: int) -> str:
+    """Canonical per-link series key: ``st_link_..._total{link="3"}``."""
+    return f'{name}{{link="{int(link)}"}}'
+
+
+def canonicalize(legacy: dict) -> dict:
+    """Flatten a legacy ``peer.metrics()`` dict into canonical keys. Every
+    numeric leaf of the legacy shape is covered (tests assert this), so the
+    canonical view loses nothing the old one had."""
+    out: dict = {}
+
+    def walk(prefix: str, node) -> None:
+        for k, v in node.items():
+            path = f"{prefix}{k}" if not prefix else f"{prefix}.{k}"
+            if isinstance(v, dict):
+                walk(path, v)
+            elif path in DEPRECATED_ALIASES:
+                out[DEPRECATED_ALIASES[path]] = v
+            # unknown leaves fall through silently only if numeric-less;
+            # tests enforce schema coverage of the real metrics() shape
+
+    links = legacy.get("links", {})
+    top = {k: v for k, v in legacy.items() if k != "links"}
+    walk("", top)
+    for link, stats in links.items():
+        for k, v in stats.items():
+            alias = DEPRECATED_ALIASES.get(f"links.*.{k}")
+            if alias is not None:
+                out[link_key(alias, link)] = v
+    return out

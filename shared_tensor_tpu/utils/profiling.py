@@ -51,6 +51,20 @@ class RateMeter:
 
     def update(self, **counters: float) -> None:
         now = time.monotonic()
+        # Counter-reset tolerance (r08 satellite): cumulative counters can
+        # legitimately restart from ~0 — a link re-graft hands the stream
+        # to a FRESH link id (new LinkStats), an engine peer is re-created
+        # after a crash-point kill, a compat peer reconnects. A window
+        # spanning the reset would then report a huge NEGATIVE rate (new
+        # minus old counter). Detect any counter going backwards and drop
+        # the pre-reset history: the meter re-anchors at the reset point
+        # and reports rates for the new stream only.
+        if self._samples:
+            _, last = self._samples[-1]
+            if any(
+                counters[k] < last[k] for k in counters if k in last
+            ):
+                self._samples.clear()
         self._samples.append((now, dict(counters)))
         cutoff = now - self.window
         # Evict while the SECOND-oldest sample is already at/past the window

@@ -722,7 +722,7 @@ def test_engine_repr_after_destroy_is_string():
         r = repr(eng)
         assert isinstance(r, str) and "destroyed" in r
         # counters after destroy: zeros, not a crash
-        assert eng._counters().tolist() == [0] * 8
+        assert eng._counters().tolist() == [0] * 12
         assert eng.link_ids == ()
         assert eng.inflight_total() == 0
         # mutating calls raise a Python error instead of faulting

@@ -64,6 +64,36 @@ def test_rate_meter_window_spans_more_than_last_interval():
     assert m2._samples[0][0] <= time.monotonic() - m2.window
 
 
+def test_rate_meter_tolerates_counter_reset():
+    """r08 satellite: a counter that goes BACKWARDS (fresh link id after a
+    re-graft, re-created peer) must re-anchor the window, not emit a huge
+    negative rate for the whole window span."""
+    m = RateMeter(window_sec=60.0)
+    m.update(frames=1000, bytes=100000)
+    time.sleep(0.01)
+    m.update(frames=2000, bytes=200000)
+    time.sleep(0.01)
+    # the re-graft: counters restart near zero on the new link
+    m.update(frames=5, bytes=500)
+    time.sleep(0.01)
+    m.update(frames=10, bytes=1000)
+    r = m.rates()
+    assert r["frames"] >= 0, r
+    assert r["bytes"] >= 0, r
+    # and the post-reset stream is measured (~5 frames / ~10 ms)
+    assert r["frames"] > 50, r
+    # a reset in ONE counter re-anchors the whole sample set (mixed-epoch
+    # windows are meaningless), so the untouched counter stays sane too
+    m2 = RateMeter(window_sec=60.0)
+    m2.update(a=100, b=100)
+    time.sleep(0.01)
+    m2.update(a=0, b=200)
+    time.sleep(0.01)
+    m2.update(a=50, b=300)
+    r2 = m2.rates()
+    assert r2["a"] >= 0 and r2["b"] >= 0, r2
+
+
 def test_rate_meter_idle_gap_does_not_dilute():
     """After an idle gap longer than the window, rates() must reflect the
     recent window (counters interpolated at the window edge), not average

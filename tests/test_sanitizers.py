@@ -43,6 +43,52 @@ def _runtime(name: str):
     return p if p.is_absolute() and p.exists() else None
 
 
+def _san_env(asan, ubsan):
+    env = dict(os.environ)
+    env.update(
+        {
+            "LD_PRELOAD": f"{asan} {ubsan}",
+            "ASAN_OPTIONS": "detect_leaks=0,abort_on_error=1",
+            "UBSAN_OPTIONS": "print_stacktrace=1,halt_on_error=1",
+            "ST_NATIVE_DIR": str(NATIVE / "san"),
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    return env
+
+
+@pytest.mark.slow
+def test_obs_suite_under_asan_ubsan():
+    """r08 satellite: the obs event ring is lock-free SPSC code shared by
+    every native thread — exactly where a memory-order bug is silent on
+    x86 until it isn't. Run the whole obs test file (ring drain, chaos
+    timelines, postmortems) against the sanitizer builds: ASan/UBSan watch
+    every ring write/drain while the chaos tests hammer them from the
+    transport + engine threads."""
+    asan = _runtime("libasan.so")
+    ubsan = _runtime("libubsan.so")
+    if asan is None or ubsan is None:
+        pytest.skip("gcc sanitizer runtimes unavailable")
+    build = subprocess.run(
+        ["make", "-C", str(NATIVE), "sanitize"],
+        capture_output=True, text=True, timeout=300,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"sanitize build failed: {build.stderr[-500:]}")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "tests/test_obs.py", "-q",
+            "-p", "no:cacheprovider",
+        ],
+        env=_san_env(asan, ubsan), capture_output=True, text=True,
+        timeout=540, cwd=str(REPO),
+    )
+    err_tail = proc.stderr[-4000:]
+    assert "AddressSanitizer" not in proc.stderr, err_tail
+    assert "runtime error:" not in proc.stderr, err_tail  # UBSan findings
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-2000:], err_tail)
+
+
 @pytest.mark.slow
 def test_chaos_soak_native_arm_under_asan_ubsan():
     asan = _runtime("libasan.so")
@@ -56,19 +102,9 @@ def test_chaos_soak_native_arm_under_asan_ubsan():
     if build.returncode != 0:
         pytest.skip(f"sanitize build failed: {build.stderr[-500:]}")
 
-    env = dict(os.environ)
+    env = _san_env(asan, ubsan)
     env.update(
         {
-            # the python binary is uninstrumented: the ASan runtime must be
-            # the first thing the dynamic loader maps
-            "LD_PRELOAD": f"{asan} {ubsan}",
-            # CPython leaks by design at interpreter exit; halt hard on
-            # everything the sanitizers CAN attribute
-            "ASAN_OPTIONS": "detect_leaks=0,abort_on_error=1",
-            "UBSAN_OPTIONS": "print_stacktrace=1,halt_on_error=1",
-            # route every ctypes loader at the sanitizer builds
-            "ST_NATIVE_DIR": str(NATIVE / "san"),
-            "JAX_PLATFORMS": "cpu",
             # one native arm, short window: the chaos classes (drop, stall,
             # sever -> rollback -> carry -> re-graft) all fire within
             # seconds; ASan costs ~2-5x wall clock on top
