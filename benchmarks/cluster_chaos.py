@@ -1,0 +1,215 @@
+"""7-node cluster observability chaos run (r09 acceptance artifact).
+
+Builds a 7-node loopback tree (binary fan-out, native-engine tier), puts a
+deterministic ST_FAULT_PLAN drop schedule under ONE node's C sender, and
+streams multi-origin updates (root + the chaotic deep leaf) through the
+chaos. After exact reconvergence and a full drain, it asserts the r09
+acceptance bar:
+
+- **trace-path contiguity**: >= 99% of delivered update generations
+  reconstruct a contiguous hop path from the trace_apply records (a node
+  only re-stamps hop k+1 after applying hop k, so a gap means lost
+  telemetry — ring overflow, which the artifact also reports);
+- **digest exactness**: after bottom-up digest pushes at the quiesced
+  instant, the root's cluster totals equal the SUM of the 7 per-node
+  registries EXACTLY for every quiesce-stable counter;
+- chaos actually fired (injected drops >= 1) and was repaired
+  (retransmits >= 1, exact convergence).
+
+Also exports the run's merged timeline as a Perfetto-loadable Chrome
+trace (the committed TRACE artifact rides profile_trace.py instead; this
+one is optional via ST_CLUSTER_TRACE_OUT).
+
+Emits one JSON document and writes it to argv[1] (default CHAOS_r09.json).
+Run:  JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py CHAOS_r09.json
+Knobs: ST_CLUSTER_NODES (default 7), ST_CLUSTER_N (2048),
+ST_CLUSTER_ADDS (40), ST_CLUSTER_SEED (9).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+NODES = int(os.environ.get("ST_CLUSTER_NODES", "7"))
+N = int(os.environ.get("ST_CLUSTER_N", "2048"))
+ADDS = int(os.environ.get("ST_CLUSTER_ADDS", "40"))
+SEED = int(os.environ.get("ST_CLUSTER_SEED", "9"))
+
+STABLE_COUNTERS = (
+    "st_frames_out_total", "st_frames_in_total", "st_updates_total",
+    "st_msgs_out_total", "st_msgs_in_total",
+    "st_retransmit_msgs_total", "st_dedup_discards_total",
+    "st_traced_msgs_in_total",
+)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main() -> int:
+    art_path = sys.argv[1] if len(sys.argv) > 1 else "CHAOS_r09.json"
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from shared_tensor_tpu import obs
+    from shared_tensor_tpu.comm import faults
+    from shared_tensor_tpu.comm.peer import create_or_fetch
+    from shared_tensor_tpu.config import (
+        Config, FaultConfig, ObsConfig, TransportConfig,
+    )
+    from shared_tensor_tpu.obs import trace_export
+
+    hub = obs.hub()
+    hub.poll_native()
+    hub.recorder.clear()
+    hub.recorder.set_capacity(500_000)
+
+    cfg = Config(
+        transport=TransportConfig(peer_timeout_sec=20.0, ack_timeout_sec=0.4),
+        obs=ObsConfig(digest_interval_sec=0.2),
+    )
+    port = _free_port()
+    seed = jnp.zeros((N,), jnp.float32)
+    chaos_idx = NODES - 1  # the deep leaf that also originates adds
+    env = faults.to_env(
+        FaultConfig(enabled=True, seed=SEED, drop_pct=0.25, only_link=1)
+    )
+    peers = []
+    for i in range(NODES):
+        if i == chaos_idx:
+            os.environ["ST_FAULT_PLAN"] = env["ST_FAULT_PLAN"]
+        try:
+            peers.append(
+                create_or_fetch("127.0.0.1", port, seed, cfg, timeout=60.0)
+            )
+        finally:
+            os.environ.pop("ST_FAULT_PLAN", None)
+
+    out = {
+        "bench": "cluster_chaos",
+        "nodes": NODES,
+        "n": N,
+        "adds": ADDS,
+        "seed": SEED,
+        "engine_tier": all(p._engine is not None for p in peers),
+        "chaos": {"drop_pct": 0.25, "only_link": 1, "node_index": chaos_idx},
+    }
+    try:
+        total = np.zeros(N, np.float64)
+        rng = np.random.default_rng(0)
+        for i in range(ADDS):
+            d = rng.uniform(-0.5, 0.5, N).astype(np.float32)
+            peers[0 if i % 2 else chaos_idx].add(jnp.asarray(d))
+            total += d
+            time.sleep(0.015)
+
+        deadline = time.time() + 120.0
+        converged = [False] * NODES
+        while time.time() < deadline and not all(converged):
+            for i, p in enumerate(peers):
+                if not converged[i]:
+                    converged[i] = bool(
+                        np.allclose(np.asarray(p.read()), total, atol=1e-4)
+                    )
+            time.sleep(0.05)
+        drained = all(p.drain(timeout=30.0, tol=1e-30) for p in peers)
+
+        hub.poll_native()
+        timeline = hub.recorder.timeline()
+        paths = trace_export.trace_paths(timeline)
+        stats = trace_export.path_stats(paths)
+        counts = hub.recorder.counts
+
+        # quiesced-instant digest: push bottom-up rounds so every level's
+        # exact totals reach the root regardless of the tree's shape
+        for _ in range(4):
+            for p in peers:
+                if p._uplink is not None:
+                    p.push_digest()
+            time.sleep(0.4)
+        cluster = peers[0].metrics(cluster=True)
+        snaps = [p.metrics(canonical=True) for p in peers]
+        digest = {"nodes_seen": len(cluster["nodes"]), "counters": {}}
+        digest_exact = len(cluster["nodes"]) == NODES
+        for name in STABLE_COUNTERS:
+            want = sum(s.get(name, 0) for s in snaps)
+            got = cluster["counters"].get(name, 0)
+            digest["counters"][name] = {
+                "cluster": got, "sum_of_registries": want,
+            }
+            digest_exact = digest_exact and got == want
+
+        staleness = [
+            v for s in snaps for k, v in s.items()
+            if k.startswith("st_staleness_seconds")
+        ]
+        out.update(
+            converged_all=all(converged),
+            drained_all=drained,
+            injected={
+                "fault_drop": counts.get("fault_drop", 0),
+                "retransmit": counts.get("retransmit", 0),
+            },
+            trace_paths=stats,
+            trace_events=counts.get("trace_apply", 0),
+            native_ring_dropped=int(
+                next(iter(snaps), {}).get("st_obs_events_dropped_total", 0)
+            ),
+            staleness_seconds={
+                "max": max(staleness, default=0.0),
+                "observed_links": len(staleness),
+            },
+            digest=digest,
+            digest_exact=digest_exact,
+        )
+        trace_out = os.environ.get("ST_CLUSTER_TRACE_OUT", "")
+        if trace_out:
+            trace_export.export_file(trace_out, timeline)
+            out["trace_export"] = trace_out
+        out["pass"] = bool(
+            all(converged)
+            and drained
+            and out["injected"]["fault_drop"] >= 1
+            and out["injected"]["retransmit"] >= 1
+            and stats["paths"] >= ADDS // 2
+            and stats["contiguous_frac"] >= 0.99
+            and digest_exact
+        )
+    finally:
+        for p in peers:
+            p.close()
+
+    doc = json.dumps(out, indent=2)
+    print(doc)
+    if not os.path.isabs(art_path):
+        art_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            art_path,
+        )
+    with open(art_path, "w") as f:
+        f.write(doc + "\n")
+    print(
+        f"cluster_chaos: {out.get('trace_paths', {}).get('paths', 0)} paths, "
+        f"contiguous {out.get('trace_paths', {}).get('contiguous_frac', 0):.3f}, "
+        f"digest_exact={out.get('digest_exact')} -> "
+        f"{'PASS' if out['pass'] else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return 0 if out["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,12 +1,21 @@
 """Capture a jax.profiler trace of the flagship training step (verdict r2
-item 6: a committed trace artifact attributing step time).
+item 6: a committed trace artifact attributing step time) — and, since
+r09, a Perfetto/Chrome ``trace_event`` export of the OBSERVABILITY
+timeline (the flight recorder's merged native+Python events, with
+cross-node flow arrows per update generation).
 
-Runs a few warm steps, then traces a short chained run of each arm
-(sync_off / compressed / compressed_overlap) into ``--out`` (default
-PROFILE_TRACE_r03/). The trace directory is the artifact; load it with
-TensorBoard's profile plugin or xprof.
+Default mode runs a few warm steps, then traces a short chained run of
+each arm (sync_off / compressed / compressed_overlap) into ``--out``
+(default PROFILE_TRACE_r03/); load with TensorBoard's profile plugin.
+
+``--events-out FILE`` instead runs a 3-node loopback CHAIN (max_children=1
+so hops reach depth 2), streams a few updates through it, and exports the
+flight-recorder timeline as Chrome trace JSON — open in
+https://ui.perfetto.dev or chrome://tracing. This is how TRACE_r09.json
+is produced.
 
 Usage: python benchmarks/profile_trace.py [--out DIR] [--steps 20]
+       python benchmarks/profile_trace.py --events-out TRACE_r09.json
 """
 
 from __future__ import annotations
@@ -14,8 +23,69 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _events_demo(out_path: str) -> None:
+    """3-node chain, multi-hop traffic, Perfetto export (r09)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import socket
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from shared_tensor_tpu import obs
+    from shared_tensor_tpu.comm.peer import create_or_fetch
+    from shared_tensor_tpu.config import Config, ObsConfig, TransportConfig
+    from shared_tensor_tpu.obs import trace_export
+
+    hub = obs.hub()
+    hub.poll_native()
+    hub.recorder.clear()
+    hub.recorder.set_capacity(100_000)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg = Config(
+        transport=TransportConfig(peer_timeout_sec=20.0, max_children=1),
+        obs=ObsConfig(digest_interval_sec=0.2),
+    )
+    n = 4096
+    seed = jnp.zeros((n,), jnp.float32)
+    peers = [
+        create_or_fetch("127.0.0.1", port, seed, cfg, timeout=60.0)
+        for _ in range(3)
+    ]
+    try:
+        total = np.zeros(n, np.float64)
+        rng = np.random.default_rng(0)
+        for i in range(12):
+            d = rng.normal(size=n).astype(np.float32)
+            peers[i % len(peers)].add(jnp.asarray(d))
+            total += d
+            time.sleep(0.02)
+        deadline = time.time() + 60.0
+        while time.time() < deadline and not all(
+            np.allclose(np.asarray(p.read()), total, atol=1e-4)
+            for p in peers
+        ):
+            time.sleep(0.05)
+        for p in peers:
+            p.drain(timeout=20.0, tol=1e-30)
+        hub.poll_native()
+        timeline = hub.recorder.timeline()
+        stats = trace_export.path_stats(trace_export.trace_paths(timeline))
+        trace_export.export_file(out_path, timeline)
+        print(
+            f"exported {len(timeline)} events / {stats['paths']} update "
+            f"paths (max {stats['max_hops']} hops) -> {out_path}",
+            flush=True,
+        )
+    finally:
+        for p in peers:
+            p.close()
 
 
 def main() -> None:
@@ -24,7 +94,15 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument(
+        "--events-out", default="",
+        help="export the obs timeline as Chrome trace JSON instead of "
+        "running the jax.profiler arms (r09; writes e.g. TRACE_r09.json)",
+    )
     args = ap.parse_args()
+    if args.events_out:
+        _events_demo(args.events_out)
+        return
 
     import jax
     import jax.numpy as jnp

@@ -121,7 +121,7 @@ def _worker(rank, port, stop_ev, exit_ev, out_q, ledger_dir, chaos):
     # siblings can still converge through us; exit_ev gates the close)
     ok = peer.drain(timeout=90.0, tol=1e-30)
     ledger.close()
-    out_q.put((rank, kills, leaves, ok, peer.metrics()))
+    out_q.put((rank, kills, leaves, ok, peer.metrics(canonical=True)))
     # stay alive until the coordinator says every sibling finished draining
     # and settling THROUGH us (an interior leaver closing early would drop
     # ACKed-but-not-yet-flooded frames — the drain-then-close race the
@@ -273,7 +273,7 @@ def main() -> None:
         "sum_dev_neg": neg_dev,
         "sum_dev_pos": pos_dev,
         "redelivery_noise_bound": noise_bound,
-        "master_frames_in": master.metrics()["frames_in"],
+        "master_frames_in": master.metrics(canonical=True)["st_frames_in_total"],
         "pass": bool(
             # agreement floor: the verifier's state transfer converges
             # geometrically, so its plateau is RELATIVE to the state

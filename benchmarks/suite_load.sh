@@ -51,13 +51,15 @@ if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_BENCH:-1}" = "1" ]; then
     python benchmarks/bench_gate.py "$BENCH_OUT" >>"$OUT" 2>&1 || FAILED=1
 fi
 
-# Obs-overhead gate (r08): the unified telemetry must stay <2% on the
-# engine hot path (paired within-run A/B; fails only when the measured
-# drop is statistically past the budget — benchmarks/obs_overhead.py).
-# The run is recorded as the round's OBS artifact (ST_SUITE_OBS_OUT,
-# default OBS_r08.json). ST_SUITE_OBS=0 skips (e.g. red-suite debugging).
+# Obs-overhead gate (r08; r09 added the paired trace-stamping arm): the
+# unified telemetry — cross-hop trace stamping included — must stay <2%
+# on the engine hot path (paired within-run A/B; fails only when the
+# measured drop is statistically past the budget on either arm —
+# benchmarks/obs_overhead.py). The run is recorded as the round's OBS
+# artifact (ST_SUITE_OBS_OUT, default OBS_r09.json). ST_SUITE_OBS=0
+# skips (e.g. red-suite debugging).
 if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_OBS:-1}" = "1" ]; then
-  OBS_OUT="${ST_SUITE_OBS_OUT:-OBS_r08.json}"
+  OBS_OUT="${ST_SUITE_OBS_OUT:-OBS_r09.json}"
   JAX_PLATFORMS=cpu python benchmarks/obs_overhead.py "$OBS_OUT" \
     >/dev/null 2>>"$OUT" || FAILED=1
 fi

@@ -70,8 +70,8 @@ def train_peer(text: bytes, cfg, args) -> None:
             st.add(jax.tree.map(lambda x: -args.lr * x, g))
             if i % 20 == 0:
                 loss = float(m.loss_fn(params, batch, cfg))
-                print(f"step {i:4d} loss {loss:.3f} {st.metrics()}")
-        print(f"done in {time.perf_counter() - t0:.1f}s; final metrics {st.metrics()}")
+                print(f"step {i:4d} loss {loss:.3f} {st.metrics(canonical=True)}")
+        print(f"done in {time.perf_counter() - t0:.1f}s; final metrics {st.metrics(canonical=True)}")
 
 
 def main() -> None:

@@ -105,8 +105,14 @@ void st_fault_crash_point(const char*);
 // r08 obs event ring (defined once in sttransport.cpp; codes are ABI —
 // obs/events.py CODE_NAMES is the authoritative mirror). Engine-side
 // events: retransmit(10), black-hole teardown(11), quarantine(12),
-// send-window stall(13, edge-triggered), dedup/gap discard(14), seal(15).
+// send-window stall(13, edge-triggered), dedup/gap discard(14), seal(15),
+// trace-hop apply(30, r09 — emitted per accepted traced data message with
+// (origin << 8 | hop) packed into the record's extra word).
 void st_obs_emit(uint32_t node_id, uint32_t code, int32_t link, uint64_t arg);
+void st_obs_emit2(uint32_t node_id, uint32_t code, int32_t link, uint64_t arg,
+                  uint32_t extra);
+uint64_t st_obs_now_ns();
+int32_t st_obs_is_enabled();
 uint32_t st_node_obs_id(void*);
 }
 
@@ -230,6 +236,28 @@ constexpr uint32_t kEvQuarantine = 12;
 constexpr uint32_t kEvWindowStall = 13;
 constexpr uint32_t kEvDedupDiscard = 14;
 constexpr uint32_t kEvSeal = 15;
+constexpr uint32_t kEvTraceApply = 30;  // r09 cross-hop trace propagation
+
+// ---- r09 trace context (comm/wire.py v2 framing) --------------------------
+//
+// DATA v2: [kind u8][seq u32][origin u32][origin_ns u64][hops u8][body]
+// BURST v2: [kind u8][seq u32][k u8][origin u32][origin_ns u64][hops u8][body]
+// The 13-byte trace context stamps each outgoing message with the causal
+// provenance of the LATEST update folded into this node's residuals: a
+// local add() re-seeds it (origin = this node, hops = 0); applying a
+// traced foreign message advances it (origin/gen preserved, hops + 1).
+// Receivers accept BOTH v1 (r08, 5/6-byte headers) and v2 sizes — per is a
+// multiple of 4 and the trace adds 13, so message length disambiguates the
+// version unambiguously and mixed-version trees interop (the version gate
+// lives in compat.py / ObsConfig.trace_wire; SYNC advertises it).
+constexpr size_t kTraceBytes = 13;
+constexpr size_t kDataHdrV1 = 5, kBurstHdrV1 = 6;
+constexpr size_t kDataHdrV2 = kDataHdrV1 + kTraceBytes;   // 18
+constexpr size_t kBurstHdrV2 = kBurstHdrV1 + kTraceBytes;  // 19
+// Header room reserved before a tx slot's 8-aligned frame body (was 8 in
+// r07; v2's largest header is 19 bytes, so the room grows to the next
+// multiple of 8 — the body stays aligned for the codec kernels).
+constexpr size_t kBodyOff = 24;
 
 struct SentMsg {
   // one wire message = 1..k frames; rolls back / acks whole
@@ -275,6 +303,11 @@ struct ELink {
   // guards staleness; all access under Engine::mu.
   std::vector<double> pamax, pss, psabs;
   bool pvalid = false;
+  // r09 convergence telemetry (st_engine_link_obs): origin-stamp age of the
+  // latest traced message applied FROM this link, and its hop distance.
+  // Updated at flush under Engine::mu.
+  uint64_t stale_ns = 0;
+  uint32_t last_hops = 0;
 };
 
 struct Engine {
@@ -352,6 +385,28 @@ struct Engine {
   // keeps no buckets; Python renders mean / exports sum+count).
   std::atomic<uint64_t> retx_msgs{0}, dedup_discards{0};
   std::atomic<uint64_t> rtt_ns_total{0}, rtt_msgs{0};
+  // r09 trace aggregates (st_engine_counters[12..15]): hop-count sum +
+  // sample count over applied traced messages (st_update_hops on the
+  // Python tier keeps buckets; the C hot path exports sum/count like the
+  // RTT pair), the most recent apply-time staleness, and how many applied
+  // data messages carried a v2 trace stamp at all.
+  std::atomic<uint64_t> hops_sum{0}, hops_msgs{0};
+  std::atomic<uint64_t> staleness_ns_last{0};
+  std::atomic<uint64_t> traced_msgs_in{0};
+  // r09 wire format: stamp outgoing DATA/BURST with the v2 trace context
+  // (0 = v1 framing, byte-identical to r08 — the receive side accepts
+  // both regardless, so mixed trees interop; ObsConfig.trace_wire).
+  int32_t trace_wire = 0;
+  // Pending trace stamp (under mu): provenance of the latest update folded
+  // into the residuals — re-seeded by add() (this node, now, 0 hops),
+  // advanced by every traced apply (origin kept, hops + 1). Approximate by
+  // design: residual coalescing means one outgoing message can carry many
+  // generations' mass; it is stamped with the newest (README "Cluster
+  // observability" documents the semantics).
+  uint32_t t_origin = 0;
+  uint64_t t_gen = 0;
+  uint32_t t_hops = 0;
+  bool t_has = false;
   uint32_t obs_id = 0;  // the node's process-unique obs id (event tag)
   std::thread send_thread, recv_thread;
 
@@ -423,7 +478,7 @@ void rollback_unacked(Engine* e, ELink& lk) {
       const float* fs;
       const uint32_t* fw;
       if (msg.slot) {
-        const uint8_t* body = msg.slot->buf.data() + 8 + (size_t)f * per;
+        const uint8_t* body = msg.slot->buf.data() + kBodyOff + (size_t)f * per;
         fs = (const float*)body;
         fw = (const uint32_t*)(body + (size_t)e->L * 4);
       } else {
@@ -629,7 +684,7 @@ void sender_loop(Engine* e) {
         uint8_t* body = nullptr;
         if (!e->compat_bytes) {
           slot = e->txpool.acquire();
-          body = slot->buf.data() + 8;
+          body = slot->buf.data() + kBodyOff;
         }
         if ((int64_t)lk2.pamax.size() != e->L) {
           lk2.pamax.resize((size_t)e->L);
@@ -691,22 +746,41 @@ void sender_loop(Engine* e) {
         // docstring); a failed send rolls back THIS message inline below.
         if (!e->compat_bytes) {
           msg.seq = ++lk2.tx_seq;
-          // wire header, packed flush against the 8-aligned body: BURST
-          // [kind][u32 seq][u8 k] from offset 2, DATA [kind][u32 seq]
-          // from offset 3 (comm/wire.py framing; LE host assumed)
+          // wire header, packed flush against the 8-aligned body at
+          // kBodyOff (comm/wire.py framing; LE host assumed): BURST
+          // [kind][u32 seq][u8 k], DATA [kind][u32 seq], each followed by
+          // the 13-byte r09 trace context when trace_wire is on.
           uint32_t seq32 = (uint32_t)msg.seq;
+          size_t hdr = e->burst > 1
+                           ? (e->trace_wire ? kBurstHdrV2 : kBurstHdrV1)
+                           : (e->trace_wire ? kDataHdrV2 : kDataHdrV1);
+          slot->wire_off = (uint32_t)(kBodyOff - hdr);
+          uint8_t* H = slot->buf.data() + slot->wire_off;
+          size_t o;
           if (e->burst > 1) {
-            slot->wire_off = 2;
-            slot->buf[2] = kBurst;
-            std::memcpy(slot->buf.data() + 3, &seq32, 4);
-            slot->buf[7] = (uint8_t)msg.nframes;
-            slot->wire_len = 6 + (uint32_t)((size_t)msg.nframes * per);
+            H[0] = kBurst;
+            std::memcpy(H + 1, &seq32, 4);
+            H[5] = (uint8_t)msg.nframes;
+            o = kBurstHdrV1;
           } else {
-            slot->wire_off = 3;
-            slot->buf[3] = kData;
-            std::memcpy(slot->buf.data() + 4, &seq32, 4);
-            slot->wire_len = 5 + (uint32_t)per;
+            H[0] = kData;
+            std::memcpy(H + 1, &seq32, 4);
+            o = kDataHdrV1;
           }
+          if (e->trace_wire) {
+            // pending stamp, read under e->mu (we hold it here). A node
+            // that never added nor applied anything traced stamps itself
+            // at hop 0 — e.g. the join-seed diff residual.
+            uint32_t to = e->t_has ? e->t_origin : e->obs_id;
+            uint64_t tg = e->t_has ? e->t_gen : st_obs_now_ns();
+            uint8_t th =
+                e->t_has ? (uint8_t)(e->t_hops > 255 ? 255 : e->t_hops) : 0;
+            std::memcpy(H + o, &to, 4);
+            std::memcpy(H + o + 4, &tg, 8);
+            H[o + 12] = th;
+          }
+          slot->wire_len =
+              (uint32_t)(hdr + (size_t)msg.nframes * per);
           msg.slot = slot;  // the ledger entry owns the acquire reference
           msg.sent_at = EClock::now();
           if (lk2.unacked.empty()) lk2.ack_progress = msg.sent_at;
@@ -841,9 +915,21 @@ void receiver_loop(Engine* e) {
       for (auto& kv : e->links)
         if (!kv.second.dead) ids.push_back(kv.first);
     }
+    // r09 trace bookkeeping is part of the obs subsystem's toggleable cost
+    // (the overhead bench's paired A/B flips this flag): when off, traced
+    // headers are still parsed for framing but no clock reads / atomics /
+    // events happen per message.
+    bool obs_on = st_obs_is_enabled() != 0;
     for (int32_t id : ids) {
       int32_t batchk = 0;
       uint64_t msgs = 0;
+      // last traced stamp accepted in this batch (+ per-batch aggregates):
+      // folded into the engine's pending stamp and the link's staleness
+      // gauge at flush, under e->mu
+      bool have_trace = false;
+      uint32_t tr_origin = 0, tr_hops = 0;
+      uint64_t tr_gen = 0;
+      uint64_t n_traced = 0, hops_acc = 0;
       // last in-order wire seq accepted on this link (go-back-N; only this
       // thread advances rx_count, so the snapshot stays valid across the
       // batch — msgs tracks acceptances not yet folded in by flush)
@@ -862,6 +948,28 @@ void receiver_loop(Engine* e) {
         if (it == e->links.end()) return;
         if (batchk > 0) {
           apply_batch(e, id, batchk, bscales.data(), bwords.data());
+        }
+        if (have_trace) {
+          // advance the pending stamp: this node is now one hop further
+          // from the origin than the message that carried it
+          uint32_t hop = tr_hops + 1;
+          e->t_origin = tr_origin;
+          e->t_gen = tr_gen;
+          e->t_hops = hop;
+          e->t_has = true;
+          if (obs_on) {
+            uint64_t now = st_obs_now_ns();
+            uint64_t age = now > tr_gen ? now - tr_gen : 0;
+            it->second.stale_ns = age;
+            it->second.last_hops = hop;
+            e->staleness_ns_last.store(age, std::memory_order_relaxed);
+            e->hops_sum += hops_acc;
+            e->hops_msgs += n_traced;
+            e->traced_msgs_in += n_traced;
+          }
+          have_trace = false;
+          n_traced = 0;
+          hops_acc = 0;
         }
         // crash point: applied + flooded, ACK not yet sent — the sender
         // still ledgers these messages and re-delivers (at-least-once)
@@ -928,19 +1036,51 @@ void receiver_loop(Engine* e) {
             st_obs_emit(e->obs_id, kEvDedupDiscard, id, (uint64_t)seq);
             continue;
           }
+          // v1 or v2 framing by exact length (per is a multiple of 4, the
+          // trace context is 13 bytes — the sizes can never coincide), so
+          // a v1 sender's messages keep applying on a v2 node and vice
+          // versa (the r09 version gate is about what we EMIT).
           int32_t k = 0;
           const uint8_t* p = nullptr;
-          if (kind == kData && (size_t)n == 5 + per) {
+          const uint8_t* trace = nullptr;  // 13-byte context, if present
+          if (kind == kData && (size_t)n == kDataHdrV1 + per) {
             k = 1;
-            p = buf.data() + 5;
+            p = buf.data() + kDataHdrV1;
+          } else if (kind == kData && (size_t)n == kDataHdrV2 + per) {
+            k = 1;
+            trace = buf.data() + kDataHdrV1;
+            p = buf.data() + kDataHdrV2;
           } else if (kind == kBurst && n >= 6 && buf[5] > 0 &&
-                     (size_t)n == 6 + (size_t)buf[5] * per) {
+                     (size_t)n == kBurstHdrV1 + (size_t)buf[5] * per) {
             k = buf[5];
-            p = buf.data() + 6;
+            p = buf.data() + kBurstHdrV1;
+          } else if (kind == kBurst && n >= 19 && buf[5] > 0 &&
+                     (size_t)n == kBurstHdrV2 + (size_t)buf[5] * per) {
+            k = buf[5];
+            trace = buf.data() + kBurstHdrV1;
+            p = buf.data() + kBurstHdrV2;
           } else {
             continue;  // undecodable: seq not consumed, await retransmit
           }
           msgs++;
+          if (trace) {
+            std::memcpy(&tr_origin, trace, 4);
+            std::memcpy(&tr_gen, trace + 4, 8);
+            tr_hops = trace[12];
+            have_trace = true;
+            if (obs_on) {
+              uint32_t hop = tr_hops + 1;
+              n_traced++;
+              hops_acc += hop;
+              // one record per accepted traced message: node/link say who
+              // applied it, arg carries the generation (origin ns), extra
+              // packs (origin id << 8 | hop) — the flight recorder
+              // reconstructs the full causal path from these
+              // (obs/trace_export.py trace_paths).
+              st_obs_emit2(e->obs_id, kEvTraceApply, id, tr_gen,
+                           (tr_origin << 8) | (hop > 255 ? 255 : hop));
+            }
+          }
           for (int32_t f = 0; f < k; f++) {
             size_t bs = bscales.size(), bw = bwords.size();
             bscales.resize(bs + (size_t)e->L);
@@ -1026,7 +1166,7 @@ __attribute__((visibility("default"))) void* st_engine_create(
     const float* init_values /* or NULL */, int32_t policy, int32_t per_leaf,
     int32_t burst, int32_t recv_cap, int32_t compat_frame_bytes,
     int32_t quarantine_send_failures, double ack_timeout_sec,
-    int32_t ack_retry_limit) {
+    int32_t ack_retry_limit, int32_t trace_wire) {
   if (compat_frame_bytes > 0 &&
       (n_leaves != 1 || compat_frame_bytes < 5 ||
        (int64_t)(compat_frame_bytes - 4) > total / 8))
@@ -1056,14 +1196,18 @@ __attribute__((visibility("default"))) void* st_engine_create(
   // max(1, ack_retry_limit) — the knob must mean the same thing on
   // both data planes
   e->ack_retry_limit = ack_retry_limit > 0 ? ack_retry_limit : 1;
+  // trace context is native-framing only (the reference compat protocol
+  // has no header to extend)
+  e->trace_wire = (trace_wire != 0 && compat_frame_bytes <= 0) ? 1 : 0;
   e->values.assign((size_t)total, 0.0f);
   if (init_values)
     std::memcpy(e->values.data(), init_values, (size_t)total * 4);
-  // tx ring slot size: 8 bytes of header room (body 8-aligned for the
-  // codec kernels) + the largest message this engine can emit. The window
-  // (kSendWindow) bounds live slots per link; keep_warm bounds idle memory.
+  // tx ring slot size: kBodyOff bytes of header room (body 8-aligned for
+  // the codec kernels; headers pack flush against it) + the largest
+  // message this engine can emit. The window (kSendWindow) bounds live
+  // slots per link; keep_warm bounds idle memory.
   e->txpool.slot_bytes =
-      8 + (size_t)e->burst * ((size_t)e->L * 4 + (size_t)e->W * 4);
+      kBodyOff + (size_t)e->burst * ((size_t)e->L * 4 + (size_t)e->W * 4);
   return e;
 }
 
@@ -1171,6 +1315,16 @@ __attribute__((visibility("default"))) void st_engine_add(void* h,
                                e->off.data(), e->ns.data(), e->padded.data(),
                                e->L);
     e->updates++;
+    if (e->trace_wire) {
+      // a local update is a fresh generation: re-seed the pending stamp
+      // (origin = this node, generation = its monotonic birth time, 0
+      // hops). One clock read per add() — adds are orders of magnitude
+      // rarer than wire messages.
+      e->t_origin = e->obs_id;
+      e->t_gen = st_obs_now_ns();
+      e->t_hops = 0;
+      e->t_has = true;
+    }
   }
   e->wake();
 }
@@ -1341,10 +1495,33 @@ __attribute__((visibility("default"))) double st_engine_residual_rms(
   auto* e = (Engine*)h;
   std::lock_guard<std::mutex> lk(e->mu);
   auto it = e->links.find(link_id);
-  if (it == e->links.end()) return 0.0;
+  if (it == e->links.end()) {
+    // the carry pseudo-slot (peer.CARRY_LINK == -1): an orphaned node's
+    // owed mass lives here, not in any link — st_residual_norm must see
+    // it or an orphan reads "quiesced" while still holding undelivered
+    // updates. O(total) scan, but only reachable while a carry exists.
+    if (link_id != -1 || !e->has_carry) return 0.0;
+    double css = 0;
+    const float* c = e->carry.data();
+    for (int64_t i = 0; i < e->total; i++) css += (double)c[i] * (double)c[i];
+    return std::sqrt(css / (double)e->total_n);
+  }
+  ELink& lk2 = it->second;
+  // Fast path off the scale-partials cache: pss[] holds each leaf's
+  // residual sum-of-squares, refreshed by every fused add/apply/quantize
+  // pass — the exact quantity this scan would recompute. Matters because
+  // the r09 digest beat (and drain()'s poll) samples this under e->mu
+  // every interval on EVERY peer: a full O(total) walk here (64 MiB at
+  // 16 Mi) would stall the data-plane threads that share the mutex. The
+  // slow scan remains only for the rare cache-bypassing writes
+  // (rollback, restore — pvalid false).
   double ss = 0;
-  const float* r = it->second.resid.data();
-  for (int64_t i = 0; i < e->total; i++) ss += (double)r[i] * (double)r[i];
+  if (lk2.pvalid && (int64_t)lk2.pss.size() == e->L) {
+    for (int64_t i = 0; i < e->L; i++) ss += lk2.pss[i];
+  } else {
+    const float* r = lk2.resid.data();
+    for (int64_t i = 0; i < e->total; i++) ss += (double)r[i] * (double)r[i];
+  }
   return std::sqrt(ss / (double)e->total_n);
 }
 
@@ -1359,33 +1536,59 @@ __attribute__((visibility("default"))) int64_t st_engine_inflight(void* h) {
 
 // counters: [frames_out, frames_in, updates, msgs_out, msgs_in,
 //            tx_slot_acquires, tx_slot_alloc_events, tx_slots_allocated,
-//            retx_msgs, dedup_discards, rtt_ns_total, rtt_msgs]
+//            retx_msgs, dedup_discards, rtt_ns_total, rtt_msgs,
+//            hops_sum, hops_msgs, staleness_ns_last, traced_msgs_in]
 // [5..7] are the r07 tx-ring pool stats (steady state: acquires grow,
 // alloc_events flat); [8..11] are the r08 obs aggregates (go-back-N
 // retransmitted messages, dup/gap discards, and the ACK round-trip
-// sum-of-ns + sample count — obs/schema.py names them canonically).
+// sum-of-ns + sample count); [12..15] the r09 trace aggregates (hop-count
+// sum + sample count over applied traced messages, the most recent
+// apply-time staleness in ns, and the traced-message count —
+// obs/schema.py names all of them canonically).
 __attribute__((visibility("default"))) void st_engine_counters(
-    void* h, uint64_t* out12) {
+    void* h, uint64_t* out16) {
   if (!h) {  // the SIGSEGV that aborted the whole suite (r05 Weak #2)
-    for (int i = 0; i < 12; i++) out12[i] = 0;
+    for (int i = 0; i < 16; i++) out16[i] = 0;
     return;
   }
   auto* e = (Engine*)h;
-  out12[0] = e->frames_out.load();
-  out12[1] = e->frames_in.load();
-  out12[2] = e->updates.load();
-  out12[3] = e->msgs_out.load();
-  out12[4] = e->msgs_in.load();
-  out12[5] = e->txpool.acquires.load();
-  out12[6] = e->txpool.alloc_events.load();
+  out16[0] = e->frames_out.load();
+  out16[1] = e->frames_in.load();
+  out16[2] = e->updates.load();
+  out16[3] = e->msgs_out.load();
+  out16[4] = e->msgs_in.load();
+  out16[5] = e->txpool.acquires.load();
+  out16[6] = e->txpool.alloc_events.load();
   {
     std::lock_guard<std::mutex> lk(e->txpool.mu);
-    out12[7] = (uint64_t)e->txpool.all_.size();
+    out16[7] = (uint64_t)e->txpool.all_.size();
   }
-  out12[8] = e->retx_msgs.load();
-  out12[9] = e->dedup_discards.load();
-  out12[10] = e->rtt_ns_total.load();
-  out12[11] = e->rtt_msgs.load();
+  out16[8] = e->retx_msgs.load();
+  out16[9] = e->dedup_discards.load();
+  out16[10] = e->rtt_ns_total.load();
+  out16[11] = e->rtt_msgs.load();
+  out16[12] = e->hops_sum.load();
+  out16[13] = e->hops_msgs.load();
+  out16[14] = e->staleness_ns_last.load();
+  out16[15] = e->traced_msgs_in.load();
+}
+
+// r09 per-link convergence telemetry: out2[0] = origin-stamp age (ns) of
+// the latest traced message applied from this link, out2[1] = its hop
+// distance from the origin. Returns 1 when the link exists. The peer's
+// registry collector renders these as the st_staleness_seconds{link=} and
+// st_update_hops-adjacent gauges (obs/schema.py).
+__attribute__((visibility("default"))) int32_t st_engine_link_obs(
+    void* h, int32_t link_id, uint64_t* out2) {
+  out2[0] = out2[1] = 0;
+  if (!h) return 0;
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->links.find(link_id);
+  if (it == e->links.end()) return 0;
+  out2[0] = it->second.stale_ns;
+  out2[1] = it->second.last_hops;
+  return 1;
 }
 
 // Pop one control-plane message; returns its length (0 = none). link_out

@@ -136,7 +136,18 @@ std::atomic<int> g_enabled{[] {
   return (e && e[0] == '0' && !e[1]) ? 0 : 1;
 }()};
 std::atomic<uint64_t> g_dropped{0};
-std::atomic<uint32_t> g_next_node_id{1};
+// Node obs ids must be unique across the PROCESSES of a loopback cluster,
+// not just within one — the r09 digest keys its per-node breakdown and the
+// trace context keys update origins on this id. Layout: 12 pid bits +
+// 12 local bits = 24 bits, EXACTLY the origin field the trace record
+// packs (origin << 8 | hop in a u32) — the local counter wraps INSIDE its
+// pid block so an id can never exceed 2^24 (a spill past it would be
+// silently truncated in every trace event, conflating origins). 4096
+// nodes per process before in-block reuse; a long pytest session creates
+// hundreds, not thousands. Cross-process risk left: two pids equal mod
+// 4096 in ONE tree (1/4096 per pair — accepted, documented).
+std::atomic<uint32_t> g_next_node_local{0};
+const uint32_t g_node_id_base = ((uint32_t)getpid() & 0xFFFu) << 12;
 
 inline uint64_t now_ns() {
   timespec ts;
@@ -200,12 +211,26 @@ extern "C" __attribute__((visibility("default"))) uint64_t st_obs_dropped() {
   return stobs::g_dropped.load(std::memory_order_relaxed);
 }
 
+// Emission gate as an ABI call: the engine's r09 trace bookkeeping (clock
+// reads, per-message hops/staleness accounting) keys off the same flag as
+// ring emission, so the obs-overhead bench's paired A/B toggle
+// (st_obs_set_enabled) covers the trace-stamping cost too.
+extern "C" __attribute__((visibility("default"))) int32_t
+st_obs_is_enabled() {
+  return stobs::g_enabled.load(std::memory_order_relaxed);
+}
+
 // Record one event on the calling thread's ring. Cheap enough to leave on
 // in production (one relaxed load when disabled; one clock read + one
 // 32-byte store when armed) — and RARE by design: every call site is a
-// protocol/recovery/fault event, never a per-element loop.
-extern "C" __attribute__((visibility("default"))) void st_obs_emit(
-    uint32_t node_id, uint32_t code, int32_t link, uint64_t arg) {
+// protocol/recovery/fault event, never a per-element loop (the r09
+// trace_apply events are per accepted wire MESSAGE, still orders of
+// magnitude below per-element). ``extra`` lands in the record's fourth
+// word (obs/events.py Event.extra) — r09 packs (origin_id << 8 | hops)
+// there so one record carries a full trace-hop observation.
+extern "C" __attribute__((visibility("default"))) void st_obs_emit2(
+    uint32_t node_id, uint32_t code, int32_t link, uint64_t arg,
+    uint32_t extra) {
   if (!stobs::g_enabled.load(std::memory_order_relaxed)) return;
   thread_local stobs::RingHolder tl;
   stobs::Ring* r = tl.r;
@@ -219,9 +244,14 @@ extern "C" __attribute__((visibility("default"))) void st_obs_emit(
   e.node_id = node_id;
   e.code = code;
   e.link = link;
-  e.reserved = 0;
+  e.reserved = extra;
   e.arg = arg;
   r->head.store(h + 1, std::memory_order_release);
+}
+
+extern "C" __attribute__((visibility("default"))) void st_obs_emit(
+    uint32_t node_id, uint32_t code, int32_t link, uint64_t arg) {
+  st_obs_emit2(node_id, code, link, arg, 0);
 }
 
 // Drain every thread's ring into buf (whole 32-byte records only); returns
@@ -1246,7 +1276,10 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
   }
   auto* node = new Node();
   node->obs_id =
-      stobs::g_next_node_id.fetch_add(1, std::memory_order_relaxed);
+      stobs::g_node_id_base |
+      ((stobs::g_next_node_local.fetch_add(1, std::memory_order_relaxed) +
+        1u) &
+       0xFFFu);
   Config& cfg = node->cfg;
   cfg.wire_compat = cfg_c->wire_compat;
   cfg.compat_frame_bytes = cfg_c->compat_frame_bytes;

@@ -60,6 +60,11 @@ def load_engine() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,  # quarantine_send_failures (0 = disabled)
             ctypes.c_double,  # ack_timeout_sec (go-back-N; 0 = disabled)
             ctypes.c_int32,  # ack_retry_limit (rounds before teardown)
+            ctypes.c_int32,  # trace_wire (r09 v2 framing; 0 = v1 emission)
+        ]
+        lib.st_engine_link_obs.restype = ctypes.c_int32
+        lib.st_engine_link_obs.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, _u64p,
         ]
         lib.st_engine_compat_regraft.restype = ctypes.c_int32
         lib.st_engine_compat_regraft.argtypes = [
@@ -169,6 +174,7 @@ class EngineTensor:
         quarantine_send_failures: int = 0,  # see TransportConfig
         ack_timeout_sec: float = 0.0,  # go-back-N timer; see TransportConfig
         ack_retry_limit: int = 8,  # rounds before black-hole teardown
+        trace_wire: bool = True,  # r09 v2 framing (compat.WIRE_VERSION)
     ):
         from ..ops.codec_np import _layout, flatten_np
 
@@ -199,6 +205,7 @@ class EngineTensor:
             quarantine_send_failures,
             ack_timeout_sec,
             ack_retry_limit,
+            1 if trace_wire else 0,
         )
         if not self._h:
             raise RuntimeError("st_engine_create failed")
@@ -435,14 +442,29 @@ class EngineTensor:
         Layout (st_engine_counters): [frames_out, frames_in, updates,
         msgs_out, msgs_in, tx_slot_acquires, tx_slot_alloc_events,
         tx_slots_allocated, retx_msgs, dedup_discards, rtt_ns_total,
-        rtt_msgs] — [5..7] are the r07 tx-ring pool stats (steady state:
-        acquires grow, alloc_events stay flat); [8..11] the r08 obs
-        aggregates (go-back-N retransmits, dup/gap discards, ACK
-        round-trip ns sum + sample count)."""
-        out = np.zeros(12, np.uint64)
+        rtt_msgs, hops_sum, hops_msgs, staleness_ns_last, traced_msgs_in]
+        — [5..7] are the r07 tx-ring pool stats (steady state: acquires
+        grow, alloc_events stay flat); [8..11] the r08 obs aggregates
+        (go-back-N retransmits, dup/gap discards, ACK round-trip ns sum +
+        sample count); [12..15] the r09 trace aggregates (hop-count sum +
+        sample count, latest apply-time staleness ns, traced applied
+        messages)."""
+        out = np.zeros(16, np.uint64)
         if self._h:
             self._lib.st_engine_counters(self._h, out)
         return out
+
+    def link_obs(self, link_id: int) -> Optional[tuple[float, int]]:
+        """(staleness_seconds, hops) of the latest traced message applied
+        from this link, or None when the link is unknown / engine closed —
+        the r09 per-link convergence gauges (st_staleness_seconds{link=},
+        st_update_hops_last{link=})."""
+        if not self._h:
+            return None
+        out = np.zeros(2, np.uint64)
+        if not self._lib.st_engine_link_obs(self._h, link_id, out):
+            return None
+        return float(out[0]) / 1e9, int(out[1])
 
     def pool_stats(self) -> dict:
         """Tx-ring slot stats for metrics()/tests: zero per-message heap
@@ -456,16 +478,21 @@ class EngineTensor:
         }
 
     def obs_stats(self) -> dict:
-        """r08 delivery-observability aggregates (canonical names per
+        """r08/r09 observability aggregates (canonical names per
         obs/schema.py): go-back-N retransmitted messages, dup/gap discards
-        at the receive acceptance check, and the engine-tier ACK round
-        trip as a sum/count pair (the C hot path keeps no buckets)."""
+        at the receive acceptance check, the engine-tier ACK round trip as
+        a sum/count pair (the C hot path keeps no buckets), and the r09
+        trace aggregates — hop counts (sum/count, same discipline as the
+        RTT pair) and how many applied messages carried a trace stamp."""
         c = self._counters()
         return {
             "st_retransmit_msgs_total": int(c[8]),
             "st_dedup_discards_total": int(c[9]),
             "st_ack_rtt_seconds_sum": int(c[10]) / 1e9,
             "st_ack_rtt_seconds_count": int(c[11]),
+            "st_update_hops_sum": int(c[12]),
+            "st_update_hops_count": int(c[13]),
+            "st_traced_msgs_in_total": int(c[15]),
         }
 
     @property

@@ -85,6 +85,7 @@ class FaultPlan:
         on_crash: Optional[Callable[[str], None]] = None,
         scale_bytes: int = 0,
         wire_compat: bool = False,
+        trace_bytes: int = 0,
     ):
         if config.crash_point and config.crash_point not in CRASH_POINTS:
             raise ValueError(
@@ -98,6 +99,12 @@ class FaultPlan:
         #: geometry-blind flip could hit a later frame's scale exponent —
         #: unbounded chaos; see corrupt()). 0 = geometry unknown.
         self.scale_bytes = scale_bytes
+        #: Bytes of r09 trace context in the peer's DATA/BURST headers (13
+        #: on a v2-emitting peer, 0 on v1): corrupt() must skip them too —
+        #: a flip in the origin_ns field would only garble telemetry, but
+        #: one in a scale EXPONENT rescales a whole frame by up to 2^127
+        #: (the unbounded class this injector exists to avoid).
+        self.trace_bytes = trace_bytes
         #: Wire-compat links carry the reference's fixed-size raw frames:
         #: no seqs, no ACKs, no retransmission. Truncation would shear the
         #: fixed-size re-framing (every later frame misparsed) and a
@@ -167,7 +174,7 @@ class FaultPlan:
             ):
                 self.counts["corrupted"] += 1
                 self._event("fault_corrupt", link, n)
-                out = corrupt(out, r, self.scale_bytes)
+                out = corrupt(out, r, self.scale_bytes, self.trace_bytes)
             if (
                 cfg.truncate_pct > 0
                 and not self.wire_compat  # would shear the fixed framing
@@ -218,14 +225,16 @@ class FaultPlan:
 
 
 def corrupt(
-    payload: bytes, rng: random.Random, scale_bytes: int = 0
+    payload: bytes, rng: random.Random, scale_bytes: int = 0,
+    trace_bytes: int = 0,
 ) -> bytes:
     """Flip one random bit in the packed SIGN WORDS of one frame: past the
-    kind byte (the message still routes as DATA/BURST) and past every
-    scale prefix. A flipped sign bit mis-applies one element by 2*scale —
-    bounded, which is what lets the chaos soak assert
-    convergence-within-bound. A flipped scale-EXPONENT bit would instead
-    multiply a whole frame's mass by up to 2^127 while remaining
+    kind byte (the message still routes as DATA/BURST), past the r09
+    trace context when the emitter stamps one (``trace_bytes`` = 13 on a
+    v2 peer), and past every scale prefix. A flipped sign bit mis-applies
+    one element by 2*scale — bounded, which is what lets the chaos soak
+    assert convergence-within-bound. A flipped scale-EXPONENT bit would
+    instead multiply a whole frame's mass by up to 2^127 while remaining
     protocol-legal (finite scales up to 2^127 are inside the wire's trust
     domain — see wire.decode_frame), i.e. chaos no recovery path can
     bound; the codec has no scale authentication by design. Bursts
@@ -236,14 +245,18 @@ def corrupt(
     sign words for single-frame DATA, best-effort otherwise."""
     b = bytearray(payload)
     lo, hi = 0, 0
-    if scale_bytes > 0 and b[0] == 0 and len(b) > 5 + scale_bytes:
-        lo, hi = 5 + scale_bytes, len(b)  # DATA: one frame after the seq
-    elif scale_bytes > 0 and b[0] == 7 and len(b) > 6:
+    data_hdr = 5 + trace_bytes  # [kind][u32 seq][trace?]
+    burst_hdr = 6 + trace_bytes  # [kind][u32 seq][u8 k][trace?]
+    if scale_bytes > 0 and b[0] == 0 and len(b) > data_hdr + scale_bytes:
+        # DATA: one frame after the header
+        lo, hi = data_hdr + scale_bytes, len(b)
+    elif scale_bytes > 0 and b[0] == 7 and len(b) > burst_hdr:
         k = b[5]
-        per = (len(b) - 6) // k if k else 0
-        if k and per > scale_bytes and 6 + k * per == len(b):
+        per = (len(b) - burst_hdr) // k if k else 0
+        if k and per > scale_bytes and burst_hdr + k * per == len(b):
             f = rng.randrange(k)  # one frame's words span
-            lo, hi = 6 + f * per + scale_bytes, 6 + (f + 1) * per
+            lo = burst_hdr + f * per + scale_bytes
+            hi = burst_hdr + (f + 1) * per
     if not lo:
         lo, hi = max(1, len(b) // 4), len(b)
     i = rng.randrange(lo, hi)

@@ -33,6 +33,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import warnings
 from collections import deque
 from typing import Any, Optional
 
@@ -74,6 +75,12 @@ SEND_WINDOW = 32
 #: a short prefix repairs it without re-shipping the whole window's bytes
 #: every round. Ditto in the native engine.
 RETX_PREFIX = 4
+
+#: Once-per-process latch for the legacy ``peer.metrics()`` deprecation
+#: warning (r09 satellite: the r08 alias keys were kept "for one release";
+#: this release says so out loud). Races on the flag are benign — worst
+#: case the warning fires twice.
+_legacy_metrics_warned = False
 
 
 def _python_tier_auto_burst(spec) -> int:
@@ -118,7 +125,9 @@ class _PeerObs:
         # never-incremented instrument under the same name would shadow
         # the collector's real value in every snapshot/scrape (instrument
         # values take precedence), reporting 0 while a link black-holes.
-        self.retransmits = self.dedup = None
+        # Ditto the r09 st_update_hops histogram: the engine tier exports
+        # sum/count through the widened counters ABI instead.
+        self.retransmits = self.dedup = self.hops = None
         if peer._engine is None:
             self.retransmits = self.registry.counter(
                 "st_retransmit_msgs_total",
@@ -128,6 +137,25 @@ class _PeerObs:
                 "st_dedup_discards_total",
                 help="duplicate/out-of-order data messages discarded unapplied",
             )
+            self.hops = self.registry.histogram(
+                "st_update_hops",
+                buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32),
+                help="tree hops traversed by applied traced updates",
+            )
+        # r09 in-band digest plumbing (python-side on BOTH tiers: digests
+        # ride the control plane, never the C data path)
+        self.digest_out = self.registry.counter(
+            "st_digest_sends_total",
+            help="cluster metrics digests sent up the tree",
+        )
+        self.digest_in = self.registry.counter(
+            "st_digest_msgs_in_total",
+            help="cluster metrics digests received from subtree links",
+        )
+        self.cluster_nodes = self.registry.gauge(
+            "st_cluster_nodes",
+            help="nodes represented in the latest merged cluster digest",
+        )
         self.registry.register_collector(peer._obs_collect)
         self.label = f"peer-{peer.node.obs_id}"
         self.hub.register_registry(self.label, self.registry)
@@ -140,9 +168,11 @@ class _PeerObs:
 
     def event(
         self, name: str, node: int = 0, link: int = 0, arg: int = 0,
-        detail: str = "",
+        detail: str = "", extra: int = 0,
     ) -> None:
-        self.hub.emit(name, node=node, link=link, arg=arg, detail=detail)
+        self.hub.emit(
+            name, node=node, link=link, arg=arg, detail=detail, extra=extra
+        )
 
     def close(self) -> None:
         self.registry.stop_jsonl_sink()
@@ -177,23 +207,58 @@ class SharedTensorPeer:
         codec = self.config.codec
         tcfg = self.config.transport
         spec = make_spec(template)
+        # r09 cross-hop trace propagation: which DATA/BURST framing this
+        # peer EMITS (compat.WIRE_VERSION; decoders accept both). Lazy
+        # import — compat.py imports this module at its top level. Decided
+        # BEFORE the fault plan: corrupt()'s bounded-flip geometry must
+        # skip the v2 trace bytes too.
+        from ..compat import wire_protocol_version
+
+        self._wire_version = (
+            1 if tcfg.wire_compat else wire_protocol_version(self.config)
+        )
+        self._trace_wire = self._wire_version >= 2
         # Python-tier fault injection (Config.faults): consulted at the
         # send boundary and at named protocol points. None when disabled —
         # the production path pays one None-check per send. The NATIVE data
         # planes (transport sender loop, engine) read the same schedule
         # from the ST_FAULT_PLAN/ST_FAULT_CRASH env table instead
-        # (faults.to_env), parsed at node-create time. scale_bytes hands
-        # the plan the frame geometry so corrupt() flips land in sign
-        # words, not scale exponents (the bounded fault class).
+        # (faults.to_env), parsed at node-create time. scale_bytes/
+        # trace_bytes hand the plan the frame geometry so corrupt() flips
+        # land in sign words, not scale exponents or trace fields (the
+        # bounded fault class).
         self._faults: Optional[faults.FaultPlan] = (
             faults.FaultPlan(
                 self.config.faults,
                 scale_bytes=4 * spec.num_leaves,
                 wire_compat=tcfg.wire_compat,
+                trace_bytes=wire.TRACE_BYTES if self._trace_wire else 0,
             )
             if self.config.faults.enabled
             else None
         )
+        # pending trace stamp (origin node, origin monotonic ns, hops):
+        # re-seeded by add(), advanced at every traced apply; read by the
+        # send path when stamping outgoing messages. Tuple assignment —
+        # atomic under the GIL, no lock on the hot path.
+        self._trace_stamp: Optional[tuple[int, int, int]] = None
+        # per-link (staleness_seconds, hops) of the latest traced apply
+        # (python tier; the engine tier serves st_engine_link_obs instead)
+        self._staleness: dict[int, tuple[float, int]] = {}
+        self._traced_in = 0
+        # r09 in-band digest aggregation: each child link's latest digest
+        # (replaced wholesale per arrival; merged on demand)
+        self._child_digests: dict[int, dict] = {}
+        # digests ride the native control plane AND presume an r09 peer on
+        # the other end: a peer pinned to v1 emission (ST_WIRE_TRACE=0 —
+        # the join-a-pre-r09-tree escape hatch) must not spray kind-8
+        # messages a pre-r09 parent would log as unknown every beat
+        self._digest_interval = (
+            0.0
+            if tcfg.wire_compat or self._wire_version < 2
+            else self.config.obs.digest_interval_sec
+        )
+        self._digest_last = 0.0
         from ..core import host_tier_active
 
         # Burst sizing (Config.frame_burst): host tier only — the device
@@ -313,6 +378,7 @@ class SharedTensorPeer:
                     quarantine_send_failures=tcfg.quarantine_send_failures,
                     ack_timeout_sec=tcfg.ack_timeout_sec,
                     ack_retry_limit=tcfg.ack_retry_limit,
+                    trace_wire=self._trace_wire,
                 )
                 self._engine = self.st
                 # Vacuous-chaos guard: Config.faults WIRE knobs inject in
@@ -399,10 +465,12 @@ class SharedTensorPeer:
         self._tx_pool: Optional[wire.FramePool] = None
         if not tcfg.wire_compat:
             per = wire.frame_payload_bytes(spec)
+            # slots sized for the v2 (traced) headers either way — 13
+            # bytes of slack on a v1 peer, never an overrun on a v2 one
             self._tx_pool = wire.FramePool(
                 max(
-                    wire.DATA_HDR + per,
-                    wire.BURST_HDR
+                    wire.DATA_HDR_T + per,
+                    wire.BURST_HDR_T
                     + max(self._burst, self._burst_device, 1) * per,
                 ),
                 keep=max(1, int(self.config.frame_pool_keep)),
@@ -450,6 +518,10 @@ class SharedTensorPeer:
         locally at once and streams to every peer asynchronously (reference
         addFromTensor)."""
         self.st.add(delta)
+        if self._trace_wire and self._engine is None:
+            # a local update is a fresh generation: re-seed the pending
+            # trace stamp (the engine tier stamps inside st_engine_add)
+            self._trace_stamp = (self.node.obs_id, time.monotonic_ns(), 0)
         self._wake.set()
 
     def wait_ready(self, timeout: float = 30.0) -> None:
@@ -552,13 +624,47 @@ class SharedTensorPeer:
         """Registry collector: the canonical-schema view of everything this
         peer can report that is not a live histogram — sampled once per
         snapshot/scrape (obs/schema.py is the name authority)."""
-        out = _schema.canonicalize(self.metrics())
+        import math
+
+        out = _schema.canonicalize(self.metrics(_warn=False))
         if self._engine is not None:
             out.update(self._engine.obs_stats())
         out["st_corrupt_scales_zeroed_total"] = wire.corrupt_scales_zeroed()
         from ..obs import events as _events
 
         out["st_obs_events_dropped_total"] = _events.native_dropped()
+        # r09 convergence telemetry. st_residual_norm: the L2 norm over
+        # EVERY error-feedback residual (carry slot included — that is
+        # owed mass too), derived from the per-link RMS both tiers already
+        # serve: norm^2 = sum(rms_l^2 * n). 0 = quiesced, nothing owed.
+        # The python tier's link_ids lists the carry pseudo-slot itself;
+        # the engine keeps its carry outside the link map, so query it
+        # explicitly (st_engine_residual_rms answers -1 with the carry).
+        ss = 0.0
+        n = self.st.spec.total_n
+        links = list(self.st.link_ids)
+        if self._engine is not None:
+            links.append(CARRY_LINK)
+        for link in links:
+            rms = self.st.residual_rms(link)
+            ss += rms * rms * n
+        out["st_residual_norm"] = math.sqrt(ss)
+        # per-link staleness/hops of the latest traced apply: the engine
+        # tier serves them over the st_engine_link_obs ABI; the python
+        # tier records them at _note_trace time
+        if self._engine is not None:
+            for link in self.st.link_ids:
+                if link < 0:
+                    continue
+                lo = self._engine.link_obs(link)
+                if lo is not None and lo[1] > 0:
+                    out[_schema.link_key("st_staleness_seconds", link)] = lo[0]
+                    out[_schema.link_key("st_update_hops_last", link)] = lo[1]
+        else:
+            for link, (sec, hop) in list(self._staleness.items()):
+                out[_schema.link_key("st_staleness_seconds", link)] = sec
+                out[_schema.link_key("st_update_hops_last", link)] = hop
+            out["st_traced_msgs_in_total"] = self._traced_in
         for link in self.node.links:
             s = self.node.stats(link)
             if s is not None:
@@ -566,14 +672,22 @@ class SharedTensorPeer:
                 out[_schema.link_key("st_link_recv_queue", link)] = s.recv_queue
         return out
 
-    def metrics(self, canonical: bool = False) -> dict:
+    def metrics(
+        self, canonical: bool = False, cluster: bool = False,
+        _warn: bool = True,
+    ) -> dict:
         """Observability the reference entirely lacks (SURVEY.md §5.5).
 
         ``canonical=True`` returns the r08 flat canonical-schema view
         (obs/schema.py): every key below plus the engine delivery
-        aggregates and queue-depth gauges, under ``st_*`` names. The
-        legacy nested shape below remains the DEPRECATED alias surface for
-        one release (schema.DEPRECATED_ALIASES documents the mapping).
+        aggregates and queue-depth gauges, under ``st_*`` names.
+        ``cluster=True`` (r09) returns the merged WHOLE-TREE digest from
+        this node's vantage — own registry + every subtree digest
+        (obs/aggregate.py); at the root that is the cluster. The legacy
+        nested shape below was kept "for one release" in r08 and now
+        emits a DeprecationWarning once per process — move to
+        ``canonical=True`` (byte-equal values under the documented alias
+        mapping, schema.DEPRECATED_ALIASES).
 
         Counter taxonomy (ONE definition per number, reconcilable across
         layers — round-3 verdict Weak #6):
@@ -601,6 +715,8 @@ class SharedTensorPeer:
           RECEIVE-side wire count includes idle-period keepalives there
           (the send side still excludes them).
         """
+        if cluster:
+            return self.cluster_metrics()
         if canonical:
             # the registry snapshot merges the collector (this peer's
             # sampled counters) with the LIVE instruments (histograms,
@@ -609,6 +725,17 @@ class SharedTensorPeer:
             if self._obs is not None:
                 return self._obs.registry.snapshot()
             return self._obs_collect()
+        if _warn:
+            global _legacy_metrics_warned
+            if not _legacy_metrics_warned:
+                _legacy_metrics_warned = True
+                warnings.warn(
+                    "the nested peer.metrics() shape is a deprecated alias "
+                    "surface (r08); use metrics(canonical=True) — values "
+                    "are byte-equal under schema.DEPRECATED_ALIASES",
+                    DeprecationWarning,
+                    stacklevel=2,
+                )
         if self._engine is not None:
             # ONE snapshot for every engine counter: separate reads would
             # mix instants and could show e.g. msgs_in > frames_in mid-run
@@ -740,8 +867,8 @@ class SharedTensorPeer:
                     payload = self._register_data(
                         link,
                         seq,
-                        lambda buf, s: wire.encode_burst_into(
-                            burst, self.st.spec, s, buf
+                        lambda buf, s, t: wire.encode_burst_into(
+                            burst, self.st.spec, s, buf, trace=t
                         ),
                     )
                     # crash point: frames ledgered + error feedback applied,
@@ -820,15 +947,17 @@ class SharedTensorPeer:
                     payload = self._register_data(
                         link,
                         seq,
-                        lambda buf, s: wire.encode_burst_into(
-                            frame, self.st.spec, s, buf
+                        lambda buf, s, t: wire.encode_burst_into(
+                            frame, self.st.spec, s, buf, trace=t
                         ),
                     )
                 else:
                     payload = self._register_data(
                         link,
                         seq,
-                        lambda buf, s: wire.encode_frame_into(frame, s, buf),
+                        lambda buf, s, t: wire.encode_frame_into(
+                            frame, s, buf, trace=t
+                        ),
                     )
                 self._fault_point("mid-burst")  # ledgered, not yet sent
                 if self._send_blocking(link, payload, data=True):
@@ -855,8 +984,9 @@ class SharedTensorPeer:
 
     def _register_data(self, link: int, ledger_seq: int, encode_into):
         """Allocate the link's next wire seq, encode the outgoing DATA/BURST
-        message with it INTO a pooled slot (r07: ``encode_into(buf, seq)``
-        writes the wire bytes in place and returns the length), and append
+        message with it INTO a pooled slot (r07/r09: ``encode_into(buf,
+        seq, trace)`` writes the wire bytes — v2-framed when ``trace`` is
+        set — in place and returns the length), and append
         (ledger_seq, wire_seq, payload, slot) to the unacked retransmission
         ledger — the slot's filled prefix IS the payload, kept verbatim so
         a delivery timeout can resend it byte-identical (go-back-N; wire.py
@@ -875,9 +1005,16 @@ class SharedTensorPeer:
         with self._ack_mu:
             txs = self._tx_seq.get(link, 0) + 1
             self._tx_seq[link] = txs
+        # r09 trace context: the pending stamp (latest local add or traced
+        # apply); a peer that has neither yet stamps itself at hop 0
+        trace = None
+        if self._trace_wire:
+            trace = self._trace_stamp
+            if trace is None:
+                trace = (self.node.obs_id, time.monotonic_ns(), 0)
         slot = self._tx_pool.acquire()
         t0 = time.monotonic()
-        n = encode_into(slot, txs)
+        n = encode_into(slot, txs, trace)
         if obs is not None:
             obs.encode.observe(time.monotonic() - t0)
         payload = slot[:n]
@@ -1105,6 +1242,28 @@ class SharedTensorPeer:
                 # the peer's own thread (never a background thread racing
                 # node teardown); rate-limited inside poll_native
                 self._obs.hub.poll_native(self._obs.drain_interval)
+            if self._digest_interval > 0 and self._obs is not None:
+                # r09 in-band aggregation: piggyback this subtree's merged
+                # metrics digest up the tree (or, at the root, publish the
+                # whole-tree view) once per interval — control-plane
+                # traffic on the peer's own housekeeping thread. Gated on
+                # obs like everything else: ST_OBS=0 / ObsConfig.enabled
+                # =False means NO periodic snapshot/JSON/wire work (the
+                # explicit metrics(cluster=True) call still serves).
+                now = time.monotonic()
+                if now - self._digest_last >= self._digest_interval and (
+                    self._uplink is not None
+                    or self.config.obs.cluster_json_path
+                ):
+                    # a root with no JSON sink has nobody to publish TO —
+                    # its cluster view is built on demand
+                    # (metrics(cluster=True)); don't pay the snapshot per
+                    # beat just to discard it
+                    self._digest_last = now
+                    try:
+                        self._publish_digest()
+                    except Exception as e:
+                        log.debug("digest publish failed: %s", e)
             busy = self._handle_events()
             if (
                 compat
@@ -1142,8 +1301,12 @@ class SharedTensorPeer:
                 # backs up by hundreds of frames. Control messages flush the
                 # batch first so relative order is preserved. ``msgs`` counts
                 # wire MESSAGES (what the sender's ledger tracks and ACKs
-                # acknowledge); a burst message carries many frames.
+                # acknowledge); a burst message carries many frames. Trace
+                # notes are buffered and recorded AFTER the flush applies
+                # (same accounting instant as the native receiver) —
+                # telemetry must not claim a hop whose batch then failed.
                 batch: list = []
+                traced: list = []
                 msgs = 0
                 # host tier only: its applies are synchronous numpy/C work,
                 # so recycling after the flush cannot race anything. A
@@ -1224,6 +1387,7 @@ class SharedTensorPeer:
                                     )
                                 )
                             msgs += 1
+                            traced.append(payload)
                             continue
                     except Exception as e:  # a bad frame must not kill the node
                         log.warning("dropping bad frame on link %d: %s", link, e)
@@ -1232,7 +1396,9 @@ class SharedTensorPeer:
                     # never let a flush failure swallow the control message —
                     # a dropped WELCOME/DONE would hang the join handshake
                     self._flush_frames(link, batch, msgs, scratch)
-                    batch, msgs = [], 0
+                    for p in traced:
+                        self._note_trace(link, p)
+                    batch, traced, msgs = [], [], 0
                     try:
                         self._on_message(link, payload)
                     except Exception as e:
@@ -1244,6 +1410,8 @@ class SharedTensorPeer:
                         # the attach-time count)
                         break
                 self._flush_frames(link, batch, msgs, scratch)
+                for p in traced:
+                    self._note_trace(link, p)
                 self._flush_acks(link)  # retry any backpressure-dropped ACK
             if not busy:
                 time.sleep(0.002)
@@ -1290,6 +1458,121 @@ class SharedTensorPeer:
         # retransmitted by their sender.
         if n_ack:
             self._ack_received(link, n_ack)
+
+    def _note_trace(self, link: int, payload: bytes) -> None:
+        """r09 trace bookkeeping for one ACCEPTED data message (python
+        tier; the engine's receiver does the same in C): advance the
+        pending stamp one hop, record the link's staleness/hop gauges, and
+        put a trace_apply record on the timeline. Telemetry gates on obs
+        exactly like the native twin (st_obs_is_enabled in stengine.cpp's
+        receiver) — with obs off only the stamp advance remains, the part
+        PROPAGATION needs."""
+        obs = self._obs
+        if obs is None and not self._trace_wire:
+            return
+        tr = wire.data_trace(payload, self.st.spec)
+        if tr is None:
+            return
+        origin, gen, hops = tr
+        hop = min(hops + 1, 255)
+        if self._trace_wire:
+            self._trace_stamp = (origin, gen, hop)
+        if obs is None:
+            return
+        now_ns = time.monotonic_ns()
+        self._staleness[link] = (
+            (now_ns - gen) / 1e9 if now_ns > gen else 0.0,
+            hop,
+        )
+        self._traced_in += 1
+        if obs.hops is not None:
+            obs.hops.observe(hop)
+        obs.event(
+            "trace_apply", self.node.obs_id, link, gen,
+            extra=((origin << 8) | hop),
+        )
+
+    # -- r09 in-band cluster digest -----------------------------------------
+
+    def _build_digest(self) -> dict:
+        """This subtree's merged metrics digest: our own registry snapshot
+        folded with each child link's latest digest (obs/aggregate.py owns
+        the merge semantics; subtree disjointness makes counter sums
+        exact). Bounded before it ever hits the wire."""
+        from ..obs import aggregate
+
+        doc = aggregate.from_snapshot(
+            self.node.obs_id,
+            self.metrics(canonical=True),
+            time.monotonic_ns(),
+        )
+        for child in list(self._child_digests.values()):
+            aggregate.merge(doc, child)
+        aggregate.bounded(doc)
+        if self._obs is not None:
+            self._obs.cluster_nodes.set(aggregate.cluster_nodes(doc))
+        return doc
+
+    def _publish_digest(self) -> dict:
+        """One digest beat: send the subtree digest to the uplink, or —
+        at the root — write the whole-tree view to
+        ObsConfig.cluster_json_path for ``obs.top``. Lossy by design
+        (backpressure skips a beat; the next one carries fresher
+        totals)."""
+        doc = self._build_digest()
+        up = self._uplink
+        if up is not None:
+            try:
+                # small blocking budget, NOT 0: a saturated data plane (the
+                # normal state of a training run — the engine keeps the
+                # 8-deep transport queue full) would bounce every
+                # zero-timeout enqueue and the tree view would silently go
+                # stale exactly when it matters; 50 ms is one queue-drain
+                # on any healthy link, paid on the housekeeping thread. A
+                # beat that still bounces is dropped — the next one
+                # carries fresher totals anyway.
+                if (
+                    self.node.send(up, wire.encode_digest(doc), timeout=0.05)
+                    and self._obs is not None
+                ):
+                    self._obs.digest_out.inc()
+            except BrokenPipeError:
+                pass  # uplink died; LINK_DOWN will re-route the next beat
+        elif self.config.obs.cluster_json_path:
+            import json as _json
+            import os as _os
+
+            path = self.config.obs.cluster_json_path
+            tmp = f"{path}.tmp.{_os.getpid()}"
+            try:
+                with open(tmp, "w") as f:
+                    _json.dump(doc, f)
+                    f.write("\n")
+                _os.replace(tmp, path)  # atomic: top never reads a torn file
+            except OSError as e:
+                log.debug("cluster digest write failed: %s", e)
+        return doc
+
+    def push_digest(self) -> dict:
+        """Force one digest beat NOW (the periodic timer keeps running).
+        Tests and quiesce-time accounting use this to propagate exact
+        totals bottom-up instead of waiting out the interval."""
+        self._digest_last = time.monotonic()
+        return self._publish_digest()
+
+    def cluster_metrics(self) -> dict:
+        """The live whole-tree view from this node's vantage: its own
+        registry + every digest its subtree has reported. At the tree ROOT
+        this is the cluster — ``metrics(cluster=True)`` is the documented
+        spelling."""
+        return self._build_digest()
+
+    def cluster_prometheus_text(self) -> str:
+        """Prometheus text exposition of the cluster digest (merged
+        counters/histograms; per-node gauges labeled ``{node=}``)."""
+        from ..obs import aggregate
+
+        return aggregate.prometheus_text(self._build_digest())
 
     def _ack_received(self, link: int, n: int) -> None:
         """Tell the sender its frames arrived (drives its in-flight ledger;
@@ -1450,6 +1733,8 @@ class SharedTensorPeer:
             self._pending.pop(ev.link_id, None)
             self._engine_links.discard(ev.link_id)
             self._rx_scratch.pop(ev.link_id, None)
+            self._staleness.pop(ev.link_id, None)
+            self._child_digests.pop(ev.link_id, None)
             with self._ack_mu:
                 purged = self._unacked.pop(ev.link_id, ())
                 self._tx_seq.pop(ev.link_id, None)
@@ -1582,7 +1867,9 @@ class SharedTensorPeer:
             # values_now - sent_snapshot, which is exactly carry + whatever
             # lands during the handshake (the live slot keeps absorbing)
         self._sent_snapshot = snap
-        self._send_blocking(uplink, wire.encode_sync(self.st.spec))
+        self._send_blocking(
+            uplink, wire.encode_sync(self.st.spec, self._wire_version)
+        )
         # crash point: SYNC sent, snapshot not — the parent holds a pending
         # handshake buffer for a child that just died mid-walk
         self._fault_point("mid-join-walk")
@@ -1629,6 +1916,15 @@ class SharedTensorPeer:
                 self.st.ack_frame(link, entry[0])
         elif kind == wire.SYNC:
             k, n, digest = wire.decode_sync(payload)
+            ver = wire.sync_wire_version(payload)
+            if ver != self._wire_version:
+                # framing skew is fine (decoders accept both) but worth a
+                # line: a tree stuck on v1 emission has no trace telemetry
+                log.info(
+                    "link %d joins with wire framing v%d (ours: v%d) — "
+                    "interop ok; trace coverage follows the emitter",
+                    link, ver, self._wire_version,
+                )
             mine = self.st.spec
             if digest != mine.layout_digest():
                 log.warning(
@@ -1681,6 +1977,14 @@ class SharedTensorPeer:
                 self._attach_zero(link)
             self._ready.set()
             self._wake.set()
+        elif kind == wire.DIGEST:
+            # r09 in-band aggregation: a subtree's bounded metrics digest.
+            # Latest-wins per link; merged lazily at the next build. Engine
+            # links route here too (the C receiver defers every non-data
+            # kind to poll_ctrl).
+            self._child_digests[link] = wire.decode_digest(payload)
+            if self._obs is not None:
+                self._obs.digest_in.inc()
         elif kind == wire.REJECT:
             self._error = SpecMismatch(wire.decode_reject(payload))
             self._ready.set()  # unblock wait_ready, which re-raises

@@ -103,6 +103,7 @@ WELCOME = 4  # parent -> child: accepted, streaming begins
 REJECT = 5  # parent -> child: spec mismatch, reason attached
 ACK = 6  # cumulative count of DATA/BURST messages received on this link
 BURST = 7  # K codec frames in one message (host tier, small tables)
+DIGEST = 8  # child -> parent: r09 in-band cluster metrics digest (JSON)
 
 _SYNC_FMT = "<IQ16s"  # num_leaves, total_n, layout digest
 _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
@@ -134,15 +135,36 @@ BURST_MAX_BYTES = 1 << 24
 
 
 #: Wire overhead of a DATA message before the frame body: kind byte +
-#: u32 tx_seq. BURST adds one more byte (the frame count).
+#: u32 tx_seq. BURST adds one more byte (the frame count). These are the
+#: v1 (r08) headers; the v2 (r09) framing appends a TRACE_BYTES-long trace
+#: context — origin node id (u32 LE), origin monotonic ns (u64 LE), hop
+#: count (u8) — giving every update generation a causal provenance that
+#: survives the tree walk (each hop re-stamps hops+1; obs/trace_export.py
+#: reconstructs full paths from the per-hop apply events). Decoders accept
+#: BOTH sizes — the frame body is a multiple of 4 bytes and the trace adds
+#: 13, so message length disambiguates the version and mixed-version trees
+#: interop (compat.py WIRE_VERSION documents the gate; ObsConfig.trace_wire
+#: / ST_WIRE_TRACE=0 pins a peer to v1 emission).
 DATA_HDR = 5
 BURST_HDR = 6
+TRACE_BYTES = 13
+DATA_HDR_T = DATA_HDR + TRACE_BYTES  # 18
+BURST_HDR_T = BURST_HDR + TRACE_BYTES  # 19
+_TRACE_FMT = "<IQB"  # origin node id, origin monotonic ns, hop count
+
+#: Hard cap on one DIGEST message's JSON body. The digest is BOUNDED by
+#: construction (obs/aggregate.py truncates per-node breakdowns past its
+#: node cap), and every peer's receive buffer is sized to carry at least
+#: this much (frame_wire_bytes below).
+DIGEST_MAX_BYTES = 1 << 16
 
 
 def burst_frames_cap(spec: TableSpec) -> int:
-    """Most frames one BURST message may carry for this spec (>= 1)."""
+    """Most frames one BURST message may carry for this spec (>= 1).
+    Sized against the v2 header so a traced burst never exceeds the
+    receive-buffer bound either way."""
     per = frame_payload_bytes(spec)
-    return max(1, min(BURST_MAX_FRAMES, (BURST_MAX_BYTES - BURST_HDR) // per))
+    return max(1, min(BURST_MAX_FRAMES, (BURST_MAX_BYTES - BURST_HDR_T) // per))
 
 
 def compat_burst_frames_cap(n: int) -> int:
@@ -162,15 +184,20 @@ def frame_payload_bytes(spec: TableSpec) -> int:
 
 
 def burst_wire_bytes(spec: TableSpec) -> int:
-    """Max BURST message size for this spec."""
-    return BURST_HDR + burst_frames_cap(spec) * frame_payload_bytes(spec)
+    """Max BURST message size for this spec — v2 (traced) header: this
+    feeds every receive-buffer bound, and 13 bytes short means a full
+    traced burst is silently truncated at the transport, rejected as
+    undecodable without consuming its seq, and retransmitted identically
+    until go-back-N black-holes the link."""
+    return BURST_HDR_T + burst_frames_cap(spec) * frame_payload_bytes(spec)
 
 
 def frame_wire_bytes(spec: TableSpec) -> int:
-    """Max payload size of any native-mode message for this spec."""
-    data = DATA_HDR + frame_payload_bytes(spec)
+    """Max payload size of any native-mode message for this spec (covers
+    the v2 trace headers and the bounded DIGEST control message)."""
+    data = DATA_HDR_T + frame_payload_bytes(spec)
     chunk = 1 + struct.calcsize(_CHUNK_HDR) + CHUNK_BYTES
-    return max(data, chunk, burst_wire_bytes(spec))
+    return max(data, chunk, burst_wire_bytes(spec), 1 + DIGEST_MAX_BYTES)
 
 
 def data_seq(payload: bytes) -> int:
@@ -180,6 +207,26 @@ def data_seq(payload: bytes) -> int:
             f"{len(payload)}-byte data message is too short to carry a seq"
         )
     return struct.unpack_from("<I", payload, 1)[0]
+
+
+def data_trace(
+    payload: bytes, spec: TableSpec
+) -> Optional[tuple[int, int, int]]:
+    """The (origin_node, origin_ns, hops) trace context of a DATA/BURST
+    payload, or None for v1 (untraced) framing. Version detection is by
+    exact length — see the header-constant docstring."""
+    per = frame_payload_bytes(spec)
+    n = len(payload)
+    if not payload:
+        return None
+    if payload[0] == DATA:
+        if n == DATA_HDR_T + per:
+            return struct.unpack_from(_TRACE_FMT, payload, DATA_HDR)
+    elif payload[0] == BURST and n > BURST_HDR_T:
+        k = payload[BURST_HDR - 1]
+        if k and n == BURST_HDR_T + k * per:
+            return struct.unpack_from(_TRACE_FMT, payload, BURST_HDR)
+    return None
 
 
 class FramePool:
@@ -250,22 +297,47 @@ def _write_frame_body(buf: memoryview, off: int, frame: TableFrame) -> int:
     return off + sb + wb
 
 
-def encode_frame_into(frame: TableFrame, seq: int, buf: memoryview) -> int:
+def _clamp_trace(trace) -> tuple[int, int, int]:
+    """The ONE place the trace stamp's field clamping lives: origin and
+    generation wrap to their wire widths, hops saturate at 255."""
+    origin, gen, hops = trace
+    return (
+        origin & 0xFFFFFFFF,
+        gen & 0xFFFFFFFFFFFFFFFF,
+        min(int(hops), 255),
+    )
+
+
+def _pack_trace(buf: memoryview, off: int, trace) -> int:
+    """Write the 13-byte trace context at ``off``; returns the new
+    offset."""
+    struct.pack_into(_TRACE_FMT, buf, off, *_clamp_trace(trace))
+    return off + TRACE_BYTES
+
+
+def encode_frame_into(
+    frame: TableFrame, seq: int, buf: memoryview, trace=None
+) -> int:
     """encode_frame writing into a pooled slot (FramePool) instead of
     building bytes: header + scales + sign words land at their final wire
     offsets, and the filled prefix doubles as the ledger's byte-identical
-    retransmission payload. Returns the message length."""
+    retransmission payload. ``trace`` = (origin, origin_ns, hops) selects
+    the v2 framing (r09 trace context); None keeps the v1 bytes untouched.
+    Returns the message length."""
     buf[0] = DATA
     struct.pack_into("<I", buf, 1, seq & 0xFFFFFFFF)
-    return _write_frame_body(buf, DATA_HDR, frame)
+    off = DATA_HDR if trace is None else _pack_trace(buf, DATA_HDR, trace)
+    return _write_frame_body(buf, off, frame)
 
 
-def encode_frame(frame: TableFrame, seq: int) -> bytes:
+def encode_frame(frame: TableFrame, seq: int, trace=None) -> bytes:
     scales = np.asarray(frame.scales, dtype="<f4")
     words = np.asarray(frame.words, dtype="<u4")
+    th = b"" if trace is None else struct.pack(_TRACE_FMT, *_clamp_trace(trace))
     return (
         bytes([DATA])
         + struct.pack("<I", seq & 0xFFFFFFFF)
+        + th
         + scales.tobytes()
         + words.tobytes()
     )
@@ -297,48 +369,59 @@ def decode_frame(
     so steady-state decode allocates nothing per frame."""
     k = spec.num_leaves
     w = spec.total // 32
-    want = DATA_HDR + frame_payload_bytes(spec)
-    if len(payload) != want:
+    per = frame_payload_bytes(spec)
+    # v1 or v2 framing by exact length (the trace context adds 13 bytes to
+    # a 4-multiple body — unambiguous); the trace itself is read separately
+    # via data_trace, so the decode stays format-agnostic
+    if len(payload) == DATA_HDR + per:
+        off = DATA_HDR
+    elif len(payload) == DATA_HDR_T + per:
+        off = DATA_HDR_T
+    else:
         raise ValueError(
-            f"DATA frame is {len(payload)} bytes, spec wants {want} "
+            f"DATA frame is {len(payload)} bytes, spec wants "
+            f"{DATA_HDR + per} or {DATA_HDR_T + per} "
             f"(k={k}, words={w}) — peer table layout mismatch"
         )
-    return _decode_one_frame(payload, DATA_HDR, spec, scratch)
+    return _decode_one_frame(payload, off, spec, scratch)
 
 
-def encode_burst(frames, spec: TableSpec, seq: int) -> bytes:
-    """K frames in one message: [BURST][u32 seq][u8 k][k x (scales||words)].
-    Successive frames of one link are successive halvings of its residual;
-    shipping them together amortizes the per-message engine cost that
-    dominates at small table sizes (see Config.frame_burst)."""
+def encode_burst(frames, spec: TableSpec, seq: int, trace=None) -> bytes:
+    """K frames in one message: [BURST][u32 seq][u8 k][trace?][k x
+    (scales||words)]. Successive frames of one link are successive halvings
+    of its residual; shipping them together amortizes the per-message
+    engine cost that dominates at small table sizes (see
+    Config.frame_burst). ``trace`` selects the v2 framing (one context per
+    MESSAGE — the burst is one ledger entry, one delivery, one hop)."""
     cap = burst_frames_cap(spec)
     if not 1 <= len(frames) <= cap:
         raise ValueError(
             f"burst of {len(frames)} frames (this spec allows 1..{cap} — "
             f"the bound peers sized their receive buffers for)"
         )
-    parts = [
-        bytes([BURST])
-        + struct.pack("<I", seq & 0xFFFFFFFF)
-        + bytes([len(frames)])
-    ]
+    hdr = bytes([BURST]) + struct.pack("<I", seq & 0xFFFFFFFF) + bytes(
+        [len(frames)]
+    )
+    if trace is not None:
+        hdr += struct.pack(_TRACE_FMT, *_clamp_trace(trace))
+    parts = [hdr]
     for f in frames:
         parts.append(np.asarray(f.scales, dtype="<f4").tobytes())
         parts.append(np.asarray(f.words, dtype="<u4").tobytes())
     out = b"".join(parts)
     # hard check, not assert (would vanish under python -O): an encoder that
     # emits a mis-sized burst silently desyncs every downstream decoder
-    if len(out) != BURST_HDR + len(frames) * frame_payload_bytes(spec):
+    want = len(hdr) + len(frames) * frame_payload_bytes(spec)
+    if len(out) != want:
         raise ValueError(
-            f"encoded burst is {len(out)} bytes, layout wants "
-            f"{BURST_HDR + len(frames) * frame_payload_bytes(spec)} — "
+            f"encoded burst is {len(out)} bytes, layout wants {want} — "
             f"frame/spec mismatch"
         )
     return out
 
 
 def encode_burst_into(
-    frames, spec: TableSpec, seq: int, buf: memoryview
+    frames, spec: TableSpec, seq: int, buf: memoryview, trace=None
 ) -> int:
     """encode_burst writing into a pooled slot (FramePool): same layout and
     the same hard size check, zero intermediate bytes objects. Returns the
@@ -352,15 +435,16 @@ def encode_burst_into(
     buf[0] = BURST
     struct.pack_into("<I", buf, 1, seq & 0xFFFFFFFF)
     buf[BURST_HDR - 1] = len(frames)
-    off = BURST_HDR
+    hdr = BURST_HDR if trace is None else _pack_trace(buf, BURST_HDR, trace)
+    off = hdr
     for f in frames:
         off = _write_frame_body(buf, off, f)
     # hard check, not assert (see encode_burst): a mis-sized burst silently
     # desyncs every downstream decoder
-    if off != BURST_HDR + len(frames) * frame_payload_bytes(spec):
+    if off != hdr + len(frames) * frame_payload_bytes(spec):
         raise ValueError(
             f"encoded burst is {off} bytes, layout wants "
-            f"{BURST_HDR + len(frames) * frame_payload_bytes(spec)} — "
+            f"{hdr + len(frames) * frame_payload_bytes(spec)} — "
             f"frame/spec mismatch"
         )
     return off
@@ -450,26 +534,48 @@ def decode_burst(
         # that delivered nothing (a frame-less BURST is corruption)
         raise ValueError("BURST with k_frames == 0")
     per = frame_payload_bytes(spec)
-    want = BURST_HDR + k_frames * per
-    if len(payload) != want:
+    # v1 or v2 framing by exact length (see decode_frame)
+    if len(payload) == BURST_HDR + k_frames * per:
+        hdr = BURST_HDR
+    elif len(payload) == BURST_HDR_T + k_frames * per:
+        hdr = BURST_HDR_T
+    else:
         raise ValueError(
-            f"BURST of {k_frames} frames is {len(payload)} bytes, "
-            f"layout wants {want} — peer table layout mismatch"
+            f"BURST of {k_frames} frames is {len(payload)} bytes, layout "
+            f"wants {BURST_HDR + k_frames * per} or "
+            f"{BURST_HDR_T + k_frames * per} — peer table layout mismatch"
         )
     return [
-        _decode_one_frame(payload, BURST_HDR + i * per, spec, scratch)
+        _decode_one_frame(payload, hdr + i * per, spec, scratch)
         for i in range(k_frames)
     ]
 
 
-def encode_sync(spec: TableSpec) -> bytes:
-    return bytes([SYNC]) + struct.pack(
-        _SYNC_FMT, spec.num_leaves, spec.total_n, spec.layout_digest()
+def encode_sync(spec: TableSpec, wire_version: int = 1) -> bytes:
+    """Join request header. Since r09 a trailing version byte advertises
+    the joiner's DATA/BURST framing (compat.WIRE_VERSION); pre-r09 parents
+    decode with unpack_from and ignore the trailing byte, so the SYNC
+    stays backward-compatible — and decoders here tolerate both emitted
+    framings regardless (the byte is informational, surfaced through
+    sync_wire_version for logging/telemetry)."""
+    return (
+        bytes([SYNC])
+        + struct.pack(
+            _SYNC_FMT, spec.num_leaves, spec.total_n, spec.layout_digest()
+        )
+        + bytes([wire_version & 0xFF])
     )
 
 
 def decode_sync(payload: bytes) -> tuple[int, int, bytes]:
     return struct.unpack_from(_SYNC_FMT, payload, 1)
+
+
+def sync_wire_version(payload: bytes) -> int:
+    """The joiner's advertised DATA/BURST framing version (1 when absent —
+    a pre-r09 SYNC has no version byte)."""
+    base = 1 + struct.calcsize(_SYNC_FMT)
+    return payload[base] if len(payload) > base else 1
 
 
 def encode_snapshot_chunks(flat: np.ndarray) -> Iterator[bytes]:
@@ -511,6 +617,31 @@ def encode_ack(count: int) -> bytes:
 def decode_ack(payload: bytes) -> int:
     (count,) = struct.unpack_from("<Q", payload, 1)
     return count
+
+
+def encode_digest(doc: dict) -> bytes:
+    """Child -> parent: one bounded cluster-metrics digest (r09 in-band
+    aggregation; obs/aggregate.py owns the document shape and the merge
+    semantics). JSON keeps the control plane debuggable — this is
+    off-hot-path traffic, one message per digest interval per link."""
+    import json
+
+    body = json.dumps(doc, separators=(",", ":")).encode()
+    if len(body) > DIGEST_MAX_BYTES:
+        raise ValueError(
+            f"digest is {len(body)} bytes, cap {DIGEST_MAX_BYTES} — "
+            f"aggregate.py must truncate before encoding"
+        )
+    return bytes([DIGEST]) + body
+
+
+def decode_digest(payload: bytes) -> dict:
+    import json
+
+    doc = json.loads(payload[1:].decode("utf-8"))
+    if not isinstance(doc, dict):
+        raise ValueError("digest body is not a JSON object")
+    return doc
 
 
 def encode_reject(reason: str) -> bytes:

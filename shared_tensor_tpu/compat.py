@@ -18,10 +18,47 @@ jax arrays are immutable, so the out-parameter idiom has no meaning here.
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 from .comm.peer import SharedTensorPeer, create_or_fetch
 from .config import Config
+
+# ---- native wire-format versioning (r09) ----------------------------------
+#
+# The native protocol's DATA/BURST framing is versioned here, in one place,
+# because this module is the compatibility boundary of the project: v1 is
+# the r08 framing ([kind][u32 seq][body]); v2 (r09) appends a 13-byte trace
+# context (origin node id, origin monotonic ns, hop count — comm/wire.py
+# TRACE_BYTES) that powers cross-hop trace propagation and the staleness
+# telemetry. The gate is asymmetric by design:
+#
+# - DECODERS on both tiers accept BOTH framings forever (message length
+#   disambiguates them unambiguously), so mixed-version trees interop and
+#   a rollback never strands a peer;
+# - EMISSION is gated: ``ObsConfig.trace_wire`` (default on) selects v2,
+#   and ``ST_WIRE_TRACE=0`` in the environment force-pins a peer to v1
+#   emission — the escape hatch for joining a tree of pre-r09 peers whose
+#   decoders reject the longer headers.
+#
+# The SYNC handshake advertises the joiner's emission version
+# (wire.encode_sync trailing byte) so a version skew is visible in the
+# parent's logs instead of silent.
+
+WIRE_VERSION_V1 = 1  # r08 framing, no trace context
+WIRE_VERSION_V2 = 2  # r09 framing, 13-byte trace context
+WIRE_VERSION = WIRE_VERSION_V2  # what this build emits by default
+
+
+def wire_protocol_version(config: Config | None = None) -> int:
+    """The DATA/BURST framing version this peer should EMIT: v2 unless the
+    config or the ST_WIRE_TRACE=0 escape hatch pins v1 (wire-compat mode
+    has no native framing at all and ignores this)."""
+    if os.environ.get("ST_WIRE_TRACE", "1") == "0":
+        return WIRE_VERSION_V1
+    if config is not None and not config.obs.trace_wire:
+        return WIRE_VERSION_V1
+    return WIRE_VERSION_V2
 
 
 class _CompatHandle:

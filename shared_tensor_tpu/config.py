@@ -227,6 +227,26 @@ class ObsConfig:
     #: appended to this path ("" = no sink).
     jsonl_path: str = ""
     jsonl_interval_sec: float = 5.0
+    #: r09 trace propagation: stamp outgoing DATA/BURST messages with the
+    #: v2 wire framing's 13-byte trace context (origin node, origin
+    #: monotonic ns, hop count — compat.WIRE_VERSION). Decoders accept
+    #: both framings regardless; ST_WIRE_TRACE=0 force-pins v1 emission
+    #: (e.g. to join a tree of pre-r09 peers). The obs-overhead gate holds
+    #: the stamping cost inside the same <2% budget (OBS_r09).
+    trace_wire: bool = True
+    #: r09 in-band metric aggregation: how often this peer piggybacks its
+    #: subtree's bounded metrics digest up the tree on the existing link
+    #: (counters merged by sum, histograms by bucket-add, gauges by
+    #: labeled max/min — obs/aggregate.py). The root's
+    #: ``peer.metrics(cluster=True)`` / Prometheus exposition then serve a
+    #: live whole-tree view. 0 = digests off. Native framing only (the
+    #: reference compat protocol has no typed control messages).
+    digest_interval_sec: float = 0.5
+    #: Root-side live cluster view: when set, a peer with no uplink (the
+    #: tree root) writes the merged cluster digest JSON to this path every
+    #: digest interval — the file ``python -m shared_tensor_tpu.obs.top``
+    #: tails for its terminal dashboard. "" = don't write.
+    cluster_json_path: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

@@ -14,6 +14,17 @@ Three pieces, one timeline:
   last-N merged events + registry snapshots dumped to a postmortem file on
   crash-point fires, recv-thread exceptions and go-back-N teardowns.
 
+The r09 distributed tier adds:
+
+- :mod:`~shared_tensor_tpu.obs.aggregate` — the bounded cluster metrics
+  digest peers piggyback up the tree (counters by sum, histograms by
+  bucket-add, gauges by labeled max/min); the root's
+  ``peer.metrics(cluster=True)`` serves the whole-tree view;
+- :mod:`~shared_tensor_tpu.obs.trace_export` — causal-path reconstruction
+  over the wire trace context + Perfetto/Chrome ``trace_event`` export;
+- :mod:`~shared_tensor_tpu.obs.top` — ``python -m shared_tensor_tpu.obs.top``,
+  a live terminal view of the root's cluster digest.
+
 ``ST_OBS=0`` disables the whole subsystem (native ring emission included);
 the production default is ON — the native events are rare (link churn,
 recovery, injected faults) and the OBS_r08 gate proves the hot-path cost

@@ -51,6 +51,12 @@ CODE_NAMES: dict[int, str] = {
     25: "fault_stall",
     26: "fault_sever",
     27: "crash_point",
+    # 30+: r09 cross-hop trace propagation. One trace_apply per accepted
+    # traced DATA/BURST message: node/link say who applied it, ``arg``
+    # carries the update generation (origin monotonic ns) and ``extra``
+    # packs (origin_node << 8 | hop) — obs/trace_export.py reconstructs
+    # full causal paths from these records.
+    30: "trace_apply",
 }
 NAME_CODES = {v: k for k, v in CODE_NAMES.items()}
 
@@ -67,7 +73,9 @@ class Event:
     or "py" (emitted by the Python tier); ``node`` is the transport node's
     process-unique obs id (0 = not node-scoped); ``arg`` is the event's
     numeric payload (is_uplink for membership, message count for
-    retransmit, wire seq for dedup_discard, ...)."""
+    retransmit, wire seq for dedup_discard, origin ns for trace_apply,
+    ...); ``extra`` is the record's fourth word (u32 on the native ABI —
+    r09 packs origin<<8|hop there for trace_apply)."""
 
     t_ns: int
     tier: str
@@ -76,18 +84,22 @@ class Event:
     link: int = 0
     arg: int = 0
     detail: str = ""
+    extra: int = 0
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
         if not d["detail"]:
             del d["detail"]
+        if not d["extra"]:
+            del d["extra"]
         return d
 
 
 def py_event(
-    name: str, node: int = 0, link: int = 0, arg: int = 0, detail: str = ""
+    name: str, node: int = 0, link: int = 0, arg: int = 0, detail: str = "",
+    extra: int = 0,
 ) -> Event:
-    return Event(time.monotonic_ns(), "py", name, node, link, arg, detail)
+    return Event(time.monotonic_ns(), "py", name, node, link, arg, detail, extra)
 
 
 def _lib():
@@ -114,7 +126,7 @@ def drain_native(cap_events: int = 8192, lib=None) -> list[Event]:
     )
     out: list[Event] = []
     for off in range(0, int(n), EVENT_BYTES):
-        t_ns, node, code, link, _res, arg = struct.unpack_from(
+        t_ns, node, code, link, res, arg = struct.unpack_from(
             _EVENT_FMT, buf, off
         )
         out.append(
@@ -125,6 +137,7 @@ def drain_native(cap_events: int = 8192, lib=None) -> list[Event]:
                 node,
                 link,
                 arg,
+                extra=res,
             )
         )
     return out

@@ -56,6 +56,16 @@ class FlightRecorder:
                 self._events.append(e)
                 self.counts[e.name] += 1
 
+    def set_capacity(self, capacity: int) -> None:
+        """Re-bound the window, preserving the newest events. The r09
+        cluster tests raise this before a chaos run so every trace_apply
+        record survives until path reconstruction (the default window is
+        sized for postmortems, not full-run captures)."""
+        with self._mu:
+            self._events = collections.deque(
+                self._events, maxlen=max(16, int(capacity))
+            )
+
     def timeline(self) -> list[ev.Event]:
         with self._mu:
             out = list(self._events)
@@ -85,7 +95,7 @@ class ObsHub:
 
     def emit(
         self, name: str, node: int = 0, link: int = 0, arg: int = 0,
-        detail: str = "",
+        detail: str = "", extra: int = 0,
     ) -> None:
         """Record one Python-tier event (no-op when obs is disabled — the
         callers gate on their own cached flag; this is the backstop)."""
@@ -93,7 +103,7 @@ class ObsHub:
 
         if not obs_enabled():
             return
-        self.recorder.record([ev.py_event(name, node, link, arg, detail)])
+        self.recorder.record([ev.py_event(name, node, link, arg, detail, extra)])
 
     def poll_native(self, min_interval_sec: float = 0.0, lib=None) -> int:
         """Drain the native ring into the recorder (rate-limited when

@@ -55,6 +55,18 @@ SCHEMA: dict[str, tuple[str, str]] = {
     "st_transport_zc_msgs_total": ("counter", "zero-copy (borrowed-slot) sends enqueued"),
     # native event ring health
     "st_obs_events_dropped_total": ("counter", "native ring events lost to overflow (undrained)"),
+    # r09 convergence/staleness telemetry (trace context at apply)
+    "st_staleness_seconds": ("gauge", "origin-stamp age of the latest traced update applied on the link (per-link; CLOCK_MONOTONIC delta — valid within one host, needs synced clocks across hosts)"),
+    "st_residual_norm": ("gauge", "L2 norm over every link's error-feedback residual (0 = quiesced)"),
+    "st_update_hops": ("histogram", "tree hops traversed by applied traced updates (python tier buckets)"),
+    "st_update_hops_sum": ("counter", "engine-tier hop-count aggregate (sum over applied traced msgs)"),
+    "st_update_hops_count": ("counter", "engine-tier hop-count sample count"),
+    "st_update_hops_last": ("gauge", "hop distance of the latest traced update applied on the link (per-link)"),
+    "st_traced_msgs_in_total": ("counter", "applied data messages that carried a v2 trace stamp"),
+    # r09 in-band cluster digest aggregation
+    "st_digest_sends_total": ("counter", "cluster metrics digests sent up the tree"),
+    "st_digest_msgs_in_total": ("counter", "cluster metrics digests received from subtree links"),
+    "st_cluster_nodes": ("gauge", "nodes represented in this peer's latest merged cluster digest"),
     # per-link series (rendered via link_key)
     "st_link_bytes_out_total": ("counter", "wire bytes sent on the link (incl. framing/keepalives)"),
     "st_link_bytes_in_total": ("counter", "wire bytes received on the link"),
@@ -64,6 +76,17 @@ SCHEMA: dict[str, tuple[str, str]] = {
     "st_link_recv_queue": ("gauge", "transport recv-queue depth"),
     "st_link_residual_rms": ("gauge", "outgoing residual RMS (0 = quiesced)"),
 }
+
+#: Names whose value is PROCESS-scoped, not peer-scoped: every peer in a
+#: process reports the same module/ring-global number. The cluster digest
+#: (obs/aggregate.py) must deduplicate these by pid before summing, or a
+#: 7-peer single-process tree would report them 7x.
+PROCESS_GLOBAL = frozenset(
+    {
+        "st_corrupt_scales_zeroed_total",
+        "st_obs_events_dropped_total",
+    }
+)
 
 #: Legacy ``peer.metrics()`` key -> canonical name, kept ONE release as
 #: deprecated aliases. Paths are dotted into the legacy nested dict;

@@ -1,0 +1,140 @@
+"""``python -m shared_tensor_tpu.obs.top`` — live cluster digest viewer.
+
+A `top`-style terminal view over the r09 in-band cluster digest: point the
+tree ROOT at a file (``ObsConfig.cluster_json_path="/tmp/st_cluster.json"``)
+and this tool tails it, rendering whole-tree totals, throughput rates
+(derived by differencing counters between refreshes) and the per-node
+breakdown — staleness, residual norm, frames, retransmits — one row per
+node. Stdlib-only and read-only: it never touches the peers, so it can run
+on a box that merely shares the file (NFS, kubectl cp loop, scp cron).
+
+Usage:
+    python -m shared_tensor_tpu.obs.top --file /tmp/st_cluster.json
+    python -m shared_tensor_tpu.obs.top --file ... --once   # one frame (CI)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _fmt(v, width=10) -> str:
+    if isinstance(v, float):
+        if v != 0 and (abs(v) < 1e-3 or abs(v) >= 1e6):
+            return f"{v:>{width}.2e}"
+        return f"{v:>{width}.3f}"
+    return f"{v:>{width}}"
+
+
+def _node_val(m: dict, base: str) -> float:
+    """A node's value for a base metric name, max over labeled variants
+    (per-link gauges render as ``name{link="N"}``)."""
+    best = 0.0
+    for k, v in m.items():
+        if k == base or k.startswith(base + "{"):
+            best = max(best, float(v))
+    return best
+
+
+def render(doc: dict, prev: dict | None, dt: float) -> str:
+    nodes = doc.get("nodes", {})
+    counters = doc.get("counters", {})
+    pc = (prev or {}).get("counters", {})
+
+    def rate(name: str) -> float:
+        if dt <= 0:
+            return 0.0
+        return max(0.0, (counters.get(name, 0) - pc.get(name, 0)) / dt)
+
+    lines = [
+        f"shared-tensor cluster digest — {len(nodes)} node(s), "
+        f"{doc.get('truncated', 0)} breakdown(s) truncated",
+        (
+            f"  frames in {counters.get('st_frames_in_total', 0):.0f}"
+            f" ({rate('st_frames_in_total'):.0f}/s)"
+            f"   msgs in {counters.get('st_msgs_in_total', 0):.0f}"
+            f" ({rate('st_msgs_in_total'):.0f}/s)"
+            f"   retx {counters.get('st_retransmit_msgs_total', 0):.0f}"
+            f"   dedup {counters.get('st_dedup_discards_total', 0):.0f}"
+        ),
+    ]
+    gmax = doc.get("gmax", {})
+    stale = gmax.get("st_staleness_seconds")
+    resid = gmax.get("st_residual_norm")
+    if stale or resid:
+        parts = []
+        if stale:
+            parts.append(
+                f"worst staleness {stale[0]:.4f}s @ node {int(stale[1])}"
+            )
+        if resid:
+            parts.append(
+                f"worst residual L2 {resid[0]:.4g} @ node {int(resid[1])}"
+            )
+        lines.append("  " + "   ".join(parts))
+    lines.append("")
+    hdr = (
+        f"{'node':>6} {'stale_s':>10} {'resid_L2':>10} {'hops':>5} "
+        f"{'frames_out':>11} {'frames_in':>10} {'updates':>8} "
+        f"{'retx':>6} {'inflight':>9}"
+    )
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for nid in sorted(nodes, key=int):
+        m = nodes[nid].get("m", {})
+        lines.append(
+            f"{nid:>6} "
+            f"{_fmt(_node_val(m, 'st_staleness_seconds'))} "
+            f"{_fmt(_node_val(m, 'st_residual_norm'))} "
+            f"{int(_node_val(m, 'st_update_hops_last')):>5} "
+            f"{_fmt(m.get('st_frames_out_total', 0), 11)} "
+            f"{_fmt(m.get('st_frames_in_total', 0))} "
+            f"{_fmt(m.get('st_updates_total', 0), 8)} "
+            f"{_fmt(m.get('st_retransmit_msgs_total', 0), 6)} "
+            f"{_fmt(_node_val(m, 'st_inflight_msgs'), 9)}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live terminal view of the r09 cluster metrics digest"
+    )
+    ap.add_argument(
+        "--file", required=True,
+        help="digest JSON the tree root writes (ObsConfig.cluster_json_path)",
+    )
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument(
+        "--once", action="store_true", help="render one frame and exit"
+    )
+    args = ap.parse_args(argv)
+    prev, prev_t = None, 0.0
+    while True:
+        try:
+            with open(args.file) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            if args.once:
+                print(f"cannot read digest {args.file}: {e}", file=sys.stderr)
+                return 1
+            time.sleep(args.interval)
+            continue
+        now = time.monotonic()
+        frame = render(doc, prev, now - prev_t if prev is not None else 0.0)
+        if args.once:
+            print(frame)
+            return 0
+        # ANSI clear + home keeps it flicker-light without curses
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        prev, prev_t = doc, now
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""Causal-path reconstruction + Perfetto/Chrome trace export (r09).
+
+The r09 wire trace context gives every applied DATA/BURST message a
+``trace_apply`` event — ``(node, link)`` say who applied it, ``arg``
+carries the update generation (the origin's monotonic ns at add() time)
+and ``extra`` packs ``origin_node << 8 | hop``. This module turns a flight
+recorder timeline into:
+
+- :func:`trace_paths` — ``{(origin, gen): [hop records]}``, the full
+  causal path of each update generation across the tree, plus
+  :func:`contiguous` to verify a path has no hop gaps (a generation whose
+  mass coalesced into a newer one simply STOPS — hops 1..k — but can
+  never skip a hop: a node only re-stamps hop k+1 after applying hop k,
+  so a gap means lost telemetry, and the CHAOS_r09 gate bounds it);
+- :func:`chrome_trace` — a Chrome ``trace_event`` JSON document
+  (Perfetto/chrome://tracing loadable): every event becomes an instant on
+  its node's track, and each multi-hop update generation becomes a flow
+  (``s``/``t`` arrows) hopping across node tracks — the visual "which hop
+  delayed this update" answer.
+
+Timestamps: the shared CLOCK_MONOTONIC timebase, converted to the trace
+format's microseconds. ``pid`` is the node obs id (process-unique), with
+metadata records naming them; ``tid`` separates the native ("c") and
+Python ("py") tiers.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Optional
+
+from . import events as ev
+
+
+def unpack_trace(event: ev.Event) -> Optional[tuple[int, int, int]]:
+    """(origin, gen, hop) of a trace_apply event, else None."""
+    if event.name != "trace_apply":
+        return None
+    return (event.extra >> 8) & 0xFFFFFF, event.arg, event.extra & 0xFF
+
+
+def trace_paths(
+    events: Iterable[ev.Event],
+) -> dict[tuple[int, int], list[dict]]:
+    """Group trace_apply events by update generation. Each value is the
+    generation's hop list sorted by hop then time:
+    ``{"hop": h, "node": applier, "link": l, "t_ns": t, "tier": tier}``.
+    Retransmissions of the same message are deduplicated upstream by the
+    wire's go-back-N acceptance (a discarded duplicate never emits
+    trace_apply), so one (generation, node) pair appears at most once per
+    delivery."""
+    out: dict[tuple[int, int], list[dict]] = {}
+    for e in events:
+        tr = unpack_trace(e)
+        if tr is None:
+            continue
+        origin, gen, hop = tr
+        out.setdefault((origin, gen), []).append(
+            {
+                "hop": hop,
+                "node": e.node,
+                "link": e.link,
+                "t_ns": e.t_ns,
+                "tier": e.tier,
+            }
+        )
+    for path in out.values():
+        path.sort(key=lambda r: (r["hop"], r["t_ns"]))
+    return out
+
+
+def contiguous(path: list[dict]) -> bool:
+    """True when the path's hop set is exactly 1..max (no gaps). A short
+    path (coalesced into a newer generation mid-tree) is contiguous; a
+    HOLE means a hop's telemetry was lost."""
+    hops = sorted({r["hop"] for r in path})
+    return bool(hops) and hops[0] == 1 and hops == list(range(1, hops[-1] + 1))
+
+
+def path_stats(paths: dict) -> dict:
+    """Aggregate verdict over reconstructed paths (the CHAOS_r09 gate
+    reads ``contiguous_frac``)."""
+    total = len(paths)
+    ok = sum(1 for p in paths.values() if contiguous(p))
+    max_hops = max((p[-1]["hop"] for p in paths.values() if p), default=0)
+    return {
+        "paths": total,
+        "contiguous": ok,
+        "contiguous_frac": (ok / total) if total else 1.0,
+        "max_hops": max_hops,
+    }
+
+
+_TIER_TID = {"c": 1, "py": 2}
+
+
+def chrome_trace(
+    events: Iterable[ev.Event], flows: bool = True
+) -> dict:
+    """Chrome ``trace_event`` JSON document from a merged timeline."""
+    events = sorted(events, key=lambda e: e.t_ns)
+    out: list[dict] = []
+    nodes = sorted({e.node for e in events})
+    for n in nodes:
+        out.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": n,
+                "args": {"name": f"node-{n}" if n else "process"},
+            }
+        )
+        for tier, tid in _TIER_TID.items():
+            out.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": n,
+                    "tid": tid,
+                    "args": {"name": f"{tier}-tier"},
+                }
+            )
+    for e in events:
+        args: dict = {"link": e.link, "arg": e.arg}
+        tr = unpack_trace(e)
+        if tr is not None:
+            args.update(origin=tr[0], gen=tr[1], hop=tr[2])
+        if e.detail:
+            args["detail"] = e.detail
+        out.append(
+            {
+                "name": e.name,
+                "cat": "st",
+                "ph": "i",
+                "s": "t",  # thread-scoped instant
+                "ts": e.t_ns / 1000.0,
+                "pid": e.node,
+                "tid": _TIER_TID.get(e.tier, 3),
+                "args": args,
+            }
+        )
+    if flows:
+        # one flow per multi-hop generation: arrows from each hop's track
+        # to the next — the cross-node causal chain made visual
+        for flow_id, ((origin, gen), path) in enumerate(
+            sorted(trace_paths(events).items()), start=1
+        ):
+            if len(path) < 2:
+                continue
+            for i, rec in enumerate(path):
+                out.append(
+                    {
+                        "name": f"update-{origin}-{gen}",
+                        "cat": "st_trace",
+                        "ph": "s" if i == 0 else "t",
+                        "id": flow_id,
+                        "ts": rec["t_ns"] / 1000.0,
+                        "pid": rec["node"],
+                        "tid": _TIER_TID.get(rec["tier"], 3),
+                        "args": {"hop": rec["hop"], "origin": origin},
+                    }
+                )
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def export_file(
+    path: str, events: Iterable[ev.Event], flows: bool = True
+) -> str:
+    doc = chrome_trace(events, flows=flows)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    return path

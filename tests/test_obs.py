@@ -188,6 +188,93 @@ def test_schema_alias_table_is_consistent():
     assert schema.link_key("st_link_send_queue", 3) == 'st_link_send_queue{link="3"}'
 
 
+def test_schema_lint_every_emitted_st_name_is_documented():
+    """r09 satellite, HARD GATE: grep-collect every ``st_*`` name emitted
+    anywhere — quoted string literals across the Python package AND the
+    native sources' string tables — and fail if one is missing from
+    obs/schema.py. A new cluster metric cannot ship undocumented: adding
+    an instrument/collector key without a SCHEMA row fails here, by name,
+    with the file that emits it."""
+    import pathlib
+    import re
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    pat = re.compile(r'["\'](st_[a-z0-9_]+)["\']')
+    # Non-metric st_* literals, each with a reason. ABI symbol names appear
+    # as ctypes attributes (not strings), so almost none are needed — keep
+    # this list honest: every entry must still occur in the scan.
+    allowed_non_metrics: dict[str, str] = {
+        "st_trace": "Chrome trace_event category tag (trace_export.py)",
+    }
+    emitted: dict[str, set[str]] = {}
+    sources = list((repo / "shared_tensor_tpu").rglob("*.py")) + [
+        p
+        for ext in ("*.c", "*.cpp", "*.h")
+        for p in (repo / "native").glob(ext)
+    ]
+    assert sources, "scan found no sources"
+    for path in sources:
+        for name in pat.findall(path.read_text(errors="replace")):
+            emitted.setdefault(name, set()).add(str(path.relative_to(repo)))
+    assert emitted, "scan found no st_* literals (pattern rot?)"
+    undocumented = {
+        name: sorted(files)
+        for name, files in emitted.items()
+        if name not in schema.SCHEMA and name not in allowed_non_metrics
+    }
+    assert not undocumented, (
+        f"st_* names emitted but missing from obs/schema.py SCHEMA: "
+        f"{undocumented}"
+    )
+    stale_allow = set(allowed_non_metrics) - set(emitted)
+    assert not stale_allow, f"allowlist entries no longer emitted: {stale_allow}"
+    # sanity: the r09 cluster names are among what the scan found
+    for must in ("st_staleness_seconds", "st_update_hops", "st_cluster_nodes"):
+        assert must in emitted, f"scan missed {must}"
+
+
+def test_legacy_metrics_alias_deprecation_and_byte_equality():
+    """r09 satellite: the r08 legacy ``peer.metrics()`` alias keys now emit
+    a DeprecationWarning once per process, and every alias value is
+    byte-equal to its canonical twin (the aliases are a VIEW, never a
+    parallel accounting)."""
+    import warnings
+
+    from shared_tensor_tpu.comm import peer as peer_mod
+
+    port = _free_port()
+    m = create_or_fetch("127.0.0.1", port, jnp.zeros((256,), jnp.float32), _cfg())
+    try:
+        m.add(jnp.ones((256,), jnp.float32))
+        peer_mod._legacy_metrics_warned = False
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            legacy = m.metrics()
+            again = m.metrics()
+        deps = [w for w in caught if issubclass(w.category, DeprecationWarning)]
+        assert len(deps) == 1, "once per process, not per call"
+        assert "canonical=True" in str(deps[0].message)
+        del again
+        # a linkless quiesced master: the legacy and canonical surfaces
+        # sample identical state — alias values must be EXACTLY equal
+        canon = m.metrics(canonical=True)
+        flat = schema.canonicalize(legacy)
+        assert flat, "canonicalize produced nothing"
+        for key, val in flat.items():
+            assert canon[key] == val, (key, canon[key], val)
+        # canonical/cluster paths never warn
+        peer_mod._legacy_metrics_warned = False
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            m.metrics(canonical=True)
+            m.metrics(cluster=True)
+        assert not [
+            w for w in caught if issubclass(w.category, DeprecationWarning)
+        ]
+    finally:
+        m.close()
+
+
 # ---------------------------------------------------------------------------
 # native event ring
 # ---------------------------------------------------------------------------

@@ -1,0 +1,415 @@
+"""Cluster-wide observability tests (r09 tentpole evidence).
+
+Covers the distributed tier end to end:
+
+- the digest algebra (obs/aggregate.py): counters by sum, histograms by
+  bucket-add, gauges by labeled max/min, bounded per-node breakdowns;
+- causal-path reconstruction + the Perfetto exporter
+  (obs/trace_export.py) and the ``obs.top`` terminal renderer;
+- v1/v2 wire interop (a trace-disabled peer in a traced tree);
+- the 7-node loopback tree under chaos: every delivered update's
+  reconstructed trace path is CONTIGUOUS (no hop gaps), and at a quiesced
+  instant the root's cluster-digest totals equal the SUM of the per-node
+  registries exactly (the acceptance bar CHAOS_r09.json re-runs as a
+  committed artifact, benchmarks/cluster_chaos.py).
+"""
+
+import io
+import json
+import time
+from contextlib import redirect_stdout
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shared_tensor_tpu import compat, obs
+from shared_tensor_tpu.comm import faults, transport, wire
+from shared_tensor_tpu.comm.peer import SharedTensorPeer, create_or_fetch
+from shared_tensor_tpu.config import (
+    Config, FaultConfig, ObsConfig, TransportConfig,
+)
+from shared_tensor_tpu.obs import aggregate, trace_export
+from shared_tensor_tpu.obs import events as obs_events
+
+from tests._ports import free_port as _free_port
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    transport.build_native()
+
+
+def _cfg(fault: FaultConfig | None = None, obs_cfg: ObsConfig | None = None,
+         engine: bool = True, **tkw):
+    tkw.setdefault("peer_timeout_sec", 15.0)
+    return Config(
+        transport=TransportConfig(**tkw),
+        faults=fault or FaultConfig(),
+        obs=obs_cfg or ObsConfig(digest_interval_sec=0.2),
+        native_engine=engine,
+    )
+
+
+def _fresh_hub(capacity: int = 0):
+    h = obs.hub()
+    h.poll_native()
+    h.recorder.clear()
+    if capacity:
+        h.recorder.set_capacity(capacity)
+    return h
+
+
+def _wait(pred, timeout=60.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# digest algebra (obs/aggregate.py)
+# ---------------------------------------------------------------------------
+
+
+def test_aggregate_merge_semantics():
+    a = aggregate.from_snapshot(
+        1,
+        {
+            "st_frames_out_total": 10,
+            "st_msgs_out_total": 4,
+            "st_residual_norm": 2.5,
+            'st_staleness_seconds{link="1"}': 0.25,
+            "st_apply_seconds": {"sum": 1.0, "count": 2,
+                                 "buckets": {0.01: 1, 0.1: 2}},
+        },
+        t_ns=100,
+    )
+    b = aggregate.from_snapshot(
+        2,
+        {
+            "st_frames_out_total": 7,
+            "st_residual_norm": 9.0,
+            'st_staleness_seconds{link="1"}': 0.05,
+            "st_apply_seconds": {"sum": 0.5, "count": 1,
+                                 "buckets": {0.01: 0, 0.1: 1}},
+        },
+        t_ns=200,
+    )
+    m = aggregate.merge(a, b)
+    # counters: SUM (per-link labels strip into the base name)
+    assert m["counters"]["st_frames_out_total"] == 17
+    assert m["counters"]["st_msgs_out_total"] == 4
+    # histograms: bucket-add, sums and counts add
+    h = m["hists"]["st_apply_seconds"]
+    assert h["sum"] == 1.5 and h["count"] == 3
+    assert h["buckets"] == {"0.01": 1, "0.1": 3}
+    # gauges: labeled max/min — value AND owner
+    assert m["gmax"]["st_residual_norm"] == [9.0, 2]
+    assert m["gmin"]["st_residual_norm"] == [2.5, 1]
+    assert m["gmax"]["st_staleness_seconds"] == [0.25, 1]
+    # per-node breakdown is the union, stamped
+    assert set(m["nodes"]) == {"1", "2"}
+    assert m["nodes"]["2"]["t_ns"] == 200
+    # the rendered exposition carries the node labels
+    text = aggregate.prometheus_text(m)
+    assert "st_frames_out_total 17" in text
+    assert 'st_residual_norm_max{node="2"} 9' in text
+    assert 'st_staleness_seconds{node="1",link="1"} 0.25' in text
+    # encodes under the wire cap and round-trips
+    payload = wire.encode_digest(aggregate.bounded(m))
+    assert wire.decode_digest(payload)["counters"]["st_frames_out_total"] == 17
+
+
+def test_aggregate_process_global_counters_dedup_by_pid():
+    """PROCESS-scoped counters (ring drops, corrupt-scale zeroings) are the
+    same number at every peer of a process: the digest must count each
+    process once, not once per peer — 7 loopback peers reporting a ring
+    drop must not inflate it 7x (review catch)."""
+    snap = {"st_obs_events_dropped_total": 5, "st_frames_out_total": 3}
+    a = aggregate.from_snapshot(1, snap, t_ns=1)
+    b = aggregate.from_snapshot(2, snap, t_ns=2)  # same process, same value
+    m = aggregate.merge(a, b)
+    # peer-scoped counters sum; process-scoped dedup by pid
+    assert m["counters"]["st_frames_out_total"] == 6
+    assert "st_obs_events_dropped_total" not in m["counters"]
+    assert aggregate.process_global_totals(m) == {
+        "st_obs_events_dropped_total": 5
+    }
+    text = aggregate.prometheus_text(m)
+    assert "st_obs_events_dropped_total 5" in text
+    # full-precision rendering: %g would round this to 1.23457e+07
+    big = aggregate.from_snapshot(3, {"st_frames_out_total": 12345678}, 3)
+    assert "st_frames_out_total 12345678" in aggregate.prometheus_text(big)
+
+
+def test_aggregate_bounded_truncates_oldest_breakdowns():
+    doc = aggregate.empty()
+    for i in range(aggregate.MAX_NODES + 10):
+        aggregate.merge(
+            doc,
+            aggregate.from_snapshot(i, {"st_updates_total": 1}, t_ns=i),
+        )
+    aggregate.bounded(doc)
+    assert len(doc["nodes"]) == aggregate.MAX_NODES
+    assert doc["truncated"] == 10
+    # the OLDEST breakdowns dropped; totals kept every node's contribution
+    assert "0" not in doc["nodes"] and "9" not in doc["nodes"]
+    assert doc["counters"]["st_updates_total"] == aggregate.MAX_NODES + 10
+
+
+# ---------------------------------------------------------------------------
+# path reconstruction + exporters
+# ---------------------------------------------------------------------------
+
+
+def _apply_ev(node, link, origin, gen, hop, t):
+    return obs_events.Event(
+        t, "c", "trace_apply", node, link, gen, extra=(origin << 8) | hop
+    )
+
+
+def test_trace_paths_and_contiguity():
+    evs = [
+        _apply_ev(2, 1, 1, 1000, 1, 10),
+        _apply_ev(3, 2, 1, 1000, 2, 20),
+        _apply_ev(4, 1, 1, 1000, 2, 21),  # sibling at the same hop depth
+        _apply_ev(2, 1, 1, 2000, 1, 30),  # second generation, short path
+        _apply_ev(5, 3, 1, 3000, 3, 40),  # HOLE: hops {3} misses 1..2
+        obs_events.Event(15, "py", "link_up", 9, 1, 0),  # non-trace noise
+    ]
+    paths = trace_export.trace_paths(evs)
+    assert set(paths) == {(1, 1000), (1, 2000), (1, 3000)}
+    assert [r["hop"] for r in paths[(1, 1000)]] == [1, 2, 2]
+    assert trace_export.contiguous(paths[(1, 1000)])
+    assert trace_export.contiguous(paths[(1, 2000)])  # short but gap-free
+    assert not trace_export.contiguous(paths[(1, 3000)])  # the hole
+    stats = trace_export.path_stats(paths)
+    assert stats["paths"] == 3 and stats["contiguous"] == 2
+    assert stats["max_hops"] == 3
+    assert stats["contiguous_frac"] == pytest.approx(2 / 3)
+
+
+def test_chrome_trace_export_is_perfetto_loadable_shape(tmp_path):
+    evs = [
+        _apply_ev(2, 1, 1, 1000, 1, 10_000),
+        _apply_ev(3, 2, 1, 1000, 2, 20_000),
+        obs_events.Event(5_000, "py", "retransmit", 2, 1, 3),
+    ]
+    path = str(tmp_path / "trace.json")
+    trace_export.export_file(path, evs)
+    doc = json.loads(open(path).read())
+    tes = doc["traceEvents"]
+    # metadata names every node track
+    assert any(
+        t["ph"] == "M" and t["name"] == "process_name" and t["pid"] == 2
+        for t in tes
+    )
+    # instants carry the trace args, microsecond timestamps
+    inst = [t for t in tes if t["ph"] == "i" and t["name"] == "trace_apply"]
+    assert len(inst) == 2 and inst[0]["args"]["origin"] == 1
+    assert inst[0]["ts"] == pytest.approx(10.0)
+    # the multi-hop generation became a flow (s -> t) across node tracks
+    flow = [t for t in tes if t["ph"] in ("s", "t")]
+    assert len(flow) == 2
+    assert flow[0]["ph"] == "s" and flow[0]["pid"] == 2
+    assert flow[1]["ph"] == "t" and flow[1]["pid"] == 3
+
+
+def test_obs_top_renders_digest(tmp_path):
+    from shared_tensor_tpu.obs import top
+
+    doc = aggregate.from_snapshot(
+        3,
+        {
+            "st_frames_out_total": 12,
+            "st_frames_in_total": 9,
+            "st_updates_total": 4,
+            "st_residual_norm": 1.25,
+            'st_staleness_seconds{link="2"}': 0.5,
+        },
+        t_ns=1,
+    )
+    p = tmp_path / "cluster.json"
+    p.write_text(json.dumps(doc))
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = top.main(["--file", str(p), "--once"])
+    assert rc == 0
+    text = out.getvalue()
+    assert "1 node(s)" in text
+    assert "worst staleness 0.5000s @ node 3" in text
+    # the per-node row renders its metrics
+    assert any(l.strip().startswith("3 ") for l in text.splitlines())
+
+
+# ---------------------------------------------------------------------------
+# wire-format version gate (compat.py)
+# ---------------------------------------------------------------------------
+
+
+def test_wire_protocol_version_gate(monkeypatch):
+    assert compat.wire_protocol_version(Config()) == compat.WIRE_VERSION_V2
+    cfg = Config(obs=ObsConfig(trace_wire=False))
+    assert compat.wire_protocol_version(cfg) == compat.WIRE_VERSION_V1
+    monkeypatch.setenv("ST_WIRE_TRACE", "0")
+    assert compat.wire_protocol_version(Config()) == compat.WIRE_VERSION_V1
+
+
+def test_sync_advertises_wire_version():
+    from shared_tensor_tpu.ops.table import make_spec
+
+    spec = make_spec(np.zeros(64, np.float32))
+    p2 = wire.encode_sync(spec, 2)
+    assert wire.sync_wire_version(p2) == 2
+    # a pre-r09 SYNC (no trailing byte) reads as v1
+    legacy = p2[:-1]
+    assert wire.sync_wire_version(legacy) == 1
+    # and the layout fields decode identically either way
+    assert wire.decode_sync(p2) == wire.decode_sync(legacy)
+
+
+def test_v1_v2_mixed_tree_interop():
+    """A trace-disabled (v1-emitting) joiner in a traced tree: both
+    directions decode, both replicas converge exactly — the r09 framing is
+    version-gated, never a flag-day."""
+    port = _free_port()
+    n = 1024
+    seed = jnp.zeros((n,), jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg())
+    c = create_or_fetch(
+        "127.0.0.1", port, seed,
+        _cfg(obs_cfg=ObsConfig(trace_wire=False, digest_interval_sec=0.2)),
+    )
+    try:
+        assert m._trace_wire and not c._trace_wire
+        total = np.zeros(n, np.float64)
+        rng = np.random.default_rng(3)
+        for i in range(10):
+            d = rng.normal(size=n).astype(np.float32)
+            (m if i % 2 == 0 else c).add(jnp.asarray(d))
+            total += d
+            time.sleep(0.01)
+        for p, who in ((m, "master"), (c, "joiner")):
+            _wait(
+                lambda p=p: np.allclose(np.asarray(p.read()), total, atol=1e-4),
+                msg=f"{who} to converge across mixed framings",
+            )
+        # the v2->v1 direction still produced staleness telemetry at c;
+        # the v1->v2 direction left m without trace stamps from c — both
+        # are fine, and nothing was dropped as undecodable
+        assert c.metrics(canonical=True)["st_dedup_discards_total"] == 0
+    finally:
+        m.close()
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# the 7-node chaos tree (acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+def _build_tree(port, n_nodes, seed, monkeypatch, chaos_node=6):
+    """Root + (n_nodes-1) joiners, binary fan-out. ``chaos_node`` (index)
+    is created under an ST_FAULT_PLAN drop schedule, so its ENGINE sender
+    injects wire chaos below Python (the env table is parsed per
+    st_node_create — only that node is chaotic). Default: the deep leaf
+    that also originates adds — a leaf that never adds sends nothing
+    upward (split horizon), so chaos on it would be vacuous."""
+    peers = []
+    env = faults.to_env(
+        FaultConfig(enabled=True, seed=9, drop_pct=0.25, only_link=1)
+    )
+    for i in range(n_nodes):
+        if i == chaos_node:
+            monkeypatch.setenv("ST_FAULT_PLAN", env["ST_FAULT_PLAN"])
+        try:
+            peers.append(
+                create_or_fetch(
+                    "127.0.0.1", port, seed,
+                    _cfg(ack_timeout_sec=0.4), timeout=60.0,
+                )
+            )
+        finally:
+            if i == chaos_node:
+                monkeypatch.delenv("ST_FAULT_PLAN")
+    return peers
+
+
+def test_cluster_trace_paths_and_digest_totals_7_nodes(monkeypatch):
+    """The acceptance bar, in-suite: a 7-node loopback tree under an
+    engine-tier drop schedule. Every delivered update's reconstructed
+    trace path must be contiguous (>= 99%), and at a quiesced instant the
+    root's cluster-digest totals must equal the sum of the per-node
+    registries EXACTLY for the quiesce-stable counters."""
+    hub = _fresh_hub(capacity=200_000)
+    port = _free_port()
+    n = 2048
+    seed = jnp.zeros((n,), jnp.float32)
+    peers = _build_tree(port, 7, seed, monkeypatch)
+    try:
+        assert all(p._engine is not None for p in peers), "engine tier expected"
+        total = np.zeros(n, np.float64)
+        rng = np.random.default_rng(0)
+        # updates from the root AND a deep node: multi-origin traffic, so
+        # paths cross in both directions while the chaos node drops frames
+        for i in range(24):
+            d = rng.uniform(-0.5, 0.5, n).astype(np.float32)
+            peers[0 if i % 2 else 6].add(jnp.asarray(d))
+            total += d
+            time.sleep(0.015)
+        for i, p in enumerate(peers):
+            _wait(
+                lambda p=p: np.allclose(np.asarray(p.read()), total, atol=1e-4),
+                timeout=90.0, msg=f"peer {i} to reconverge through chaos",
+            )
+        assert all(p.drain(timeout=30.0, tol=1e-30) for p in peers)
+
+        # ---- trace-path contiguity over the whole run -------------------
+        hub.poll_native()
+        timeline = hub.recorder.timeline()
+        paths = trace_export.trace_paths(timeline)
+        stats = trace_export.path_stats(paths)
+        assert stats["paths"] >= 20, stats
+        assert stats["contiguous_frac"] >= 0.99, stats
+        # a 7-node binary tree is 2 levels deep: root-origin updates reach
+        # hop 2, leaf-origin ones hop >= 3 somewhere
+        assert stats["max_hops"] >= 3, stats
+        # chaos actually happened AND was repaired (drops -> retransmits)
+        assert hub.recorder.counts["fault_drop"] >= 1
+        assert hub.recorder.counts["retransmit"] >= 1
+
+        # ---- digest totals == sum of registries at the quiesced instant -
+        # push bottom-up a few rounds so every subtree's latest totals
+        # reach the root regardless of tree shape
+        for _ in range(4):  # one round per possible tree level, + margin
+            for p in peers:
+                if p._uplink is not None:
+                    p.push_digest()
+            time.sleep(0.4)
+        cluster = peers[0].metrics(cluster=True)
+        assert len(cluster["nodes"]) == 7, sorted(cluster["nodes"])
+        snaps = [p.metrics(canonical=True) for p in peers]
+        stable = (
+            "st_frames_out_total", "st_frames_in_total", "st_updates_total",
+            "st_msgs_out_total", "st_msgs_in_total",
+            "st_retransmit_msgs_total", "st_dedup_discards_total",
+            "st_traced_msgs_in_total",
+        )
+        for name in stable:
+            want = sum(s.get(name, 0) for s in snaps)
+            got = cluster["counters"].get(name, 0)
+            assert got == want, (name, got, want)
+        # staleness extrema carry their owning node
+        gmax = cluster["gmax"].get("st_staleness_seconds")
+        assert gmax is not None and gmax[0] >= 0.0
+        assert int(gmax[1]) in {p.node.obs_id for p in peers}
+        # and the Prometheus rendering serves the whole-tree view
+        text = peers[0].cluster_prometheus_text()
+        want_updates = sum(s.get("st_updates_total", 0) for s in snaps)
+        assert f"st_updates_total {float(want_updates):g}" in text
+    finally:
+        for p in peers:
+            p.close()

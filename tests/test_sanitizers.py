@@ -90,6 +90,39 @@ def test_obs_suite_under_asan_ubsan():
 
 
 @pytest.mark.slow
+def test_cluster_trace_suite_under_asan_ubsan():
+    """r09 satellite: the cluster-trace paths are new native hot code —
+    per-message trace parsing in the engine receiver, the widened counters
+    ABI, st_engine_link_obs, st_obs_emit2's reserved word — plus the
+    digest parse paths on the control plane. Run the whole cluster test
+    file (7-node chaos tree included) against the sanitizer builds so
+    ASan/UBSan watch every trace-header read and ring write while the
+    chaos schedule drops frames under it."""
+    asan = _runtime("libasan.so")
+    ubsan = _runtime("libubsan.so")
+    if asan is None or ubsan is None:
+        pytest.skip("gcc sanitizer runtimes unavailable")
+    build = subprocess.run(
+        ["make", "-C", str(NATIVE), "sanitize"],
+        capture_output=True, text=True, timeout=300,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"sanitize build failed: {build.stderr[-500:]}")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "tests/test_obs_cluster.py",
+            "-q", "-p", "no:cacheprovider",
+        ],
+        env=_san_env(asan, ubsan), capture_output=True, text=True,
+        timeout=540, cwd=str(REPO),
+    )
+    err_tail = proc.stderr[-4000:]
+    assert "AddressSanitizer" not in proc.stderr, err_tail
+    assert "runtime error:" not in proc.stderr, err_tail  # UBSan findings
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-2000:], err_tail)
+
+
+@pytest.mark.slow
 def test_chaos_soak_native_arm_under_asan_ubsan():
     asan = _runtime("libasan.so")
     ubsan = _runtime("libubsan.so")

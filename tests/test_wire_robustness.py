@@ -277,6 +277,27 @@ def test_send_window_saturation_on_burst_path():
         m.close()
 
 
+def test_recv_bounds_cover_max_traced_burst():
+    """Every receive-buffer bound (frame_wire_bytes, which sizes the
+    transport recv buffer AND the engine's recv_cap) must cover a
+    MAX-SIZE v2 (traced) burst at every table size. 13 bytes short — the
+    r09 review catch — means a full traced burst is silently truncated at
+    the transport, rejected as undecodable without consuming its seq, and
+    retransmitted byte-identical until go-back-N black-holes the link.
+    Latent in benches because full-cap bursts are rare (halvings usually
+    go idle long before the cap); explicit here so it stays fixed."""
+    for n in (64, 2048, 1 << 17, 1 << 20, 1 << 24):
+        spec = make_spec(jnp.zeros((n,), jnp.float32))
+        per = wire.frame_payload_bytes(spec)
+        cap = wire.burst_frames_cap(spec)
+        worst = wire.BURST_HDR_T + cap * per
+        assert wire.burst_wire_bytes(spec) >= worst, n
+        assert wire.frame_wire_bytes(spec) >= worst, n
+        assert wire.frame_wire_bytes(spec) >= wire.DATA_HDR_T + per, n
+        # and the burst itself stays inside the protocol budget
+        assert worst <= wire.BURST_HDR_T + wire.BURST_MAX_BYTES, n
+
+
 def test_apply_saturates_no_absorbing_inf():
     """A max-scale frame applied to values already at the +/-SAT clamp must
     saturate, not overflow: inf would be an absorbing state (inf - inf = NaN
