@@ -20,10 +20,21 @@ Also exports the run's merged timeline as a Perfetto-loadable Chrome
 trace (the committed TRACE artifact rides profile_trace.py instead; this
 one is optional via ST_CLUSTER_TRACE_OUT).
 
+r10 ``--subscribers N`` arm: N read-only serve-tier leaves graft DIRECTLY
+under the chaotic node (whose drop schedule then covers their unledgered
+links too — ``only_link=0``). The serving contract under chaos: reads
+either verify their ``max_staleness`` bound or raise (never silently
+stale), a swallowed delta is a seq gap repaired by resync, and the WRITER
+tree is never wedged by any of it (exact convergence + full drain with the
+subscribers attached). Emits the subscriber tallies alongside the r09
+telemetry checks.
+
 Emits one JSON document and writes it to argv[1] (default CHAOS_r09.json).
 Run:  JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py CHAOS_r09.json
+      JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py CHAOS_r10.json \
+          --subscribers 2
 Knobs: ST_CLUSTER_NODES (default 7), ST_CLUSTER_N (2048),
-ST_CLUSTER_ADDS (40), ST_CLUSTER_SEED (9).
+ST_CLUSTER_ADDS (40), ST_CLUSTER_SEED (9), ST_CLUSTER_SUBSCRIBERS (0).
 """
 
 import json
@@ -39,6 +50,13 @@ NODES = int(os.environ.get("ST_CLUSTER_NODES", "7"))
 N = int(os.environ.get("ST_CLUSTER_N", "2048"))
 ADDS = int(os.environ.get("ST_CLUSTER_ADDS", "40"))
 SEED = int(os.environ.get("ST_CLUSTER_SEED", "9"))
+SUBS = int(os.environ.get("ST_CLUSTER_SUBSCRIBERS", "0"))
+if "--subscribers" in sys.argv:
+    i = sys.argv.index("--subscribers")
+    SUBS = int(sys.argv[i + 1])
+    del sys.argv[i : i + 2]
+#: Staleness bound subscriber reads must verify (or raise) under chaos.
+SUB_BOUND = float(os.environ.get("ST_CLUSTER_SUB_BOUND", "0.75"))
 
 STABLE_COUNTERS = (
     "st_frames_out_total", "st_frames_in_total", "st_updates_total",
@@ -84,8 +102,15 @@ def main() -> int:
     port = _free_port()
     seed = jnp.zeros((N,), jnp.float32)
     chaos_idx = NODES - 1  # the deep leaf that also originates adds
+    # with subscribers attached, the chaotic node's drop schedule covers
+    # ALL its links (only_link=0) so the unledgered subscriber links face
+    # the same 25% drops as its uplink; the r09-compatible run keeps the
+    # original uplink-only schedule
     env = faults.to_env(
-        FaultConfig(enabled=True, seed=SEED, drop_pct=0.25, only_link=1)
+        FaultConfig(
+            enabled=True, seed=SEED, drop_pct=0.25,
+            only_link=0 if SUBS > 0 else 1,
+        )
     )
     peers = []
     for i in range(NODES):
@@ -98,6 +123,20 @@ def main() -> int:
         finally:
             os.environ.pop("ST_FAULT_PLAN", None)
 
+    # r10 subscriber arm: read-only leaves grafted DIRECTLY under the
+    # chaotic node, so every delta they receive crosses its drop schedule
+    subs = []
+    if SUBS > 0:
+        from shared_tensor_tpu import serve
+
+        chaos_port = peers[chaos_idx].node.listen_port
+        for _ in range(SUBS):
+            subs.append(
+                serve.subscribe(
+                    "127.0.0.1", chaos_port, seed, cfg, timeout=60.0
+                )
+            )
+
     out = {
         "bench": "cluster_chaos",
         "nodes": NODES,
@@ -107,13 +146,31 @@ def main() -> int:
         "engine_tier": all(p._engine is not None for p in peers),
         "chaos": {"drop_pct": 0.25, "only_link": 1, "node_index": chaos_idx},
     }
+    if SUBS > 0:
+        out["chaos"]["only_link"] = 0
+        out["subscribers"] = {
+            "count": SUBS, "max_staleness_sec": SUB_BOUND,
+        }
     try:
+        from shared_tensor_tpu.serve import StalenessError
+
+        reads_ok = reads_refused = 0  # mid-chaos tallies (the adds loop)
+        q_ok = q_refused = 0  # post-quiesce convergence-loop tallies
         total = np.zeros(N, np.float64)
         rng = np.random.default_rng(0)
         for i in range(ADDS):
             d = rng.uniform(-0.5, 0.5, N).astype(np.float32)
             peers[0 if i % 2 else chaos_idx].add(jnp.asarray(d))
             total += d
+            # the serving contract, exercised mid-chaos: every read either
+            # verifies its bound or raises — silent staleness is
+            # structurally impossible, and this tallies which happened
+            for s in subs:
+                try:
+                    s.read(max_staleness=SUB_BOUND)
+                    reads_ok += 1
+                except StalenessError:
+                    reads_refused += 1
             time.sleep(0.015)
 
         deadline = time.time() + 120.0
@@ -126,6 +183,26 @@ def main() -> int:
                     )
             time.sleep(0.05)
         drained = all(p.drain(timeout=30.0, tol=1e-30) for p in peers)
+
+        # subscriber convergence: once the writers quiesce, every
+        # subscriber's VERIFIED read must reach the same total (resyncs
+        # repair whatever the chaos swallowed; FRESH marks — control
+        # plane, outside the chaos classes — keep the bound verifiable
+        # on the idle tree)
+        sub_converged = [False] * len(subs)
+        sub_deadline = time.time() + 90.0
+        while time.time() < sub_deadline and not all(sub_converged):
+            for i, s in enumerate(subs):
+                if not sub_converged[i]:
+                    try:
+                        v = np.asarray(s.read(max_staleness=SUB_BOUND))
+                        sub_converged[i] = bool(
+                            np.allclose(v, total, atol=1e-3)
+                        )
+                        q_ok += 1
+                    except StalenessError:
+                        q_refused += 1
+            time.sleep(0.05)
 
         hub.poll_native()
         timeline = hub.recorder.timeline()
@@ -143,7 +220,10 @@ def main() -> int:
         cluster = peers[0].metrics(cluster=True)
         snaps = [p.metrics(canonical=True) for p in peers]
         digest = {"nodes_seen": len(cluster["nodes"]), "counters": {}}
-        digest_exact = len(cluster["nodes"]) == NODES
+        # writers must all be visible; subscriber digests ride the same
+        # control plane but on their own beat, so their visibility is
+        # recorded, not required, at the quiesce instant
+        digest_exact = NODES <= len(cluster["nodes"]) <= NODES + len(subs)
         for name in STABLE_COUNTERS:
             want = sum(s.get(name, 0) for s in snaps)
             got = cluster["counters"].get(name, 0)
@@ -156,6 +236,22 @@ def main() -> int:
             v for s in snaps for k, v in s.items()
             if k.startswith("st_staleness_seconds")
         ]
+        if subs:
+            sm = [s.metrics() for s in subs]
+            out["subscribers"].update(
+                converged_all=all(sub_converged),
+                reads_ok_mid_chaos=reads_ok,
+                reads_refused_mid_chaos=reads_refused,
+                reads_ok_at_quiesce=q_ok,
+                reads_refused_at_quiesce=q_refused,
+                resyncs=sum(int(m["st_sub_resyncs_total"]) for m in sm),
+                gap_discards=sum(
+                    int(m["st_sub_gap_discards_total"]) for m in sm
+                ),
+                stale_reads_raised=sum(
+                    int(m["st_read_stale_total"]) for m in sm
+                ),
+            )
         out.update(
             converged_all=all(converged),
             drained_all=drained,
@@ -187,8 +283,17 @@ def main() -> int:
             and stats["paths"] >= ADDS // 2
             and stats["contiguous_frac"] >= 0.99
             and digest_exact
+            # r10 arm: the writer tree was never wedged (the criteria
+            # above, evaluated WITH subscribers attached), every
+            # subscriber's verified read reached the exact total, and at
+            # least one read VERIFIED somewhere in the run (mid-chaos
+            # reads may legitimately all refuse under heavy drops — the
+            # artifact records both tallies separately)
+            and (not subs or (all(sub_converged) and reads_ok + q_ok >= 1))
         )
     finally:
+        for s in subs:
+            s.close()
         for p in peers:
             p.close()
 

@@ -63,4 +63,16 @@ if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_OBS:-1}" = "1" ]; then
   JAX_PLATFORMS=cpu python benchmarks/obs_overhead.py "$OBS_OUT" \
     >/dev/null 2>>"$OUT" || FAILED=1
 fi
+
+# Serving-tier gate (r10): under full write load, a read-only subscriber's
+# p99 verified staleness must stay inside the configured bound — lower-90%
+# discipline across repeats (mean - 1.645*SEM), same as the obs gate, per
+# this box's 5-10% loopback noise. Runs AFTER the perf-floor gate so the
+# committed SERVE artifact always rides a passing write-path floor in the
+# same suite run (benchmarks/serve_bench.py). ST_SUITE_SERVE=0 skips.
+if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_SERVE:-1}" = "1" ]; then
+  SERVE_OUT="${ST_SUITE_SERVE_OUT:-SERVE_r10.json}"
+  JAX_PLATFORMS=cpu python benchmarks/serve_bench.py "$SERVE_OUT" \
+    >/dev/null 2>>"$OUT" || FAILED=1
+fi
 exit "$FAILED"

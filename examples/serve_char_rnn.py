@@ -1,0 +1,177 @@
+"""char-rnn SERVING demo (r10): a read-only subscriber replica serves text
+generation while trainer peers stream weight updates through the tree.
+
+The read-path twin of train_char_rnn.py's peer mode, and the shape of an
+inference fleet on this system:
+
+- N trainer peers (writers) join the tree at the rendezvous and run
+  async-SGD, each ``add()``-ing its own gradient steps;
+- one SUBSCRIBER joins as a read-only leaf (it never adds — writers keep
+  zero ledger/ACK state for it), and a :class:`serve.ServingHandle`
+  hot-swaps verified snapshots into the sampling loop;
+- every swap VERIFIES its staleness bound against the r09 origin stamps /
+  FRESH drain marks — a violation raises StalenessError instead of
+  serving stale weights (run it under chaos and watch the refusals).
+
+Single-process demo by default (trainers on background threads, the
+subscriber serving from the main thread); pass --peer/--serve to split
+across real processes:
+
+  # terminal 1..n: trainers (writers)
+  python examples/serve_char_rnn.py --peer 127.0.0.1:50000
+  # terminal n+1: the serving replica
+  python examples/serve_char_rnn.py --serve 127.0.0.1:50000
+"""
+
+import argparse
+import os
+import pathlib
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from shared_tensor_tpu import serve
+from shared_tensor_tpu.models import char_rnn as m
+
+
+def run_trainer(host, port, cfg, text, args, stop=None, tag="trainer",
+                ready=None):
+    from shared_tensor_tpu.comm.peer import create_or_fetch
+
+    params = m.init_params(jax.random.key(0), cfg)
+    data = m.encode_corpus(text)
+    grad = jax.jit(jax.grad(lambda p, b: m.loss_fn(p, b, cfg)))
+    with create_or_fetch(host, port, params) as st:
+        if ready is not None:
+            ready.set()  # the tree exists: joiners/subscribers may start
+        for i in range(args.steps):
+            if stop is not None and stop.is_set():
+                break
+            params = st.read()
+            batch = m.make_batches(data, args.batch, args.seq, jax.random.key(i))
+            g = grad(params, batch)
+            st.add(jax.tree.map(lambda x: -args.lr * x, g))
+            if i % 20 == 0:
+                print(f"[{tag}] step {i:4d} "
+                      f"loss {float(m.loss_fn(params, batch, cfg)):.3f}")
+        st.drain(timeout=30.0, tol=1e-30)
+
+
+def run_server(host, port, cfg, text, args, stop=None):
+    """The serving loop: subscribe read-only, hot-swap verified weights,
+    sample. Every ``refresh`` is a verified bounded-staleness read — the
+    only way stale weights could be served is loudly, as an exception."""
+    template = m.init_params(jax.random.key(0), cfg)
+    sub = serve.subscribe(host, port, template, timeout=60.0)
+    handle = sub.serving_handle(max_staleness=args.max_staleness)
+    served = refused = 0
+    prompt = jnp.frombuffer(text[:8], dtype=jnp.uint8).astype(jnp.int32)
+    try:
+        deadline = time.monotonic() + args.serve_seconds
+        while time.monotonic() < deadline:
+            if stop is not None and stop.is_set() and served:
+                break
+            try:
+                handle.refresh()
+            except serve.StalenessError as e:
+                refused += 1
+                print(f"[serve] REFUSED: {e}")
+                time.sleep(0.25)
+                continue
+            out = m.sample(
+                handle.params(), jax.random.key(served), prompt, cfg,
+                length=args.sample_len, temperature=0.8,
+            )
+            txt = (text[:8] + bytes(int(t) % 256 for t in out)).decode(
+                errors="replace"
+            )
+            served += 1
+            print(
+                f"[serve] v{handle.version} "
+                f"staleness {handle.staleness:.3f}s (bound "
+                f"{args.max_staleness}s): {txt[:72]!r}"
+            )
+            time.sleep(args.serve_interval)
+    finally:
+        mtr = sub.metrics()
+        print(
+            f"[serve] served {served} samples, {refused} refused; "
+            f"reads ok/stale = {mtr['st_read_total']:.0f}/"
+            f"{mtr['st_read_stale_total']:.0f}, "
+            f"resyncs {mtr['st_sub_resyncs_total']:.0f}"
+        )
+        sub.close()
+    if served == 0:
+        sys.exit("[serve] nothing served — were the trainers up?")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("corpus", nargs="?", help="text file (default: pangram)")
+    ap.add_argument("--peer", help="host:port — run ONE trainer process")
+    ap.add_argument("--serve", help="host:port — run ONE serving process")
+    ap.add_argument("--port", type=int, default=50310)
+    ap.add_argument("--trainers", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=48)
+    ap.add_argument("--lr", type=float, default=0.5)
+    ap.add_argument("--hidden", type=int, default=96)
+    ap.add_argument("--layers", type=int, default=1)
+    ap.add_argument("--max-staleness", type=float, default=1.0)
+    ap.add_argument("--serve-seconds", type=float, default=30.0)
+    ap.add_argument("--serve-interval", type=float, default=0.5)
+    ap.add_argument("--sample-len", type=int, default=64)
+    args = ap.parse_args()
+
+    if args.corpus:
+        text = pathlib.Path(args.corpus).read_bytes()
+    else:
+        text = b"The quick brown fox jumps over the lazy dog. " * 500
+    cfg = m.CharRNNConfig(hidden=args.hidden, layers=args.layers)
+
+    if args.peer:
+        host, port = args.peer.rsplit(":", 1)
+        run_trainer(host, int(port), cfg, text, args)
+        return
+    if args.serve:
+        host, port = args.serve.rsplit(":", 1)
+        run_server(host, int(port), cfg, text, args)
+        return
+
+    # single-process demo: trainers on threads, serving on the main thread
+    host, port = "127.0.0.1", args.port
+    stop = threading.Event()
+    master_up = threading.Event()
+    # demo trainers train for the WHOLE serving window (stop ends them);
+    # --steps only bounds the split-process mode
+    t_args = argparse.Namespace(**{**vars(args), "steps": 10**9})
+    trainers = [
+        threading.Thread(
+            target=run_trainer,
+            args=(host, port, cfg, text, t_args, stop, f"trainer{i}"),
+            kwargs={"ready": master_up if i == 0 else None},
+            daemon=True,
+        )
+        for i in range(args.trainers)
+    ]
+    trainers[0].start()
+    if not master_up.wait(120.0):  # model init + jit happen before the join
+        sys.exit("trainer 0 never claimed the rendezvous")
+    for t in trainers[1:]:
+        t.start()
+    try:
+        run_server(host, port, cfg, text, args, stop)
+    finally:
+        stop.set()
+        for t in trainers:
+            t.join(timeout=60.0)
+
+
+if __name__ == "__main__":
+    main()

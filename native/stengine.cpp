@@ -122,6 +122,14 @@ namespace {
 constexpr uint8_t kData = 0;
 constexpr uint8_t kAck = 6;
 constexpr uint8_t kBurst = 7;
+// r10 serving tier: FRESH = parent's drained-residual freshness mark for a
+// subscriber link ([kind][u64 monotonic ns]); RDATA = one frame sliced to
+// the subscribed word range ([kind][u32 seq][u32 word_lo][u32 word_cnt]
+// [trace?][scales L*4][words word_cnt*4]). Both are emitted by this
+// sender for subscriber-mode links only; neither is ever received here
+// (subscribers run the Python serve tier).
+constexpr uint8_t kFresh = 10;
+constexpr uint8_t kRData = 11;
 
 constexpr float kSat = 3.0e38f;
 
@@ -131,6 +139,13 @@ constexpr float kSat = 3.0e38f;
 // actually restore in-order progress at the receiver).
 constexpr size_t kSendWindow = 32;
 constexpr size_t kRetxPrefix = 4;
+// Frames per message on SUBSCRIBER links (r10), capping the writer-tier
+// burst: a serving link trades batch efficiency for pipeline LATENCY —
+// its staleness floor is (transport queue depth) x (per-message apply
+// time at the python-tier subscriber), so 255-frame multi-MB bursts put
+// the floor at seconds while 32 keeps it near the read bound. Writers'
+// writer links keep the full burst (peer.py SEND_WINDOW rationale).
+constexpr int kSubBurstCap = 32;
 
 // scale policies (config.ScalePolicy)
 enum Policy { kPow2Rms = 0, kRms = 1, kAbsMean = 2 };
@@ -237,6 +252,7 @@ constexpr uint32_t kEvWindowStall = 13;
 constexpr uint32_t kEvDedupDiscard = 14;
 constexpr uint32_t kEvSeal = 15;
 constexpr uint32_t kEvTraceApply = 30;  // r09 cross-hop trace propagation
+constexpr uint32_t kEvSubAttach = 31;   // r10 subscriber link attached
 
 // ---- r09 trace context (comm/wire.py v2 framing) --------------------------
 //
@@ -308,6 +324,18 @@ struct ELink {
   // Updated at flush under Engine::mu.
   uint64_t stale_ns = 0;
   uint32_t last_hops = 0;
+  // r10 subscriber link mode (st_engine_attach_sub): read-only leaf on the
+  // other end — UNLEDGERED (no unacked entries, no ACKs expected, no
+  // go-back-N; loss shows up as a seq gap the subscriber repairs with a
+  // resync handshake), optionally RANGE-FILTERED (only words
+  // [wlo, wlo+wcnt) of each frame ship, as kRData messages — the
+  // paged-subscription discipline), with periodic kFresh drain marks so an
+  // idle subscriber can still verify its staleness bound.
+  bool subscriber = false;
+  bool ranged = false;
+  int64_t wlo = 0, wcnt = 0;  // subscribed word range
+  uint64_t fresh_interval_ns = 0;
+  uint64_t last_fresh_ns = 0;
 };
 
 struct Engine {
@@ -393,6 +421,11 @@ struct Engine {
   std::atomic<uint64_t> hops_sum{0}, hops_msgs{0};
   std::atomic<uint64_t> staleness_ns_last{0};
   std::atomic<uint64_t> traced_msgs_in{0};
+  // r10 serving tier (st_engine_counters[16..17]): unledgered data
+  // messages sent to subscriber links (OUTSIDE the msgs_out taxonomy —
+  // that one stays "ACK-ledgered wire messages" on both tiers) and kFresh
+  // drain marks delivered.
+  std::atomic<uint64_t> sub_msgs_out{0}, sub_fresh_out{0};
   // r09 wire format: stamp outgoing DATA/BURST with the v2 trace context
   // (0 = v1 framing, byte-identical to r08 — the receive side accepts
   // both regardless, so mixed trees interop; ObsConfig.trace_wire).
@@ -622,6 +655,24 @@ void retransmit_pass(Engine* e, const std::vector<int32_t>& ids) {
   }
 }
 
+// One unledgered send with the same backpressure/quarantine discipline as
+// the main path (r10 subscriber links). Returns false when the link died
+// or was quarantined — the caller marks it dead and rolls its frames back.
+bool sub_send(Engine* e, int32_t id, const uint8_t* p, size_t n) {
+  int32_t fails = 0;
+  while (!e->stop.load()) {
+    int32_t r = st_node_send(e->node, id, p, (int32_t)n, 0.1);
+    if (r == 1) return true;
+    if (r < 0) return false;
+    if (e->quarantine > 0 && ++fails >= e->quarantine) {
+      st_obs_emit(e->obs_id, kEvQuarantine, id, (uint64_t)fails);
+      st_node_drop_link(e->node, id);
+      return false;
+    }
+  }
+  return false;
+}
+
 void sender_loop(Engine* e) {
   std::vector<uint8_t> payload;
   std::vector<float> scales((size_t)e->L);
@@ -645,11 +696,45 @@ void sender_loop(Engine* e) {
       SentMsg msg;
       TxSlot* slot = nullptr;
       size_t per = frame_bytes(e);
+      // r10 subscriber-link state, captured under e->mu for the unledgered
+      // send path below (incl. the trace stamp — the ledgered path reads it
+      // while packing headers under the same lock)
+      bool sub = false, sub_ranged = false;
+      int64_t sub_wlo = 0, sub_wcnt = 0;
+      uint32_t tr_o = 0;
+      uint64_t tr_g = 0;
+      uint8_t tr_h = 0;
       {
         std::lock_guard<std::mutex> lk(e->mu);
         auto it = e->links.find(id);
         if (it == e->links.end() || it->second.dead) continue;
         ELink& lk2 = it->second;
+        sub = lk2.subscriber;
+        if (sub) {
+          sub_ranged = lk2.ranged;
+          sub_wlo = lk2.wlo;
+          sub_wcnt = lk2.wcnt;
+          if (lk2.fresh_interval_ns && !lk2.dirty) {
+            // FRESH beat: the residual is fully drained — "as of now you
+            // have everything I have, through message tx_seq" (the seq
+            // makes the mark verifiable: a subscriber missing the stream
+            // tail resyncs instead of falsely trusting it). Sent from
+            // under e->mu with a zero timeout, same discipline as
+            // flush_acks (lossy: a bounced beat retries next pass).
+            uint64_t now = st_obs_now_ns();
+            if (now - lk2.last_fresh_ns >= lk2.fresh_interval_ns) {
+              uint8_t fb[13];
+              fb[0] = kFresh;
+              std::memcpy(fb + 1, &now, 8);
+              uint32_t ls = (uint32_t)lk2.tx_seq;
+              std::memcpy(fb + 9, &ls, 4);
+              if (st_node_send(e->node, id, fb, 13, 0.0) == 1) {
+                lk2.last_fresh_ns = now;
+                e->sub_fresh_out++;
+              }
+            }
+          }
+        }
         if (!lk2.dirty) continue;
         // go-back-N send window: a full unacked ledger (stalled peer)
         // stops NEW production on this link; the residual keeps
@@ -682,7 +767,10 @@ void sender_loop(Engine* e) {
         // with no further copies.
         msg.nframes = 0;
         uint8_t* body = nullptr;
-        if (!e->compat_bytes) {
+        if (!e->compat_bytes && !sub) {
+          // subscriber links are unledgered: no slot (the ledger entry IS
+          // the slot on the ledgered path) — frames quantize into the
+          // msg.scales/words buffers like compat and encode below
           slot = e->txpool.acquire();
           body = slot->buf.data() + kBodyOff;
         }
@@ -692,6 +780,19 @@ void sender_loop(Engine* e) {
           lk2.psabs.resize((size_t)e->L);
           lk2.pvalid = false;
         }
+        if (sub_ranged) {
+          // range discipline: out-of-range residual is mass this link's
+          // receiver will never get (adds/floods refill the FULL residual
+          // between passes) — drop it BEFORE scale selection, so frames
+          // never budget scale for it and the link goes idle the moment
+          // its own pages drain (without this, the dropped mass decays
+          // geometrically across dozens of frames of useless traffic)
+          std::fill(lk2.resid.begin(), lk2.resid.begin() + sub_wlo * 32,
+                    0.0f);
+          std::fill(lk2.resid.begin() + (sub_wlo + sub_wcnt) * 32,
+                    lk2.resid.end(), 0.0f);
+          lk2.pvalid = false;  // cached partials counted the dropped mass
+        }
         if (lk2.pvalid) {
           std::copy(lk2.pamax.begin(), lk2.pamax.end(), amax.begin());
           std::copy(lk2.pss.begin(), lk2.pss.end(), ss.begin());
@@ -700,7 +801,8 @@ void sender_loop(Engine* e) {
           stc_scale_partials(lk2.resid.data(), e->off.data(), e->ns.data(),
                              e->L, amax.data(), ss.data(), sabs.data());
         }
-        for (int b = 0; b < e->burst; b++) {
+        int bmax = sub && e->burst > kSubBurstCap ? kSubBurstCap : e->burst;
+        for (int b = 0; b < bmax; b++) {
           scales_from_partials(e, amax, ss, sabs, scales.data());
           if (!any_nonzero(scales.data(), e->L)) {
             if (b == 0) lk2.dirty = false;  // nothing to say at all
@@ -739,12 +841,26 @@ void sender_loop(Engine* e) {
           continue;
         }
         e->frames_out += (uint64_t)msg.nframes;
+        if (sub) {
+          // unledgered: allocate wire seqs (the subscriber's gap detector
+          // needs them) and capture the trace stamp; no unacked entry —
+          // delivery degrades to ack-on-send like compat, and loss is the
+          // subscriber's resync to repair
+          int nmsg = sub_ranged ? msg.nframes : 1;
+          msg.seq = lk2.tx_seq + 1;
+          lk2.tx_seq += (uint64_t)nmsg;
+          if (e->trace_wire) {
+            tr_o = e->t_has ? e->t_origin : e->obs_id;
+            tr_g = e->t_has ? e->t_gen : st_obs_now_ns();
+            tr_h = e->t_has ? (uint8_t)(e->t_hops > 255 ? 255 : e->t_hops) : 0;
+          }
+        }
         // ledger entry BEFORE the send: the receiver's ACK must never race
         // ahead of the entry it acknowledges (comm/peer.py _send_loop).
         // Compat: no ACKs exist, so no ledger — delivery degrades to
         // ack-on-send like the Python compat tier (peer.py _send_loop
         // docstring); a failed send rolls back THIS message inline below.
-        if (!e->compat_bytes) {
+        if (!e->compat_bytes && !sub) {
           msg.seq = ++lk2.tx_seq;
           // wire header, packed flush against the 8-aligned body at
           // kBodyOff (comm/wire.py framing; LE host assumed): BURST
@@ -794,6 +910,98 @@ void sender_loop(Engine* e) {
           // lock for the same reason.
           slot->refs.fetch_add(1, std::memory_order_relaxed);
         }
+      }
+      // r10 subscriber links: encode + send outside the lock, unledgered.
+      // Ranged: one kRData message per frame ([kind][seq][wlo][wcnt]
+      // [trace?][scales][word slice]) — the subscriber receives and
+      // buffers ONLY its pages. Full-table: one ordinary DATA/BURST
+      // message (the subscriber speaks the normal framing, just without
+      // ACKing it). Frame buffers live in msg.scales/words (transient —
+      // nothing to retransmit, by design).
+      if (sub) {
+        st_fault_crash_point("mid-burst");
+        const size_t L4 = (size_t)e->L * 4;
+        bool ok = true;
+        if (sub_ranged) {
+          const size_t hdr = e->trace_wire ? 26 : 13;
+          payload.resize(hdr + L4 + (size_t)sub_wcnt * 4);
+          for (int32_t f = 0; f < msg.nframes && ok; f++) {
+            uint8_t* p = payload.data();
+            p[0] = kRData;
+            uint32_t s32 = (uint32_t)(msg.seq + (uint64_t)f);
+            uint32_t lo32 = (uint32_t)sub_wlo, c32 = (uint32_t)sub_wcnt;
+            std::memcpy(p + 1, &s32, 4);
+            std::memcpy(p + 5, &lo32, 4);
+            std::memcpy(p + 9, &c32, 4);
+            size_t o = 13;
+            if (e->trace_wire) {
+              std::memcpy(p + o, &tr_o, 4);
+              std::memcpy(p + o + 4, &tr_g, 8);
+              p[o + 12] = tr_h;
+              o += 13;
+            }
+            std::memcpy(p + o, msg.scales.data() + (size_t)f * e->L, L4);
+            std::memcpy(p + o + L4,
+                        msg.words.data() + (size_t)f * e->W + sub_wlo,
+                        (size_t)sub_wcnt * 4);
+            ok = sub_send(e, id, payload.data(), payload.size());
+            if (ok) e->sub_msgs_out++;
+          }
+        } else {
+          const size_t per2 = L4 + (size_t)e->W * 4;
+          const bool burst = msg.nframes > 1;
+          const size_t hdr =
+              burst ? (e->trace_wire ? kBurstHdrV2 : kBurstHdrV1)
+                    : (e->trace_wire ? kDataHdrV2 : kDataHdrV1);
+          payload.resize(hdr + (size_t)msg.nframes * per2);
+          uint8_t* p = payload.data();
+          uint32_t s32 = (uint32_t)msg.seq;
+          size_t o;
+          if (burst) {
+            p[0] = kBurst;
+            std::memcpy(p + 1, &s32, 4);
+            p[5] = (uint8_t)msg.nframes;
+            o = kBurstHdrV1;
+          } else {
+            p[0] = kData;
+            std::memcpy(p + 1, &s32, 4);
+            o = kDataHdrV1;
+          }
+          if (e->trace_wire) {
+            std::memcpy(p + o, &tr_o, 4);
+            std::memcpy(p + o + 4, &tr_g, 8);
+            p[o + 12] = tr_h;
+            o += 13;
+          }
+          for (int32_t f = 0; f < msg.nframes; f++) {
+            std::memcpy(p + o, msg.scales.data() + (size_t)f * e->L, L4);
+            std::memcpy(p + o + L4, msg.words.data() + (size_t)f * e->W,
+                        (size_t)e->W * 4);
+            o += per2;
+          }
+          ok = sub_send(e, id, payload.data(), payload.size());
+          if (ok) e->sub_msgs_out++;
+        }
+        if (ok) {
+          sent_any = true;
+        } else {
+          // undelivered: roll this message's frames back so a detach
+          // returns the residual the subscriber is still owed, and mark
+          // the link dead (membership is Python's call, as everywhere)
+          std::lock_guard<std::mutex> lk(e->mu);
+          auto it = e->links.find(id);
+          if (it != e->links.end()) {
+            for (int32_t f = 0; f < msg.nframes; f++)
+              stc_apply_frame(it->second.resid.data(),
+                              it->second.resid.data(), e->off.data(),
+                              e->ns.data(), e->padded.data(), e->L,
+                              msg.scales.data() + (size_t)f * e->L,
+                              msg.words.data() + (size_t)f * e->W);
+            it->second.pvalid = false;
+            it->second.dead = true;
+          }
+        }
+        continue;
       }
       // send outside the lock
       if (e->compat_bytes) {
@@ -1366,6 +1574,60 @@ __attribute__((visibility("default"))) int32_t st_engine_attach(
   return 1;
 }
 
+// r10: open a SUBSCRIBER link — read-only leaf, unledgered (no unacked
+// entries, no ACK expectation, no go-back-N: a lost message is a seq gap
+// the subscriber repairs with a resync handshake), optionally filtered to
+// a word range (kRData framing ships only words [word_lo, word_lo+word_cnt)
+// per frame; word_cnt <= 0 subscribes the whole table), with kFresh drain
+// marks every fresh_interval_sec while idle. Residual seeds like
+// st_engine_attach (values - snapshot; NULL snapshot = full replica), then
+// zeroes outside the range — mass nobody will ever receive must not keep
+// the sender busy. Attach and mode-set are ONE atomic step ON PURPOSE: a
+// two-call attach-then-mark would let this sender emit a LEDGERED message
+// in the window, whose missing ACK would black-hole the link.
+// Returns 0 on duplicate link or compat mode (no SYNC handshake there, so
+// no subscribers).
+__attribute__((visibility("default"))) int32_t st_engine_attach_sub(
+    void* h, int32_t link_id, const float* snapshot, uint64_t rx_init,
+    int64_t word_lo, int64_t word_cnt, double fresh_interval_sec) {
+  if (!h) return 0;
+  auto* e = (Engine*)h;
+  if (e->compat_bytes) return 0;
+  {
+    std::lock_guard<std::mutex> lk(e->mu);
+    if (e->links.count(link_id)) return 0;
+    ELink& lk2 = e->links[link_id];
+    lk2.resid.assign((size_t)e->total, 0.0f);
+    if (snapshot) {
+      for (int64_t i = 0; i < e->total; i++)
+        lk2.resid[i] = e->values[i] - snapshot[i];
+    } else {
+      std::memcpy(lk2.resid.data(), e->values.data(), (size_t)e->total * 4);
+    }
+    if (word_cnt <= 0 || word_lo < 0 || word_lo + word_cnt > e->W) {
+      word_lo = 0;
+      word_cnt = e->W;
+    }
+    lk2.subscriber = true;
+    lk2.wlo = word_lo;
+    lk2.wcnt = word_cnt;
+    lk2.ranged = (word_lo > 0 || word_cnt < e->W);
+    if (lk2.ranged) {
+      std::fill(lk2.resid.begin(), lk2.resid.begin() + word_lo * 32, 0.0f);
+      std::fill(lk2.resid.begin() + (word_lo + word_cnt) * 32,
+                lk2.resid.end(), 0.0f);
+    }
+    lk2.fresh_interval_ns =
+        fresh_interval_sec > 0 ? (uint64_t)(fresh_interval_sec * 1e9) : 0;
+    lk2.rx_count = rx_init;
+    lk2.ack_sent = rx_init;
+    lk2.dirty = true;
+  }
+  st_obs_emit(e->obs_id, kEvSubAttach, link_id, (uint64_t)word_cnt);
+  e->wake();
+  return 1;
+}
+
 // The wire-compat LEAF re-graft as ONE atomic step (the C analog of
 // core.SharedTensor.regraft_reset_to_carry, same rationale): consume the
 // carry, set the replica to EXACTLY the carry (fresh-joiner semantics — a
@@ -1543,34 +1805,38 @@ __attribute__((visibility("default"))) int64_t st_engine_inflight(void* h) {
 // retransmitted messages, dup/gap discards, and the ACK round-trip
 // sum-of-ns + sample count); [12..15] the r09 trace aggregates (hop-count
 // sum + sample count over applied traced messages, the most recent
-// apply-time staleness in ns, and the traced-message count —
-// obs/schema.py names all of them canonically).
+// apply-time staleness in ns, and the traced-message count); [16..17] the
+// r10 serving aggregates (unledgered subscriber data messages sent +
+// kFresh drain marks delivered — obs/schema.py names all of them
+// canonically).
 __attribute__((visibility("default"))) void st_engine_counters(
-    void* h, uint64_t* out16) {
+    void* h, uint64_t* out18) {
   if (!h) {  // the SIGSEGV that aborted the whole suite (r05 Weak #2)
-    for (int i = 0; i < 16; i++) out16[i] = 0;
+    for (int i = 0; i < 18; i++) out18[i] = 0;
     return;
   }
   auto* e = (Engine*)h;
-  out16[0] = e->frames_out.load();
-  out16[1] = e->frames_in.load();
-  out16[2] = e->updates.load();
-  out16[3] = e->msgs_out.load();
-  out16[4] = e->msgs_in.load();
-  out16[5] = e->txpool.acquires.load();
-  out16[6] = e->txpool.alloc_events.load();
+  out18[0] = e->frames_out.load();
+  out18[1] = e->frames_in.load();
+  out18[2] = e->updates.load();
+  out18[3] = e->msgs_out.load();
+  out18[4] = e->msgs_in.load();
+  out18[5] = e->txpool.acquires.load();
+  out18[6] = e->txpool.alloc_events.load();
   {
     std::lock_guard<std::mutex> lk(e->txpool.mu);
-    out16[7] = (uint64_t)e->txpool.all_.size();
+    out18[7] = (uint64_t)e->txpool.all_.size();
   }
-  out16[8] = e->retx_msgs.load();
-  out16[9] = e->dedup_discards.load();
-  out16[10] = e->rtt_ns_total.load();
-  out16[11] = e->rtt_msgs.load();
-  out16[12] = e->hops_sum.load();
-  out16[13] = e->hops_msgs.load();
-  out16[14] = e->staleness_ns_last.load();
-  out16[15] = e->traced_msgs_in.load();
+  out18[8] = e->retx_msgs.load();
+  out18[9] = e->dedup_discards.load();
+  out18[10] = e->rtt_ns_total.load();
+  out18[11] = e->rtt_msgs.load();
+  out18[12] = e->hops_sum.load();
+  out18[13] = e->hops_msgs.load();
+  out18[14] = e->staleness_ns_last.load();
+  out18[15] = e->traced_msgs_in.load();
+  out18[16] = e->sub_msgs_out.load();
+  out18[17] = e->sub_fresh_out.load();
 }
 
 // r09 per-link convergence telemetry: out2[0] = origin-stamp age (ns) of

@@ -195,6 +195,11 @@ constexpr uint32_t kEvFaultTruncate = 23;
 constexpr uint32_t kEvFaultDelay = 24;
 constexpr uint32_t kEvFaultStall = 25;
 constexpr uint32_t kEvFaultSever = 26;
+// 30 (trace_apply) and 31 (sub_attach, r10 subscriber link mode) are
+// emitted by stengine.cpp; listed in obs/events.py CODE_NAMES like the
+// rest — the numeric values are ABI across all three surfaces.
+constexpr uint32_t kEvSubAttach = 31;
+static_assert(kEvSubAttach == 31, "ABI code mirrored in obs/events.py");
 
 }  // namespace stobs
 
@@ -815,8 +820,12 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
     const FaultPlan& fp = node->cfg.fault;
     if (fp.enabled && have) {
       const uint8_t* d = msg.data();
+      // data kinds: DATA(0), BURST(7), and the r10 range-filtered RDATA(11)
+      // — a subscriber's delta stream must face the same chaos classes as
+      // a writer's, or the serve-tier drop arm would inject nothing
       bool is_data = node->cfg.wire_compat ||
-                     (msg.size() > 0 && (d[0] == 0 || d[0] == 7));
+                     (msg.size() > 0 &&
+                      (d[0] == 0 || d[0] == 7 || d[0] == 11));
       if (is_data && (fp.only_link <= 0 || link->id == fp.only_link)) {
         if (!link->fault_rng)
           link->fault_rng =

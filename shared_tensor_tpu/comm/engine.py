@@ -66,6 +66,15 @@ def load_engine() -> Optional[ctypes.CDLL]:
         lib.st_engine_link_obs.argtypes = [
             ctypes.c_void_p, ctypes.c_int32, _u64p,
         ]
+        # r10 subscriber link mode: unledgered + optionally range-filtered
+        lib.st_engine_attach_sub.restype = ctypes.c_int32
+        lib.st_engine_attach_sub.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_void_p,  # snapshot (nullable)
+            ctypes.c_uint64,  # rx_init
+            ctypes.c_int64, ctypes.c_int64,  # word_lo, word_cnt
+            ctypes.c_double,  # fresh_interval_sec
+        ]
         lib.st_engine_compat_regraft.restype = ctypes.c_int32
         lib.st_engine_compat_regraft.argtypes = [
             ctypes.c_void_p, ctypes.c_int32,
@@ -304,6 +313,38 @@ class EngineTensor:
         if r == 0:
             raise DuplicateLink(f"link {link_id} already exists")
 
+    def new_link_sub(
+        self,
+        link_id: int,
+        peer_snapshot: Optional[np.ndarray],
+        rx_init: int = 0,
+        word_lo: int = 0,
+        word_cnt: int = 0,
+        fresh_interval_sec: float = 0.0,
+    ) -> None:
+        """Open a SUBSCRIBER link (r10 serving tier): unledgered — the C
+        sender keeps no unacked entries, expects no ACKs and never
+        retransmits — and, when ``word_cnt > 0`` names a sub-range,
+        range-filtered (kRData framing ships only those words per frame).
+        Attach and mode are one atomic native call: a separate mark-after-
+        attach would let the sender emit a ledgered message whose missing
+        ACK black-holes the link. ``peer_snapshot=None`` seeds the full
+        replica (fresh subscriber / resync re-seed)."""
+        snap_ptr = None
+        if peer_snapshot is not None:
+            snap = np.ascontiguousarray(peer_snapshot, np.float32)
+            if snap.shape != (self.spec.total,):
+                raise ValueError(
+                    f"snapshot shape {snap.shape} != ({self.spec.total},)"
+                )
+            snap_ptr = snap.ctypes.data_as(ctypes.c_void_p)
+        r = self._lib.st_engine_attach_sub(
+            self._handle(), link_id, snap_ptr, rx_init,
+            word_lo, word_cnt, fresh_interval_sec,
+        )
+        if r == 0:
+            raise DuplicateLink(f"link {link_id} already exists")
+
     def stash_carry(self, link_id: int) -> bool:
         """Park a dead uplink's residual in the engine's LIVE carry slot —
         it keeps accumulating add()/flood mass while orphaned (an orphan
@@ -442,14 +483,16 @@ class EngineTensor:
         Layout (st_engine_counters): [frames_out, frames_in, updates,
         msgs_out, msgs_in, tx_slot_acquires, tx_slot_alloc_events,
         tx_slots_allocated, retx_msgs, dedup_discards, rtt_ns_total,
-        rtt_msgs, hops_sum, hops_msgs, staleness_ns_last, traced_msgs_in]
+        rtt_msgs, hops_sum, hops_msgs, staleness_ns_last, traced_msgs_in,
+        sub_msgs_out, sub_fresh_out]
         — [5..7] are the r07 tx-ring pool stats (steady state: acquires
         grow, alloc_events stay flat); [8..11] the r08 obs aggregates
         (go-back-N retransmits, dup/gap discards, ACK round-trip ns sum +
         sample count); [12..15] the r09 trace aggregates (hop-count sum +
         sample count, latest apply-time staleness ns, traced applied
-        messages)."""
-        out = np.zeros(16, np.uint64)
+        messages); [16..17] the r10 serving aggregates (unledgered
+        subscriber data messages sent, FRESH drain marks delivered)."""
+        out = np.zeros(18, np.uint64)
         if self._h:
             self._lib.st_engine_counters(self._h, out)
         return out
@@ -493,6 +536,8 @@ class EngineTensor:
             "st_update_hops_sum": int(c[12]),
             "st_update_hops_count": int(c[13]),
             "st_traced_msgs_in_total": int(c[15]),
+            "st_sub_msgs_out_total": int(c[16]),
+            "st_sub_fresh_out_total": int(c[17]),
         }
 
     @property

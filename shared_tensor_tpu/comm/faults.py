@@ -247,9 +247,15 @@ def corrupt(
     lo, hi = 0, 0
     data_hdr = 5 + trace_bytes  # [kind][u32 seq][trace?]
     burst_hdr = 6 + trace_bytes  # [kind][u32 seq][u8 k][trace?]
+    rdata_hdr = 13 + trace_bytes  # [kind][u32 seq][u32 lo][u32 cnt][trace?]
     if scale_bytes > 0 and b[0] == 0 and len(b) > data_hdr + scale_bytes:
         # DATA: one frame after the header
         lo, hi = data_hdr + scale_bytes, len(b)
+    elif scale_bytes > 0 and b[0] == 11 and len(b) > rdata_hdr + scale_bytes:
+        # RDATA (r10 range-filtered frame): one frame's scales then the
+        # sliced words after the range header — same bounded-flip rule
+        # (never the seq/range fields, never a scale exponent)
+        lo, hi = rdata_hdr + scale_bytes, len(b)
     elif scale_bytes > 0 and b[0] == 7 and len(b) > burst_hdr:
         k = b[5]
         per = (len(b) - burst_hdr) // k if k else 0

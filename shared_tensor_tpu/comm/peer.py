@@ -443,6 +443,25 @@ class SharedTensorPeer:
         self._compat_reset_on_regraft = False
         self._sealed = False  # leave() in progress: discard unACKed ingress
         self._uplink: Optional[int] = None
+        # r10 serving tier, WRITER side. _sub_links: attached read-only
+        # subscriber links -> their word range (None = full table). These
+        # links are UNLEDGERED: the send loop never appends to _unacked for
+        # them (no ACKs will come — compat.SYNC_FLAG_READ_ONLY), loss is
+        # the subscriber's seq-gap detector + resync handshake to repair,
+        # and LINK_DOWN discards their residual without a carry (a
+        # read-only leaf owes the tree nothing). _pending_sub: handshake
+        # state between a read-only SYNC and its DONE (value = the RANGE
+        # subscription received so far, None = full). _sub_fresh: last
+        # FRESH drain-mark time per link (python-tier beat; the engine
+        # tier beats in C).
+        self._sub_links: dict[int, Optional[tuple[int, int]]] = {}
+        self._pending_sub: dict[int, Optional[tuple[int, int]]] = {}
+        self._sub_fresh: dict[int, float] = {}
+        # replica state_version at each ranged link's last residual mask
+        # (skip the full-table mask copy on idle passes)
+        self._sub_mask_ver: dict[int, int] = {}
+        self._sub_msgs_out = 0
+        self._sub_fresh_out = 0
         # delivery accounting (see _send_loop): per link, the in-order list
         # of sent-but-unacked messages as (ledger_seq, wire_seq, payload)
         # — the payload is kept so an ACK timeout can retransmit it
@@ -627,6 +646,12 @@ class SharedTensorPeer:
         import math
 
         out = _schema.canonicalize(self.metrics(_warn=False))
+        # r10 writer-side serving gauges/counters. The python-tier counts
+        # are authoritative only on the python tier (the engine's C sender
+        # owns them otherwise and obs_stats() below overrides).
+        out["st_sub_links"] = len(self._sub_links)
+        out["st_sub_msgs_out_total"] = self._sub_msgs_out
+        out["st_sub_fresh_out_total"] = self._sub_fresh_out
         if self._engine is not None:
             out.update(self._engine.obs_stats())
         out["st_corrupt_scales_zeroed_total"] = wire.corrupt_scales_zeroed()
@@ -843,6 +868,12 @@ class SharedTensorPeer:
                 del pipe[stale]  # LINK_DOWN already rolled their ledger back
                 hot.discard(stale)
             for link in links:
+                if link in self._sub_links:
+                    # r10 subscriber link: unledgered send path (no window,
+                    # no unacked entries, no retransmission) + FRESH beats
+                    if self._send_sub(link):
+                        sent_any = True
+                    continue
                 if not compat and self._window_full(link):
                     # go-back-N send window: a link whose unacked ledger is
                     # full (stalled peer, black hole in progress) produces
@@ -981,6 +1012,111 @@ class SharedTensorPeer:
                 # new residual mass (event-driven wake, fixing quirk Q2)
                 self._wake.wait(0.05)
                 self._wake.clear()
+
+    def _send_sub(self, link: int) -> bool:
+        """One sender pass for a read-only subscriber link (python tier;
+        the native engine runs the same logic in C — stengine.cpp's
+        subscriber branch). Unledgered: the message is considered delivered
+        on enqueue (``ack_frame`` immediately — the compat-tier discipline),
+        no unacked entry is kept and no ACK will come; a message the wire
+        swallows surfaces as a seq gap at the subscriber, whose resync
+        handshake re-seeds the link. Ranged subscriptions ship one
+        wire.RDATA per frame (only the subscribed words); full-table ones
+        ship ordinary DATA/BURST. Idle links get a periodic wire.FRESH
+        drain mark so the subscriber can keep verifying its staleness
+        bound while nothing is being written."""
+        rng = self._sub_links.get(link)
+        scfg = self.config.serve
+        if rng is not None:
+            # drop out-of-range residual BEFORE scale selection (the range
+            # discipline — core.mask_link_residual docstring), but only
+            # when the replica has actually moved since the last mask: the
+            # mask is a full-table copy under the state lock, and paying
+            # it every idle send-loop pass would contend with add() for
+            # nothing (st.state_version() is two counter reads)
+            ver = self.st.state_version()
+            if ver != self._sub_mask_ver.get(link):
+                wlo, wcnt = rng
+                self.st.mask_link_residual(link, wlo * 32, (wlo + wcnt) * 32)
+                self._sub_mask_ver[link] = ver
+        # FRESH stamp candidate, captured BEFORE the drained-residual
+        # determination below: an add() racing in after the begin_* call
+        # found the residual empty must not be covered by the mark (its
+        # mass is not in what we sent) — a stamp taken at send time would
+        # falsely verify freshness over it. Any t at or before the
+        # determination is safe: everything added before the determination
+        # was either quantized+enqueued already (FIFO delivers it before
+        # the FRESH) or left mass that made the determination non-empty.
+        # The C tier gets the same guarantee by stamping under e->mu.
+        fresh_t = time.monotonic_ns()
+        if self.st.host_tier:
+            # serving links trade batch efficiency for pipeline LATENCY:
+            # the subscriber's staleness floor is queue depth x per-message
+            # apply time, so cap the burst well under the wire budget
+            # (stengine.cpp kSubBurstCap — same bound on the C tier)
+            out = self.st.begin_frame_burst(link, min(self._burst, 32))
+            if out is None:
+                return False
+            seq, frames = out
+        else:
+            out = self.st.begin_frame(link)
+            if out is None:
+                return False
+            seq, df = out
+            f = self.st.finish_frame(df)
+            frames = [f] if f is not None else []
+        if not frames:
+            self.st.ack_frame(link, seq)  # idle: no-op
+            now = time.monotonic()
+            if now - self._sub_fresh.get(link, 0.0) >= scfg.fresh_interval_sec:
+                with self._ack_mu:
+                    last_seq = self._tx_seq.get(link, 0)
+                try:
+                    if self.node.send(
+                        link,
+                        wire.encode_fresh(fresh_t, last_seq),
+                        timeout=0.0,
+                    ):
+                        self._sub_fresh[link] = now
+                        self._sub_fresh_out += 1
+                except BrokenPipeError:
+                    pass  # LINK_DOWN will clean the link up
+            return False
+        trace = None
+        if self._trace_wire:
+            trace = self._trace_stamp
+            if trace is None:
+                trace = (self.node.obs_id, time.monotonic_ns(), 0)
+        nmsg = len(frames) if rng else 1
+        with self._ack_mu:
+            base = self._tx_seq.get(link, 0)
+            self._tx_seq[link] = base + nmsg
+        ok = True
+        if rng:
+            wlo, wcnt = rng
+            for i, f in enumerate(frames):
+                payload = wire.encode_rdata(
+                    f, wlo, wcnt, base + i + 1, trace=trace
+                )
+                if not self._send_blocking(link, payload, data=True):
+                    ok = False
+                    break
+                self._sub_msgs_out += 1
+        else:
+            if len(frames) == 1:
+                payload = wire.encode_frame(frames[0], base + 1, trace=trace)
+            else:
+                payload = wire.encode_burst(
+                    frames, self.st.spec, base + 1, trace=trace
+                )
+            ok = self._send_blocking(link, payload, data=True)
+            if ok:
+                self._sub_msgs_out += 1
+        if ok:
+            self.st.ack_frame(link, seq)  # delivered-on-enqueue (unledgered)
+        else:
+            self.st.nack_frame(link)
+        return ok
 
     def _register_data(self, link: int, ledger_seq: int, encode_into):
         """Allocate the link's next wire seq, encode the outgoing DATA/BURST
@@ -1735,6 +1871,13 @@ class SharedTensorPeer:
             self._rx_scratch.pop(ev.link_id, None)
             self._staleness.pop(ev.link_id, None)
             self._child_digests.pop(ev.link_id, None)
+            # a dead subscriber link carries NO residual forward: a
+            # read-only leaf owes the tree nothing, and a re-joining
+            # subscriber re-seeds from scratch anyway
+            self._sub_links.pop(ev.link_id, None)
+            self._sub_fresh.pop(ev.link_id, None)
+            self._sub_mask_ver.pop(ev.link_id, None)
+            self._pending_sub.pop(ev.link_id, None)
             with self._ack_mu:
                 purged = self._unacked.pop(ev.link_id, ())
                 self._tx_seq.pop(ev.link_id, None)
@@ -1832,6 +1975,89 @@ class SharedTensorPeer:
             self._engine_links.add(link)
         else:
             self.st.new_link_diff(link, snap)
+
+    def _attach_sub(self, link: int, rng: Optional[tuple[int, int]]) -> None:
+        """Attach — or RE-seed, the resync path — a read-only subscriber
+        link (r10 serving tier). Order matters throughout:
+
+        - a resync DETACHES first (discarding the old residual — the
+          snapshot about to ship supersedes it) so the sender produces
+          nothing in the window;
+        - the wire seq restarts at 1 so the subscriber's post-seed gap
+          detector has a deterministic base;
+        - ``_sub_links`` is set BEFORE the codec link opens, so the send
+          loop can never take the ledgered path for it (an unacked entry
+          on a never-ACKing link would black-hole it);
+        - WELCOME + snapshot CHUNKs + DONE + FRESH are enqueued BEFORE the
+          attach (per-link FIFO ⇒ the subscriber finishes seeding before
+          any codec DATA arrives — the same rationale as the writer join
+          path).
+
+        On the engine tier, attach and subscriber mode are ONE atomic
+        native call (st_engine_attach_sub) for the same no-ledgered-window
+        reason."""
+        resync = link in self._sub_links
+        if resync:
+            if self._engine is not None:
+                self._engine.drop_link(link)
+            else:
+                self.st.drop_link(link)
+        with self._ack_mu:
+            purged = self._unacked.pop(link, ())
+            self._tx_seq.pop(link, None)
+            self._acked.pop(link, None)
+            self._ack_progress.pop(link, None)
+            self._retx_rounds.pop(link, None)
+        self._release_slots(purged)
+        wlo, wcnt = rng if rng is not None else (0, 0)
+        self._sub_links[link] = rng
+        self._sub_fresh[link] = 0.0
+        # The seed rides the CONTROL plane: WELCOME, then our replica
+        # snapshot (the subscribed pages only) as CHUNKs + DONE + a FRESH
+        # mark stamped at snapshot time, and only THEN the codec link
+        # opens (residual = whatever landed between snapshot and attach).
+        # Rationale: subscriber links are unledgered, so a codec-stream
+        # seed is only as reliable as every one of its messages — under
+        # sustained loss a multi-message drain essentially never completes
+        # gap-free, and the subscriber would resync forever (measured in
+        # the r10 chaos arm). Control traffic is outside the chaos classes
+        # by the r06 rule (chaos exercises recovery, never wedges a
+        # handshake), so a re-seed completes DETERMINISTICALLY and the
+        # codec stream carries only steady-state deltas.
+        t_snap = time.monotonic_ns()
+        vals = np.asarray(self.st.snapshot_flat(), np.float32)
+        self._send_blocking(link, bytes([wire.WELCOME]))
+        sl = vals[wlo * 32 : (wlo + wcnt) * 32] if rng is not None else vals
+        for chunk in wire.encode_snapshot_chunks(sl):
+            self._send_blocking(link, chunk)
+        # last_seq 0: the post-seed stream hasn't started (seqs restart at
+        # 1 below), and the subscriber has applied exactly 0 of it
+        self._send_blocking(link, wire.encode_fresh(t_snap, 0))
+        if self._engine is not None:
+            self._engine.new_link_sub(
+                link,
+                vals,
+                rx_init=self._rx_count.get(link, 0),
+                word_lo=wlo,
+                word_cnt=wcnt,
+                fresh_interval_sec=self.config.serve.fresh_interval_sec,
+            )
+            self._engine_links.add(link)
+        else:
+            # residual = values_now - vals: exactly the adds/floods that
+            # raced the snapshot transfer (usually zero); _send_sub
+            # range-masks it per pass
+            self.st.new_link_diff(link, vals)
+        if self._obs is not None:
+            self._obs.event(
+                "sub_resync" if resync else "sub_attach",
+                self.node.obs_id, link, wcnt,
+            )
+        log.info(
+            "link %d attached read-only%s%s", link,
+            f" (words [{wlo}, {wlo + wcnt}))" if rng else " (full table)",
+            " — resync re-seed" if resync else "",
+        )
 
     def _attach_zero(self, link: int) -> None:
         if self._engine is not None:
@@ -1942,15 +2168,54 @@ class SharedTensorPeer:
                 )
                 self.node.drop_link(link)
                 self._pending.pop(link, None)
+                self._pending_sub.pop(link, None)
             else:
+                from ..compat import SYNC_FLAG_READ_ONLY
+
+                if wire.sync_flags(payload) & SYNC_FLAG_READ_ONLY:
+                    # r10 read-only subscriber handshake — possibly a
+                    # RESYNC on a live link (seq gap repair): a RANGE
+                    # message may follow before DONE
+                    self._pending_sub[link] = None
+                    log.info(
+                        "link %d joins read-only (subscriber handshake)",
+                        link,
+                    )
                 self._pending[link] = bytearray(self.st.spec.total * 4)
+        elif kind == wire.RANGE:
+            wlo, wcnt = wire.decode_range(payload)
+            words = self.st.spec.total // 32
+            if link not in self._pending_sub:
+                log.warning(
+                    "ignoring RANGE on link %d outside a subscriber "
+                    "handshake", link,
+                )
+            elif not (0 <= wlo and 0 < wcnt and wlo + wcnt <= words):
+                self._send_blocking(
+                    link,
+                    wire.encode_reject(
+                        f"range [{wlo}, {wlo + wcnt}) outside the "
+                        f"{words}-word table"
+                    ),
+                )
+                self.node.drop_link(link)
+                self._pending.pop(link, None)
+                self._pending_sub.pop(link, None)
+            else:
+                self._pending_sub[link] = (wlo, wcnt)
         elif kind == wire.CHUNK:
             buf = self._pending.get(link)
             if buf is not None:
                 wire.decode_chunk_into(payload, buf)
         elif kind == wire.DONE:
             buf = self._pending.pop(link, None)
-            if buf is not None:
+            if buf is not None and link in self._pending_sub:
+                # r10 subscriber attach / resync re-seed (the subscriber's
+                # handshake carries no snapshot upload — the parent pushes
+                # ITS snapshot down the control plane instead)
+                self._attach_sub(link, self._pending_sub.pop(link))
+                self._wake.set()
+            elif buf is not None:
                 # tier-native: numpy on the host tier (no backend init)
                 snap = self.st._asarray(np.frombuffer(bytes(buf), "<f4"))
                 # WELCOME is enqueued BEFORE the codec link opens: per-link

@@ -104,6 +104,11 @@ REJECT = 5  # parent -> child: spec mismatch, reason attached
 ACK = 6  # cumulative count of DATA/BURST messages received on this link
 BURST = 7  # K codec frames in one message (host tier, small tables)
 DIGEST = 8  # child -> parent: r09 in-band cluster metrics digest (JSON)
+# r10 read-path serving tier (serve/). RANGE and FRESH are control-plane;
+# RDATA is the range-filtered data framing for paged subscriptions.
+RANGE = 9  # subscriber -> parent: word-range subscription (before DONE)
+FRESH = 10  # parent -> subscriber: freshness mark (residual fully drained)
+RDATA = 11  # parent -> subscriber: one frame sliced to the subscribed range
 
 _SYNC_FMT = "<IQ16s"  # num_leaves, total_n, layout digest
 _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
@@ -194,10 +199,17 @@ def burst_wire_bytes(spec: TableSpec) -> int:
 
 def frame_wire_bytes(spec: TableSpec) -> int:
     """Max payload size of any native-mode message for this spec (covers
-    the v2 trace headers and the bounded DIGEST control message)."""
+    the v2 trace headers, the bounded DIGEST control message, and the r10
+    RDATA framing — whose range header is 8 bytes longer than DATA's, so a
+    near-full-range subscription on a burst-cap-1 table would otherwise
+    exceed every other bound by a few bytes and be silently truncated at
+    the transport: the exact r09 burst_wire_bytes failure class)."""
     data = DATA_HDR_T + frame_payload_bytes(spec)
+    rdata = RDATA_HDR_T + frame_payload_bytes(spec)
     chunk = 1 + struct.calcsize(_CHUNK_HDR) + CHUNK_BYTES
-    return max(data, chunk, burst_wire_bytes(spec), 1 + DIGEST_MAX_BYTES)
+    return max(
+        data, rdata, chunk, burst_wire_bytes(spec), 1 + DIGEST_MAX_BYTES
+    )
 
 
 def data_seq(payload: bytes) -> int:
@@ -551,19 +563,24 @@ def decode_burst(
     ]
 
 
-def encode_sync(spec: TableSpec, wire_version: int = 1) -> bytes:
+def encode_sync(spec: TableSpec, wire_version: int = 1, flags: int = 0) -> bytes:
     """Join request header. Since r09 a trailing version byte advertises
     the joiner's DATA/BURST framing (compat.WIRE_VERSION); pre-r09 parents
     decode with unpack_from and ignore the trailing byte, so the SYNC
     stays backward-compatible — and decoders here tolerate both emitted
     framings regardless (the byte is informational, surfaced through
-    sync_wire_version for logging/telemetry)."""
+    sync_wire_version for logging/telemetry).
+
+    ``flags`` (r10, one more trailing byte — same tolerant-extension
+    discipline) advertises handshake capabilities: compat.SYNC_FLAG_*
+    (read-only subscriber, range subscription to follow). Pre-r10 parents
+    ignore it; pre-r10 SYNCs read back as flags 0."""
     return (
         bytes([SYNC])
         + struct.pack(
             _SYNC_FMT, spec.num_leaves, spec.total_n, spec.layout_digest()
         )
-        + bytes([wire_version & 0xFF])
+        + bytes([wire_version & 0xFF, flags & 0xFF])
     )
 
 
@@ -576,6 +593,134 @@ def sync_wire_version(payload: bytes) -> int:
     a pre-r09 SYNC has no version byte)."""
     base = 1 + struct.calcsize(_SYNC_FMT)
     return payload[base] if len(payload) > base else 1
+
+
+def sync_flags(payload: bytes) -> int:
+    """The joiner's advertised handshake-capability flags (r10 trailing
+    byte; compat.SYNC_FLAG_*). 0 when absent — every pre-r10 joiner is a
+    read-write peer with no range subscription."""
+    base = 2 + struct.calcsize(_SYNC_FMT)
+    return payload[base] if len(payload) > base else 0
+
+
+# -- r10 serving-tier messages ----------------------------------------------
+#
+# RANGE: [kind][u32 word_lo][u32 word_cnt] — a subscriber's page-range
+# subscription (32-element words of the flat table), sent between SYNC and
+# DONE. The parent then forwards only those words per frame (RDATA framing)
+# so the subscriber receives — and buffers — only its pages.
+#
+# FRESH: [kind][u64 t_ns][u32 last_seq] — the parent's CLOCK_MONOTONIC at
+# an instant when the subscriber link's residual had fully drained ("as of
+# t you have everything I have") plus the link's last data tx_seq at that
+# instant. The seq makes the mark VERIFIABLE on the unledgered link: a
+# subscriber accepts it only when it has applied exactly last_seq messages
+# — otherwise the tail of the stream was swallowed (undetectable from
+# data alone on an idle tree: no next message ever exposes the gap) and
+# the mark must trigger a resync instead of falsely verifying freshness
+# over diverged state. Same-host-monotonic semantics, like the r09 origin
+# stamps (obs/schema.py st_staleness_seconds caveat).
+#
+# RDATA: [kind][u32 seq][u32 word_lo][u32 word_cnt][trace?][scales L*4]
+# [words word_cnt*4] — ONE codec frame sliced to the subscribed word range.
+# The range header sits BEFORE the optional 13-byte trace context so the
+# fixed fields parse at fixed offsets; v1/v2 framing disambiguates by exact
+# length exactly like DATA/BURST (the body is a multiple of 4, the trace
+# adds 13). Unledgered by design: subscriber links have no ACK ledger —
+# the subscriber detects loss by seq gap and re-seeds via a fresh SYNC/DONE
+# handshake on the same link (serve/subscriber.py).
+
+_RANGE_FMT = "<II"
+_FRESH_FMT = "<QI"
+RDATA_HDR = 13  # kind + u32 seq + u32 word_lo + u32 word_cnt
+RDATA_HDR_T = RDATA_HDR + TRACE_BYTES  # 26
+
+
+def encode_range(word_lo: int, word_cnt: int) -> bytes:
+    return bytes([RANGE]) + struct.pack(_RANGE_FMT, word_lo, word_cnt)
+
+
+def decode_range(payload: bytes) -> tuple[int, int]:
+    return struct.unpack_from(_RANGE_FMT, payload, 1)
+
+
+def encode_fresh(t_ns: int, last_seq: int) -> bytes:
+    return bytes([FRESH]) + struct.pack(
+        _FRESH_FMT, t_ns & 0xFFFFFFFFFFFFFFFF, last_seq & 0xFFFFFFFF
+    )
+
+
+def decode_fresh(payload: bytes) -> tuple[int, int]:
+    """(t_ns, last_seq) — see the FRESH format note above."""
+    return struct.unpack_from(_FRESH_FMT, payload, 1)
+
+
+def encode_rdata(
+    frame: TableFrame, word_lo: int, word_cnt: int, seq: int, trace=None
+) -> bytes:
+    """One frame's scales + the [word_lo, word_lo+word_cnt) slice of its
+    sign words — the range-filtered forwarding unit for paged
+    subscriptions. Scales ship whole (4L bytes — per-leaf metadata, small);
+    only the word payload is sliced."""
+    scales = np.asarray(frame.scales, dtype="<f4")
+    words = np.asarray(frame.words, dtype="<u4")[word_lo : word_lo + word_cnt]
+    if len(words) != word_cnt:
+        raise ValueError(
+            f"range [{word_lo}, {word_lo + word_cnt}) overruns the "
+            f"{np.asarray(frame.words).size}-word frame"
+        )
+    th = b"" if trace is None else struct.pack(_TRACE_FMT, *_clamp_trace(trace))
+    return (
+        bytes([RDATA])
+        + struct.pack("<I", seq & 0xFFFFFFFF)
+        + struct.pack(_RANGE_FMT, word_lo, word_cnt)
+        + th
+        + scales.tobytes()
+        + words.tobytes()
+    )
+
+
+def decode_rdata(
+    payload: bytes, spec: TableSpec
+) -> tuple[np.ndarray, np.ndarray, int, int, Optional[tuple[int, int, int]]]:
+    """Inverse of :func:`encode_rdata`. Returns (scales f32[L], words
+    u32[word_cnt], word_lo, word_cnt, trace-or-None) — with the same
+    non-finite-scale corruption guard as decode_frame (a poisoned scale
+    zeroes its leaf instead of NaN-ing the serving replica)."""
+    k = spec.num_leaves
+    word_lo, word_cnt = struct.unpack_from(_RANGE_FMT, payload, 5)
+    if word_cnt <= 0 or word_lo + word_cnt > spec.total // 32:
+        raise ValueError(
+            f"RDATA range [{word_lo}, {word_lo + word_cnt}) outside the "
+            f"{spec.total // 32}-word table"
+        )
+    body = 4 * k + 4 * word_cnt
+    if len(payload) == RDATA_HDR + body:
+        off, trace = RDATA_HDR, None
+    elif len(payload) == RDATA_HDR_T + body:
+        off = RDATA_HDR_T
+        trace = struct.unpack_from(_TRACE_FMT, payload, RDATA_HDR)
+    else:
+        raise ValueError(
+            f"RDATA is {len(payload)} bytes, range header wants "
+            f"{RDATA_HDR + body} or {RDATA_HDR_T + body}"
+        )
+    scales = np.frombuffer(payload, "<f4", count=k, offset=off).copy()
+    words = np.frombuffer(
+        payload, "<u4", count=word_cnt, offset=off + 4 * k
+    ).copy()
+    bad = ~np.isfinite(scales)
+    if bad.any():
+        nbad = int(np.count_nonzero(bad))
+        log.warning(
+            "zeroing %d non-finite scale(s) in received RDATA (corrupt link?)",
+            nbad,
+        )
+        _count_corrupt_scales(nbad)
+        scales[bad] = np.float32(0.0)
+    return scales, words, word_lo, word_cnt, trace
+
+
 
 
 def encode_snapshot_chunks(flat: np.ndarray) -> Iterator[bytes]:

@@ -49,6 +49,33 @@ WIRE_VERSION_V1 = 1  # r08 framing, no trace context
 WIRE_VERSION_V2 = 2  # r09 framing, 13-byte trace context
 WIRE_VERSION = WIRE_VERSION_V2  # what this build emits by default
 
+# ---- r10 handshake-capability flags ---------------------------------------
+#
+# One more trailing SYNC byte (wire.encode_sync ``flags``), following the
+# same tolerant-extension discipline as the r09 version byte: pre-r10
+# parents unpack the fixed header and ignore trailing bytes, and absent
+# flags read back as 0 (a plain read-write peer). The serving tier
+# (serve/subscriber.py) advertises itself here so WRITERS can skip all
+# ledger/ACK state for the link:
+#
+# - SYNC_FLAG_READ_ONLY: the joiner is a read-only subscriber leaf. It will
+#   never add(), never ACK, and never needs a re-graft carry — the parent
+#   attaches the link UNLEDGERED (no unacked ledger, no go-back-N, no
+#   retransmission; loss shows up as a seq gap the subscriber repairs by
+#   re-running the SYNC/DONE handshake on the same link).
+# - SYNC_FLAG_RANGE: a wire.RANGE message follows before DONE; the parent
+#   forwards only the subscribed word range per frame (wire.RDATA framing —
+#   the paged-subscription discipline).
+#
+# Joining a pre-r10 parent with these flags is detectably broken rather
+# than silently wrong: the old parent treats the subscriber as a writer
+# child, its unACKed ledger black-holes, and the link tears down — the
+# subscriber keeps resyncing and its reads keep raising StalenessError
+# (never silent staleness).
+
+SYNC_FLAG_READ_ONLY = 0x01
+SYNC_FLAG_RANGE = 0x02
+
 
 def wire_protocol_version(config: Config | None = None) -> int:
     """The DATA/BURST framing version this peer should EMIT: v2 unless the

@@ -250,6 +250,35 @@ class ObsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Read-path serving tier (r10; ``shared_tensor_tpu/serve``): read-only
+    subscriber leaves with bounded-staleness reads, verified against the
+    r09 origin stamps. Consumed by :class:`serve.Subscriber` and by the
+    WRITER side's FRESH-beat pacing for subscriber links."""
+
+    #: Default staleness bound for ``Subscriber.read()`` when the call
+    #: passes none: the read raises StalenessError unless the subscriber
+    #: can PROVE its state is at most this many seconds behind (latest
+    #: applied origin stamp, or the parent's FRESH drain mark — same-host
+    #: CLOCK_MONOTONIC semantics, the r09 staleness caveat).
+    max_staleness_sec: float = 1.0
+    #: How often a writer sends a FRESH mark on an IDLE subscriber link
+    #: (residual fully drained — "as of t you have everything"). Without
+    #: it, a quiet tree would read as ever-staler even though the
+    #: subscriber is exactly current. Bounds the staleness floor an idle
+    #: subscriber can verify.
+    fresh_interval_sec: float = 0.25
+    #: Minimum seconds between subscriber resync handshakes (a seq gap on
+    #: the unledgered subscriber link triggers a fresh SYNC/DONE re-seed;
+    #: under sustained drop chaos this caps the re-seed storm).
+    resync_min_interval_sec: float = 0.25
+    #: Element range [lo, hi) to subscribe to (page/embedding-style reads);
+    #: rounded outward to 32-element word boundaries on the wire. None =
+    #: the full table.
+    range: Optional[tuple[int, int]] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Pod-tier (intra-slice) configuration: how the shared array is laid out
     across the local device mesh and which collective strategy syncs it."""
@@ -277,6 +306,9 @@ class Config:
     #: Unified telemetry (metrics registry + event timeline + flight
     #: recorder); enabled default, <2% hot-path cost (OBS_r08 gate).
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    #: Read-path serving tier (r10): subscriber staleness bounds, FRESH
+    #: beat pacing, range subscription.
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     #: Background sync frame pacing: target seconds between frames per link;
     #: 0 = free-running (reference behavior: fill all bandwidth, README.md:31).
     sync_interval_sec: float = 0.0

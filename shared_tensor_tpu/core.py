@@ -112,6 +112,46 @@ class DuplicateLink(ValueError):
     error there silently desyncs the peer — ADVICE r04 item 2 follow-up)."""
 
 
+class SnapshotPublisher:
+    """Lock-free double-buffered snapshot publication (r10 serving tier).
+
+    The snapshot paths the reference's ``copyToTensor`` maps to all copy
+    under the data-plane lock — ``EngineTensor.read()`` holds the engine
+    mutex for a full-table memcpy, so a serving loop polling it would
+    stall the quantize/apply threads that share that mutex exactly when
+    traffic is heaviest. The serve tier reads from THIS instead: the
+    writer side (the subscriber's apply thread) builds a fresh snapshot
+    and :meth:`publish`\\ es it as one reference swap; readers
+    :meth:`acquire` the current (array, meta) tuple with zero locks — a
+    single attribute read, atomic under the GIL — so a read can never
+    block an apply (or an ``add()`` upstream) by more than the one
+    buffer swap the writer itself performs.
+
+    The published array is owned by the publisher's consumers: the writer
+    must hand over a COPY (or an array it will no longer mutate) — that
+    copy is the "double buffer"."""
+
+    __slots__ = ("_cur",)
+
+    def __init__(self):
+        self._cur: tuple = (None, 0, 0)  # (array, freshness_ns, version)
+
+    def publish(self, array, freshness_ns: int, version: int) -> None:
+        self._cur = (array, int(freshness_ns), int(version))
+
+    def touch(self, freshness_ns: int) -> None:
+        """Refresh the freshness mark WITHOUT a new array (idle FRESH
+        beats: the state didn't change, only its verified age did)."""
+        arr, old, ver = self._cur
+        if freshness_ns > old:
+            self._cur = (arr, int(freshness_ns), ver)
+
+    def acquire(self) -> tuple:
+        """(array, freshness_ns, version) — the latest published snapshot,
+        read lock-free. array is None until the first publish."""
+        return self._cur
+
+
 class SharedTensor:
     """Replica + per-link residuals for one shared table of tensors.
 
@@ -440,6 +480,26 @@ class SharedTensor:
                 self._links[i] = r
             self.updates += 1
 
+    def mask_link_residual(self, link_id: int, elo: int, ehi: int) -> None:
+        """Zero a link's residual OUTSIDE [elo, ehi) — the r10 range-
+        subscription discipline: adds/floods refill the full residual, but
+        a ranged subscriber link's receiver will never get the out-of-range
+        mass, so the sender drops it before scale selection instead of
+        letting it decay through frames of useless traffic (the native
+        engine does the same in its subscriber branch). Functional replace,
+        never an in-place mutation — snapshots may share storage."""
+        with self._lock:
+            r = self._links.get(link_id)
+            if r is None:
+                return
+            if self._np:
+                m = np.array(r, np.float32, copy=True)
+                m[:elo] = 0.0
+                m[ehi:] = 0.0
+            else:
+                m = jnp.asarray(r).at[:elo].set(0.0).at[ehi:].set(0.0)
+            self._links[link_id] = m
+
     # -- sync engine hooks -------------------------------------------------
 
     def begin_frame(self, link_id: int) -> Optional[tuple[int, TableFrame]]:
@@ -699,6 +759,14 @@ class SharedTensor:
             self.frames_in += applied
 
     # -- introspection -----------------------------------------------------
+
+    def state_version(self) -> int:
+        """Monotone change counter for the replica: bumps on every local
+        add and every applied foreign frame. Cheap (two counter reads) —
+        the peer's ranged-subscriber send path uses it to skip the
+        full-table residual mask on passes where nothing moved
+        (peer._send_sub)."""
+        return self.updates + self.frames_in
 
     def residual_rms(self, link_id: int) -> float:
         with self._lock:

@@ -57,6 +57,10 @@ CODE_NAMES: dict[int, str] = {
     # packs (origin_node << 8 | hop) — obs/trace_export.py reconstructs
     # full causal paths from these records.
     30: "trace_apply",
+    # 31: r10 subscriber link attached in the native engine (unledgered,
+    # possibly range-filtered; arg = subscribed word count). The python
+    # tier emits the same name — plus "sub_resync" — directly.
+    31: "sub_attach",
 }
 NAME_CODES = {v: k for k, v in CODE_NAMES.items()}
 

@@ -67,6 +67,23 @@ SCHEMA: dict[str, tuple[str, str]] = {
     "st_digest_sends_total": ("counter", "cluster metrics digests sent up the tree"),
     "st_digest_msgs_in_total": ("counter", "cluster metrics digests received from subtree links"),
     "st_cluster_nodes": ("gauge", "nodes represented in this peer's latest merged cluster digest"),
+    # r10 read-path serving tier. st_read_* live on the SUBSCRIBER
+    # (serve/subscriber.py registry); st_sub_* split: resyncs/gap/fresh-in/
+    # freshness/range on the subscriber, links/msgs-out/fresh-out on the
+    # WRITER (peer collector; engine tier serves the counts over the
+    # widened counters ABI). Staleness semantics follow the r09 caveat:
+    # same-host CLOCK_MONOTONIC deltas.
+    "st_read_total": ("counter", "serving reads served (staleness bound verified)"),
+    "st_read_stale_total": ("counter", "serving reads REFUSED: staleness bound not verifiable (raised, never silently stale)"),
+    "st_read_staleness_seconds": ("histogram", "verified staleness observed at read time"),
+    "st_sub_resyncs_total": ("counter", "subscriber re-seed handshakes (seq gap or re-join)"),
+    "st_sub_gap_discards_total": ("counter", "data messages discarded while desynced (gap -> resync window)"),
+    "st_sub_fresh_marks_total": ("counter", "FRESH drain marks applied by the subscriber"),
+    "st_sub_freshness_seconds": ("gauge", "age of the subscriber's newest verified-fresh instant (stamp or FRESH mark)"),
+    "st_sub_range_words": ("gauge", "subscribed word count (full table when it equals total/32)"),
+    "st_sub_links": ("gauge", "writer: attached read-only subscriber links"),
+    "st_sub_msgs_out_total": ("counter", "writer: unledgered data messages sent to subscriber links"),
+    "st_sub_fresh_out_total": ("counter", "writer: FRESH drain marks delivered to subscriber links"),
     # per-link series (rendered via link_key)
     "st_link_bytes_out_total": ("counter", "wire bytes sent on the link (incl. framing/keepalives)"),
     "st_link_bytes_in_total": ("counter", "wire bytes received on the link"),

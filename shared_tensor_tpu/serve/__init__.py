@@ -1,0 +1,39 @@
+"""Read-path serving tier (r10): parameter subscription for inference fleets.
+
+Every other workload in the repo WRITES (async-SGD trainers calling
+``add()``); the "millions of users" north star is read-dominated — fleets
+of inference replicas that need fresh-enough weights, not write access.
+This package opens that scenario:
+
+- :class:`Subscriber` — a read-only leaf of the tree. It advertises itself
+  in the SYNC handshake (compat.SYNC_FLAG_READ_ONLY, the r09 wire-version
+  machinery's r10 extension), so writers attach its link UNLEDGERED: no
+  unacked ledger, no ACKs, no go-back-N state — a read-only leaf owes the
+  tree nothing and its loss repairs by re-seed, not by carry.
+- **Bounded-staleness reads** — ``Subscriber.read(max_staleness=...)``
+  VERIFIES the bound against the r09 origin stamps (and the writer's FRESH
+  drain marks) and raises :class:`StalenessError` when it cannot: a read is
+  never silently stale. ``wait_fresh(epoch)`` blocks until the replica
+  provably includes everything up to a monotonic-ns epoch token
+  (:func:`epoch`). Same-host CLOCK_MONOTONIC semantics, like the r09
+  ``st_staleness_seconds`` telemetry.
+- **Range subscription** — subscribe to a sub-range of the table
+  (``ServeConfig.range``; embedding/paged-style reads): the wire gains a
+  RANGE control message, writers forward only the subscribed words per
+  frame (wire.RDATA), and the subscriber buffers ONLY its pages.
+- :class:`ServingHandle` — double-buffered hot-swap weight publication
+  into an inference loop: ``refresh()`` atomically swaps a verified JAX
+  snapshot in; ``params()`` is a lock-free reference read, so serving
+  threads never touch the data plane (core.SnapshotPublisher).
+"""
+
+from .handle import ServingHandle
+from .subscriber import StalenessError, Subscriber, epoch, subscribe
+
+__all__ = [
+    "ServingHandle",
+    "StalenessError",
+    "Subscriber",
+    "epoch",
+    "subscribe",
+]

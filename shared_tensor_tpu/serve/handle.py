@@ -1,0 +1,110 @@
+"""ServingHandle: double-buffered hot-swap weight publication.
+
+The inference loop's side of the serving tier: ``params()`` is a plain
+reference read (lock-free, GIL-atomic — never touches the subscriber's
+apply path, the transport, or any data-plane lock), and ``refresh()``
+atomically swaps a NEW verified snapshot in underneath it. A model server
+calls ``refresh`` on its own schedule (per request batch, per N steps, or
+from a background ticker) while trainers stream updates through the tree;
+requests in flight keep the pytree they started with — the swap can never
+tear a forward pass.
+
+This is where the JAX conversion happens: the subscriber itself is pure
+numpy (host-tier rule — it never initializes a backend), while the serving
+process builds jnp arrays because it is about to run a jitted model anyway.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from .subscriber import StalenessError, Subscriber
+
+
+class ServingHandle:
+    """Hot-swap view over a :class:`Subscriber` for an inference loop."""
+
+    def __init__(
+        self,
+        sub: Subscriber,
+        max_staleness: Optional[float] = None,
+        as_jax: bool = True,
+    ):
+        self._sub = sub
+        self._bound = max_staleness
+        self._as_jax = as_jax and not sub._ranged
+        self._params: Any = None
+        self._version = -1
+        self._staleness = float("inf")
+        self._swaps = 0
+        # refresh() may be called from several serving threads; the swap
+        # itself is a reference assignment, but the (version check ->
+        # rebuild -> swap) sequence should not run twice for one version
+        self._mu = threading.Lock()
+
+    def params(self) -> Any:
+        """The current published params (None before the first successful
+        refresh). Lock-free reference read — safe from any thread, never
+        blocks, never touches the data plane."""
+        return self._params
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    @property
+    def staleness(self) -> float:
+        """Verified staleness of the CURRENT params at their last refresh,
+        plus the time elapsed since — the bound a request served now is
+        actually getting."""
+        return self._staleness + (time.monotonic() - self._at)
+
+    @property
+    def swaps(self) -> int:
+        """How many times refresh() actually swapped new weights in."""
+        return self._swaps
+
+    def refresh(self, max_staleness: Optional[float] = None) -> bool:
+        """Verify + swap: pull the subscriber's latest snapshot, verify its
+        staleness bound (raise :class:`StalenessError` otherwise — a
+        serving loop must fail loud, not serve stale), and atomically
+        publish it. Returns True when new weights were swapped in, False
+        when the state hadn't moved (params untouched, verification still
+        performed — the freshness clock advances either way)."""
+        bound = self._bound if max_staleness is None else max_staleness
+        with self._mu:
+            # ONE acquire: array, staleness and version arrive together
+            # (a separately-read version could label older params with a
+            # newer number and skip the real newest snapshot forever)
+            flat, staleness, ver = self._sub.read_flat(bound)
+            self._staleness = staleness
+            self._at = time.monotonic()
+            if ver == self._version and self._params is not None:
+                return False
+            if self._sub._ranged:
+                new = flat  # raw page array; callers index it directly
+            else:
+                from ..ops.codec_np import unflatten_np
+
+                tree = unflatten_np(flat, self._sub.spec)
+                if self._as_jax:
+                    import jax
+
+                    tree = jax.tree.map(self._to_jax, tree)
+                new = tree
+            # the swap: one reference assignment — in-flight readers keep
+            # the pytree they already hold
+            self._params = new
+            self._version = ver
+            self._swaps += 1
+            return True
+
+    _at = 0.0
+
+    @staticmethod
+    def _to_jax(x):
+        import jax.numpy as jnp
+
+        return jnp.asarray(x)

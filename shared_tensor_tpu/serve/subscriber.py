@@ -1,0 +1,698 @@
+"""The read-only subscriber peer: parameter subscription with verified
+bounded-staleness reads.
+
+Protocol (all on the existing tree overlay — a subscriber is one more leaf
+in the transport's join walk):
+
+1. join: the transport grafts us under some writer; on LINK_UP(uplink) we
+   send SYNC with compat.SYNC_FLAG_READ_ONLY (+ SYNC_FLAG_RANGE and a
+   wire.RANGE message for a paged subscription) and DONE. The parent
+   answers WELCOME + a snapshot of our subscribed pages as CHUNKs + DONE +
+   a FRESH mark stamped at snapshot time, then opens the codec stream —
+   the seed rides the CONTROL plane (which chaos never touches, the r06
+   rule), so joins and resyncs complete deterministically on a lossy data
+   plane. The post-seed codec stream arms the seq gap detector at 1.
+2. steady state: the parent streams unledgered DATA/BURST (full table) or
+   RDATA (range) messages, each carrying the r09 trace stamp; applying one
+   advances our *verified freshness* to the stamp's origin time. An IDLE
+   parent sends FRESH drain marks instead ("as of t you have everything"),
+   so a quiet tree does not read as ever-staler.
+3. loss: subscriber links have no ACK ledger by design (writers skip all
+   delivery state for read-only leaves), so a swallowed message surfaces
+   as a seq gap here. We DESYNC — reads refuse past the staleness bound,
+   never silently serve a diverged replica — and repair by re-running the
+   SYNC/DONE handshake on the same link (rate-limited), which re-seeds the
+   whole subscription. The transport's normal re-join handles a dead
+   uplink the same way.
+
+Reads never touch the data plane: the recv thread publishes each applied
+batch through a :class:`core.SnapshotPublisher` double buffer, and
+``read()`` is a lock-free reference read + staleness verification (the
+reference's ``copyToTensor`` copies under the data-plane lock; serving
+fleets must not — see SnapshotPublisher's docstring).
+
+The subscriber runs pure numpy (it never initializes a JAX backend — the
+host-tier rule); :class:`serve.ServingHandle` does the JAX conversion in
+the inference process.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from .. import obs as _obs
+from ..compat import SYNC_FLAG_RANGE, SYNC_FLAG_READ_ONLY, wire_protocol_version
+from ..comm import wire
+from ..comm.transport import EventKind, TransportNode
+from ..config import Config
+from ..core import SnapshotPublisher
+from ..ops.codec import SAT as _SAT
+from ..ops.codec_np import _layout, unflatten_np
+from ..ops.table import make_spec
+
+log = logging.getLogger("shared_tensor_tpu.serve")
+
+
+def epoch() -> int:
+    """A freshness epoch token: CLOCK_MONOTONIC nanoseconds, the same clock
+    the r09 origin stamps and FRESH marks carry. Capture one AFTER a
+    write (``peer.add()``), then ``Subscriber.wait_fresh(token)`` — valid
+    within one host (the r09 staleness caveat; cross-host needs synced
+    clocks)."""
+    return time.monotonic_ns()
+
+
+class StalenessError(RuntimeError):
+    """A read's staleness bound could not be VERIFIED: the subscriber is
+    desynced (gap/resync in progress), still seeding, or its newest
+    verified-fresh instant (origin stamp / FRESH mark) is older than the
+    bound. Raised instead of returning possibly-stale weights — the serving
+    tier's contract is "fresh-enough or loud", never silent staleness."""
+
+    def __init__(self, msg: str, staleness: float = float("inf")):
+        super().__init__(msg)
+        #: Seconds since the newest verified-fresh instant (inf = never
+        #: verified / desynced).
+        self.staleness = staleness
+
+
+class Subscriber:
+    """One read-only leaf: joins the tree at (host, port), subscribes to
+    the full table or a sub-range, and serves verified bounded-staleness
+    reads. Never ``add()``\\ s — there is deliberately no write API here.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        template: Any,
+        config: Config | None = None,
+    ):
+        self.config = config or Config()
+        tcfg = self.config.transport
+        scfg = self.config.serve
+        if tcfg.wire_compat:
+            raise ValueError(
+                "the serving tier needs the native protocol (the reference "
+                "compat wire has no handshake to advertise read-only on)"
+            )
+        self.spec = make_spec(template)
+        self._offs, self._ns, self._padded = _layout(self.spec)
+        words = self.spec.total // 32
+        # element range -> outward-rounded word range
+        if scfg.range is not None:
+            lo, hi = scfg.range
+            if not (0 <= lo < hi <= self.spec.total):
+                raise ValueError(
+                    f"serve range [{lo}, {hi}) outside the "
+                    f"{self.spec.total}-element table"
+                )
+            self._wlo = lo // 32
+            self._wcnt = -(-hi // 32) - self._wlo
+        else:
+            self._wlo, self._wcnt = 0, words
+        self._ranged = self._wlo > 0 or self._wcnt < words
+        self._elo = self._wlo * 32
+        n_el = self._wcnt * 32
+        # per-element leaf index + live (non-padding) mask for the range —
+        # the apply kernel's geometry (mirrors codec_np._scale_per_element /
+        # _live_mask_np, restricted to the buffered pages)
+        bounds = np.cumsum(self._padded)
+        el = np.arange(self._elo, self._elo + n_el)
+        self._leaf_of = np.searchsorted(bounds, el, side="right").astype(
+            np.int64
+        )
+        starts = self._offs[self._leaf_of]
+        self._live = (
+            (el - starts) < self._ns[self._leaf_of]
+        ).astype(np.float32)
+        # the ONLY buffered state: the subscribed pages (plus the published
+        # double-buffer copies) — a ranged subscriber never allocates the
+        # full table
+        self._vals = np.zeros(n_el, np.float32)
+        self._pub = SnapshotPublisher()
+        self._version = 0
+        self._fresh_ns = 0  # newest VERIFIED-fresh instant (stamp/FRESH)
+        self._wire_version = wire_protocol_version(self.config)
+        self._synced = False  # seq detector armed (post-seed)
+        self._await_welcome = False
+        self._seeding = False  # WELCOME seen, CHUNK seed in flight
+        self._staging: bytes | bytearray = b""
+        self._expected_seq = 1
+        self._last_resync = 0.0
+        self._handshake_t0 = 0.0
+        self._uplink: Optional[int] = None
+        self._error: Optional[Exception] = None
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+        self._digest_last = 0.0
+
+        self.node = TransportNode(
+            host,
+            port,
+            tcfg,
+            frame_bytes=wire.frame_wire_bytes(self.spec),
+            max_children=1,
+            keepalive_sec=min(1.0, max(0.05, tcfg.peer_timeout_sec / 4)),
+        )
+        if self.node.is_master:
+            # a read-only replica cannot seed state: claiming an empty
+            # rendezvous would serve zeros forever (and orphan real writers
+            # behind us). Fail loudly; start the writers first.
+            self.node.close()
+            raise ConnectionError(
+                f"no tree to subscribe to at {host}:{port} — a read-only "
+                f"subscriber cannot become master; start a writer first"
+            )
+        # observability: own registry under the canonical st_read_*/st_sub_*
+        # schema + digest beats up the tree (the cluster view includes
+        # subscribers)
+        self._obs_on = _obs.obs_enabled() and self.config.obs.enabled
+        self._hub = _obs.hub() if self._obs_on else None
+        self._reg = _obs.Registry()
+        self._m_reads = self._reg.counter(
+            "st_read_total", help="serving reads served (bound verified)"
+        )
+        self._m_stale = self._reg.counter(
+            "st_read_stale_total",
+            help="reads refused: staleness bound not verifiable",
+        )
+        self._m_staleness = self._reg.histogram(
+            "st_read_staleness_seconds",
+            buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0),
+            help="verified staleness observed at read time",
+        )
+        self._m_resyncs = self._reg.counter(
+            "st_sub_resyncs_total", help="re-seed handshakes"
+        )
+        self._m_gaps = self._reg.counter(
+            "st_sub_gap_discards_total",
+            help="data messages discarded while desynced",
+        )
+        self._m_fresh = self._reg.counter(
+            "st_sub_fresh_marks_total", help="FRESH drain marks applied"
+        )
+        self._reg.register_collector(self._collect)
+        self._label = f"sub-{self.node.obs_id}"
+        if self._hub is not None:
+            self._hub.register_registry(self._label, self._reg)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="st-sub"
+        )
+        self._thread.start()
+
+    # -- user API ------------------------------------------------------------
+
+    def read(self, max_staleness: Optional[float] = None) -> Any:
+        """The subscribed state, VERIFIED at most ``max_staleness`` seconds
+        behind (default: ServeConfig.max_staleness_sec) — or raise
+        :class:`StalenessError`. Full-table subscriptions return the
+        caller's pytree structure (the reference's ``copyToTensor`` shape);
+        ranged ones return the raw f32 page array (use :meth:`read_flat`'s
+        twin semantics). Lock-free: a read can never block the apply path
+        (or a writer's ``add()``) — it touches only the published double
+        buffer."""
+        flat, _staleness, _ver = self.read_flat(max_staleness)
+        if self._ranged:
+            return flat
+        return unflatten_np(flat, self.spec)
+
+    def read_flat(
+        self, max_staleness: Optional[float] = None
+    ) -> tuple[np.ndarray, float, int]:
+        """(flat f32 snapshot of the subscribed pages, verified staleness
+        seconds, snapshot version) — the allocation-light spelling
+        :class:`ServingHandle` refreshes from. All three come from ONE
+        publisher acquire, so the version can never label a different
+        array than the one returned (a torn pair would let a handle skip
+        the real newest snapshot forever on an idle tree). Raises
+        StalenessError when the bound cannot be verified."""
+        bound = (
+            self.config.serve.max_staleness_sec
+            if max_staleness is None
+            else float(max_staleness)
+        )
+        err = self._error
+        if err is not None:
+            self._m_stale.inc()
+            raise StalenessError(f"subscriber failed: {err}") from err
+        arr, fresh_ns, ver = self._pub.acquire()
+        if arr is None or fresh_ns <= 0:
+            self._m_stale.inc()
+            raise StalenessError(
+                "no verified-fresh state yet (still seeding)"
+            )
+        staleness = max(0.0, (time.monotonic_ns() - fresh_ns) / 1e9)
+        if staleness > bound:
+            self._m_stale.inc()
+            raise StalenessError(
+                f"state is {staleness:.3f}s behind, bound {bound:.3f}s "
+                f"(desynced or writer unreachable — reads refuse rather "
+                f"than serve silently-stale weights)",
+                staleness,
+            )
+        self._m_reads.inc()
+        self._m_staleness.observe(staleness)
+        return arr, staleness, ver
+
+    def wait_fresh(self, epoch_ns: int, timeout: float = 30.0) -> None:
+        """Block until the replica provably includes every update
+        originated at or before ``epoch_ns`` (a :func:`epoch` token — capture
+        it AFTER the write you care about): i.e. until the verified-fresh
+        instant reaches the token. TimeoutError past the budget."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and not self._stop.is_set():
+            err = self._error
+            if err is not None:
+                raise StalenessError(f"subscriber failed: {err}") from err
+            _arr, fresh_ns, _ver = self._pub.acquire()
+            if fresh_ns >= epoch_ns:
+                return
+            time.sleep(0.002)
+        raise TimeoutError(
+            f"state did not reach epoch within {timeout}s "
+            f"(behind by {(epoch_ns - self._pub.acquire()[1]) / 1e9:.3f}s)"
+        )
+
+    def wait_ready(self, timeout: float = 30.0) -> None:
+        """Block until seeded AND verified fresh at least once (the first
+        read can succeed)."""
+        if not self._ready.wait(timeout):
+            if self._error is not None:
+                raise self._error
+            raise TimeoutError(f"subscriber not ready after {timeout}s")
+        if self._error is not None:
+            raise self._error
+
+    def staleness(self) -> float:
+        """Seconds since the newest verified-fresh instant (inf before the
+        first verification)."""
+        _arr, fresh_ns, _ver = self._pub.acquire()
+        if fresh_ns <= 0:
+            return float("inf")
+        return max(0.0, (time.monotonic_ns() - fresh_ns) / 1e9)
+
+    @property
+    def version(self) -> int:
+        """Monotone snapshot version (bumps per applied batch) — serving
+        handles skip rebuilding params when it hasn't moved."""
+        return self._pub.acquire()[2]
+
+    @property
+    def range_elements(self) -> tuple[int, int]:
+        """The buffered element range [lo, hi) (word-aligned; the full
+        padded table when no range was configured)."""
+        return self._elo, self._elo + self._vals.size
+
+    def serving_handle(self, max_staleness: Optional[float] = None):
+        """A :class:`serve.ServingHandle` over this subscription (hot-swap
+        weight publication for an inference loop)."""
+        from .handle import ServingHandle
+
+        return ServingHandle(self, max_staleness=max_staleness)
+
+    def metrics(self) -> dict:
+        """Canonical-schema snapshot (st_read_*/st_sub_* — obs/schema.py)."""
+        return self._reg.snapshot()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        if self._hub is not None:
+            self._hub.unregister_registry(self._label)
+        self.node.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- observability -------------------------------------------------------
+
+    def _collect(self) -> dict:
+        _arr, fresh_ns, _ver = self._pub.acquire()
+        age = (
+            (time.monotonic_ns() - fresh_ns) / 1e9 if fresh_ns > 0 else -1.0
+        )
+        return {
+            "st_sub_freshness_seconds": age,
+            "st_sub_range_words": self._wcnt,
+        }
+
+    def _event(self, name: str, link: int = 0, arg: int = 0) -> None:
+        if self._hub is not None:
+            self._hub.emit(name, node=self.node.obs_id, link=link, arg=arg)
+
+    # -- protocol ------------------------------------------------------------
+
+    def _send_ctrl(self, link: int, payload: bytes) -> bool:
+        """Small control sends with bounded retry (handshake/digest)."""
+        for _ in range(50):
+            if self._stop.is_set():
+                return False
+            try:
+                if self.node.send(link, payload, timeout=0.1):
+                    return True
+            except BrokenPipeError:
+                return False
+        return False
+
+    def _start_handshake(self, uplink: int, resync: bool) -> None:
+        """SYNC (+RANGE) + DONE. The parent answers with WELCOME + ITS
+        snapshot of our pages as CHUNKs + DONE + a FRESH mark stamped at
+        snapshot time — the seed rides the CONTROL plane, which the chaos
+        classes never touch (the r06 rule), so a resync completes
+        DETERMINISTICALLY however lossy the data plane is. A codec-stream
+        seed would instead need every one of its unledgered messages to
+        survive end-to-end: under sustained loss that essentially never
+        happens, and the subscriber would resync forever (measured)."""
+        self._synced = False
+        self._seeding = False
+        self._await_welcome = True
+        self._handshake_t0 = time.monotonic()
+        flags = SYNC_FLAG_READ_ONLY | (SYNC_FLAG_RANGE if self._ranged else 0)
+        ok = self._send_ctrl(
+            uplink, wire.encode_sync(self.spec, self._wire_version, flags)
+        )
+        if ok and self._ranged:
+            ok = self._send_ctrl(
+                uplink, wire.encode_range(self._wlo, self._wcnt)
+            )
+        if ok:
+            ok = self._send_ctrl(uplink, bytes([wire.DONE]))
+        if ok and resync:
+            self._m_resyncs.inc()
+            self._event("sub_resync", uplink)
+        if not ok:
+            log.warning("subscriber handshake send failed (uplink down?)")
+
+    def _desync(self, why: str, seq: int = 0) -> None:
+        if self._synced:
+            log.info("subscriber desynced (%s, seq %d): will resync", why, seq)
+        self._synced = False
+
+    def _maybe_resync(self) -> None:
+        up = self._uplink
+        if up is None or self._synced:
+            return
+        now = time.monotonic()
+        if self._await_welcome or self._seeding:
+            # a handshake/seed is in flight; but a WELCOME or seed DONE
+            # that never arrives (parent died mid-handshake) must not
+            # wedge the subscriber forever — re-run after a bounded wait
+            if now - self._handshake_t0 < 5.0:
+                return
+        if now - self._last_resync < self.config.serve.resync_min_interval_sec:
+            return
+        self._last_resync = now
+        self._start_handshake(up, resync=True)
+
+    def _apply_frame(
+        self, scales: np.ndarray, words: np.ndarray, word_lo: int
+    ) -> bool:
+        """Apply one frame's (scales, word slice) to the buffered pages —
+        the receive half of the sign codec, restricted to our range
+        (bit-compatible with stc_apply_frame over the same elements:
+        value += scale[leaf] * (1 - 2*bit), padding untouched, ±SAT
+        saturation). Returns False for an all-zero-scale no-op."""
+        if not scales.any():
+            return False
+        if word_lo != self._wlo or words.size != self._wcnt:
+            # a full-table frame covers any subscription: slice it; an
+            # RDATA for a different range is a protocol error
+            if word_lo == 0 and words.size >= self._wlo + self._wcnt:
+                words = words[self._wlo : self._wlo + self._wcnt]
+            else:
+                raise ValueError(
+                    f"frame words [{word_lo}, {word_lo + words.size}) do "
+                    f"not cover subscription [{self._wlo}, "
+                    f"{self._wlo + self._wcnt})"
+                )
+        bits = np.unpackbits(
+            np.ascontiguousarray(words, "<u4").view(np.uint8),
+            bitorder="little",
+        ).astype(np.float32)
+        s_el = scales[self._leaf_of] * self._live
+        self._vals += s_el * (1.0 - 2.0 * bits)
+        np.clip(self._vals, -_SAT, _SAT, out=self._vals)
+        return True
+
+    def _publish(self) -> None:
+        self._version += 1
+        self._pub.publish(self._vals.copy(), self._fresh_ns, self._version)
+        if self._fresh_ns > 0:
+            self._ready.set()
+
+    def _on_data(self, payload: bytes) -> bool:
+        """One DATA/BURST/RDATA message. Returns True if state changed."""
+        seq = wire.data_seq(payload)
+        if not self._synced:
+            self._m_gaps.inc()
+            return False
+        if seq != self._expected_seq & 0xFFFFFFFF:
+            if seq == (self._expected_seq - 1) & 0xFFFFFFFF:
+                return False  # duplicate delivery: drop quietly
+            # a message vanished on the unledgered link: nothing will ever
+            # re-deliver it — desync and re-seed
+            self._m_gaps.inc()
+            self._desync("seq gap", seq)
+            return False
+        kind = payload[0]
+        changed = False
+        trace = None
+        try:
+            if kind == wire.RDATA:
+                scales, words, wlo, _wcnt, trace = wire.decode_rdata(
+                    payload, self.spec
+                )
+                changed = self._apply_frame(scales, words, wlo)
+            elif kind == wire.DATA:
+                f = wire.decode_frame(payload, self.spec)
+                trace = wire.data_trace(payload, self.spec)
+                changed = self._apply_frame(
+                    np.asarray(f.scales), np.asarray(f.words), 0
+                )
+            else:  # BURST
+                trace = wire.data_trace(payload, self.spec)
+                for f in wire.decode_burst(payload, self.spec):
+                    changed |= self._apply_frame(
+                        np.asarray(f.scales), np.asarray(f.words), 0
+                    )
+        except Exception as e:
+            # undecodable (sheared/garbled): do NOT consume the seq — on
+            # the ledgered writer path that rule lets the retransmission
+            # re-deliver the message whole; here nothing retransmits, so
+            # the only honest repair is a desync + control-plane re-seed
+            # (silently skipping it would lose the frame's mass forever
+            # while freshness kept advancing)
+            log.warning("undecodable data message (seq %d): %s", seq, e)
+            self._m_gaps.inc()
+            self._desync("undecodable", seq)
+            return False
+        self._expected_seq += 1
+        if trace is not None:
+            _origin, gen, _hops = trace
+            if gen > self._fresh_ns:
+                # verified freshness: the state now includes an update
+                # originated at `gen` — and FIFO + in-order seqs mean it
+                # includes everything the parent folded before it
+                self._fresh_ns = gen
+        return changed
+
+    def _on_message(self, link: int, payload: bytes) -> bool:
+        kind = payload[0]
+        if kind in (wire.DATA, wire.BURST, wire.RDATA):
+            return self._on_data(payload)
+        if kind == wire.WELCOME:
+            # seed transfer starting: the parent's snapshot of our pages
+            # follows as CHUNKs, then DONE arms the stream
+            self._await_welcome = False
+            self._seeding = True
+            self._staging = bytearray(self._vals.size * 4)
+            return True
+        if kind == wire.CHUNK:
+            if self._seeding:
+                wire.decode_chunk_into(payload, self._staging)
+            return True
+        if kind == wire.DONE:
+            if self._seeding:
+                # seed complete: adopt the parent's snapshot wholesale and
+                # arm the gap detector at 1 (codec DATA follows, FIFO);
+                # freshness re-establishes from the FRESH mark the parent
+                # stamped at snapshot time (next message)
+                self._vals[:] = np.frombuffer(self._staging, "<f4")
+                self._staging = b""
+                self._seeding = False
+                self._expected_seq = 1
+                self._synced = True
+                self._fresh_ns = 0
+                self._publish()
+            return True
+        if kind == wire.FRESH:
+            t, last_seq = wire.decode_fresh(payload)
+            if not self._synced:
+                return True
+            applied = (self._expected_seq - 1) & 0xFFFFFFFF
+            if last_seq != applied:
+                # the mark covers messages we never saw: the stream TAIL
+                # was swallowed — undetectable from data alone on an idle
+                # tree (no next message ever exposes the gap), which is
+                # exactly why FRESH carries the seq. Resync instead of
+                # falsely verifying freshness over diverged state.
+                self._m_gaps.inc()
+                self._desync("fresh-mark seq mismatch", last_seq)
+                return True
+            if t > self._fresh_ns:
+                self._fresh_ns = t
+                self._m_fresh.inc()
+                self._pub.touch(self._fresh_ns)
+                self._ready.set()
+            return True
+        if kind == wire.REJECT:
+            self._error = ConnectionError(
+                f"parent rejected subscription: {wire.decode_reject(payload)}"
+            )
+            self._ready.set()
+            return True
+        if kind == wire.SYNC:
+            # a writer (or another subscriber) tried to join UNDER us: a
+            # read-only leaf has nothing to seed it with
+            self._send_ctrl(
+                link,
+                wire.encode_reject(
+                    "read-only subscriber accepts no children"
+                ),
+            )
+            self.node.drop_link(link)
+            return True
+        return False  # ACK/DIGEST/...: not ours, ignore
+
+    def _publish_digest(self) -> None:
+        """r09 in-band aggregation, subscriber edition: our st_read_*/
+        st_sub_* registry rides the same DIGEST control message up the
+        tree, so the root's cluster view (obs.top) includes the serving
+        fleet."""
+        up = self._uplink
+        if up is None:
+            return
+        from ..obs import aggregate
+
+        doc = aggregate.from_snapshot(
+            self.node.obs_id, self._reg.snapshot(), time.monotonic_ns()
+        )
+        aggregate.bounded(doc)
+        try:
+            self.node.send(up, wire.encode_digest(doc), timeout=0.05)
+        except BrokenPipeError:
+            pass
+
+    def _loop(self) -> None:
+        digest_interval = (
+            self.config.obs.digest_interval_sec if self._obs_on else 0.0
+        )
+        while not self._stop.is_set():
+            busy = False
+            for ev in self.node.poll_events(timeout=0.0):
+                busy = True
+                if ev.kind == EventKind.LINK_UP:
+                    if ev.is_uplink:
+                        self._uplink = ev.link_id
+                        self._error = None
+                        self._start_handshake(ev.link_id, resync=False)
+                    # else: a joiner grafted under us — kept up just long
+                    # enough to REJECT its SYNC (the _on_message SYNC
+                    # branch), so the joiner fails loudly with a reason
+                    # instead of retrying a silent drop forever
+                elif ev.kind == EventKind.LINK_DOWN and ev.is_uplink:
+                    self._uplink = None
+                    self._desync("uplink down")
+                elif ev.kind == EventKind.BECAME_MASTER:
+                    self._error = ConnectionError(
+                        "subscriber was elected master (all writers died):"
+                        " a read-only replica cannot serve the tree —"
+                        " restart a writer and re-create the subscriber"
+                    )
+                    self._desync("became master")
+                    self._ready.set()
+                elif ev.kind == EventKind.REJOIN_FAILED:
+                    self._desync("rejoin failed")
+            up = self._uplink
+            changed = False
+            if up is not None:
+                for _ in range(256):
+                    try:
+                        payload = self.node.recv(up, timeout=0.0)
+                    except BrokenPipeError:
+                        break
+                    if payload is None:
+                        break
+                    busy = True
+                    try:
+                        changed |= self._on_message(up, payload)
+                    except Exception as e:
+                        log.warning("dropping bad message: %s", e)
+                    if changed:
+                        # publish PER applied message, not per drain pass:
+                        # under sustained write load the drain loop stays
+                        # busy for whole seconds, and readers must see
+                        # freshness advance with every apply, not when the
+                        # backlog finally empties (the copy is the cheap
+                        # part — the apply above dwarfs it)
+                        self._publish()
+                        changed = False
+            # also drain/reject stray child links (see _on_message SYNC)
+            for link in self.node.links:
+                if link == up:
+                    continue
+                try:
+                    payload = self.node.recv(link, timeout=0.0)
+                except BrokenPipeError:
+                    continue
+                if payload is not None:
+                    busy = True
+                    try:
+                        self._on_message(link, payload)
+                    except Exception as e:
+                        log.warning("dropping bad child message: %s", e)
+            if changed:
+                self._publish()
+            self._maybe_resync()
+            if digest_interval > 0:
+                now = time.monotonic()
+                if now - self._digest_last >= digest_interval:
+                    self._digest_last = now
+                    try:
+                        self._publish_digest()
+                    except Exception as e:
+                        log.debug("subscriber digest failed: %s", e)
+            if self._hub is not None:
+                self._hub.poll_native(
+                    self.config.obs.native_drain_interval_sec
+                )
+            if not busy:
+                time.sleep(0.002)
+
+
+def subscribe(
+    host: str,
+    port: int,
+    template: Any,
+    config: Config | None = None,
+    timeout: float = 30.0,
+) -> Subscriber:
+    """Create a :class:`Subscriber` and block until its first verified-fresh
+    read can succeed — the serving twin of ``create_or_fetch``."""
+    sub = Subscriber(host, port, template, config)
+    try:
+        sub.wait_ready(timeout)
+    except BaseException:
+        sub.close()
+        raise
+    return sub

@@ -19,6 +19,61 @@ def test_example_lua_program_shape():
         np.testing.assert_allclose(np.asarray(a.copyToTensor()), [2, 3, 4, 5])
 
 
+def test_reference_shim_tree_serves_a_read_only_subscriber():
+    """r10 interop satellite (compat surface): a tree built through the
+    reference-named shim (createOrFetch / addFromTensor — a writer that
+    knows nothing about the serving tier) transparently serves a read-only
+    subscriber: the subscriber advertises itself through the same SYNC the
+    shim's peer already speaks, gets the seed + every subsequent add, and
+    the shim peer keeps its reference semantics untouched."""
+    import time
+
+    from shared_tensor_tpu import serve
+
+    x = jnp.arange(1.0, 65.0, dtype=jnp.float32)
+    port = _free_port()
+    with compat.createOrFetch("127.0.0.1", port, x) as a:
+        with serve.subscribe(
+            "127.0.0.1", port, jnp.zeros_like(x), timeout=30.0
+        ) as sub:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                try:
+                    if np.allclose(
+                        np.asarray(sub.read(max_staleness=10.0)),
+                        np.asarray(x), atol=1e-4,
+                    ):
+                        break
+                except serve.StalenessError:
+                    pass
+                time.sleep(0.05)
+            np.testing.assert_allclose(
+                np.asarray(sub.read(max_staleness=10.0)), np.asarray(x),
+                atol=1e-4,
+            )
+            a.addFromTensor(jnp.ones_like(x))
+            sub.wait_fresh(serve.epoch(), timeout=20.0)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                try:
+                    if np.allclose(
+                        np.asarray(sub.read(max_staleness=10.0)),
+                        np.asarray(x) + 1, atol=1e-4,
+                    ):
+                        break
+                except serve.StalenessError:
+                    pass
+                time.sleep(0.05)
+            np.testing.assert_allclose(
+                np.asarray(sub.read(max_staleness=10.0)),
+                np.asarray(x) + 1, atol=1e-4,
+            )
+            # the shim peer's own view is untouched by the subscriber
+            np.testing.assert_allclose(
+                np.asarray(a.copyToTensor()), np.asarray(x) + 1, atol=1e-6
+            )
+
+
 def test_two_process_semantics_in_one_process():
     """Master + joiner through the compat names; joiner receives state and
     both see each other's adds (example.lua's multi-terminal story)."""

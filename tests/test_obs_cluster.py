@@ -264,11 +264,25 @@ def test_sync_advertises_wire_version():
     spec = make_spec(np.zeros(64, np.float32))
     p2 = wire.encode_sync(spec, 2)
     assert wire.sync_wire_version(p2) == 2
-    # a pre-r09 SYNC (no trailing byte) reads as v1
-    legacy = p2[:-1]
+    assert wire.sync_flags(p2) == 0  # r10: absent/zero flags = plain writer
+    # an r09 SYNC (version byte, no r10 flags byte) reads as v2 + flags 0
+    r09 = p2[:-1]
+    assert wire.sync_wire_version(r09) == 2
+    assert wire.sync_flags(r09) == 0
+    # a pre-r09 SYNC (no trailing bytes at all) reads as v1
+    legacy = p2[:-2]
     assert wire.sync_wire_version(legacy) == 1
-    # and the layout fields decode identically either way
+    assert wire.sync_flags(legacy) == 0
+    # and the layout fields decode identically every way
     assert wire.decode_sync(p2) == wire.decode_sync(legacy)
+    # r10 subscriber flags survive the trip
+    from shared_tensor_tpu.compat import SYNC_FLAG_RANGE, SYNC_FLAG_READ_ONLY
+
+    flagged = wire.encode_sync(
+        spec, 2, SYNC_FLAG_READ_ONLY | SYNC_FLAG_RANGE
+    )
+    assert wire.sync_flags(flagged) == (SYNC_FLAG_READ_ONLY | SYNC_FLAG_RANGE)
+    assert wire.sync_wire_version(flagged) == 2
 
 
 def test_v1_v2_mixed_tree_interop():

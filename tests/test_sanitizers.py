@@ -123,6 +123,39 @@ def test_cluster_trace_suite_under_asan_ubsan():
 
 
 @pytest.mark.slow
+def test_serve_suite_under_asan_ubsan():
+    """r10 satellite: the subscriber link mode is new native hot code —
+    the unledgered sender branch (per-frame RDATA encoding off the
+    msg.scales/words buffers, range slicing arithmetic, FRESH sends from
+    under the engine mutex) and the widened counters ABI. Run the whole
+    serve test file (resync-under-drop chaos included) against the
+    sanitizer builds so ASan/UBSan watch every range offset and buffer
+    copy while the chaos schedule drops frames under it."""
+    asan = _runtime("libasan.so")
+    ubsan = _runtime("libubsan.so")
+    if asan is None or ubsan is None:
+        pytest.skip("gcc sanitizer runtimes unavailable")
+    build = subprocess.run(
+        ["make", "-C", str(NATIVE), "sanitize"],
+        capture_output=True, text=True, timeout=300,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"sanitize build failed: {build.stderr[-500:]}")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "tests/test_serve.py", "-q",
+            "-p", "no:cacheprovider",
+        ],
+        env=_san_env(asan, ubsan), capture_output=True, text=True,
+        timeout=540, cwd=str(REPO),
+    )
+    err_tail = proc.stderr[-4000:]
+    assert "AddressSanitizer" not in proc.stderr, err_tail
+    assert "runtime error:" not in proc.stderr, err_tail  # UBSan findings
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-2000:], err_tail)
+
+
+@pytest.mark.slow
 def test_chaos_soak_native_arm_under_asan_ubsan():
     asan = _runtime("libasan.so")
     ubsan = _runtime("libubsan.so")

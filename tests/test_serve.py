@@ -1,0 +1,342 @@
+"""Read-path serving tier (r10): subscriber join, verified bounded-staleness
+reads, range subscription, gap->resync repair, and the lock-free read
+discipline (reads never touch the data plane).
+
+Staleness semantics under test are the serving contract: every
+``read(max_staleness=s)`` either returns state VERIFIED at most ``s``
+seconds behind (r09 origin stamps / FRESH drain marks) or raises
+StalenessError — never silent staleness.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shared_tensor_tpu import serve
+from shared_tensor_tpu.comm.peer import create_or_fetch
+from shared_tensor_tpu.config import (
+    Config, FaultConfig, ServeConfig, TransportConfig,
+)
+from tests._ports import free_port
+
+
+def _poll(fn, deadline=45.0, every=0.02):
+    """Retry fn() (StalenessError tolerated — the subscriber may be mid
+    seed/resync) until truthy or the deadline."""
+    t0 = time.monotonic()
+    last = None
+    while time.monotonic() - t0 < deadline:
+        try:
+            last = fn()
+            if last:
+                return last
+        except serve.StalenessError:
+            pass
+        time.sleep(every)
+    return last
+
+
+def test_subscriber_joins_reads_and_tracks_writes():
+    """A read-only leaf joins a live tree, receives the seed through the
+    normal codec stream, and tracks writes with verified freshness — while
+    the writer keeps ZERO delivery ledger for it (the unledgered-link
+    contract: read-only leaves cost writers no ACK state)."""
+    port = free_port()
+    n = 512
+    with create_or_fetch(
+        "127.0.0.1", port, jnp.arange(n, dtype=jnp.float32)
+    ) as m:
+        with serve.subscribe(
+            "127.0.0.1", port, jnp.zeros(n, jnp.float32), timeout=30.0
+        ) as sub:
+            assert _poll(lambda: np.allclose(
+                np.asarray(sub.read(max_staleness=10.0)), np.arange(n),
+                atol=1e-4,
+            ))
+            m.add(jnp.ones(n, jnp.float32))
+            ep = serve.epoch()
+            sub.wait_fresh(ep, timeout=20.0)
+            assert _poll(lambda: np.allclose(
+                np.asarray(sub.read(max_staleness=10.0)), np.arange(n) + 1,
+                atol=1e-4,
+            ))
+            # unledgered: no RETAINED in-flight state toward the subscriber
+            # (a frame is transiently ledgered only within one send call)
+            assert _poll(lambda: m.st.inflight_total() == 0, deadline=10.0)
+            wm = m.metrics(canonical=True)
+            assert wm["st_sub_links"] == 1
+            assert wm["st_sub_msgs_out_total"] >= 1
+            sm = sub.metrics()
+            assert sm["st_read_total"] >= 2
+            assert sm["st_read_stale_total"] == 0
+            # freshness was VERIFIED (stamp or FRESH mark), not assumed
+            assert 0 <= sm["st_sub_freshness_seconds"] < 30.0
+
+
+def test_read_raises_not_stale_silently_when_writers_vanish():
+    """Kill the only writer: within one staleness bound the subscriber's
+    reads must START RAISING StalenessError — the reads-refuse-or-verify
+    contract. (Idle-but-alive writers keep reads fresh via FRESH marks;
+    a dead one cannot, and that difference must be loud.)"""
+    port = free_port()
+    n = 128
+    m = create_or_fetch("127.0.0.1", port, jnp.arange(n, dtype=jnp.float32))
+    sub = serve.subscribe(
+        "127.0.0.1", port, jnp.zeros(n, jnp.float32), timeout=30.0
+    )
+    try:
+        assert _poll(
+            lambda: sub.read(max_staleness=10.0) is not None, deadline=20.0
+        )
+        # an IDLE writer keeps freshness verifiable (FRESH beats)
+        time.sleep(0.8)
+        assert sub.read(max_staleness=0.6) is not None
+        m.close()
+        time.sleep(1.0)
+        with pytest.raises(serve.StalenessError):
+            sub.read(max_staleness=0.5)
+        assert sub.metrics()["st_read_stale_total"] >= 1
+    finally:
+        sub.close()
+        m.close()
+
+
+def test_range_subscription_buffers_only_its_pages():
+    """Paged subscription: the subscriber buffers ONLY the subscribed
+    word-aligned element range, converges on it, and the writer forwards
+    range-filtered RDATA (satellite: the paged-HBM discipline)."""
+    port = free_port()
+    n = 4096
+    lo, hi = 1024, 2048
+    with create_or_fetch(
+        "127.0.0.1", port, jnp.arange(n, dtype=jnp.float32)
+    ) as m:
+        cfg = Config(serve=ServeConfig(range=(lo, hi)))
+        with serve.subscribe(
+            "127.0.0.1", port, jnp.zeros(n, jnp.float32), cfg, timeout=30.0
+        ) as sub:
+            assert sub.range_elements == (lo, hi)
+            assert sub._vals.size == hi - lo  # pages only, not the table
+            assert _poll(lambda: np.allclose(
+                sub.read(max_staleness=10.0), np.arange(lo, hi), atol=1e-4
+            ))
+            m.add(jnp.full((n,), 3.0, jnp.float32))
+            assert _poll(lambda: np.allclose(
+                sub.read(max_staleness=10.0), np.arange(lo, hi) + 3,
+                atol=1e-4,
+            ))
+            assert sub.metrics()["st_sub_range_words"] == (hi - lo) // 32
+            assert m.metrics(canonical=True)["st_sub_msgs_out_total"] >= 1
+
+
+def test_gap_triggers_resync_and_reads_stay_honest_under_drop_chaos():
+    """25%-drop chaos on an (unledgered) subscriber link: every swallowed
+    message is a seq gap, the subscriber re-seeds via the resync
+    handshake, reads either verify their bound or raise, and the value
+    converges exactly once the chaos quiesces. Python-tier writer so the
+    FaultConfig wire knobs inject directly."""
+    port = free_port()
+    n = 256
+    cfg_w = Config(
+        faults=FaultConfig(enabled=True, seed=7, drop_pct=0.25),
+        native_engine=False,
+    )
+    m = create_or_fetch("127.0.0.1", port, jnp.zeros(n, jnp.float32), cfg_w)
+    assert m._engine is None  # the python wire boundary is where drops land
+    sub = serve.subscribe(
+        "127.0.0.1", port, jnp.zeros(n, jnp.float32), timeout=30.0
+    )
+    try:
+        total = np.zeros(n)
+        rng = np.random.default_rng(1)
+        for _ in range(25):
+            d = rng.uniform(-1, 1, n).astype(np.float32)
+            m.add(jnp.asarray(d))
+            total += d
+            time.sleep(0.02)
+        assert _poll(lambda: np.allclose(
+            np.asarray(sub.read(max_staleness=1.0)), total, atol=1e-3
+        ), deadline=60.0)
+        sm = sub.metrics()
+        assert sm["st_sub_resyncs_total"] >= 1, "drops never forced a resync?"
+        assert sm["st_sub_gap_discards_total"] >= 1
+        # the writer was never wedged: its ledger RETAINS nothing for the
+        # subscriber (transient within-send entries aside) and its add
+        # path stayed live through every resync
+        assert _poll(lambda: m.st.inflight_total() == 0, deadline=10.0)
+    finally:
+        sub.close()
+        m.close()
+
+
+def test_reads_outlive_the_data_plane():
+    """The core.py satellite's structural proof: a read touches ONLY the
+    published double buffer (core.SnapshotPublisher) — no transport, no
+    engine mutex, no apply lock. Strongest demonstration: reads still
+    serve (within their bound) after close() tore the whole data plane
+    down."""
+    port = free_port()
+    n = 128
+    with create_or_fetch(
+        "127.0.0.1", port, jnp.arange(n, dtype=jnp.float32)
+    ) as m:
+        sub = serve.subscribe(
+            "127.0.0.1", port, jnp.zeros(n, jnp.float32), timeout=30.0
+        )
+        assert _poll(lambda: sub.read(max_staleness=10.0) is not None)
+        sub.close()  # recv thread joined, transport node closed
+        v = sub.read(max_staleness=30.0)  # still serves: no data plane left
+        assert np.allclose(np.asarray(v), np.arange(n), atol=1e-4)
+        assert _poll(lambda: m.st.inflight_total() == 0, deadline=10.0)
+
+
+def test_concurrent_reads_never_block_add():
+    """Regression (core.py satellite): reader threads hammering the
+    serving handle must not block a writer's add() — the old snapshot
+    path copied under the data-plane lock; serve reads swap references.
+    Bound is deliberately generous (box noise): an add is microseconds,
+    a lock-coupled read storm would push it to the staleness bound."""
+    port = free_port()
+    n = 1024
+    with create_or_fetch(
+        "127.0.0.1", port, jnp.zeros(n, jnp.float32)
+    ) as m:
+        with serve.subscribe(
+            "127.0.0.1", port, jnp.zeros(n, jnp.float32), timeout=30.0
+        ) as sub:
+            handle = sub.serving_handle(max_staleness=30.0)
+            assert _poll(lambda: handle.refresh() or True)
+            stop = threading.Event()
+            reads = [0]
+
+            def reader():
+                while not stop.is_set():
+                    handle.params()
+                    try:
+                        handle.refresh()
+                    except serve.StalenessError:
+                        pass
+                    reads[0] += 1
+
+            threads = [threading.Thread(target=reader) for _ in range(4)]
+            for t in threads:
+                t.start()
+            worst = 0.0
+            try:
+                for i in range(20):
+                    t0 = time.monotonic()
+                    m.add(jnp.full((n,), 0.01, jnp.float32))
+                    worst = max(worst, time.monotonic() - t0)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join()
+            assert reads[0] > 0
+            assert worst < 1.0, f"add() blocked {worst:.3f}s under read load"
+
+
+def test_writer_join_under_subscriber_is_refused():
+    """A subscriber is a LEAF: it seeds nobody. A writer pointed at the
+    subscriber's own listen port must fail its join loudly instead of
+    grafting under a read-only node and waiting forever for state."""
+    port = free_port()
+    n = 64
+    with create_or_fetch(
+        "127.0.0.1", port, jnp.zeros(n, jnp.float32)
+    ) as _m:
+        with serve.subscribe(
+            "127.0.0.1", port, jnp.zeros(n, jnp.float32), timeout=30.0
+        ) as sub:
+            sub_port = sub.node.listen_port
+            cfg = Config(
+                transport=TransportConfig(join_timeout_sec=3.0),
+            )
+            with pytest.raises(ConnectionError):
+                create_or_fetch(
+                    "127.0.0.1", sub_port, jnp.zeros(n, jnp.float32), cfg,
+                    timeout=8.0,
+                )
+
+
+def test_subscriber_cannot_become_master():
+    """A read-only replica must not claim an empty rendezvous (it would
+    serve zeros forever and orphan real writers behind it)."""
+    port = free_port()
+    with pytest.raises(ConnectionError):
+        serve.Subscriber("127.0.0.1", port, jnp.zeros(64, jnp.float32))
+
+
+def test_mixed_tree_v2_writers_legacy_peer_and_ranged_subscriber(monkeypatch):
+    """Satellite: a v2 writer tree with one read-only subscriber AND one
+    legacy peer interops — the legacy (pre-range, v1-pinned emission) peer
+    still gets the full flood, the subscriber gets exactly its range."""
+    port = free_port()
+    n = 2048
+    lo, hi = 512, 1024
+    with create_or_fetch(
+        "127.0.0.1", port, jnp.zeros(n, jnp.float32)
+    ) as master:
+        # legacy writer peer: pinned to v1 emission (the pre-r09 escape
+        # hatch — no trace stamps, no flags beyond the version byte)
+        monkeypatch.setenv("ST_WIRE_TRACE", "0")
+        legacy = create_or_fetch(
+            "127.0.0.1", port, jnp.zeros(n, jnp.float32)
+        )
+        monkeypatch.delenv("ST_WIRE_TRACE")
+        cfg = Config(serve=ServeConfig(range=(lo, hi)))
+        sub = serve.subscribe(
+            "127.0.0.1", port, jnp.zeros(n, jnp.float32), cfg, timeout=30.0
+        )
+        try:
+            master.add(jnp.arange(n, dtype=jnp.float32))
+            # legacy peer converges on the FULL table
+            deadline = time.monotonic() + 45.0
+            while time.monotonic() < deadline:
+                if np.allclose(
+                    np.asarray(legacy.read()), np.arange(n), atol=1e-3
+                ):
+                    break
+                time.sleep(0.05)
+            np.testing.assert_allclose(
+                np.asarray(legacy.read()), np.arange(n), atol=1e-3
+            )
+            # subscriber converges on exactly its pages
+            assert _poll(lambda: np.allclose(
+                sub.read(max_staleness=10.0), np.arange(lo, hi), atol=1e-3
+            ))
+            # and a legacy-originated write floods everywhere too
+            legacy.add(jnp.ones(n, jnp.float32))
+            assert _poll(lambda: np.allclose(
+                sub.read(max_staleness=10.0), np.arange(lo, hi) + 1,
+                atol=1e-3,
+            ), deadline=60.0)
+        finally:
+            sub.close()
+            legacy.close()
+
+
+def test_serving_handle_hot_swap_identity():
+    """The hot-swap contract: params() is reference-stable between
+    refreshes (an in-flight forward pass can never see a half-swapped
+    tree), and refresh() swaps in one reference assignment."""
+    port = free_port()
+    n = 256
+    with create_or_fetch(
+        "127.0.0.1", port, jnp.zeros(n, jnp.float32)
+    ) as m:
+        with serve.subscribe(
+            "127.0.0.1", port, jnp.zeros(n, jnp.float32), timeout=30.0
+        ) as sub:
+            handle = sub.serving_handle(max_staleness=30.0)
+            assert _poll(lambda: handle.refresh() or handle.params() is not None)
+            p1 = handle.params()
+            assert p1 is handle.params()  # no per-call copies
+            m.add(jnp.ones(n, jnp.float32))
+            sub.wait_fresh(serve.epoch(), timeout=20.0)
+            assert _poll(lambda: handle.refresh(), deadline=20.0)
+            p2 = handle.params()
+            assert p2 is not p1
+            assert np.allclose(np.asarray(p2), 1.0, atol=1e-4)
