@@ -2,17 +2,26 @@
 
 Runs bench.py once, records the JSON result as the round's BENCH artifact
 (argv[1]), and exits nonzero when ``sync_bandwidth_equiv_fp32_per_link``
-regressed more than the tolerance (default 10%, ST_BENCH_GATE_PCT) against
-the newest *committed* BENCH_r*.json — so a data-plane refactor that
-passes every functional test but halves throughput turns the suite red.
+falls below the RATCHETED floor — so a data-plane refactor that passes
+every functional test but halves throughput turns the suite red.
 
-The comparison value is the best prior round's ``parsed.value`` (the
+r11 ratchet ("raise the floor, don't just pass it", ROADMAP item 4): the
+floor is ``max(prior round's locked floor, (1 - pct) * prior headline)``
+— monotone non-decreasing across rounds, so a round that lands a big gain
+LOCKS IT IN via the ``floor_locked`` field its artifact records (=
+``max(floor used, (1 - pct) * measured value)``); a later regression back
+to the pre-gain level fails even if it is within 10% of the most recent
+(already-regressed) round. Pre-r11 artifacts carry no ``floor_locked``,
+so the first ratcheted round degrades to the old newest-headline rule.
+
+The comparison value is the newest prior round's ``parsed.value`` (the
 driver's artifact shape) or top-level ``value`` (raw bench.py output);
 with no prior artifact the reference baseline (1.01 GB/s, BASELINE.md)
 is the floor's base. Caveat recorded in the artifact: bench.py's arm
 ladder means a round measured on a degraded arm (chip wedged worse than
 usual) can trip the gate spuriously — the artifact keeps the arm trail
-(detail.attempts) so a red gate is diagnosable at a glance.
+(detail.attempts) so a red gate is diagnosable at a glance, and the box's
+5-10% loopback noise is why pct stays 10 rather than 0.
 """
 
 import glob
@@ -27,7 +36,9 @@ REFERENCE_GBPS = 1.01  # BASELINE.md E2E yardstick (bench.py BASELINE_GBPS)
 
 
 def _prior_value(exclude: str):
-    """(value, source_path) from the newest committed BENCH_r*.json."""
+    """(value, locked_floor, source_path) from the newest committed
+    BENCH_r*.json. ``locked_floor`` is that round's recorded ratchet
+    (0.0 when the artifact predates r11)."""
     best = None
     for p in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
         name = os.path.basename(p)
@@ -40,15 +51,16 @@ def _prior_value(exclude: str):
         if best is None or rnd > best[0]:
             best = (rnd, p)
     if best is None:
-        return REFERENCE_GBPS, "BASELINE.md reference"
+        return REFERENCE_GBPS, 0.0, "BASELINE.md reference"
     try:
         with open(best[1]) as f:
             doc = json.load(f)
         parsed = doc.get("parsed", doc)
         v = float(parsed["value"])
-        return v, os.path.basename(best[1])
+        locked = float(doc.get("floor_locked", 0.0))
+        return v, locked, os.path.basename(best[1])
     except Exception:
-        return REFERENCE_GBPS, "BASELINE.md reference (prior unparseable)"
+        return REFERENCE_GBPS, 0.0, "BASELINE.md reference (prior unparseable)"
 
 
 def main() -> int:
@@ -56,8 +68,14 @@ def main() -> int:
     if not os.path.isabs(art_path):
         art_path = os.path.join(REPO, art_path)
     pct = float(os.environ.get("ST_BENCH_GATE_PCT", "10"))
-    prior, source = _prior_value(art_path)
-    floor = prior * (1.0 - pct / 100.0)
+    prior, locked, source = _prior_value(art_path)
+    floor = max(locked, prior * (1.0 - pct / 100.0))
+    floor_from = (
+        f"max({source} floor_locked {locked:.2f}, "
+        f"{source} value * (1 - {pct}%))"
+        if locked > prior * (1.0 - pct / 100.0)
+        else f"{source} * (1 - {pct}%)"
+    )
 
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -76,10 +94,12 @@ def main() -> int:
     ok = result is not None and value >= floor
 
     artifact = {
-        "gate": "suite_load perf floor",
+        "gate": "suite_load perf floor (ratcheted, r11)",
         "metric": "sync_bandwidth_equiv_fp32_per_link",
         "floor_gbps": round(floor, 3),
-        "floor_from": f"{source} * (1 - {pct}%)",
+        "floor_from": floor_from,
+        # the ratchet the NEXT round inherits: this round's gain, locked
+        "floor_locked": round(max(floor, value * (1.0 - pct / 100.0)), 3),
         "pass": ok,
         "parsed": result,
     }

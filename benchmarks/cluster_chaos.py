@@ -29,12 +29,26 @@ tree is never wedged by any of it (exact convergence + full drain with the
 subscribers attached). Emits the subscriber tallies alongside the r09
 telemetry checks.
 
+r11 ``--stripes N`` arm: every link in the tree runs striped over N
+sockets, and the chaotic node's plan SEVERS ONE STRIPE SOCKET of its
+uplink mid-stream (``only_stripe`` + ``sever_after_frames`` on top of the
+drop schedule) — the striping contract under chaos: the link must degrade
+to the surviving stripes (stripe_stats deaths >= 1 with the link still
+converging) or, if reassembly wedged on a swallowed stripe seq, take the
+clean go-back-N black-hole teardown into carry/re-graft — either way the
+tree reaches the exact total; a wedged link shows up as a convergence
+timeout and fails the run. Stripe telemetry (deaths, reroutes, live vs
+negotiated counts) is tallied in the artifact.
+
 Emits one JSON document and writes it to argv[1] (default CHAOS_r09.json).
 Run:  JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py CHAOS_r09.json
       JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py CHAOS_r10.json \
           --subscribers 2
+      JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py CHAOS_r11.json \
+          --stripes 4
 Knobs: ST_CLUSTER_NODES (default 7), ST_CLUSTER_N (2048),
-ST_CLUSTER_ADDS (40), ST_CLUSTER_SEED (9), ST_CLUSTER_SUBSCRIBERS (0).
+ST_CLUSTER_ADDS (40), ST_CLUSTER_SEED (9), ST_CLUSTER_SUBSCRIBERS (0),
+ST_CLUSTER_STRIPES (1).
 """
 
 import json
@@ -55,6 +69,14 @@ if "--subscribers" in sys.argv:
     i = sys.argv.index("--subscribers")
     SUBS = int(sys.argv[i + 1])
     del sys.argv[i : i + 2]
+STRIPES = int(os.environ.get("ST_CLUSTER_STRIPES", "1"))
+if "--stripes" in sys.argv:
+    i = sys.argv.index("--stripes")
+    STRIPES = int(sys.argv[i + 1])
+    del sys.argv[i : i + 2]
+# frames the chaotic node's targeted stripe carries before its sever fires
+# (one constant: both the injected FaultConfig and the artifact cite it)
+SEVER_AFTER = 4
 #: Staleness bound subscriber reads must verify (or raise) under chaos.
 SUB_BOUND = float(os.environ.get("ST_CLUSTER_SUB_BOUND", "0.75"))
 
@@ -96,7 +118,10 @@ def main() -> int:
     hub.recorder.set_capacity(500_000)
 
     cfg = Config(
-        transport=TransportConfig(peer_timeout_sec=20.0, ack_timeout_sec=0.4),
+        transport=TransportConfig(
+            peer_timeout_sec=20.0, ack_timeout_sec=0.4,
+            stripe_count=max(1, min(8, STRIPES)),
+        ),
         obs=ObsConfig(digest_interval_sec=0.2),
     )
     port = _free_port()
@@ -105,11 +130,15 @@ def main() -> int:
     # with subscribers attached, the chaotic node's drop schedule covers
     # ALL its links (only_link=0) so the unledgered subscriber links face
     # the same 25% drops as its uplink; the r09-compatible run keeps the
-    # original uplink-only schedule
+    # original uplink-only schedule. The r11 striped arm additionally
+    # SEVERS one stripe socket of the chaotic node's uplink mid-stream —
+    # the per-stripe chaos the satellite task names.
     env = faults.to_env(
         FaultConfig(
             enabled=True, seed=SEED, drop_pct=0.25,
             only_link=0 if SUBS > 0 else 1,
+            only_stripe=STRIPES - 1 if STRIPES > 1 else -1,
+            sever_after_frames=SEVER_AFTER if STRIPES > 1 else 0,
         )
     )
     peers = []
@@ -151,6 +180,10 @@ def main() -> int:
         out["subscribers"] = {
             "count": SUBS, "max_staleness_sec": SUB_BOUND,
         }
+    if STRIPES > 1:
+        out["chaos"]["severed_stripe"] = STRIPES - 1
+        out["chaos"]["sever_after_frames"] = SEVER_AFTER
+        out["stripes"] = {"count": STRIPES}
     try:
         from shared_tensor_tpu.serve import StalenessError
 
@@ -236,6 +269,44 @@ def main() -> int:
             v for s in snaps for k, v in s.items()
             if k.startswith("st_staleness_seconds")
         ]
+        # r11 striped arm: the sever killed ONE socket of the chaotic
+        # node's uplink. Acceptable outcomes, both of which the exact
+        # convergence above already survived: (a) the link DEGRADED to
+        # the survivors — some live link reports deaths >= 1 with
+        # live < negotiated; (b) reassembly wedged on a stripe seq the
+        # dead socket swallowed and go-back-N tore the LINK down into
+        # carry/re-graft (stripe_down/link_down in the ring, the
+        # re-grafted link reporting a full stripe set). A wedged link is
+        # the one outcome that cannot reach this point (convergence
+        # times out and fails the run first).
+        if STRIPES > 1:
+            per_link = []
+            for i, p in enumerate(peers):
+                for link in list(p.node.links or ()):
+                    ss = p.node.stripe_stats(link)
+                    if ss is not None and ss["stripes"] > 1:
+                        per_link.append({"node": i, "link": link, **ss})
+            deaths = sum(s["deaths"] for s in per_link)
+            reroutes = sum(s["reroutes"] for s in per_link)
+            degraded = [
+                s for s in per_link if s["deaths"] >= 1
+                and s["live"] == s["stripes"] - s["deaths"]
+            ]
+            stripe_down_events = counts.get("stripe_down", 0)
+            teardowns = counts.get("blackhole_teardown", 0)
+            out["stripes"].update(
+                links_striped=len(per_link),
+                deaths=deaths,
+                reroutes=reroutes,
+                degraded_links=len(degraded),
+                stripe_down_events=stripe_down_events,
+                gbn_teardowns=teardowns,
+                outcome=(
+                    "degraded-to-survivors" if degraded
+                    else "gbn-teardown-regraft" if teardowns >= 1
+                    else "none-observed"
+                ),
+            )
         if subs:
             sm = [s.metrics() for s in subs]
             out["subscribers"].update(
@@ -290,6 +361,14 @@ def main() -> int:
             # reads may legitimately all refuse under heavy drops — the
             # artifact records both tallies separately)
             and (not subs or (all(sub_converged) and reads_ok + q_ok >= 1))
+            # r11 striped arm: the injected stripe sever must actually
+            # have fired AND resolved into one of the two clean outcomes
+            # (degrade-to-survivors or go-back-N teardown) — never a
+            # wedged link (which the convergence deadline above catches)
+            and (
+                STRIPES <= 1
+                or out["stripes"]["outcome"] != "none-observed"
+            )
         )
     finally:
         for s in subs:

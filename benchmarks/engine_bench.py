@@ -34,7 +34,14 @@ MEASURE_S = float(os.environ.get("ST_ENGINE_BENCH_S", "8"))
 #: quiesces (drain needs ~30 successive halvings), slow enough that the
 #: codec stream owns the core.
 def _add_period(n: int) -> float:
-    return max(0.002, n / (1 << 20) * 0.02)
+    # r11: the cascade quantizer drains a residual in ~tens of frames and
+    # idles (instead of free-running a junk tail), so "fast enough that
+    # residual mass never quiesces" now means ~1 ms at 1 Mi (measured:
+    # 1 ms saturates the cascade-32 pass loop — ~74 GB/s equiv after the
+    # TxPool warm fix — while 4 ms starves the wire to a fraction of
+    # that; the add itself is 2 fused table passes, ~0.3 ms, still well
+    # under the period).
+    return max(0.001, n / (1 << 20) * 0.001)
 #: ST_ENGINE_BENCH_COMPAT=1 runs both peers on the reference's raw wire
 #: protocol (engine compat data plane, K-frame compat bursts) — the
 #: saturation measurement behind the "faster than the reference at its own
@@ -53,13 +60,28 @@ def _force_cpu():
     jax.config.update("jax_platforms", "cpu")
 
 
-def _cfg():
-    if not COMPAT:
-        return None
-    from shared_tensor_tpu.config import Config, TransportConfig
+#: r11 link striping: sockets per logical link for the native arm
+#: (ST_ENGINE_BENCH_STRIPES; the stripe sweep drives this 1/2/4).
+STRIPES = int(os.environ.get("ST_ENGINE_BENCH_STRIPES", "4"))
+#: r11 cascade depth (frames quantized per memory pass; 0 = the
+#: CodecConfig default). The sweep knob behind the committed retune.
+CASCADE = int(os.environ.get("ST_ENGINE_BENCH_CASCADE", "0"))
 
+
+def _cfg():
+    from shared_tensor_tpu.config import CodecConfig, Config, TransportConfig
+
+    if COMPAT:
+        return Config(
+            transport=TransportConfig(peer_timeout_sec=30.0, wire_compat=True)
+        )
+    codec = CodecConfig(cascade_frames=CASCADE) if CASCADE > 0 else None
     return Config(
-        transport=TransportConfig(peer_timeout_sec=30.0, wire_compat=True)
+        transport=TransportConfig(
+            peer_timeout_sec=30.0,
+            stripe_count=max(1, min(8, STRIPES)),
+        ),
+        **({"codec": codec} if codec else {}),
     )
 
 

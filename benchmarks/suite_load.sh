@@ -75,4 +75,19 @@ if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_SERVE:-1}" = "1" ]; then
   JAX_PLATFORMS=cpu python benchmarks/serve_bench.py "$SERVE_OUT" \
     >/dev/null 2>>"$OUT" || FAILED=1
 fi
+
+# Sanitizer arm (r11): striping + adaptive precision put new hot code in
+# all three native libs (per-stripe sender/receiver threads + reassembly,
+# sign2 pack/unpack + cascade kernels, the precision governor). Run the
+# striped+adaptive sanitizer test (ASan+UBSan via make -C native sanitize;
+# the sign2 suite + the per-stripe chaos tests) as part of the loaded
+# suite so a latent memory bug in the new planes turns the suite red, not
+# just the nightly. ST_SUITE_SAN=0 skips (e.g. a box without the gcc
+# sanitizer runtimes — the test itself also skips cleanly there).
+if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_SAN:-1}" = "1" ]; then
+  echo "--- sanitizer arm (striped+adaptive) ---" >>"$OUT"
+  JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_sanitizers.py::test_striped_adaptive_suite_under_asan_ubsan \
+    -m slow -q -p no:cacheprovider >>"$OUT" 2>&1 || FAILED=1
+fi
 exit "$FAILED"

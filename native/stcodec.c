@@ -125,9 +125,14 @@ static struct {
   int started;            /* 0 = not yet, 1 = live, -1 = dead (fork child) */
   int nworkers;
   uint64_t gen;
-  stc_seg_fn fn;
-  void *ctx;
-  int64_t nseg;
+  /* job fields are relaxed atomics published under the agen seqlock (see
+   * below): plain fields raced the next submitter's writes once the
+   * publish mutex was dropped — a worker preempted between adopting agen
+   * and reading nseg could pair job N's counter tag with job N+1's nseg
+   * and claim a chunk both jobs then process. */
+  _Atomic(stc_seg_fn) fn;
+  void *_Atomic ctx;
+  _Atomic int64_t nseg;
   /* generation-tagged work counter: (gen & 0xffffffff) << 32 | next_index.
    * The tag closes a straggler race: a worker that woke for job G and
    * snapshotted fn/ctx/nseg can be preempted BEFORE its first pop while
@@ -139,15 +144,34 @@ static struct {
    * straggler falls through to re-wait (ADVICE r05 finding 2). */
   _Atomic uint64_t next;
   int64_t finished;
-  /* lock-free mirrors for the spin phases: agen is published (with the
-   * job fields already visible, release order) just before the condvar
-   * broadcast; afinished mirrors `finished` so the submitter can watch
-   * completion without the mutex. The mutex/condvar protocol is unchanged
-   * and remains the fallback once a spin window expires. */
+  /* r11 lock-free hot path: the per-job mutex round trips (publish
+   * broadcast + every worker's start/finish acquisition) measured as
+   * ~100 us of a ~250 us pass once the cascade cut the pass COUNT 8-fold
+   * — the handoff became the wall. Steady state now touches no mutex at
+   * all: agen is a SEQLOCK word, (gen << 1) | writing — the submitter
+   * flips it odd (acq_rel RMW, so the field stores cannot hoist above
+   * it), stores fn/ctx/nseg/afin/next, then release-stores the new even
+   * tag; a worker snapshots the fields between two agen loads and
+   * retries on odd or mismatch, so a snapshot is always ONE job's
+   * consistent set and its pops tag-check against that same gen.
+   * Workers count completions into afin, a single generation-tagged
+   * (gen32 << 32 | finished) word the submitter spins on. The
+   * mutex/condvar pair remains ONLY as the idle-sleep fallback: workers
+   * register in `sleepers` and timed-wait (bounded, so the publisher's
+   * racy sleepers check can never lose a wakeup for more than one
+   * tick), and a submitter whose spin expires sets sub_waiting and
+   * timed-waits on cv_done. */
   _Atomic uint64_t agen;
-  _Atomic int64_t afinished;
+  _Atomic uint64_t afin; /* (gen32 << 32) | chunks finished for that gen */
+  /* modified under mu (the condvar handshake needs that); ATOMIC because
+   * the publisher reads it without mu — the missed-wakeup that read can
+   * suffer is bounded by the 2 ms timedwait tick, but the access itself
+   * must not be a plain-int data race */
+  _Atomic int sleepers;
+  _Atomic int sub_waiting;
 } g_pool = {PTHREAD_MUTEX_INITIALIZER, PTHREAD_COND_INITIALIZER,
             PTHREAD_COND_INITIALIZER,  PTHREAD_MUTEX_INITIALIZER,
+            0,                         0,
             0,                         0,
             0,                         0,
             0,                         0,
@@ -173,40 +197,77 @@ static void *stc_pool_worker(void *arg) {
   for (;;) {
     /* spin phase: the steady-state sender submits jobs back-to-back, and
      * a condvar sleep/wake per job costs more than a whole 512 KiB chunk —
-     * watch the lock-free generation mirror briefly before sleeping. */
-    for (int i = 0; i < ST_SPIN_ITERS; i++) {
-      if (atomic_load_explicit(&g_pool.agen, memory_order_acquire) != seen)
+     * watch the lock-free generation mirror before sleeping. */
+    int spun = 0;
+    while (atomic_load_explicit(&g_pool.agen, memory_order_acquire) ==
+           seen) {
+      if (++spun >= ST_SPIN_ITERS) {
+        /* idle: sleep (the only mutex on this thread's lifetime path).
+         * timedwait bounds the publisher's racy sleepers check — a
+         * publish that misses a just-registering sleeper costs one tick,
+         * never a lost wakeup. */
+        pthread_mutex_lock(&g_pool.mu);
+        g_pool.sleepers++;
+        while (atomic_load_explicit(&g_pool.agen, memory_order_acquire) ==
+               seen) {
+          struct timespec ts;
+          clock_gettime(CLOCK_REALTIME, &ts);
+          ts.tv_nsec += 2000000; /* 2 ms tick */
+          if (ts.tv_nsec >= 1000000000) {
+            ts.tv_sec++;
+            ts.tv_nsec -= 1000000000;
+          }
+          pthread_cond_timedwait(&g_pool.cv_job, &g_pool.mu, &ts);
+        }
+        g_pool.sleepers--;
+        pthread_mutex_unlock(&g_pool.mu);
         break;
+      }
       stc_cpu_relax();
     }
-    pthread_mutex_lock(&g_pool.mu);
-    while (g_pool.gen == seen) pthread_cond_wait(&g_pool.cv_job, &g_pool.mu);
-    seen = g_pool.gen;
-    stc_seg_fn fn = g_pool.fn;
-    void *ctx = g_pool.ctx;
-    int64_t nseg = g_pool.nseg;
-    pthread_mutex_unlock(&g_pool.mu);
+    /* seqlock read: snapshot the job fields between two agen loads and
+     * adopt only a stable, even (not mid-publish) tag — the snapshot is
+     * then ONE job's consistent {fn, ctx, nseg}, and pops tag-check
+     * against that same generation. A newer job replacing the counter
+     * makes our pops fail and we loop to re-adopt (the straggler
+     * discipline, ADVICE r05 finding 2 — unchanged, just lock-free). */
+    uint64_t g1 = atomic_load_explicit(&g_pool.agen, memory_order_acquire);
+    if ((g1 & 1) != 0 || g1 == seen) continue;
+    stc_seg_fn fn = atomic_load_explicit(&g_pool.fn, memory_order_relaxed);
+    void *ctx = atomic_load_explicit(&g_pool.ctx, memory_order_relaxed);
+    int64_t nseg = atomic_load_explicit(&g_pool.nseg, memory_order_relaxed);
+    atomic_thread_fence(memory_order_acquire);
+    if (atomic_load_explicit(&g_pool.agen, memory_order_relaxed) != g1)
+      continue; /* a publish raced the snapshot: re-adopt */
+    seen = g1;
+    uint64_t gen = g1 >> 1;
     int64_t done = 0;
     for (;;) {
-      int64_t s = stc_pool_pop(seen, nseg);
+      int64_t s = stc_pool_pop(gen, nseg);
       if (s < 0) break;
       fn(ctx, s);
       done++;
     }
-    pthread_mutex_lock(&g_pool.mu);
-    /* `done` only counts chunks of OUR generation (stc_pool_pop refuses
-     * cross-generation pops), so finished can never be polluted by a
-     * straggler of an older job. A straggler that popped nothing reports
-     * done == 0 and immediately re-waits — if a newer job is already
-     * published (g_pool.gen != seen), the wait falls through and it joins
-     * that job with the CURRENT fn/ctx. */
-    if (g_pool.gen == seen) {
-      g_pool.finished += done;
-      atomic_store_explicit(&g_pool.afinished, g_pool.finished,
-                            memory_order_release);
-      if (g_pool.finished >= nseg) pthread_cond_signal(&g_pool.cv_done);
+    if (done) {
+      /* generation-tagged completion: only count into OUR job's word (a
+       * straggler of a finished job sees a mismatched tag and drops its
+       * count — that job already completed without it). */
+      uint64_t cur = atomic_load(&g_pool.afin);
+      for (;;) {
+        if ((uint32_t)(cur >> 32) != (uint32_t)gen) break;
+        if (atomic_compare_exchange_weak(&g_pool.afin, &cur,
+                                         cur + (uint64_t)done)) {
+          if ((int64_t)((cur & 0xffffffffu) + (uint64_t)done) >= nseg &&
+              atomic_load_explicit(&g_pool.sub_waiting,
+                                   memory_order_acquire)) {
+            pthread_mutex_lock(&g_pool.mu);
+            pthread_cond_broadcast(&g_pool.cv_done);
+            pthread_mutex_unlock(&g_pool.mu);
+          }
+          break;
+        }
+      }
     }
-    pthread_mutex_unlock(&g_pool.mu);
   }
   return NULL;
 }
@@ -261,23 +322,31 @@ static int stc_pool_up(void) {
 static int stc_pool_run(stc_seg_fn fn, void *ctx, int64_t nseg) {
   if (nseg < 2 || nseg >= (int64_t)1 << 32 || !stc_pool_up()) return 0;
   if (pthread_mutex_trylock(&g_pool.job_mu) != 0) return 0;
-  pthread_mutex_lock(&g_pool.mu);
-  g_pool.fn = fn;
-  g_pool.ctx = ctx;
-  g_pool.nseg = nseg;
-  g_pool.finished = 0;
-  atomic_store_explicit(&g_pool.afinished, 0, memory_order_release);
+  /* job_mu serializes submitters, so gen is ours to bump; the fields
+   * publish under the agen seqlock: odd tag first (the acq_rel RMW pins
+   * the stores AFTER it), fields + tagged counters, then the new even
+   * tag LAST (release) — a worker whose two agen reads bracket a stable
+   * even value observed exactly this job's field set. */
   g_pool.gen++;
   uint64_t gen = g_pool.gen; /* ours until job_mu is released */
-  /* publish the generation-tagged counter (index 0) with the new gen: any
-   * straggler still holding the previous gen can no longer pop from it */
+  atomic_fetch_add_explicit(&g_pool.agen, 1, memory_order_acq_rel);
+  atomic_store_explicit(&g_pool.fn, fn, memory_order_relaxed);
+  atomic_store_explicit(&g_pool.ctx, ctx, memory_order_relaxed);
+  atomic_store_explicit(&g_pool.nseg, nseg, memory_order_relaxed);
+  atomic_store_explicit(&g_pool.afin, (uint64_t)(uint32_t)gen << 32,
+                        memory_order_relaxed);
+  /* generation-tagged chunk counter (index 0): any straggler still
+   * holding the previous gen can no longer pop from it */
   atomic_store(&g_pool.next, (uint64_t)(uint32_t)gen << 32);
-  /* release-publish the spin mirror AFTER every job field above: a worker
-   * that leaves its spin loop on agen == gen sees fn/ctx/nseg/next (it
-   * still re-reads them under mu, so this is belt and braces) */
-  atomic_store_explicit(&g_pool.agen, gen, memory_order_release);
-  pthread_cond_broadcast(&g_pool.cv_job);
-  pthread_mutex_unlock(&g_pool.mu);
+  atomic_store_explicit(&g_pool.agen, gen << 1, memory_order_release);
+  /* wake sleepers only when there are any: the unlocked read can miss a
+   * JUST-registering sleeper, whose 2 ms timedwait tick re-checks agen —
+   * bounded lag on an idle->busy edge, zero mutex traffic when hot */
+  if (g_pool.sleepers > 0) {
+    pthread_mutex_lock(&g_pool.mu);
+    pthread_cond_broadcast(&g_pool.cv_job);
+    pthread_mutex_unlock(&g_pool.mu);
+  }
   int64_t done = 0;
   for (;;) {
     int64_t s = stc_pool_pop(gen, nseg);
@@ -285,26 +354,43 @@ static int stc_pool_run(stc_seg_fn fn, void *ctx, int64_t nseg) {
     fn(ctx, s);
     done++;
   }
-  /* completion: count our own chunks in, then spin-watch the lock-free
-   * finished mirror before falling back to the condvar sleep — the tail
-   * chunk usually lands within a few µs of ours. */
-  pthread_mutex_lock(&g_pool.mu);
-  g_pool.finished += done;
-  atomic_store_explicit(&g_pool.afinished, g_pool.finished,
-                        memory_order_release);
-  int64_t fin = g_pool.finished;
-  pthread_mutex_unlock(&g_pool.mu);
-  if (fin < nseg) {
+  /* completion: count our own chunks in (plain add — the tag is ours by
+   * construction and counts can never carry into it: total <= nseg <
+   * 2^32), then spin-watch the tagged word before falling back to the
+   * condvar sleep — the tail chunk usually lands within a few us. */
+  uint64_t fin_word =
+      atomic_fetch_add(&g_pool.afin, (uint64_t)done) + (uint64_t)done;
+  if ((int64_t)(fin_word & 0xffffffffu) < nseg) {
+    int waited = 0;
     for (int i = 0; i < ST_SPIN_ITERS; i++) {
-      if (atomic_load_explicit(&g_pool.afinished, memory_order_acquire) >=
-          nseg)
+      if ((int64_t)(atomic_load_explicit(&g_pool.afin,
+                                         memory_order_acquire) &
+                    0xffffffffu) >= nseg) {
+        waited = 1;
         break;
+      }
       stc_cpu_relax();
     }
-    pthread_mutex_lock(&g_pool.mu);
-    while (g_pool.finished < nseg)
-      pthread_cond_wait(&g_pool.cv_done, &g_pool.mu);
-    pthread_mutex_unlock(&g_pool.mu);
+    if (!waited &&
+        (int64_t)(atomic_load_explicit(&g_pool.afin, memory_order_acquire) &
+                  0xffffffffu) < nseg) {
+      atomic_store_explicit(&g_pool.sub_waiting, 1, memory_order_release);
+      pthread_mutex_lock(&g_pool.mu);
+      while ((int64_t)(atomic_load_explicit(&g_pool.afin,
+                                            memory_order_acquire) &
+                       0xffffffffu) < nseg) {
+        struct timespec ts;
+        clock_gettime(CLOCK_REALTIME, &ts);
+        ts.tv_nsec += 2000000; /* 2 ms tick: bounds the signal race */
+        if (ts.tv_nsec >= 1000000000) {
+          ts.tv_sec++;
+          ts.tv_nsec -= 1000000000;
+        }
+        pthread_cond_timedwait(&g_pool.cv_done, &g_pool.mu, &ts);
+      }
+      pthread_mutex_unlock(&g_pool.mu);
+      atomic_store_explicit(&g_pool.sub_waiting, 0, memory_order_release);
+    }
   }
   pthread_mutex_unlock(&g_pool.job_mu);
   return 1;
@@ -1663,4 +1749,801 @@ EXPORT void stc_apply_frames(const float *vin, float *vout, const int64_t *off,
                             out_amax ? &out_ss[i] : NULL,
                             out_amax ? &out_sabs[i] : NULL);
   }
+}
+
+/* ======================================================================
+ * r11: cascade quantize + sign2 (2-bit) kernels — the data-plane codecs
+ * behind the next-10x arc (ROADMAP item 4).
+ *
+ * CASCADE QUANTIZE. The r07 burst sender quantizes K successive frames
+ * of one residual as K full memory passes (each stc_quantize_ef_partials
+ * call re-reads and re-writes the whole table), because frame k+1's scale
+ * is re-measured from frame k's output. Measured on this box at 1 Mi that
+ * pass is ~150 us and the pool's intra-pass parallelism has already
+ * flattened — the PASS COUNT is the wall, not the bandwidth (the box
+ * streams ~600 GB/s; the sender chain uses ~70). But scales are
+ * SENDER-CHOSEN and ride the wire (receivers never recompute them), so a
+ * sender may legally emit a frame schedule it predicts instead of
+ * measures: successive halvings s, s/2, s/4, ... — which is exactly what
+ * the measured schedule converges to anyway (pow2-RMS decays ~0.85/frame
+ * => the pow2 floor halves every few frames), taken one frame earlier.
+ * These kernels quantize K such frames in ONE pass, carrying the element
+ * in registers across the K subtractions and emitting K bit planes: K
+ * frames for one table read + one write + K/32 words. The wire format is
+ * UNCHANGED — a cascade message is indistinguishable from K re-measured
+ * frames, and the fused receive (stc_apply_frames) already applies K
+ * frames in one pass. After a cascade the residual magnitude is bounded
+ * by ~s/2^(K-1) (each level halves the bound), so per-message drain is
+ * deeper than the measured schedule's, at identical bytes per frame.
+ *
+ * SIGN2 (2-bit sign/magnitude). The codec-lab winner (ops/codec_lab.py
+ * Sign2, parallel/ici_lab.py build_sign2_sync_step) promoted to the
+ * engine tier: sign bit + magnitude bit selecting +/-s or +/-3s
+ * (magnitude set when |r| > 2s), zero-negative sign convention kept
+ * (quirk Q3). Both magnitudes are exact f32 multiples of a pow2 scale
+ * (3s has a 1.5 mantissa) so the 1-ulp conservation bound carries over.
+ * Wire layout per frame: [scales L*4][sign words W*4][mag words W*4] —
+ * two packed planes, the lab's exact layout. On a uniform residual the
+ * magnitude bit idles and the trajectory is bit-identical to sign1; on
+ * gaussian/outlier-heavy residuals (retransmit rollbacks, chaos) the
+ * +/-3s level drains the tail 3x faster per frame — which is what the
+ * engine's telemetry governor upshifts for (stengine.cpp).
+ * ==================================================================== */
+
+/* K halving levels for words [w0, w1) of one leaf. scales[j] is frame j's
+ * scale for THIS leaf (any schedule; s == 0 levels record sign bits and
+ * leave the residual untouched, stc_quantize's idle-leaf semantics).
+ * Frame j's plane for this leaf lands at wp + j*wstride (wp already
+ * offset to the leaf). Partials are of the FINAL residual. */
+ST_CLONES
+static void quantize_cascade_range(const float *p, float *q, int64_t n,
+                                   const float *scales, int32_t k,
+                                   uint32_t *wp, int64_t wstride, int64_t w0,
+                                   int64_t w1, double *out_amax,
+                                   double *out_ss, double *out_sabs) {
+  double amax = 0, ssum = 0, sabs = 0;
+  for (int64_t w = w0; w < w1; w++) {
+    int64_t base = w * 32;
+    int64_t lim = n - base;
+    if (lim > 32) lim = 32;
+    if (lim < 0) lim = 0;
+    float buf[32];
+    for (int64_t b = 0; b < lim; b++) buf[b] = p[base + b];
+    for (int32_t j = 0; j < k; j++) {
+      uint32_t bits = 0;
+      float s = scales[j];
+      if (s > 0.0f) {
+        for (int64_t b = 0; b < lim; b++) {
+          float v = buf[b];
+          uint32_t neg = v <= 0.0f;
+          bits |= neg << b;
+          buf[b] = v - (neg ? -s : s);
+        }
+      } else {
+        for (int64_t b = 0; b < lim; b++)
+          bits |= (uint32_t)(buf[b] <= 0.0f) << b;
+      }
+      wp[(size_t)j * wstride + w] = bits;
+    }
+    for (int64_t b = 0; b < lim; b++) {
+      float r = buf[b];
+      q[base + b] = r;
+      double a = r < 0 ? -(double)r : (double)r;
+      if (a > amax) amax = a;
+      ssum += (double)r * (double)r;
+      sabs += a;
+    }
+    for (int64_t b = lim; b < 32; b++) q[base + b] = 0.0f;
+  }
+  *out_amax = amax;
+  *out_ss = ssum;
+  *out_sabs = sabs;
+}
+
+#ifdef ST_AVX512
+/* Full-word AVX-512 body of the cascade: two 16-lane vectors stay in
+ * registers across all K levels; partials of the final residual fused
+ * (quantize_partials_leaf_avx512's arithmetic). Covers words
+ * [w0, min(w1, n/32)); returns the stopping word. */
+ST_TARGET_AVX512
+static int64_t quantize_cascade_leaf_avx512(const float *p, float *q,
+                                            int64_t n, const float *scales,
+                                            int32_t k, uint32_t *wp,
+                                            int64_t wstride, int64_t w0,
+                                            int64_t w1, double *amax,
+                                            double *ss, double *sabs) {
+  const __m512 vzero = _mm512_setzero_ps();
+  const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
+  const __m512i vabsmask = _mm512_set1_epi32(0x7FFFFFFF);
+  __m512 vamax = _mm512_setzero_ps();
+  __m512d vss0 = _mm512_setzero_pd(), vss1 = _mm512_setzero_pd();
+  __m512d vsa0 = _mm512_setzero_pd(), vsa1 = _mm512_setzero_pd();
+  int64_t w = w0, wl = n / 32 < w1 ? n / 32 : w1;
+  for (; w < wl; w++) {
+    __m512 v0 = _mm512_loadu_ps(p + w * 32);
+    __m512 v1 = _mm512_loadu_ps(p + w * 32 + 16);
+    for (int32_t j = 0; j < k; j++) {
+      __mmask16 m0 = _mm512_cmp_ps_mask(v0, vzero, _CMP_LE_OQ);
+      __mmask16 m1 = _mm512_cmp_ps_mask(v1, vzero, _CMP_LE_OQ);
+      float s = scales[j];
+      if (s > 0.0f) {
+        const __m512i vs = _mm512_castps_si512(_mm512_set1_ps(s));
+        __m512 d0 =
+            _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m0, vs, vsign));
+        __m512 d1 =
+            _mm512_castsi512_ps(_mm512_mask_xor_epi32(vs, m1, vs, vsign));
+        v0 = _mm512_sub_ps(v0, d0);
+        v1 = _mm512_sub_ps(v1, d1);
+      }
+      wp[(size_t)j * wstride + w] = (uint32_t)m0 | ((uint32_t)m1 << 16);
+    }
+    _mm512_storeu_ps(q + w * 32, v0);
+    _mm512_storeu_ps(q + w * 32 + 16, v1);
+    __m512 a0 = _mm512_castsi512_ps(
+        _mm512_and_epi32(_mm512_castps_si512(v0), vabsmask));
+    __m512 a1 = _mm512_castsi512_ps(
+        _mm512_and_epi32(_mm512_castps_si512(v1), vabsmask));
+    vamax = _mm512_max_ps(vamax, _mm512_max_ps(a0, a1));
+    __m512d lo0 = _mm512_cvtps_pd(_mm512_castps512_ps256(v0));
+    __m512d hi0 = _mm512_cvtps_pd(_mm512_extractf32x8_ps(v0, 1));
+    __m512d lo1 = _mm512_cvtps_pd(_mm512_castps512_ps256(v1));
+    __m512d hi1 = _mm512_cvtps_pd(_mm512_extractf32x8_ps(v1, 1));
+    vss0 = _mm512_fmadd_pd(lo0, lo0, vss0);
+    vss1 = _mm512_fmadd_pd(hi0, hi0, vss1);
+    vss0 = _mm512_fmadd_pd(lo1, lo1, vss0);
+    vss1 = _mm512_fmadd_pd(hi1, hi1, vss1);
+    vsa0 = _mm512_add_pd(vsa0, _mm512_cvtps_pd(_mm512_castps512_ps256(a0)));
+    vsa1 = _mm512_add_pd(vsa1, _mm512_cvtps_pd(_mm512_extractf32x8_ps(a0, 1)));
+    vsa0 = _mm512_add_pd(vsa0, _mm512_cvtps_pd(_mm512_castps512_ps256(a1)));
+    vsa1 = _mm512_add_pd(vsa1, _mm512_cvtps_pd(_mm512_extractf32x8_ps(a1, 1)));
+  }
+  *amax = _mm512_reduce_max_ps(vamax);
+  *ss = _mm512_reduce_add_pd(vss0) + _mm512_reduce_add_pd(vss1);
+  *sabs = _mm512_reduce_add_pd(vsa0) + _mm512_reduce_add_pd(vsa1);
+  return w;
+}
+#endif
+
+/* Range body with runtime AVX-512 dispatch (full words vectorized, the
+ * live-tail word + partial-word handling stays scalar). */
+static void quantize_cascade_dispatch(const float *p, float *q, int64_t n,
+                                      const float *scales, int32_t k,
+                                      uint32_t *wp, int64_t wstride,
+                                      int64_t w0, int64_t w1, double *oa,
+                                      double *os, double *ob) {
+  int64_t w = w0;
+  double a2 = 0, s2 = 0, b2 = 0;
+#ifdef ST_AVX512
+  if (st_has_avx512() && w < w1 && n / 32 > w0) {
+    w = quantize_cascade_leaf_avx512(p, q, n, scales, k, wp, wstride, w0, w1,
+                                     &a2, &s2, &b2);
+  }
+#endif
+  double a3 = 0, s3 = 0, b3 = 0;
+  if (w < w1)
+    quantize_cascade_range(p, q, n, scales, k, wp, wstride, w, w1, &a3, &s3,
+                           &b3);
+  *oa = a2 > a3 ? a2 : a3;
+  *os = s2 + s3;
+  *ob = b2 + b3;
+}
+
+#ifdef ST_POOL
+typedef struct {
+  const float *rin;
+  float *rout;
+  const int64_t *off, *ns;
+  const float *scales; /* k * L */
+  int64_t n_leaves;
+  int32_t k;
+  uint32_t *words;
+  int64_t wstride;
+  const stc_chunk *chunks;
+  double *camax, *css, *csabs;
+} qzc_ctx;
+
+static void quantize_cascade_seg(void *vctx, int64_t c) {
+  qzc_ctx *x = (qzc_ctx *)vctx;
+  const stc_chunk *ch = &x->chunks[c];
+  int64_t i = ch->leaf;
+  /* per-leaf schedule column: frame j's scale for leaf i */
+  float ls[64];
+  for (int32_t j = 0; j < x->k; j++)
+    ls[j] = x->scales[(size_t)j * x->n_leaves + i];
+  quantize_cascade_dispatch(x->rin + x->off[i], x->rout + x->off[i],
+                            x->ns[i], ls, x->k, x->words + x->off[i] / 32,
+                            x->wstride, ch->w0, ch->w1, &x->camax[c],
+                            &x->css[c], &x->csabs[c]);
+}
+#endif
+
+/* K frames in ONE pass over the residual. scales is k*L (frame-major, the
+ * schedule the caller chose — stengine.cpp halves frame 0's measured
+ * scales); frame j's bit plane lands at words + j*wstride (wstride in u32
+ * words — the engine passes its wire-frame stride so planes land at their
+ * final slot offsets). Partials (per leaf, of the final residual) feed the
+ * next message's frame-0 scales exactly like stc_quantize_ef_partials.
+ * k is capped at 64 (the engine never asks for more — a cascade below
+ * s/2^63 is denormal territory long before). */
+EXPORT void stc_quantize_ef_cascade(
+    const float *rin, float *rout, const int64_t *off, const int64_t *ns,
+    const int64_t *padded, int64_t n_leaves, int32_t k, const float *scales,
+    uint32_t *words, int64_t wstride, double *out_amax, double *out_ss,
+    double *out_sabs) {
+  if (k < 1) k = 1;
+  if (k > 64) k = 64;
+#ifdef ST_POOL
+  int64_t total = 0;
+  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
+  if (total >= ST_PAR_MIN_ELEMS) {
+    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
+    double *pbuf = (double *)malloc((size_t)nc * 3 * sizeof(double));
+    if (chunks && pbuf) {
+      stc_build_chunks(padded, n_leaves, chunks);
+      qzc_ctx x = {rin,   rout,    off,  ns,        scales,
+                   n_leaves, k,    words, wstride,  chunks,
+                   pbuf,  pbuf + nc, pbuf + 2 * nc};
+      if (stc_pool_run(quantize_cascade_seg, &x, nc)) {
+        reduce_chunk_partials(chunks, nc, n_leaves, x.camax, x.css, x.csabs,
+                              out_amax, out_ss, out_sabs);
+        free(chunks);
+        free(pbuf);
+        return;
+      }
+    }
+    free(chunks);
+    free(pbuf);
+  }
+#endif
+  for (int64_t i = 0; i < n_leaves; i++) {
+    float ls[64];
+    for (int32_t j = 0; j < k; j++)
+      ls[j] = scales[(size_t)j * n_leaves + i];
+    quantize_cascade_dispatch(rin + off[i], rout + off[i], ns[i], ls, k,
+                              words + off[i] / 32, wstride, 0, padded[i] / 32,
+                              &out_amax[i], &out_ss[i], &out_sabs[i]);
+  }
+}
+
+/* sign2 cascade: K levels of the 2-bit rule in one pass. Frame j's sign
+ * plane lands at wp + j*wstride, its magnitude plane W words after (the
+ * wire layout: [scales][sign W][mag W] per frame). Level semantics match
+ * the lab reference exactly: neg = r <= 0, big = |r| > 2s (with s == 0
+ * that is |r| > 0 — bits still recorded, residual untouched, the
+ * idle-leaf twin of the 1-bit kernels). */
+ST_CLONES
+static void quantize2_cascade_range(const float *p, float *q, int64_t n,
+                                    const float *scales, int32_t k,
+                                    uint32_t *wp, int64_t wstride, int64_t W,
+                                    int64_t w0, int64_t w1, double *out_amax,
+                                    double *out_ss, double *out_sabs) {
+  double amax = 0, ssum = 0, sabs = 0;
+  for (int64_t w = w0; w < w1; w++) {
+    int64_t base = w * 32;
+    int64_t lim = n - base;
+    if (lim > 32) lim = 32;
+    if (lim < 0) lim = 0;
+    float buf[32];
+    for (int64_t b = 0; b < lim; b++) buf[b] = p[base + b];
+    for (int32_t j = 0; j < k; j++) {
+      uint32_t sbits = 0, mbits = 0;
+      float s = scales[j];
+      float s2x = 2.0f * s, s3x = 3.0f * s;
+      for (int64_t b = 0; b < lim; b++) {
+        float v = buf[b];
+        uint32_t neg = v <= 0.0f;
+        float av = v < 0.0f ? -v : v;
+        uint32_t big = av > s2x;
+        sbits |= neg << b;
+        mbits |= big << b;
+        if (s > 0.0f) {
+          float mag = big ? s3x : s;
+          buf[b] = v - (neg ? -mag : mag);
+        }
+      }
+      wp[(size_t)j * wstride + w] = sbits;
+      wp[(size_t)j * wstride + W + w] = mbits;
+    }
+    for (int64_t b = 0; b < lim; b++) {
+      float r = buf[b];
+      q[base + b] = r;
+      double a = r < 0 ? -(double)r : (double)r;
+      if (a > amax) amax = a;
+      ssum += (double)r * (double)r;
+      sabs += a;
+    }
+    for (int64_t b = lim; b < 32; b++) q[base + b] = 0.0f;
+  }
+  *out_amax = amax;
+  *out_ss = ssum;
+  *out_sabs = sabs;
+}
+
+#ifdef ST_AVX512
+/* Full-word AVX-512 body of the sign2 cascade (quantize_cascade_leaf_
+ * avx512's 2-bit twin): the element rides registers across all K levels;
+ * per level, two compare masks ARE the wire planes (neg -> sign bits,
+ * |v| > 2s -> magnitude bits) and the subtrahend is the magnitude blend
+ * (+/-s or +/-3s) sign-flipped by mask — bit- and ulp-identical to the
+ * scalar rule (2.0f*s / 3.0f*s precomputed in f32 exactly like it).
+ * Covers words [w0, min(w1, n/32)); returns the stopping word. */
+ST_TARGET_AVX512
+static int64_t quantize2_cascade_leaf_avx512(
+    const float *p, float *q, int64_t n, const float *scales, int32_t k,
+    uint32_t *wp, int64_t wstride, int64_t W, int64_t w0, int64_t w1,
+    double *amax, double *ss, double *sabs) {
+  const __m512 vzero = _mm512_setzero_ps();
+  const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
+  const __m512i vabsmask = _mm512_set1_epi32(0x7FFFFFFF);
+  __m512 vamax = _mm512_setzero_ps();
+  __m512d vss0 = _mm512_setzero_pd(), vss1 = _mm512_setzero_pd();
+  __m512d vsa0 = _mm512_setzero_pd(), vsa1 = _mm512_setzero_pd();
+  int64_t w = w0, wl = n / 32 < w1 ? n / 32 : w1;
+  for (; w < wl; w++) {
+    __m512 v0 = _mm512_loadu_ps(p + w * 32);
+    __m512 v1 = _mm512_loadu_ps(p + w * 32 + 16);
+    for (int32_t j = 0; j < k; j++) {
+      float s = scales[j];
+      const __m512 vs2 = _mm512_set1_ps(2.0f * s);
+      __mmask16 n0 = _mm512_cmp_ps_mask(v0, vzero, _CMP_LE_OQ);
+      __mmask16 n1 = _mm512_cmp_ps_mask(v1, vzero, _CMP_LE_OQ);
+      __m512 a0 = _mm512_castsi512_ps(
+          _mm512_and_epi32(_mm512_castps_si512(v0), vabsmask));
+      __m512 a1 = _mm512_castsi512_ps(
+          _mm512_and_epi32(_mm512_castps_si512(v1), vabsmask));
+      __mmask16 b0 = _mm512_cmp_ps_mask(a0, vs2, _CMP_GT_OQ);
+      __mmask16 b1 = _mm512_cmp_ps_mask(a1, vs2, _CMP_GT_OQ);
+      if (s > 0.0f) {
+        const __m512 vs = _mm512_set1_ps(s);
+        const __m512 vs3 = _mm512_set1_ps(3.0f * s);
+        __m512i mag0 = _mm512_castps_si512(_mm512_mask_mov_ps(vs, b0, vs3));
+        __m512i mag1 = _mm512_castps_si512(_mm512_mask_mov_ps(vs, b1, vs3));
+        __m512 d0 =
+            _mm512_castsi512_ps(_mm512_mask_xor_epi32(mag0, n0, mag0, vsign));
+        __m512 d1 =
+            _mm512_castsi512_ps(_mm512_mask_xor_epi32(mag1, n1, mag1, vsign));
+        v0 = _mm512_sub_ps(v0, d0);
+        v1 = _mm512_sub_ps(v1, d1);
+      }
+      wp[(size_t)j * wstride + w] = (uint32_t)n0 | ((uint32_t)n1 << 16);
+      wp[(size_t)j * wstride + W + w] = (uint32_t)b0 | ((uint32_t)b1 << 16);
+    }
+    _mm512_storeu_ps(q + w * 32, v0);
+    _mm512_storeu_ps(q + w * 32 + 16, v1);
+    __m512 a0 = _mm512_castsi512_ps(
+        _mm512_and_epi32(_mm512_castps_si512(v0), vabsmask));
+    __m512 a1 = _mm512_castsi512_ps(
+        _mm512_and_epi32(_mm512_castps_si512(v1), vabsmask));
+    vamax = _mm512_max_ps(vamax, _mm512_max_ps(a0, a1));
+    __m512d lo0 = _mm512_cvtps_pd(_mm512_castps512_ps256(v0));
+    __m512d hi0 = _mm512_cvtps_pd(_mm512_extractf32x8_ps(v0, 1));
+    __m512d lo1 = _mm512_cvtps_pd(_mm512_castps512_ps256(v1));
+    __m512d hi1 = _mm512_cvtps_pd(_mm512_extractf32x8_ps(v1, 1));
+    vss0 = _mm512_fmadd_pd(lo0, lo0, vss0);
+    vss1 = _mm512_fmadd_pd(hi0, hi0, vss1);
+    vss0 = _mm512_fmadd_pd(lo1, lo1, vss0);
+    vss1 = _mm512_fmadd_pd(hi1, hi1, vss1);
+    vsa0 = _mm512_add_pd(vsa0, _mm512_cvtps_pd(_mm512_castps512_ps256(a0)));
+    vsa1 = _mm512_add_pd(vsa1, _mm512_cvtps_pd(_mm512_extractf32x8_ps(a0, 1)));
+    vsa0 = _mm512_add_pd(vsa0, _mm512_cvtps_pd(_mm512_castps512_ps256(a1)));
+    vsa1 = _mm512_add_pd(vsa1, _mm512_cvtps_pd(_mm512_extractf32x8_ps(a1, 1)));
+  }
+  *amax = _mm512_reduce_max_ps(vamax);
+  *ss = _mm512_reduce_add_pd(vss0) + _mm512_reduce_add_pd(vss1);
+  *sabs = _mm512_reduce_add_pd(vsa0) + _mm512_reduce_add_pd(vsa1);
+  return w;
+}
+#endif
+
+/* Range body with runtime AVX-512 dispatch (full words vectorized, the
+ * live-tail word + partial-word handling stays scalar — same split as
+ * quantize_cascade_dispatch). */
+static void quantize2_cascade_dispatch(const float *p, float *q, int64_t n,
+                                       const float *scales, int32_t k,
+                                       uint32_t *wp, int64_t wstride,
+                                       int64_t W, int64_t w0, int64_t w1,
+                                       double *oa, double *os, double *ob) {
+  int64_t w = w0;
+  double a2 = 0, s2 = 0, b2 = 0;
+#ifdef ST_AVX512
+  if (st_has_avx512() && w < w1 && n / 32 > w0) {
+    w = quantize2_cascade_leaf_avx512(p, q, n, scales, k, wp, wstride, W, w0,
+                                      w1, &a2, &s2, &b2);
+  }
+#endif
+  double a3 = 0, s3 = 0, b3 = 0;
+  if (w < w1)
+    quantize2_cascade_range(p, q, n, scales, k, wp, wstride, W, w, w1, &a3,
+                            &s3, &b3);
+  *oa = a2 > a3 ? a2 : a3;
+  *os = s2 + s3;
+  *ob = b2 + b3;
+}
+
+#ifdef ST_POOL
+typedef struct {
+  const float *rin;
+  float *rout;
+  const int64_t *off, *ns;
+  const float *scales;
+  int64_t n_leaves;
+  int32_t k;
+  uint32_t *words;
+  int64_t wstride, W;
+  const stc_chunk *chunks;
+  double *camax, *css, *csabs;
+} qz2_ctx;
+
+static void quantize2_cascade_seg(void *vctx, int64_t c) {
+  qz2_ctx *x = (qz2_ctx *)vctx;
+  const stc_chunk *ch = &x->chunks[c];
+  int64_t i = ch->leaf;
+  float ls[64];
+  for (int32_t j = 0; j < x->k; j++)
+    ls[j] = x->scales[(size_t)j * x->n_leaves + i];
+  quantize2_cascade_dispatch(x->rin + x->off[i], x->rout + x->off[i],
+                             x->ns[i], ls, x->k, x->words + x->off[i] / 32,
+                             x->wstride, x->W, ch->w0, ch->w1, &x->camax[c],
+                             &x->css[c], &x->csabs[c]);
+}
+#endif
+
+/* The sign2 sender kernel (k = 1 is the plain per-frame quantize the
+ * parity tests pin against the JAX lab). words/wstride as in
+ * stc_quantize_ef_cascade; W is the table's total word count (locates the
+ * magnitude plane inside each frame). */
+EXPORT void stc_quantize2_ef_cascade(
+    const float *rin, float *rout, const int64_t *off, const int64_t *ns,
+    const int64_t *padded, int64_t n_leaves, int32_t k, const float *scales,
+    uint32_t *words, int64_t wstride, int64_t W, double *out_amax,
+    double *out_ss, double *out_sabs) {
+  if (k < 1) k = 1;
+  if (k > 64) k = 64;
+#ifdef ST_POOL
+  int64_t total = 0;
+  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
+  if (total >= ST_PAR_MIN_ELEMS) {
+    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
+    double *pbuf = (double *)malloc((size_t)nc * 3 * sizeof(double));
+    if (chunks && pbuf) {
+      stc_build_chunks(padded, n_leaves, chunks);
+      qz2_ctx x = {rin,      rout, off,   ns,      scales, n_leaves, k,
+                   words,    wstride, W,  chunks,  pbuf,   pbuf + nc,
+                   pbuf + 2 * nc};
+      if (stc_pool_run(quantize2_cascade_seg, &x, nc)) {
+        reduce_chunk_partials(chunks, nc, n_leaves, x.camax, x.css, x.csabs,
+                              out_amax, out_ss, out_sabs);
+        free(chunks);
+        free(pbuf);
+        return;
+      }
+    }
+    free(chunks);
+    free(pbuf);
+  }
+#endif
+  for (int64_t i = 0; i < n_leaves; i++) {
+    float ls[64];
+    for (int32_t j = 0; j < k; j++)
+      ls[j] = scales[(size_t)j * n_leaves + i];
+    quantize2_cascade_dispatch(rin + off[i], rout + off[i], ns[i], ls, k,
+                               words + off[i] / 32, wstride, W, 0,
+                               padded[i] / 32, &out_amax[i], &out_ss[i],
+                               &out_sabs[i]);
+  }
+}
+
+/* ---- sign2 receive: fused k-frame apply --------------------------------
+ * delta = s * (sign ? -1 : +1) * (mag ? 3 : 1), summed across the active
+ * frames, one pass per target, +/-3e38 clamp at the end — the sign2 twin
+ * of apply_frames_range (same ~1-ulp note vs per-frame application). */
+
+#ifdef ST_AVX512
+/* whole live words [w0, wl): the per-frame subtrahend is the magnitude
+ * blend (s or 3.0f*s by the mag plane) sign-flipped by the sign plane —
+ * apply_frames_avx512 with one extra mask_mov per frame, ulp-identical
+ * to the scalar accumulation order. */
+ST_TARGET_AVX512
+static int64_t apply2_frames_avx512(const float *in, float *out,
+                                    const uint32_t *const *sps,
+                                    const uint32_t *const *mps,
+                                    const float *svals, int m, int64_t wl,
+                                    int64_t w0, int do_part, double *amax,
+                                    double *ss, double *sabs) {
+  const __m512i vsign = _mm512_set1_epi32((int32_t)0x80000000u);
+  const __m512 vmax = _mm512_set1_ps(3.0e38f);
+  const __m512 vmin = _mm512_set1_ps(-3.0e38f);
+  const __m512i vabsmask = _mm512_set1_epi32(0x7FFFFFFF);
+  __m512 vamax = _mm512_setzero_ps();
+  __m512d vss0 = _mm512_setzero_pd(), vss1 = _mm512_setzero_pd();
+  __m512d vsa0 = _mm512_setzero_pd(), vsa1 = _mm512_setzero_pd();
+  int64_t w = w0;
+  for (; w < wl; w++) {
+    __m512 acc0 = _mm512_setzero_ps();
+    __m512 acc1 = _mm512_setzero_ps();
+    for (int f = 0; f < m; f++) {
+      uint32_t sb = sps[f][w], mb = mps[f][w];
+      const __m512 vs = _mm512_set1_ps(svals[f]);
+      const __m512 vs3 = _mm512_set1_ps(3.0f * svals[f]);
+      __m512i mag0 = _mm512_castps_si512(
+          _mm512_mask_mov_ps(vs, (__mmask16)mb, vs3));
+      __m512i mag1 = _mm512_castps_si512(
+          _mm512_mask_mov_ps(vs, (__mmask16)(mb >> 16), vs3));
+      acc0 = _mm512_add_ps(
+          acc0, _mm512_castsi512_ps(_mm512_mask_xor_epi32(
+                    mag0, (__mmask16)sb, mag0, vsign)));
+      acc1 = _mm512_add_ps(
+          acc1, _mm512_castsi512_ps(_mm512_mask_xor_epi32(
+                    mag1, (__mmask16)(sb >> 16), mag1, vsign)));
+    }
+    const float *pp = in + w * 32;
+    float *qq = out + w * 32;
+    __m512 r0 = _mm512_add_ps(_mm512_loadu_ps(pp), acc0);
+    __m512 r1 = _mm512_add_ps(_mm512_loadu_ps(pp + 16), acc1);
+    r0 = _mm512_max_ps(_mm512_min_ps(r0, vmax), vmin);
+    r1 = _mm512_max_ps(_mm512_min_ps(r1, vmax), vmin);
+    _mm512_storeu_ps(qq, r0);
+    _mm512_storeu_ps(qq + 16, r1);
+    if (do_part) {
+      __m512 a0 = _mm512_castsi512_ps(
+          _mm512_and_epi32(_mm512_castps_si512(r0), vabsmask));
+      __m512 a1 = _mm512_castsi512_ps(
+          _mm512_and_epi32(_mm512_castps_si512(r1), vabsmask));
+      vamax = _mm512_max_ps(vamax, _mm512_max_ps(a0, a1));
+      __m512d lo0 = _mm512_cvtps_pd(_mm512_castps512_ps256(r0));
+      __m512d hi0 = _mm512_cvtps_pd(_mm512_extractf32x8_ps(r0, 1));
+      __m512d lo1 = _mm512_cvtps_pd(_mm512_castps512_ps256(r1));
+      __m512d hi1 = _mm512_cvtps_pd(_mm512_extractf32x8_ps(r1, 1));
+      vss0 = _mm512_fmadd_pd(lo0, lo0, vss0);
+      vss1 = _mm512_fmadd_pd(hi0, hi0, vss1);
+      vss0 = _mm512_fmadd_pd(lo1, lo1, vss0);
+      vss1 = _mm512_fmadd_pd(hi1, hi1, vss1);
+      vsa0 = _mm512_add_pd(vsa0, _mm512_cvtps_pd(_mm512_castps512_ps256(a0)));
+      vsa1 =
+          _mm512_add_pd(vsa1, _mm512_cvtps_pd(_mm512_extractf32x8_ps(a0, 1)));
+      vsa0 = _mm512_add_pd(vsa0, _mm512_cvtps_pd(_mm512_castps512_ps256(a1)));
+      vsa1 =
+          _mm512_add_pd(vsa1, _mm512_cvtps_pd(_mm512_extractf32x8_ps(a1, 1)));
+    }
+  }
+  if (do_part) {
+    *amax = _mm512_reduce_max_ps(vamax);
+    *ss = _mm512_reduce_add_pd(vss0) + _mm512_reduce_add_pd(vss1);
+    *sabs = _mm512_reduce_add_pd(vsa0) + _mm512_reduce_add_pd(vsa1);
+  }
+  return w;
+}
+#endif
+
+ST_CLONES
+static void apply2_frames_range(const float *in, float *out,
+                                const uint32_t *const *sps,
+                                const uint32_t *const *mps,
+                                const float *svals, int m, int64_t n,
+                                int64_t pad, int64_t w0, int64_t w1,
+                                double *out_amax, double *out_ss,
+                                double *out_sabs) {
+  double amax = 0, ssum = 0, sabs = 0;
+  int64_t full = n / 32;
+  if (full > w1) full = w1;
+  int do_part = out_amax != NULL;
+  int64_t k = w0;
+#ifdef ST_AVX512
+  if (st_has_avx512() && k < full) {
+    double a2 = 0, s2 = 0, b2 = 0;
+    k = apply2_frames_avx512(in, out, sps, mps, svals, m, full, w0, do_part,
+                             &a2, &s2, &b2);
+    if (do_part) {
+      amax = a2;
+      ssum = s2;
+      sabs = b2;
+    }
+  }
+#endif
+  for (; k < full; k++) {
+    for (int b = 0; b < 32; b++) {
+      float acc = 0.0f;
+      for (int f = 0; f < m; f++) {
+        float s = svals[f];
+        float d = ((mps[f][k] >> b) & 1u) ? 3.0f * s : s;
+        acc += ((sps[f][k] >> b) & 1u) ? -d : d;
+      }
+      float v = in[k * 32 + b] + acc;
+      v = v > 3.0e38f ? 3.0e38f : v;
+      v = v < -3.0e38f ? -3.0e38f : v;
+      out[k * 32 + b] = v;
+      if (do_part) {
+        double a = v < 0 ? -(double)v : (double)v;
+        if (a > amax) amax = a;
+        ssum += (double)v * (double)v;
+        sabs += a;
+      }
+    }
+  }
+  int64_t base = full * 32;
+  if (n % 32 && n / 32 >= w0 && n / 32 < w1) {
+    base = (n / 32) * 32;
+    int64_t pw = n / 32;
+    for (int64_t b = 0; b < n - base; b++) {
+      float acc = 0.0f;
+      for (int f = 0; f < m; f++) {
+        float s = svals[f];
+        float d = ((mps[f][pw] >> b) & 1u) ? 3.0f * s : s;
+        acc += ((sps[f][pw] >> b) & 1u) ? -d : d;
+      }
+      float v = in[base + b] + acc;
+      v = v > 3.0e38f ? 3.0e38f : v;
+      v = v < -3.0e38f ? -3.0e38f : v;
+      out[base + b] = v;
+      if (do_part) {
+        double a = v < 0 ? -(double)v : (double)v;
+        if (a > amax) amax = a;
+        ssum += (double)v * (double)v;
+        sabs += a;
+      }
+    }
+    for (int64_t b = n - base; b < 32 && base + b < pad; b++)
+      out[base + b] = in[base + b];
+    base += 32;
+  }
+  if (base < w0 * 32) base = w0 * 32;
+  int64_t end = w1 * 32;
+  if (base < end && base < pad) {
+    int64_t stop = end < pad ? end : pad;
+    if (stop > base)
+      memcpy(out + base, in + base, (size_t)(stop - base) * sizeof(float));
+  }
+  if (out_amax) {
+    *out_amax = amax;
+    *out_ss = ssum;
+    *out_sabs = sabs;
+  }
+}
+
+typedef struct {
+  const float *vin;
+  float *vout;
+  const int64_t *off, *ns, *padded;
+  int64_t W;
+  int32_t k;
+  double *camax, *css, *csabs;
+#ifdef ST_POOL
+  const stc_chunk *chunks;
+#endif
+  const uint32_t *const *sps; /* [L * k] sign-plane pointers */
+  const uint32_t *const *mps; /* [L * k] mag-plane pointers */
+  const float *svals;         /* [L * k] scales */
+  const int32_t *am;          /* [L] active counts */
+} af2_ctx;
+
+static void apply2_frames_leaf_range(af2_ctx *x, int64_t i, int64_t w0,
+                                     int64_t w1, double *pa, double *ps,
+                                     double *pb) {
+  int m = x->am[i];
+  if (m == 0) {
+    copy_partials_range(x->vin + x->off[i], x->vout + x->off[i], x->ns[i],
+                        x->padded[i], w0 * 32, w1 * 32, pa, ps, pb);
+    return;
+  }
+  apply2_frames_range(x->vin + x->off[i], x->vout + x->off[i],
+                      x->sps + (size_t)i * x->k, x->mps + (size_t)i * x->k,
+                      x->svals + (size_t)i * x->k, m, x->ns[i], x->padded[i],
+                      w0, w1, pa, ps, pb);
+}
+
+#ifdef ST_POOL
+static void apply2_frames_seg(void *vctx, int64_t c) {
+  af2_ctx *x = (af2_ctx *)vctx;
+  const stc_chunk *ch = &x->chunks[c];
+  apply2_frames_leaf_range(x, ch->leaf, ch->w0, ch->w1,
+                           x->camax ? &x->camax[c] : NULL,
+                           x->camax ? &x->css[c] : NULL,
+                           x->camax ? &x->csabs[c] : NULL);
+}
+#endif
+
+/* Fused k-frame sign2 apply (stc_apply_frames's 2-bit twin). words is
+ * k * 2W: frame f's sign plane at f*2W, its magnitude plane at f*2W + W —
+ * exactly the order the planes arrive inside a wire frame body. */
+EXPORT void stc_apply_frames2(const float *vin, float *vout,
+                              const int64_t *off, const int64_t *ns,
+                              const int64_t *padded, int64_t n_leaves,
+                              int64_t W, int32_t k,
+                              const float *scales /* k*L */,
+                              const uint32_t *words /* k*2W */,
+                              double *out_amax, double *out_ss,
+                              double *out_sabs) {
+  if (k <= 0) return;
+  const uint32_t **sps =
+      (const uint32_t **)malloc((size_t)n_leaves * k * 2 * sizeof(uint32_t *));
+  float *svals = (float *)malloc((size_t)n_leaves * k * sizeof(float));
+  int32_t *am = (int32_t *)malloc((size_t)n_leaves * sizeof(int32_t));
+  if (!sps || !svals || !am) {
+    free(sps);
+    free(svals);
+    free(am);
+    return; /* OOM on tiny metadata arrays: nothing sane left to do */
+  }
+  const uint32_t **mps = sps + (size_t)n_leaves * k;
+  for (int64_t i = 0; i < n_leaves; i++) {
+    int32_t m = 0;
+    for (int32_t f = 0; f < k; f++) {
+      float s = scales[(size_t)f * n_leaves + i];
+      if (s == 0.0f) continue;
+      sps[(size_t)i * k + m] = words + (size_t)f * 2 * W + off[i] / 32;
+      mps[(size_t)i * k + m] = words + (size_t)f * 2 * W + W + off[i] / 32;
+      svals[(size_t)i * k + m] = s;
+      m++;
+    }
+    am[i] = m;
+  }
+  af2_ctx x;
+  x.vin = vin;
+  x.vout = vout;
+  x.off = off;
+  x.ns = ns;
+  x.padded = padded;
+  x.W = W;
+  x.k = k;
+  x.camax = NULL;
+  x.css = NULL;
+  x.csabs = NULL;
+  x.sps = sps;
+  x.mps = mps;
+  x.svals = svals;
+  x.am = am;
+#ifdef ST_POOL
+  int64_t total = 0;
+  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
+  if (total >= ST_PAR_MIN_ELEMS) {
+    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
+    double *pbuf =
+        out_amax ? (double *)malloc((size_t)nc * 3 * sizeof(double)) : NULL;
+    if (chunks && (!out_amax || pbuf)) {
+      stc_build_chunks(padded, n_leaves, chunks);
+      x.chunks = chunks;
+      x.camax = pbuf;
+      x.css = pbuf ? pbuf + nc : NULL;
+      x.csabs = pbuf ? pbuf + 2 * nc : NULL;
+      if (stc_pool_run(apply2_frames_seg, &x, nc)) {
+        if (out_amax)
+          reduce_chunk_partials(chunks, nc, n_leaves, x.camax, x.css,
+                                x.csabs, out_amax, out_ss, out_sabs);
+        free(chunks);
+        free(pbuf);
+        free(sps);
+        free(svals);
+        free(am);
+        return;
+      }
+      x.camax = NULL;
+      x.css = NULL;
+      x.csabs = NULL;
+    }
+    free(chunks);
+    free(pbuf);
+  }
+#endif
+  for (int64_t i = 0; i < n_leaves; i++) {
+    apply2_frames_leaf_range(&x, i, 0, padded[i] / 32,
+                             out_amax ? &out_amax[i] : NULL,
+                             out_amax ? &out_ss[i] : NULL,
+                             out_amax ? &out_sabs[i] : NULL);
+  }
+  free(sps);
+  free(svals);
+  free(am);
+}
+
+/* Single sign2 frame applied in place (the engine's rollback path: re-
+ * applying a ledgered sign2 frame to the residual restores the
+ * pre-quantize state, the 1-bit _unapply discipline). words = [sign W |
+ * mag W], the frame's wire body layout. */
+EXPORT void stc_apply_frame2(const float *vin, float *vout,
+                             const int64_t *off, const int64_t *ns,
+                             const int64_t *padded, int64_t n_leaves,
+                             int64_t W, const float *scales,
+                             const uint32_t *words) {
+  stc_apply_frames2(vin, vout, off, ns, padded, n_leaves, W, 1, scales, words,
+                    NULL, NULL, NULL);
 }

@@ -83,6 +83,23 @@ void stc_accumulate_update_to_partials(float*, const float*, const float*,
 void stc_apply_frames(const float*, float*, const int64_t*, const int64_t*,
                       const int64_t*, int64_t, int64_t, int32_t, const float*,
                       const uint32_t*, double*, double*, double*);
+// r11 cascade quantize (K halving frames in one pass) + sign2 (2-bit)
+// kernels — see stcodec.c's r11 section for semantics and layout.
+void stc_quantize_ef_cascade(const float*, float*, const int64_t*,
+                             const int64_t*, const int64_t*, int64_t, int32_t,
+                             const float*, uint32_t*, int64_t, double*,
+                             double*, double*);
+void stc_quantize2_ef_cascade(const float*, float*, const int64_t*,
+                              const int64_t*, const int64_t*, int64_t,
+                              int32_t, const float*, uint32_t*, int64_t,
+                              int64_t, double*, double*, double*);
+void stc_apply_frames2(const float*, float*, const int64_t*, const int64_t*,
+                       const int64_t*, int64_t, int64_t, int32_t,
+                       const float*, const uint32_t*, double*, double*,
+                       double*);
+void stc_apply_frame2(const float*, float*, const int64_t*, const int64_t*,
+                      const int64_t*, int64_t, int64_t, const float*,
+                      const uint32_t*);
 // sttransport.cpp
 int32_t st_node_send(void*, int32_t, const uint8_t*, int32_t, double);
 // zero-copy enqueue: the transport borrows the payload (no copy) and calls
@@ -190,6 +207,7 @@ struct TxPool {
   std::vector<std::unique_ptr<TxSlot>> all_;
   size_t slot_bytes = 0;   // 8 + burst * frame_bytes
   size_t keep_warm = 4;    // free slots retained with their buffer intact
+  size_t warm_ = 0;        // free_ entries with buf intact (all at the back)
   std::atomic<uint64_t> acquires{0}, alloc_events{0};
 
   TxSlot* acquire() {
@@ -200,6 +218,7 @@ struct TxPool {
       if (!free_.empty()) {
         s = free_.back();
         free_.pop_back();
+        if (warm_ > 0 && !s->buf.empty()) warm_--;
       } else {
         all_.emplace_back(new TxSlot());
         s = all_.back().get();
@@ -221,18 +240,23 @@ struct TxPool {
     // and the free-list push (it would then free the pool under us)
     std::lock_guard<std::mutex> lk(mu);
     if (s->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
-      if (free_.size() >= keep_warm) {
+      if (warm_ >= keep_warm) {
         // bound idle memory: keep the slot object, drop its buffer — and
         // park it at the COLD end of the list so acquire() (which pops
-        // the back) keeps hitting the warm buffers; pushed at the back,
-        // one shrunk slot would be re-popped (and re-allocated,
-        // multi-MB) on every message once the high-water exceeded
-        // keep_warm, silently defeating the zero-allocation steady state
+        // the back) keeps hitting the warm buffers. The bound counts
+        // WARM free slots (warm_), not the free list's length: once a
+        // window stall grew the pool, the list stays longer than
+        // keep_warm forever even though most entries are cold, and a
+        // length-based check then shrank every returning slot — each
+        // steady-state message paid a multi-MB value-initializing
+        // resize + page faults under the data-plane mutex (measured
+        // ~1.7 ms of the 1 Mi sender's 3.3 ms pass wall).
         s->buf.clear();
         s->buf.shrink_to_fit();
         free_.insert(free_.begin(), s);
       } else {
         free_.push_back(s);
+        warm_++;
       }
     }
   }
@@ -253,6 +277,18 @@ constexpr uint32_t kEvDedupDiscard = 14;
 constexpr uint32_t kEvSeal = 15;
 constexpr uint32_t kEvTraceApply = 30;  // r09 cross-hop trace propagation
 constexpr uint32_t kEvSubAttach = 31;   // r10 subscriber link attached
+constexpr uint32_t kEvPrecShift = 32;   // r11 governor flipped link precision
+
+// r11 adaptive precision: the kind byte's top bit marks a sign2 (2-bit)
+// DATA/BURST message — body per frame is [scales L*4][sign W*4][mag W*4]
+// instead of [scales][sign]. Receivers here tolerant-decode BOTH widths
+// unconditionally (precision bit selects the frame size; message length
+// still disambiguates the r09 v1/v2 trace framing within each width), and
+// EMISSION is gated per link on the peer's advertised capability
+// (compat.SYNC_FLAG_SIGN2 / WELCOME flags -> st_engine_link_allow_sign2),
+// so mixed trees interop: a pre-r11 peer never advertises and never
+// receives a 2-bit frame.
+constexpr uint8_t kPrecBit = 0x80;
 
 // ---- r09 trace context (comm/wire.py v2 framing) --------------------------
 //
@@ -278,6 +314,9 @@ constexpr size_t kBodyOff = 24;
 struct SentMsg {
   // one wire message = 1..k frames; rolls back / acks whole
   int32_t nframes;
+  // frame precision (r11): 1 = sign-bit frames, 2 = sign2 (2-bit) frames —
+  // rollback must re-apply each ledgered frame with the matching kernel
+  uint8_t prec = 1;
   uint64_t seq = 0;      // per-link wire seq (comm/wire.py tx_seq)
   // ledger-append time: ACK-pop minus this is the delivery round trip the
   // r08 RTT counters aggregate (st_engine_counters[10..11]); includes any
@@ -336,7 +375,45 @@ struct ELink {
   int64_t wlo = 0, wcnt = 0;  // subscribed word range
   uint64_t fresh_interval_ns = 0;
   uint64_t last_fresh_ns = 0;
+  // r11 adaptive precision. peer_sign2: the OTHER end advertised sign2
+  // decode capability (SYNC/WELCOME flags; emission is gated on it — see
+  // kPrecBit). prec: the governor's current choice for this link (1 or 2).
+  // gov_*: the telemetry loop's state — previous residual RMS sample and
+  // consecutive stall/quiet votes (2 votes with hysteresis, so one noisy
+  // interval can't flap the link).
+  //
+  // Byte-bound gating (the loop's stability half): sign2 buys more
+  // residual mass PER BYTE (the lab measurement this PR promotes) at 2x
+  // the bytes per frame — so the upshift only pays when BYTES are the
+  // link's scarce resource. A loopback/compute-bound link at its
+  // equilibrium is frame-bound, not byte-bound: upshifting it just
+  // halves the frame rate, and the rms there is a flat sawtooth whose
+  // discrete jitter (integer multiples of one add's norm) defeats every
+  // trend-based verdict — both a one-shot probation (a mark captured
+  // during the join transient "passes" forever: bimodal 26-vs-44 GB/s
+  // bench runs) and a continuous-progress rule (sawtooth dips read as
+  // progress: flapping). The honest discriminator is direct byte
+  // BACKPRESSURE, which the send path already observes: a send attempt
+  // that sat out its full timeout on a full sendq (gov_bp, counted per
+  // beat) or a go-back-N window that closed (window_blocked — the peer
+  // acks slower than we produce). Healthy loopback shows NEITHER
+  // (measured: zero events over 8 s saturated), a capped or
+  // NIC-saturated or chaos-storm link shows them continuously. Growth
+  // votes therefore only count while byte-bound, and sign2 holds
+  // exactly as long as the byte-bound condition does (kGovStall quiet
+  // beats to lift, so a bursty storm doesn't flap the link) or the
+  // residual quiesces.
+  bool peer_sign2 = false;
+  int prec = 1;
+  double gov_prev = -1.0;
+  uint64_t gov_last_ns = 0;
+  int gov_up = 0, gov_down = 0;
+  uint32_t gov_bp = 0;   // byte-backpressure events since the last beat
+  int gov_quiet = 0;     // consecutive beats without byte pressure
 };
+
+constexpr int kGovStall = 8;  // quiet beats before sign2 stands down
+                              // (~0.8 s at the default beat)
 
 struct Engine {
   void* node = nullptr;
@@ -387,6 +464,21 @@ struct Engine {
   bool has_carry = false;
   std::mutex mu;
 
+  // r11 staged adds: st_engine_add used to take the data-plane mutex for
+  // its two full-table passes, serializing every trainer add behind
+  // whatever multi-pass message quantize held it (measured: 2.9 ms per
+  // add at 1 Mi under load, the saturated pipeline's limiter). Adds now
+  // accumulate into `upend` under add_mu ONLY — sanitize+clip fused, the
+  // same kernel — and every data-plane path that reads values/residuals
+  // folds the pending sum in first (fold_pending: the old add body, run
+  // under e->mu at the next safe point). Lock order: e->mu -> add_mu,
+  // never the reverse; add() takes only add_mu. The pending trace
+  // re-seed stages through pend_gen the same way.
+  std::mutex add_mu;
+  std::vector<float> upend, ufold;  // pending accumulation + fold scratch
+  std::atomic<bool> has_pending{false};
+  std::atomic<uint64_t> pend_gen{0};
+
   // sender wake (missed-wakeup-safe sequence counter)
   std::mutex wmu;
   std::condition_variable wcv;
@@ -426,6 +518,24 @@ struct Engine {
   // that one stays "ACK-ledgered wire messages" on both tiers) and kFresh
   // drain marks delivered.
   std::atomic<uint64_t> sub_msgs_out{0}, sub_fresh_out{0};
+  // r11 adaptive precision (st_engine_counters[18..21]): governor
+  // upshifts/downshifts, and sign2 frames sent/applied (subsets of
+  // frames_out/frames_in — the taxonomy equalities are precision-blind).
+  std::atomic<uint64_t> prec_upshifts{0}, prec_downshifts{0};
+  std::atomic<uint64_t> frames2_out{0}, frames2_in{0};
+  // r11 codec config (st_engine_set_codec; called between create and
+  // start). prec_mode: 0 = fixed 1-bit, 1 = telemetry-adaptive (the
+  // governor may upshift capable links to sign2), 2 = fixed sign2 on
+  // capable links (A/B arms). gov_up_ratio: upshift when the residual RMS
+  // fails to decay below ratio*previous for 2 consecutive beats (the
+  // 1-bit codec is not keeping up); gov_down_ratio: downshift when it
+  // decays below this ratio (or quiesces). cascade: frames quantized per
+  // memory pass on the ledgered 1-bit/sign2 paths (1 = the r10 per-frame
+  // re-measured schedule).
+  int prec_mode = 0;
+  double gov_up_ratio = 1.05, gov_down_ratio = 0.5;
+  double gov_interval = 0.1;
+  int cascade = 1;
   // r09 wire format: stamp outgoing DATA/BURST with the v2 trace context
   // (0 = v1 framing, byte-identical to r08 — the receive side accepts
   // both regardless, so mixed trees interop; ObsConfig.trace_wire).
@@ -451,6 +561,53 @@ struct Engine {
     wcv.notify_all();
   }
 };
+
+// Fold the staged pending add (st_engine_add) into values + every
+// residual + the carry — the pre-r11 add body, run at the next safe
+// point by whoever holds e->mu. One atomic-bool check when idle.
+void fold_pending(Engine* e) {
+  if (!e->has_pending.load(std::memory_order_acquire)) return;
+  {
+    std::lock_guard<std::mutex> alk(e->add_mu);
+    if (!e->has_pending.load(std::memory_order_relaxed)) return;
+    // swap the accumulation buffer out (ufold is pre-zeroed — see the
+    // fill below) so concurrent adds keep landing while we fold
+    std::swap(e->upend, e->ufold);
+    e->has_pending.store(false, std::memory_order_release);
+  }
+  const float* u = e->ufold.data();
+  stc_accumulate_update_to(e->values.data(), e->values.data(), u,
+                           e->off.data(), e->ns.data(), e->padded.data(),
+                           e->L);
+  for (auto& kv : e->links) {
+    ELink& lk2 = kv.second;
+    if ((int64_t)lk2.pamax.size() != e->L) {
+      lk2.pamax.resize((size_t)e->L);
+      lk2.pss.resize((size_t)e->L);
+      lk2.psabs.resize((size_t)e->L);
+    }
+    stc_accumulate_update_to_partials(
+        lk2.resid.data(), lk2.resid.data(), u, e->off.data(), e->ns.data(),
+        e->padded.data(), e->L, lk2.pamax.data(), lk2.pss.data(),
+        lk2.psabs.data());
+    lk2.pvalid = true;
+    lk2.dirty = true;
+  }
+  if (e->has_carry)
+    stc_accumulate_update_to(e->carry.data(), e->carry.data(), u,
+                             e->off.data(), e->ns.data(), e->padded.data(),
+                             e->L);
+  std::fill(e->ufold.begin(), e->ufold.end(), 0.0f);  // ready for re-swap
+  uint64_t g = e->pend_gen.exchange(0, std::memory_order_acq_rel);
+  if (e->trace_wire && g) {
+    // a local update is a fresh generation: re-seed the pending stamp
+    // (origin = this node, generation = the add's clock reading, 0 hops)
+    e->t_origin = e->obs_id;
+    e->t_gen = g;
+    e->t_hops = 0;
+    e->t_has = true;
+  }
+}
 
 // scale = policy(partials); zero when the leaf is all-zero or the result is
 // non-finite. Same math as ops/codec_np.compute_scales_np's native branch:
@@ -507,19 +664,26 @@ bool any_nonzero(const float* s, int64_t L) {
 void rollback_unacked(Engine* e, ELink& lk) {
   size_t per = (size_t)e->L * 4 + (size_t)e->W * 4;
   for (auto& msg : lk.unacked) {
+    // frame stride follows the ledgered message's precision (r11): a
+    // sign2 frame carries a second (magnitude) word plane
+    size_t fb = msg.prec == 2 ? per + (size_t)e->W * 4 : per;
     for (int32_t f = 0; f < msg.nframes; f++) {
       const float* fs;
       const uint32_t* fw;
       if (msg.slot) {
-        const uint8_t* body = msg.slot->buf.data() + kBodyOff + (size_t)f * per;
+        const uint8_t* body = msg.slot->buf.data() + kBodyOff + (size_t)f * fb;
         fs = (const float*)body;
         fw = (const uint32_t*)(body + (size_t)e->L * 4);
       } else {
         fs = msg.scales.data() + (size_t)f * e->L;
         fw = msg.words.data() + (size_t)f * e->W;
       }
-      stc_apply_frame(lk.resid.data(), lk.resid.data(), e->off.data(),
-                      e->ns.data(), e->padded.data(), e->L, fs, fw);
+      if (msg.prec == 2)
+        stc_apply_frame2(lk.resid.data(), lk.resid.data(), e->off.data(),
+                         e->ns.data(), e->padded.data(), e->L, e->W, fs, fw);
+      else
+        stc_apply_frame(lk.resid.data(), lk.resid.data(), e->off.data(),
+                        e->ns.data(), e->padded.data(), e->L, fs, fw);
     }
     if (msg.slot) e->txpool.unref(msg.slot);
   }
@@ -529,8 +693,11 @@ void rollback_unacked(Engine* e, ELink& lk) {
 
 // Apply k decoded frames from `src_link` to the replica and every OTHER
 // link's residual (split-horizon flood). Caller holds e->mu.
+// prec (r11): 1 = sign-bit frames (words is k*W), 2 = sign2 frames (words
+// is k*2W — per frame, sign plane then magnitude plane). A receive batch
+// flushes on precision change, so one call is always homogeneous.
 void apply_batch(Engine* e, int32_t src_link, int32_t k, const float* scales,
-                 const uint32_t* words) {
+                 const uint32_t* words, int prec) {
   // NOTE: dead links are NOT skipped here (only the I/O loops skip them):
   // a dead link's residual keeps accumulating until Python detaches it —
   // that residual IS the carry the re-graft owes, and mass applied in the
@@ -547,14 +714,23 @@ void apply_batch(Engine* e, int32_t src_link, int32_t k, const float* scales,
   for (int32_t f = 0; f < k; f++)
     if (any_nonzero(scales + (size_t)f * e->L, e->L)) applied++;
   if (applied == 0) return;
-  // k-frame fused apply (stc_apply_frames): ONE pass per target regardless
-  // of k — no delta buffer (the old k>1 path paid k read-modify-write
-  // passes over a total*4 delta before touching any target; at 16 Mi that
-  // was k*128 MiB of traffic). Residual targets refresh their scale-
-  // partials cache in the same pass (see ELink::pvalid).
-  stc_apply_frames(e->values.data(), e->values.data(), e->off.data(),
-                   e->ns.data(), e->padded.data(), e->L, e->W, k, scales,
-                   words, nullptr, nullptr, nullptr);
+  // k-frame fused apply (stc_apply_frames / its sign2 twin): ONE pass per
+  // target regardless of k — no delta buffer (the old k>1 path paid k
+  // read-modify-write passes over a total*4 delta before touching any
+  // target; at 16 Mi that was k*128 MiB of traffic). Residual targets
+  // refresh their scale-partials cache in the same pass (ELink::pvalid).
+  auto apply = [&](const float* in, float* out, double* pa, double* ps,
+                   double* pb) {
+    if (prec == 2)
+      stc_apply_frames2(in, out, e->off.data(), e->ns.data(),
+                        e->padded.data(), e->L, e->W, k, scales, words, pa,
+                        ps, pb);
+    else
+      stc_apply_frames(in, out, e->off.data(), e->ns.data(),
+                       e->padded.data(), e->L, e->W, k, scales, words, pa,
+                       ps, pb);
+  };
+  apply(e->values.data(), e->values.data(), nullptr, nullptr, nullptr);
   for (auto& kv : e->links) {
     if (kv.first == src_link) continue;
     ELink& lk = kv.second;
@@ -563,17 +739,15 @@ void apply_batch(Engine* e, int32_t src_link, int32_t k, const float* scales,
       lk.pss.resize((size_t)e->L);
       lk.psabs.resize((size_t)e->L);
     }
-    stc_apply_frames(lk.resid.data(), lk.resid.data(), e->off.data(),
-                     e->ns.data(), e->padded.data(), e->L, e->W, k, scales,
-                     words, lk.pamax.data(), lk.pss.data(), lk.psabs.data());
+    apply(lk.resid.data(), lk.resid.data(), lk.pamax.data(), lk.pss.data(),
+          lk.psabs.data());
     lk.pvalid = true;
     lk.dirty = true;
   }
   if (e->has_carry)
-    stc_apply_frames(e->carry.data(), e->carry.data(), e->off.data(),
-                     e->ns.data(), e->padded.data(), e->L, e->W, k, scales,
-                     words, nullptr, nullptr, nullptr);
+    apply(e->carry.data(), e->carry.data(), nullptr, nullptr, nullptr);
   e->frames_in += applied;
+  if (prec == 2) e->frames2_in += applied;
 }
 
 // ---- sender ---------------------------------------------------------------
@@ -678,6 +852,11 @@ void sender_loop(Engine* e) {
   std::vector<float> scales((size_t)e->L);
   std::vector<double> amax((size_t)e->L), ss((size_t)e->L),
       sabs((size_t)e->L);
+  // r11 cascade schedule rows (frame-major, contiguous k*L — the kernels'
+  // scale layout; the slot copies are per-frame)
+  std::vector<float> sched((size_t)64 * e->L);
+  const uint64_t gov_interval_ns =
+      e->gov_interval > 0 ? (uint64_t)(e->gov_interval * 1e9) : 100000000ull;
   while (!e->stop.load()) {
     uint64_t seq_before;
     {
@@ -691,11 +870,14 @@ void sender_loop(Engine* e) {
       for (auto& kv : e->links)
         if (!kv.second.dead) ids.push_back(kv.first);
     }
+    // one clock read per pass feeds every link's governor beat (r11)
+    uint64_t pass_ns = e->prec_mode == 1 ? st_obs_now_ns() : 0;
     for (int32_t id : ids) {
       if (e->stop.load()) return;
       SentMsg msg;
       TxSlot* slot = nullptr;
       size_t per = frame_bytes(e);
+      int mprec = 1;  // this message's frame precision
       // r10 subscriber-link state, captured under e->mu for the unledgered
       // send path below (incl. the trace stamp — the ledgered path reads it
       // while packing headers under the same lock)
@@ -706,6 +888,7 @@ void sender_loop(Engine* e) {
       uint8_t tr_h = 0;
       {
         std::lock_guard<std::mutex> lk(e->mu);
+        fold_pending(e);  // staged adds land before this link quantizes
         auto it = e->links.find(id);
         if (it == e->links.end() || it->second.dead) continue;
         ELink& lk2 = it->second;
@@ -734,6 +917,64 @@ void sender_loop(Engine* e) {
               }
             }
           }
+        }
+        // r11 precision governor — the first closed telemetry->data-plane
+        // loop: the same per-link residual RMS the r09 st_residual_norm
+        // gauge serves (the pss partials cache, O(L) under e->mu) drives
+        // this link's wire precision. A link whose residual GROWS between
+        // beats (rms > up_ratio * prev: the stream is falling behind the
+        // mass arriving — chaos, retransmission storms, a stalled peer)
+        // upshifts to the sign2 2-bit codec; one that drains fast or
+        // quiesces (rms < down_ratio * prev, or zero) downshifts back. A
+        // healthy saturated link (flat rms at equilibrium) stays 1-bit. Two consecutive
+        // votes with reset-on-contrary give hysteresis so one noisy beat
+        // can't flap the link. Emission stays gated on the peer's
+        // advertised capability (kPrecBit note).
+        if (e->prec_mode == 1 && !sub && !e->compat_bytes &&
+            lk2.peer_sign2 &&
+            pass_ns - lk2.gov_last_ns >= gov_interval_ns && lk2.pvalid) {
+          double gss = 0;
+          for (int64_t i = 0; i < e->L; i++) gss += lk2.pss[i];
+          double rms = std::sqrt(gss / (double)e->total_n);
+          // byte pressure harvested per beat (struct comment): sendq
+          // bounces since the last beat, or a closed go-back-N window
+          bool byte_bound = lk2.gov_bp > 0 || lk2.window_blocked;
+          lk2.gov_bp = 0;
+          lk2.gov_quiet = byte_bound ? 0 : lk2.gov_quiet + 1;
+          if (lk2.gov_prev >= 0.0) {
+            if (byte_bound && rms > 0 &&
+                rms > lk2.gov_prev * e->gov_up_ratio) {
+              // growing residual on a byte-bound link: the wire cannot
+              // move the mass at 1 bit/element — the regime sign2's
+              // per-byte advantage exists for
+              lk2.gov_up++;
+              lk2.gov_down = 0;
+            } else if (rms <= 0 || rms < lk2.gov_prev * e->gov_down_ratio) {
+              lk2.gov_down++;
+              lk2.gov_up = 0;
+            } else {
+              lk2.gov_up = 0;
+              lk2.gov_down = 0;
+            }
+            if (lk2.prec == 1 && lk2.gov_up >= 2) {
+              lk2.prec = 2;
+              lk2.gov_up = 0;
+              e->prec_upshifts++;
+              st_obs_emit(e->obs_id, kEvPrecShift, id, 2);
+            } else if (lk2.prec == 2 &&
+                       (lk2.gov_down >= 2 || lk2.gov_quiet >= kGovStall)) {
+              // stand down when the residual quiesces (sign2 did its
+              // job / the load vanished) or the byte-bound condition
+              // lifts for kGovStall beats (bytes are no longer scarce —
+              // the half-cost wire format moves the same frames)
+              lk2.prec = 1;
+              lk2.gov_down = 0;
+              e->prec_downshifts++;
+              st_obs_emit(e->obs_id, kEvPrecShift, id, 1);
+            }
+          }
+          lk2.gov_prev = rms;
+          lk2.gov_last_ns = pass_ns;
         }
         if (!lk2.dirty) continue;
         // go-back-N send window: a full unacked ledger (stalled peer)
@@ -801,33 +1042,156 @@ void sender_loop(Engine* e) {
           stc_scale_partials(lk2.resid.data(), e->off.data(), e->ns.data(),
                              e->L, amax.data(), ss.data(), sabs.data());
         }
+        // r11: this message's precision, decided under e->mu. Ledgered
+        // links only (sub/compat stay 1-bit: the serve tier's python
+        // subscriber and the reference protocol don't speak sign2), and
+        // only toward a peer that advertised decode capability.
+        if (slot && lk2.peer_sign2 &&
+            (e->prec_mode == 2 || (e->prec_mode == 1 && lk2.prec == 2)))
+          mprec = 2;
+        size_t fb = mprec == 2 ? per + (size_t)e->W * 4 : per;
         int bmax = sub && e->burst > kSubBurstCap ? kSubBurstCap : e->burst;
-        for (int b = 0; b < bmax; b++) {
-          scales_from_partials(e, amax, ss, sabs, scales.data());
-          if (!any_nonzero(scales.data(), e->L)) {
-            if (b == 0) lk2.dirty = false;  // nothing to say at all
-            break;
+        if (mprec == 2) {
+          // a sign2 burst is ~2x the bytes per frame: cap it so the
+          // message still fits every peer's receive bound (r11
+          // wire.frame_wire_bytes sized recv_cap for it)
+          int64_t cap2 =
+              ((int64_t)e->recv_cap - (int64_t)kBurstHdrV2) / (int64_t)fb;
+          if (cap2 < 1) cap2 = 1;
+          if (bmax > cap2) bmax = (int)cap2;
+        }
+        if (slot) {
+          // r11 cascade quantize: up to e->cascade halving frames per
+          // MEMORY PASS (stcodec.c's r11 section). Frame 0's scales are
+          // measured from the partials as before; frames 1..k-1 take the
+          // halving schedule the measured sequence converges to anyway.
+          // Scales ride the wire, so the receiver is oblivious; the
+          // residual's drain per message gets DEEPER (bound ~s/2^(k-1))
+          // while the sender's passes per message drop ~k-fold — the
+          // pass count, not bandwidth, was the measured 1 Mi wall.
+          int64_t wstride = (int64_t)(fb / 4);
+          int kcmax = e->cascade < 1 ? 1 : (e->cascade > 64 ? 64 : e->cascade);
+          while (msg.nframes < bmax) {
+            scales_from_partials(e, amax, ss, sabs, scales.data());
+            if (!any_nonzero(scales.data(), e->L)) {
+              if (msg.nframes == 0) lk2.dirty = false;  // nothing to say
+              break;
+            }
+            // Cascade schedule: per leaf, a pow2 ladder from the
+            // residual's AMAX down to the policy (rms) scale. Anchoring
+            // the top at amax (not rms) is what makes the drain
+            // geometric for the WHOLE population: each |r| <= bound
+            // level halves the bound, outliers included — an rms-anchored
+            // ladder starves the gaussian tail (outliers move one
+            // ever-shrinking +-s per frame; measured: amax decays
+            // linearly and a full drain never terminates), while the
+            // policy's own per-frame schedule has exactly the same tail
+            // (it is the known slow-gaussian-tail regime). The depth
+            // collapses to 1 on its own when pow2(amax) == policy scale
+            // — the lockstep drain-tail states — and a single measured
+            // frame then merges phase groups and terminates the drain
+            // exactly (scale reads 0, link goes idle), the production
+            // endgame. Measured on a 64 Ki gaussian: exact drain in 44
+            // frames / 24 passes vs NO termination in 20 k frames for
+            // the per-frame schedule. sign2's magnitude bit reaches 3s,
+            // so its ladder starts two binades lower at equal coverage.
+            int kc = 1;
+            if (kcmax > 1) {
+              int maxd = 1;
+              for (int64_t i = 0; i < e->L; i++) {
+                if (scales.data()[i] <= 0.0f) continue;
+                union {
+                  float f;
+                  uint32_t u;
+                } b;
+                b.f = (float)amax[i];
+                b.u &= 0x7F800000u;  // pow2 floor; subnormals -> 0
+                float st = b.f;
+                if (mprec == 2) st *= 0.25f;  // +-3s covers the top levels
+                if (st <= scales.data()[i]) continue;
+                int d = ilogbf(st) - ilogbf(scales.data()[i]) + 1;
+                if (d > maxd) maxd = d;
+              }
+              // Dense states extend the ladder BELOW the rms anchor: the
+              // extra refinement levels are nearly free in the same pass
+              // and leave a cleaner (finer-lattice) residual, which the
+              // endgame then merges in FEWER single-frame passes — the
+              // measured 64 Ki gaussian drain goes 44 frames / 24 passes
+              // (extra 0) -> 33 / 4 (extra 8), still terminating exactly.
+              if (maxd > 1) maxd += 8;
+              kc = maxd < kcmax ? maxd : kcmax;
+            }
+            if (kc > bmax - msg.nframes) kc = bmax - msg.nframes;
+            int kreal = 0;
+            for (int j = 0; j < kc; j++) {
+              float* row = sched.data() + (size_t)j * e->L;
+              if (j == 0) {
+                if (kc == 1) {
+                  // single measured frame: exactly the policy schedule
+                  std::memcpy(row, scales.data(), (size_t)e->L * 4);
+                } else {
+                  for (int64_t i = 0; i < e->L; i++) {
+                    float s = scales.data()[i];
+                    if (s > 0.0f) {
+                      union {
+                        float f;
+                        uint32_t u;
+                      } b;
+                      b.f = (float)amax[i];
+                      b.u &= 0x7F800000u;
+                      float st = b.f;
+                      if (mprec == 2) st *= 0.25f;
+                      if (st > s) s = st;  // ladder top (>= policy scale)
+                    }
+                    row[i] = s;
+                  }
+                }
+              } else {
+                const float* prev = sched.data() + (size_t)(j - 1) * e->L;
+                for (int64_t i = 0; i < e->L; i++) row[i] = prev[i] * 0.5f;
+                // the halving hit the denormal floor: an all-zero-scale
+                // frame would count nowhere at the receiver (taxonomy)
+                if (!any_nonzero(row, e->L)) break;
+              }
+              std::memcpy(body + (size_t)(msg.nframes + j) * fb, row,
+                          (size_t)e->L * 4);
+              kreal++;
+            }
+            uint8_t* f0 = body + (size_t)msg.nframes * fb;
+            uint32_t* wbase = (uint32_t*)(f0 + (size_t)e->L * 4);
+            if (mprec == 2)
+              stc_quantize2_ef_cascade(
+                  lk2.resid.data(), lk2.resid.data(), e->off.data(),
+                  e->ns.data(), e->padded.data(), e->L, kreal, sched.data(),
+                  wbase, wstride, e->W, amax.data(), ss.data(), sabs.data());
+            else
+              stc_quantize_ef_cascade(
+                  lk2.resid.data(), lk2.resid.data(), e->off.data(),
+                  e->ns.data(), e->padded.data(), e->L, kreal, sched.data(),
+                  wbase, wstride, amax.data(), ss.data(), sabs.data());
+            msg.nframes += kreal;
+            if (kreal < kc) break;  // schedule floored mid-cascade
           }
-          float* fscales;
-          uint32_t* fwords;
-          if (slot) {
-            uint8_t* fb = body + (size_t)msg.nframes * per;
-            fscales = (float*)fb;
-            fwords = (uint32_t*)(fb + (size_t)e->L * 4);
-          } else {
+        } else {
+          for (int b = 0; b < bmax; b++) {
+            scales_from_partials(e, amax, ss, sabs, scales.data());
+            if (!any_nonzero(scales.data(), e->L)) {
+              if (b == 0) lk2.dirty = false;  // nothing to say at all
+              break;
+            }
             size_t base_s = msg.scales.size(), base_w = msg.words.size();
             msg.scales.resize(base_s + (size_t)e->L);
             msg.words.resize(base_w + (size_t)e->W);
-            fscales = msg.scales.data() + base_s;
-            fwords = msg.words.data() + base_w;
+            float* fscales = msg.scales.data() + base_s;
+            uint32_t* fwords = msg.words.data() + base_w;
+            std::memcpy(fscales, scales.data(), (size_t)e->L * 4);
+            stc_quantize_ef_partials(lk2.resid.data(), lk2.resid.data(),
+                                     e->off.data(), e->ns.data(),
+                                     e->padded.data(), e->L, scales.data(),
+                                     fwords, amax.data(), ss.data(),
+                                     sabs.data());
+            msg.nframes++;
           }
-          std::memcpy(fscales, scales.data(), (size_t)e->L * 4);
-          stc_quantize_ef_partials(lk2.resid.data(), lk2.resid.data(),
-                                   e->off.data(), e->ns.data(),
-                                   e->padded.data(), e->L, scales.data(),
-                                   fwords, amax.data(), ss.data(),
-                                   sabs.data());
-          msg.nframes++;
         }
         // amax/ss/sabs now hold the post-quantize residual's partials
         // (whether any frame was emitted or not): seed the cache for the
@@ -841,6 +1205,8 @@ void sender_loop(Engine* e) {
           continue;
         }
         e->frames_out += (uint64_t)msg.nframes;
+        if (mprec == 2) e->frames2_out += (uint64_t)msg.nframes;
+        msg.prec = (uint8_t)mprec;
         if (sub) {
           // unledgered: allocate wire seqs (the subscriber's gap detector
           // needs them) and capture the trace stamp; no unacked entry —
@@ -873,13 +1239,16 @@ void sender_loop(Engine* e) {
           slot->wire_off = (uint32_t)(kBodyOff - hdr);
           uint8_t* H = slot->buf.data() + slot->wire_off;
           size_t o;
+          // r11: the kind byte's top bit marks sign2 frame bodies (see
+          // kPrecBit) — set only toward peers that advertised the decode
+          uint8_t pbit = mprec == 2 ? kPrecBit : 0;
           if (e->burst > 1) {
-            H[0] = kBurst;
+            H[0] = kBurst | pbit;
             std::memcpy(H + 1, &seq32, 4);
             H[5] = (uint8_t)msg.nframes;
             o = kBurstHdrV1;
           } else {
-            H[0] = kData;
+            H[0] = kData | pbit;
             std::memcpy(H + 1, &seq32, 4);
             o = kDataHdrV1;
           }
@@ -896,7 +1265,7 @@ void sender_loop(Engine* e) {
             H[o + 12] = th;
           }
           slot->wire_len =
-              (uint32_t)(hdr + (size_t)msg.nframes * per);
+              (uint32_t)(hdr + (size_t)msg.nframes * fb);
           msg.slot = slot;  // the ledger entry owns the acquire reference
           msg.sent_at = EClock::now();
           if (lk2.unacked.empty()) lk2.ack_progress = msg.sent_at;
@@ -1023,7 +1392,7 @@ void sender_loop(Engine* e) {
       // the whole burst into the re-graft carry on restart
       st_fault_crash_point("mid-burst");
       bool delivered = false;
-      int32_t fails = 0;
+      int32_t fails = 0, bounces = 0;
       // (the in-flight slot reference for this send was taken under e->mu
       // at ledger-push time — see above)
       while (!e->stop.load()) {
@@ -1039,6 +1408,7 @@ void sender_loop(Engine* e) {
           break;
         }
         if (r < 0) break;  // dead link
+        bounces++;  // sat out the full timeout on a full sendq
         if (e->quarantine > 0 && ++fails >= e->quarantine) {
           // quarantine: tear the stalled link down; the failed-send
           // rollback below + Python's LINK_DOWN -> carry -> re-graft
@@ -1050,6 +1420,13 @@ void sender_loop(Engine* e) {
       }
       if (slot && !delivered)
         e->txpool.unref(slot);  // transport took no ownership
+      if (bounces > 0 && e->prec_mode == 1) {
+        // byte backpressure observed: feed the precision governor's
+        // byte-bound gate (harvested at its next beat)
+        std::lock_guard<std::mutex> lk(e->mu);
+        auto it = e->links.find(id);
+        if (it != e->links.end()) it->second.gov_bp += (uint32_t)bounces;
+      }
       if (delivered) {
         // compat: every frame IS a protocol message (the reference wire has
         // no message framing beyond the fixed frame size), keeping the
@@ -1130,6 +1507,7 @@ void receiver_loop(Engine* e) {
     bool obs_on = st_obs_is_enabled() != 0;
     for (int32_t id : ids) {
       int32_t batchk = 0;
+      int batch_prec = 1;  // r11: a batch is precision-homogeneous
       uint64_t msgs = 0;
       // last traced stamp accepted in this batch (+ per-batch aggregates):
       // folded into the engine's pending stamp and the link's staleness
@@ -1155,7 +1533,8 @@ void receiver_loop(Engine* e) {
         auto it = e->links.find(id);
         if (it == e->links.end()) return;
         if (batchk > 0) {
-          apply_batch(e, id, batchk, bscales.data(), bwords.data());
+          apply_batch(e, id, batchk, bscales.data(), bwords.data(),
+                      batch_prec);
         }
         if (have_trace) {
           // advance the pending stamp: this node is now one hop further
@@ -1192,6 +1571,17 @@ void receiver_loop(Engine* e) {
         bwords.clear();
       };
       for (int iter = 0; iter < 256; iter++) {  // bounded: don't starve links
+        // r11: also bound the batch by FRAMES. flush() applies the whole
+        // batch in one fused pass under e->mu and only THEN acks — at
+        // saturation (256 messages x a ~31-frame burst each) that single
+        // flush runs for whole seconds, the peer's send window (32 msgs)
+        // stays exhausted the entire time, and the stream freezes into a
+        // stop-and-go duty cycle paced by our flush latency. 256 frames
+        // keeps the fused pass in the tens-of-ms class (both precisions)
+        // so the cumulative ACK advances continuously and the sender's
+        // window never starves; the table read still amortizes across
+        // the full batch.
+        if (batchk >= 256) break;
         int32_t n = st_node_recv(e->node, id, buf.data(), e->recv_cap, 0.0);
         if (n == 0) break;
         if (n < 0) {
@@ -1226,6 +1616,16 @@ void receiver_loop(Engine* e) {
           continue;
         }
         uint8_t kind = buf[0];
+        // r11 precision bit: data kinds may carry kPrecBit marking sign2
+        // (2-bit) frame bodies — decoded unconditionally (tolerant decode;
+        // EMISSION is what capability-gates). Any other kind with the top
+        // bit set stays an unknown control message.
+        int p2 = 0;
+        if ((kind & kPrecBit) &&
+            ((kind & ~kPrecBit) == kData || (kind & ~kPrecBit) == kBurst)) {
+          p2 = 1;
+          kind &= ~kPrecBit;
+        }
         if (kind == kData || kind == kBurst) {
           if (e->sealed.load()) continue;  // leaving: sender re-delivers
           // Go-back-N acceptance (comm/wire.py tx_seq): only the next
@@ -1244,32 +1644,39 @@ void receiver_loop(Engine* e) {
             st_obs_emit(e->obs_id, kEvDedupDiscard, id, (uint64_t)seq);
             continue;
           }
-          // v1 or v2 framing by exact length (per is a multiple of 4, the
-          // trace context is 13 bytes — the sizes can never coincide), so
-          // a v1 sender's messages keep applying on a v2 node and vice
-          // versa (the r09 version gate is about what we EMIT).
+          // v1 or v2 framing by exact length (per_rx is a multiple of 4,
+          // the trace context is 13 bytes — the sizes can never coincide),
+          // so a v1 sender's messages keep applying on a v2 node and vice
+          // versa (the r09 version gate is about what we EMIT). The r11
+          // precision bit selects the frame width FIRST (per vs per+4W),
+          // so the two discriminations compose without ambiguity.
+          size_t per_rx = p2 ? per + (size_t)e->W * 4 : per;
           int32_t k = 0;
           const uint8_t* p = nullptr;
           const uint8_t* trace = nullptr;  // 13-byte context, if present
-          if (kind == kData && (size_t)n == kDataHdrV1 + per) {
+          if (kind == kData && (size_t)n == kDataHdrV1 + per_rx) {
             k = 1;
             p = buf.data() + kDataHdrV1;
-          } else if (kind == kData && (size_t)n == kDataHdrV2 + per) {
+          } else if (kind == kData && (size_t)n == kDataHdrV2 + per_rx) {
             k = 1;
             trace = buf.data() + kDataHdrV1;
             p = buf.data() + kDataHdrV2;
           } else if (kind == kBurst && n >= 6 && buf[5] > 0 &&
-                     (size_t)n == kBurstHdrV1 + (size_t)buf[5] * per) {
+                     (size_t)n == kBurstHdrV1 + (size_t)buf[5] * per_rx) {
             k = buf[5];
             p = buf.data() + kBurstHdrV1;
           } else if (kind == kBurst && n >= 19 && buf[5] > 0 &&
-                     (size_t)n == kBurstHdrV2 + (size_t)buf[5] * per) {
+                     (size_t)n == kBurstHdrV2 + (size_t)buf[5] * per_rx) {
             k = buf[5];
             trace = buf.data() + kBurstHdrV1;
             p = buf.data() + kBurstHdrV2;
           } else {
             continue;  // undecodable: seq not consumed, await retransmit
           }
+          // a precision change flushes the pending batch (apply_batch is
+          // homogeneous); rx_base tracking spans the flush safely
+          if (batchk > 0 && batch_prec != (p2 ? 2 : 1)) flush();
+          batch_prec = p2 ? 2 : 1;
           msgs++;
           if (trace) {
             std::memcpy(&tr_origin, trace, 4);
@@ -1289,14 +1696,15 @@ void receiver_loop(Engine* e) {
                            (tr_origin << 8) | (hop > 255 ? 255 : hop));
             }
           }
+          size_t wk = p2 ? (size_t)e->W * 2 : (size_t)e->W;  // words/frame
           for (int32_t f = 0; f < k; f++) {
             size_t bs = bscales.size(), bw = bwords.size();
             bscales.resize(bs + (size_t)e->L);
-            bwords.resize(bw + (size_t)e->W);
+            bwords.resize(bw + wk);
             std::memcpy(bscales.data() + bs, p, (size_t)e->L * 4);
             p += (size_t)e->L * 4;
-            std::memcpy(bwords.data() + bw, p, (size_t)e->W * 4);
-            p += (size_t)e->W * 4;
+            std::memcpy(bwords.data() + bw, p, wk * 4);
+            p += wk * 4;
             // trust boundary: non-finite scales become no-op leaves
             // (wire.decode_frame guard; quirk Q9's receive-path analog)
             for (int64_t i = 0; i < e->L; i++) {
@@ -1419,6 +1827,66 @@ __attribute__((visibility("default"))) void* st_engine_create(
   return e;
 }
 
+// r11 codec configuration — call between st_engine_create and
+// st_engine_start (the sender thread reads these unlocked; the tx-slot
+// ring is re-sized here for the widest message the mode can emit).
+// prec_mode: 0 = fixed 1-bit, 1 = telemetry-adaptive precision (the
+// governor upshifts capable links to sign2 when their residual RMS stops
+// decaying and downshifts quiet ones), 2 = fixed sign2 on capable links
+// (the A/B arm). cascade: frames quantized per memory pass (1 = the r10
+// per-frame re-measured schedule; >1 = halving cascade, stcodec.c r11).
+__attribute__((visibility("default"))) void st_engine_set_codec(
+    void* h, int32_t prec_mode, double up_ratio, double down_ratio,
+    double interval_sec, int32_t cascade) {
+  if (!h) return;
+  auto* e = (Engine*)h;
+  e->prec_mode = prec_mode == 1 || prec_mode == 2 ? prec_mode : 0;
+  if (up_ratio > 0) e->gov_up_ratio = up_ratio;
+  if (down_ratio > 0) e->gov_down_ratio = down_ratio;
+  if (interval_sec > 0) e->gov_interval = interval_sec;
+  e->cascade = cascade < 1 ? 1 : (cascade > 64 ? 64 : cascade);
+  if (e->prec_mode != 0 && !e->compat_bytes) {
+    // slots must fit the widest message either precision can emit: the
+    // sign2 burst is capped to the receive bound, which can exceed the
+    // 1-bit burst's bytes when the 1-bit cap was frame-count-limited
+    size_t per2 = frame_bytes(e) + (size_t)e->W * 4;
+    int64_t cap2 = ((int64_t)e->recv_cap - (int64_t)kBurstHdrV2) /
+                   (int64_t)per2;
+    if (cap2 < 1) cap2 = 1;
+    if (cap2 > e->burst) cap2 = e->burst;
+    size_t need = kBodyOff + (size_t)cap2 * per2;
+    if (need > e->txpool.slot_bytes) e->txpool.slot_bytes = need;
+  }
+}
+
+// r11: the peer on link_id advertised sign2 decode capability
+// (compat.SYNC_FLAG_SIGN2 / the WELCOME flags byte) — emission to it may
+// upshift. Without this call a link stays 1-bit forever (mixed-tree
+// safety default).
+__attribute__((visibility("default"))) int32_t st_engine_link_allow_sign2(
+    void* h, int32_t link_id, int32_t allow) {
+  if (!h) return 0;
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->links.find(link_id);
+  if (it == e->links.end()) return 0;
+  it->second.peer_sign2 = allow != 0;
+  return 1;
+}
+
+// r11: the governor's current precision choice for the link (1 or 2; 0 =
+// unknown link / closed engine) — the st_link_precision gauge.
+__attribute__((visibility("default"))) int32_t st_engine_link_precision(
+    void* h, int32_t link_id) {
+  if (!h) return 0;
+  auto* e = (Engine*)h;
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->links.find(link_id);
+  if (it == e->links.end()) return 0;
+  if (e->prec_mode == 2) return it->second.peer_sign2 ? 2 : 1;
+  return it->second.prec;
+}
+
 __attribute__((visibility("default"))) void st_engine_start(void* h) {
   // Every entry point NULL-checks its handle: a late ctypes call after
   // st_engine_destroy must no-op/return-empty, never dereference NULL —
@@ -1497,43 +1965,25 @@ __attribute__((visibility("default"))) void st_engine_add(void* h,
   if (!h) return;
   auto* e = (Engine*)h;
   {
-    std::lock_guard<std::mutex> lk(e->mu);
-    stc_accumulate_update_to(e->values.data(), e->values.data(), u,
+    // r11 staged add: accumulate sanitize+clip(u) into the pending buffer
+    // under add_mu ONLY — the trainer never waits on the data-plane
+    // mutex (a multi-pass message quantize used to hold it ~ms). The
+    // fold into values/residuals/carry — including the dead links whose
+    // residual is the re-graft carry, and the fused partials refresh —
+    // happens in fold_pending at the next data-plane safe point.
+    std::lock_guard<std::mutex> alk(e->add_mu);
+    if (e->upend.empty()) {
+      e->upend.assign((size_t)e->total, 0.0f);
+      e->ufold.assign((size_t)e->total, 0.0f);
+    }
+    stc_accumulate_update_to(e->upend.data(), e->upend.data(), u,
                              e->off.data(), e->ns.data(), e->padded.data(),
                              e->L);
-    // dead links included: their residual is the re-graft carry (see
-    // apply_batch). The fused-partials form refreshes each link's scale
-    // cache in the same pass (ELink::pvalid).
-    for (auto& kv : e->links) {
-      ELink& lk2 = kv.second;
-      if ((int64_t)lk2.pamax.size() != e->L) {
-        lk2.pamax.resize((size_t)e->L);
-        lk2.pss.resize((size_t)e->L);
-        lk2.psabs.resize((size_t)e->L);
-      }
-      stc_accumulate_update_to_partials(
-          lk2.resid.data(), lk2.resid.data(), u, e->off.data(), e->ns.data(),
-          e->padded.data(), e->L, lk2.pamax.data(), lk2.pss.data(),
-          lk2.psabs.data());
-      lk2.pvalid = true;
-      lk2.dirty = true;
-    }
-    if (e->has_carry)
-      stc_accumulate_update_to(e->carry.data(), e->carry.data(), u,
-                               e->off.data(), e->ns.data(), e->padded.data(),
-                               e->L);
-    e->updates++;
-    if (e->trace_wire) {
-      // a local update is a fresh generation: re-seed the pending stamp
-      // (origin = this node, generation = its monotonic birth time, 0
-      // hops). One clock read per add() — adds are orders of magnitude
-      // rarer than wire messages.
-      e->t_origin = e->obs_id;
-      e->t_gen = st_obs_now_ns();
-      e->t_hops = 0;
-      e->t_has = true;
-    }
+    if (e->trace_wire)
+      e->pend_gen.store(st_obs_now_ns(), std::memory_order_relaxed);
+    e->has_pending.store(true, std::memory_order_release);
   }
+  e->updates++;
   e->wake();
 }
 
@@ -1542,6 +1992,7 @@ __attribute__((visibility("default"))) void st_engine_read(void* h,
   if (!h) return;
   auto* e = (Engine*)h;
   std::lock_guard<std::mutex> lk(e->mu);
+  fold_pending(e);
   std::memcpy(out, e->values.data(), (size_t)e->total * 4);
 }
 
@@ -1557,6 +2008,7 @@ __attribute__((visibility("default"))) int32_t st_engine_attach(
   auto* e = (Engine*)h;
   {
     std::lock_guard<std::mutex> lk(e->mu);
+    fold_pending(e);  // the diff seed must include staged adds
     if (e->links.count(link_id)) return 0;  // already exists
     ELink& lk2 = e->links[link_id];
     lk2.resid.assign((size_t)e->total, 0.0f);
@@ -1595,6 +2047,7 @@ __attribute__((visibility("default"))) int32_t st_engine_attach_sub(
   if (e->compat_bytes) return 0;
   {
     std::lock_guard<std::mutex> lk(e->mu);
+    fold_pending(e);  // the sub seed must include staged adds
     if (e->links.count(link_id)) return 0;
     ELink& lk2 = e->links[link_id];
     lk2.resid.assign((size_t)e->total, 0.0f);
@@ -1642,6 +2095,7 @@ __attribute__((visibility("default"))) int32_t st_engine_compat_regraft(
   auto* e = (Engine*)h;
   {
     std::lock_guard<std::mutex> lk(e->mu);
+    fold_pending(e);
     if (e->links.count(link_id)) return 0;
     ELink& l = e->links[link_id];
     if (e->has_carry) {
@@ -1668,6 +2122,7 @@ __attribute__((visibility("default"))) int32_t st_engine_stash_carry(
   if (!h) return 0;
   auto* e = (Engine*)h;
   std::lock_guard<std::mutex> lk(e->mu);
+  fold_pending(e);
   auto it = e->links.find(link_id);
   if (it == e->links.end()) return 0;
   rollback_unacked(e, it->second);
@@ -1695,6 +2150,7 @@ __attribute__((visibility("default"))) int32_t st_engine_take_carry_and_snapshot
   if (!h) return 0;
   auto* e = (Engine*)h;
   std::lock_guard<std::mutex> lk(e->mu);
+  fold_pending(e);
   if (values_out)
     std::memcpy(values_out, e->values.data(), (size_t)e->total * 4);
   if (!e->has_carry) return 0;
@@ -1713,6 +2169,7 @@ __attribute__((visibility("default"))) int32_t st_engine_detach(
   if (!h) return 0;
   auto* e = (Engine*)h;
   std::lock_guard<std::mutex> lk(e->mu);
+  fold_pending(e);
   auto it = e->links.find(link_id);
   if (it == e->links.end()) return 0;
   rollback_unacked(e, it->second);
@@ -1732,7 +2189,9 @@ __attribute__((visibility("default"))) void st_engine_inject(
   auto* e = (Engine*)h;
   {
     std::lock_guard<std::mutex> lk(e->mu);
-    apply_batch(e, src_link, k, scales, words);
+    // externally-decoded frames are python-tier 1-bit (the serve/handshake
+    // paths never carry sign2)
+    apply_batch(e, src_link, k, scales, words, 1);
   }
   e->wake();
 }
@@ -1756,6 +2215,7 @@ __attribute__((visibility("default"))) double st_engine_residual_rms(
   if (!h) return 0.0;
   auto* e = (Engine*)h;
   std::lock_guard<std::mutex> lk(e->mu);
+  fold_pending(e);
   auto it = e->links.find(link_id);
   if (it == e->links.end()) {
     // the carry pseudo-slot (peer.CARRY_LINK == -1): an orphaned node's
@@ -1799,7 +2259,9 @@ __attribute__((visibility("default"))) int64_t st_engine_inflight(void* h) {
 // counters: [frames_out, frames_in, updates, msgs_out, msgs_in,
 //            tx_slot_acquires, tx_slot_alloc_events, tx_slots_allocated,
 //            retx_msgs, dedup_discards, rtt_ns_total, rtt_msgs,
-//            hops_sum, hops_msgs, staleness_ns_last, traced_msgs_in]
+//            hops_sum, hops_msgs, staleness_ns_last, traced_msgs_in,
+//            sub_msgs_out, sub_fresh_out,
+//            prec_upshifts, prec_downshifts, frames2_out, frames2_in]
 // [5..7] are the r07 tx-ring pool stats (steady state: acquires grow,
 // alloc_events flat); [8..11] are the r08 obs aggregates (go-back-N
 // retransmitted messages, dup/gap discards, and the ACK round-trip
@@ -1807,36 +2269,40 @@ __attribute__((visibility("default"))) int64_t st_engine_inflight(void* h) {
 // sum + sample count over applied traced messages, the most recent
 // apply-time staleness in ns, and the traced-message count); [16..17] the
 // r10 serving aggregates (unledgered subscriber data messages sent +
-// kFresh drain marks delivered — obs/schema.py names all of them
-// canonically).
+// kFresh drain marks delivered; [18..21] the r11 adaptive-precision
+// aggregates — obs/schema.py names all of them canonically).
 __attribute__((visibility("default"))) void st_engine_counters(
-    void* h, uint64_t* out18) {
+    void* h, uint64_t* out22) {
   if (!h) {  // the SIGSEGV that aborted the whole suite (r05 Weak #2)
-    for (int i = 0; i < 18; i++) out18[i] = 0;
+    for (int i = 0; i < 22; i++) out22[i] = 0;
     return;
   }
   auto* e = (Engine*)h;
-  out18[0] = e->frames_out.load();
-  out18[1] = e->frames_in.load();
-  out18[2] = e->updates.load();
-  out18[3] = e->msgs_out.load();
-  out18[4] = e->msgs_in.load();
-  out18[5] = e->txpool.acquires.load();
-  out18[6] = e->txpool.alloc_events.load();
+  out22[0] = e->frames_out.load();
+  out22[1] = e->frames_in.load();
+  out22[2] = e->updates.load();
+  out22[3] = e->msgs_out.load();
+  out22[4] = e->msgs_in.load();
+  out22[5] = e->txpool.acquires.load();
+  out22[6] = e->txpool.alloc_events.load();
   {
     std::lock_guard<std::mutex> lk(e->txpool.mu);
-    out18[7] = (uint64_t)e->txpool.all_.size();
+    out22[7] = (uint64_t)e->txpool.all_.size();
   }
-  out18[8] = e->retx_msgs.load();
-  out18[9] = e->dedup_discards.load();
-  out18[10] = e->rtt_ns_total.load();
-  out18[11] = e->rtt_msgs.load();
-  out18[12] = e->hops_sum.load();
-  out18[13] = e->hops_msgs.load();
-  out18[14] = e->staleness_ns_last.load();
-  out18[15] = e->traced_msgs_in.load();
-  out18[16] = e->sub_msgs_out.load();
-  out18[17] = e->sub_fresh_out.load();
+  out22[8] = e->retx_msgs.load();
+  out22[9] = e->dedup_discards.load();
+  out22[10] = e->rtt_ns_total.load();
+  out22[11] = e->rtt_msgs.load();
+  out22[12] = e->hops_sum.load();
+  out22[13] = e->hops_msgs.load();
+  out22[14] = e->staleness_ns_last.load();
+  out22[15] = e->traced_msgs_in.load();
+  out22[16] = e->sub_msgs_out.load();
+  out22[17] = e->sub_fresh_out.load();
+  out22[18] = e->prec_upshifts.load();
+  out22[19] = e->prec_downshifts.load();
+  out22[20] = e->frames2_out.load();
+  out22[21] = e->frames2_in.load();
 }
 
 // r09 per-link convergence telemetry: out2[0] = origin-stamp age (ns) of
@@ -1883,6 +2349,7 @@ __attribute__((visibility("default"))) void st_engine_restore(
   auto* e = (Engine*)h;
   {
     std::lock_guard<std::mutex> lk(e->mu);
+    fold_pending(e);  // pre-restore adds belong to the superseded state
     std::memcpy(e->values.data(), values, (size_t)e->total * 4);
     for (int32_t i = 0; i < n_links; i++) {
       if (ids[i] == -1) {  // the carry pseudo-slot (snapshot_all)
@@ -1912,6 +2379,7 @@ __attribute__((visibility("default"))) int32_t st_engine_snapshot_all(
   if (!h) return 0;
   auto* e = (Engine*)h;
   std::lock_guard<std::mutex> lk(e->mu);
+  fold_pending(e);
   std::memcpy(values_out, e->values.data(), (size_t)e->total * 4);
   int32_t n = 0;
   for (auto& kv : e->links) {

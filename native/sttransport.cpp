@@ -195,6 +195,10 @@ constexpr uint32_t kEvFaultTruncate = 23;
 constexpr uint32_t kEvFaultDelay = 24;
 constexpr uint32_t kEvFaultStall = 25;
 constexpr uint32_t kEvFaultSever = 26;
+// 32 (precision_shift) is emitted by stengine.cpp; 33 marks one stripe of
+// a striped link dying (arg = stripe index) while the link degrades to
+// the survivors.
+constexpr uint32_t kEvStripeDown = 33;
 // 30 (trace_apply) and 31 (sub_attach, r10 subscriber link mode) are
 // emitted by stengine.cpp; listed in obs/events.py CODE_NAMES like the
 // rest — the numeric values are ABI across all three surfaces.
@@ -293,6 +297,32 @@ constexpr uint32_t kMaxPayload = 1u << 30;  // 1 GiB sanity cap
 // mis-ack (old rule: undecodable still counts) or discard-and-churn; the
 // magic bump turns both into an explicit join rejection.
 constexpr char kMagic[4] = {'S', 'T', 'T', '3'};
+// r11 multi-socket link striping. A joiner that wants a striped link
+// sends the 'STT4' hello ([magic][u32 hint][u32 want_stripes]); the
+// acceptor replies 'Y' + [u8 granted][u64 token] and the joiner opens
+// granted-1 extra connections, each announcing itself with the 'STTS'
+// stripe hello ([magic][u64 token][u8 stripe_idx], ack 'y'). Per-stripe
+// framing gains an 8-byte stripe sequence after the length prefix
+// ([u32 len][u64 sseq][payload]; len == 0 keepalives stay 4 bytes), from
+// which the receiver reassembles the link's single in-order stream —
+// round-robin striping with per-message tags, so any stripe may carry any
+// message and a dead stripe's in-flight messages re-route to survivors.
+// stripe_count == 1 keeps the STT3 hello and the r10 framing byte-for-
+// byte (the compat escape hatch for joining pre-r11 trees); an STT4 hello
+// at a pre-r11 acceptor fails the magic check and is rejected, the same
+// explicit-breakage discipline as the STT3 bump itself.
+constexpr char kMagic4[4] = {'S', 'T', 'T', '4'};
+constexpr char kMagicS[4] = {'S', 'T', 'T', 'S'};
+constexpr int kMaxStripes = 8;
+// Reorder window: how far (in messages) one stripe may run ahead of the
+// link's in-order delivery point before its reader blocks — the
+// backpressure that bounds reassembly memory (a dead stripe holding the
+// window closed is eventually killed by its liveness timeout).
+constexpr uint64_t kReorderWindow = 4096;
+// Messages coalesced into one writev on the clean send path (faults and
+// pacing off): amortizes the syscall + wakeup cost across messages the
+// way the engine's bursts amortize framing.
+constexpr int kCoalesce = 8;
 
 // ---- fault injection (env-gated hook table; comm/faults.py to_env) -------
 //
@@ -319,6 +349,10 @@ struct FaultPlan {
   int64_t stall_after = -1;  // >=0: swallow data frames past the Nth, per link
   int64_t sever_after = 0;   // >0: hard-kill the link at its Nth data frame
   int32_t only_link = 0;     // >0: restrict ALL faults to this one link id
+  // >=0: restrict ALL faults to this stripe index of each (striped) link —
+  // the per-stripe chaos arm. sever_after then kills just that stripe
+  // (the link degrades to the survivors) instead of the whole link.
+  int32_t only_stripe = -1;
 };
 
 FaultPlan parse_fault_plan() {
@@ -346,6 +380,7 @@ FaultPlan parse_fault_plan() {
       else if (k == "stall_after") p.stall_after = (int64_t)v;
       else if (k == "sever_after") p.sever_after = (int64_t)v;
       else if (k == "only_link") p.only_link = (int32_t)v;
+      else if (k == "only_stripe") p.only_stripe = (int32_t)v;
     }
     i = j + 1;
   }
@@ -380,6 +415,7 @@ struct Config {
   // (blocking connect / fixed attempt count).
   double connect_timeout_sec = 5.0;
   double join_timeout_sec = 30.0;
+  int32_t stripe_count = 1;  // sockets per logical link (r11; 1..8)
   FaultPlan fault;  // env-gated wire chaos (parse_fault_plan)
 };
 
@@ -408,6 +444,12 @@ struct OutMsg {
   uint32_t zlen = 0;
   void (*release)(void*) = nullptr;
   void* ctx = nullptr;
+  // Stripe sequence (r11): stamped at enqueue (push_hook under the queue
+  // mutex), written on the wire after the length prefix of striped links,
+  // and the receiver's reassembly key. A re-enqueued message (its stripe
+  // died at write time) keeps its stamp — the receiver's window dedups if
+  // the dead socket had actually delivered it.
+  uint64_t sseq = 0;
 
   OutMsg() = default;
   OutMsg(const OutMsg&) = delete;
@@ -421,6 +463,7 @@ struct OutMsg {
       zlen = o.zlen;
       release = o.release;
       ctx = o.ctx;
+      sseq = o.sseq;
       o.zdata = nullptr;
       o.zlen = 0;
       o.release = nullptr;
@@ -449,11 +492,20 @@ class FrameQueue {
   explicit FrameQueue(size_t cap) : cap_(cap) {}
 
   bool push(T&& f, double timeout_sec) {
+    return push_hook(std::move(f), timeout_sec, [](T&) {});
+  }
+
+  // push with a stamp hook run under the queue mutex at insertion — the
+  // r11 stripe-seq stamp site (a failed/timed-out push runs no hook, so
+  // a stamped sequence is always eventually written).
+  template <typename F>
+  bool push_hook(T&& f, double timeout_sec, F&& hook) {
     std::unique_lock<std::mutex> lk(mu_);
     if (!not_full_.wait_for(lk, secs(timeout_sec),
                             [&] { return closed_ || q_.size() < cap_; }))
       return false;
     if (closed_) return false;
+    hook(f);
     q_.push_back(std::move(f));
     not_empty_.notify_one();
     return true;
@@ -530,13 +582,35 @@ class BufPool {
 // src/sharedtensor.c:113-189, minus the codec math which lives on-device).
 struct Link {
   int32_t id = -1;
-  int fd = -1;
+  int fd = -1;  // stripe 0's fd (kept for the pre-stripe call sites)
   int32_t is_uplink = 0;
   std::atomic<bool> alive{true};
-  // Two detached I/O threads own the link; the last one out closes the fd
-  // (closing it earlier could race a kernel fd-number reuse with the other
-  // thread's blocked read).
-  std::atomic<int> io_refs{2};
+  // r11 striping: up to kMaxStripes sockets carry this ONE logical link.
+  // stripe_fd[0] == fd; each ATTACHED stripe runs its own sender+receiver
+  // thread pair (the last of a stripe's two threads closes that stripe's
+  // fd — same fd-reuse rationale as the old io_refs). A stripe dies alone
+  // (kill_stripe: messages re-route, receiver reassembly skips nothing
+  // because sseq tags survive); the LAST live stripe's death is the
+  // link's.
+  int nstripes = 1;
+  int stripe_fd[kMaxStripes] = {-1, -1, -1, -1, -1, -1, -1, -1};
+  std::atomic<bool> stripe_ok[kMaxStripes] = {};
+  std::atomic<int> stripe_io[kMaxStripes] = {};
+  std::atomic<int> stripes_live{0};
+  std::atomic<uint64_t> stripe_deaths{0}, reroutes{0};
+  // tx stripe-seq allocator (stamped in push_hook / dup-injection)
+  std::atomic<uint64_t> sseq_next{0};
+  // rx reassembly (striped links only): out-of-order messages park in
+  // `reorder` until `rnext` arrives; `delivering` elects one drainer; the
+  // window condvar blocks readers that run too far ahead (backpressure).
+  std::mutex rmu;
+  std::condition_variable rcv;
+  std::map<uint64_t, std::vector<uint8_t>> reorder;
+  uint64_t rnext = 0;
+  bool delivering = false;
+  // stripe senders share the per-link fault-plan state below; the mutex
+  // is taken ONLY when the plan is enabled (chaos builds)
+  std::mutex fault_mu;
   FrameQueue<OutMsg> sendq;
   FrameQueue<std::vector<uint8_t>> recvq;
   // r07 buffer recycling: tx buffers cycle enqueue -> socket write -> free
@@ -565,8 +639,8 @@ struct Link {
 };
 
 struct Node;
-void link_sender_loop(Node* node, std::shared_ptr<Link> link);
-void link_receiver_loop(Node* node, std::shared_ptr<Link> link);
+void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx);
+void link_receiver_loop(Node* node, std::shared_ptr<Link> link, int sidx);
 void listener_loop(Node* node, int listen_fd);
 void rejoin_loop(Node* node);
 
@@ -588,6 +662,16 @@ struct Node {
   int lrcounter = 0;
   int32_t next_link_id = 1;
   int32_t uplink_id = -1;
+  // r11: accepted-but-not-yet-attached stripe grants (listener 'STT4'
+  // accept -> the joiner's 'STTS' stripe hellos resolve here). Guarded by
+  // mu; entries expire after connect_timeout-ish and are pruned lazily.
+  struct PendingStripe {
+    uint64_t token;
+    std::shared_ptr<Link> link;
+    Clock::time_point deadline;
+  };
+  std::vector<PendingStripe> pending_stripes;
+  uint64_t token_rng = 0;  // under mu (seeded at create)
 
   std::mutex ev_mu;
   std::deque<Event> events;
@@ -737,40 +821,55 @@ bool connect_with_timeout(int fd, const sockaddr_in* addr,
 
 // ---- link lifecycle ------------------------------------------------------
 
+// Spawn the I/O thread pair for one ATTACHED stripe (stripe 0 at
+// make_link; extra stripes as their sockets arrive — joiner's
+// open_stripes / acceptor's 'STTS' hello).
+void attach_stripe(Node* node, const std::shared_ptr<Link>& link, int sidx,
+                   int fd) {
+  link->stripe_fd[sidx] = fd;
+  link->stripe_io[sidx].store(2);
+  link->stripe_ok[sidx].store(true);
+  link->stripes_live++;
+  set_recv_timeout(fd, node->cfg.peer_timeout_sec);
+  node->active_threads += 2;
+  std::thread(link_sender_loop, node, link, sidx).detach();
+  std::thread(link_receiver_loop, node, link, sidx).detach();
+}
+
 std::shared_ptr<Link> make_link(Node* node, int fd, int32_t is_uplink,
-                                const sockaddr_in* peer) {
+                                const sockaddr_in* peer, int nstripes = 1) {
   auto link = std::make_shared<Link>((size_t)node->cfg.queue_depth);
+  if (nstripes < 1) nstripes = 1;
+  if (nstripes > kMaxStripes) nstripes = kMaxStripes;
   {
     std::lock_guard<std::mutex> lk(node->mu);
     link->id = node->next_link_id++;
     link->fd = fd;
+    link->nstripes = nstripes;
     link->is_uplink = is_uplink;
     if (peer) link->peer_addr = *peer;
     node->links[link->id] = link;
     if (is_uplink) node->uplink_id = link->id;
   }
-  set_recv_timeout(fd, node->cfg.peer_timeout_sec);
-  node->active_threads += 2;
-  std::thread(link_sender_loop, node, link).detach();
-  std::thread(link_receiver_loop, node, link).detach();
+  attach_stripe(node, link, 0, fd);
   node->emit(1, link->id, is_uplink);
   return link;
 }
 
-// Called at the end of each detached link-I/O thread.
-void link_io_exit(Node* node, const std::shared_ptr<Link>& link) {
-  if (--link->io_refs == 0) ::close(link->fd);
-  --node->active_threads;
-}
-
-// Tear down one link; the rest of the node keeps running (the fix for the
-// reference's exit(-1)-on-any-error model, src/sharedtensor.c:61-63).
+// Tear down one link (all stripes); the rest of the node keeps running
+// (the fix for the reference's exit(-1)-on-any-error model,
+// src/sharedtensor.c:61-63).
 void kill_link(Node* node, std::shared_ptr<Link> link) {
   bool was_alive = link->alive.exchange(false);
   if (!was_alive) return;
-  ::shutdown(link->fd, SHUT_RDWR);
+  for (int i = 0; i < link->nstripes; i++)
+    if (link->stripe_fd[i] >= 0) ::shutdown(link->stripe_fd[i], SHUT_RDWR);
   link->sendq.close();
   link->recvq.close();
+  {
+    std::lock_guard<std::mutex> lk(link->rmu);
+  }
+  link->rcv.notify_all();  // unblock window-waiting stripe readers
   bool was_uplink = false;
   {
     std::lock_guard<std::mutex> lk(node->mu);
@@ -783,81 +882,168 @@ void kill_link(Node* node, std::shared_ptr<Link> link) {
     node->links.erase(link->id);
   }
   node->emit(2, link->id, was_uplink ? 1 : 0);
-  // fd is closed by the last I/O thread to exit (link_io_exit); shutdown()
-  // above already unblocked both.
+  // fds are closed by each stripe's last I/O thread (stripe_io_exit);
+  // shutdown() above already unblocked them all.
 }
 
-void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
-  // token bucket for the bandwidth cap (reference README.md:31 TODO)
+// Tear down ONE stripe; the link degrades to the survivors (in-flight
+// messages re-route by stripe-seq), and the LAST stripe's death is the
+// link's.
+void kill_stripe(Node* node, std::shared_ptr<Link> link, int sidx) {
+  bool was = link->stripe_ok[sidx].exchange(false);
+  if (!was) return;
+  ::shutdown(link->stripe_fd[sidx], SHUT_RDWR);
+  link->rcv.notify_all();
+  if (--link->stripes_live <= 0) {
+    // the LAST stripe's death is the link's (link_down event), and an
+    // unstriped link's only teardown path runs through here too —
+    // neither is a degradation, so neither counts a stripe death
+    kill_link(node, link);
+    return;
+  }
+  link->stripe_deaths++;
+  st_obs_emit(node->obs_id, stobs::kEvStripeDown, link->id, (uint64_t)sidx);
+}
+
+// Called at the end of each detached stripe-I/O thread.
+void stripe_io_exit(Node* node, const std::shared_ptr<Link>& link,
+                    int sidx) {
+  if (--link->stripe_io[sidx] == 0) ::close(link->stripe_fd[sidx]);
+  --node->active_threads;
+}
+
+// Re-enqueue a message whose stripe died before (or during) its write: a
+// surviving stripe picks it up, same stripe-seq — the receiver's window
+// dedups if the dead socket had in fact delivered it. Dropped (released
+// by the destructor) only if the whole link is gone.
+void requeue_msg(Node* node, const std::shared_ptr<Link>& link,
+                 OutMsg&& m) {
+  link->reroutes++;
+  while (link->alive && !node->closing) {
+    if (link->sendq.push(std::move(m), 0.1)) return;
+  }
+}
+
+void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
+  const bool striped = link->nstripes > 1;
+  const int fd = link->stripe_fd[sidx];
+  // token bucket for the bandwidth cap (reference README.md:31 TODO);
+  // striped links split the budget evenly across stripe senders
   double tokens = 0;
   auto last = Clock::now();
-  const int64_t cap = node->cfg.bandwidth_cap_bps;
+  const int64_t cap =
+      node->cfg.bandwidth_cap_bps / (striped ? link->nstripes : 1);
+  const FaultPlan& fp = node->cfg.fault;
 
   OutMsg msg;
-  while (link->alive && !node->closing) {
+  while (link->alive && link->stripe_ok[sidx].load() && !node->closing) {
     bool have = link->sendq.pop(&msg, node->cfg.keepalive_sec);
     if (!link->alive || node->closing) break;
+    if (!link->stripe_ok[sidx].load()) {
+      if (have && striped) requeue_msg(node, link, std::move(msg));
+      break;
+    }
     if (!have) {
-      // idle: emit liveness traffic. Native: zero-length keepalive frame.
-      // Compat: a zero-scale codec frame — the reference's own idle
-      // behavior (quirk Q2), which its peers expect.
+      // idle: emit liveness traffic on THIS stripe. Native: zero-length
+      // keepalive frame (4 bytes, never a stripe seq). Compat: a
+      // zero-scale codec frame — the reference's own idle behavior
+      // (quirk Q2), which its peers expect.
       msg.reset();
+      bool kok;
       if (node->cfg.wire_compat) {
         bool hit;
         msg.owned = link->tx_pool.get(&hit);
         msg.owned.assign((size_t)node->cfg.compat_frame_bytes, 0);
+        kok = write_full(fd, msg.owned.data(), msg.owned.size());
+        link->bytes_out += msg.owned.size();
+        if (msg.owned.capacity()) {
+          link->tx_pool.put(std::move(msg.owned));
+          msg.owned = std::vector<uint8_t>();
+        }
       } else {
-        msg.owned.clear();
+        uint8_t z[4] = {0, 0, 0, 0};
+        kok = write_full(fd, z, 4);
+        link->bytes_out += 4;
       }
+      if (!kok) break;
+      continue;
     }
     // ---- fault injection at the wire boundary (Config::fault; the
     // Python tier injects the identical classes in peer._send_blocking).
-    // Data frames only: native kind 0/7, or any queued payload in compat
-    // mode (compat has no control plane on the wire). A keepalive (!have)
-    // is liveness, not data — chaos never silences liveness.
+    // Data frames only: native kind 0/7/11 (incl. the r11 0x80 precision
+    // bit), or any queued payload in compat mode. Keepalives are
+    // liveness, not data — chaos never silences liveness. Stripe senders
+    // share the per-link schedule state under fault_mu (plan-enabled
+    // builds only); only_stripe >= 0 confines every class to that stripe.
     size_t write_len = msg.size();
     int write_reps = 1;
-    const FaultPlan& fp = node->cfg.fault;
-    if (fp.enabled && have) {
+    if (fp.enabled) {
       const uint8_t* d = msg.data();
-      // data kinds: DATA(0), BURST(7), and the r10 range-filtered RDATA(11)
-      // — a subscriber's delta stream must face the same chaos classes as
-      // a writer's, or the serve-tier drop arm would inject nothing
+      uint8_t kind0 = msg.size() > 0 ? (uint8_t)(d[0] & 0x7F) : 0xFF;
       bool is_data = node->cfg.wire_compat ||
                      (msg.size() > 0 &&
-                      (d[0] == 0 || d[0] == 7 || d[0] == 11));
-      if (is_data && (fp.only_link <= 0 || link->id == fp.only_link)) {
+                      (kind0 == 0 || kind0 == 7 || kind0 == 11));
+      if (is_data && (fp.only_link <= 0 || link->id == fp.only_link) &&
+          (fp.only_stripe < 0 || sidx == fp.only_stripe)) {
+        std::unique_lock<std::mutex> flk(link->fault_mu);
         if (!link->fault_rng)
           link->fault_rng =
               (fp.seed + 1) * 0x9e3779b97f4a7c15ull + (uint64_t)link->id;
         int64_t nf = ++link->fault_frames;
-        if (fp.sever_after > 0 && nf >= fp.sever_after) {  // kill_link below
+        uint64_t* rng = &link->fault_rng;
+        if (fp.sever_after > 0 && nf >= fp.sever_after) {
           st_obs_emit(node->obs_id, stobs::kEvFaultSever, link->id,
                       (uint64_t)nf);
+          flk.unlock();
+          if (striped && fp.only_stripe >= 0) {
+            // per-stripe sever: THIS socket dies, the link degrades to
+            // the surviving stripes; the in-hand message re-routes.
+            // Kill the stripe FIRST: if this was the LAST stripe, the
+            // link dies and requeue_msg drops instead of spinning on a
+            // full sendq no surviving sender will ever drain.
+            kill_stripe(node, link, sidx);
+            requeue_msg(node, link, std::move(msg));
+            break;
+          }
+          kill_link(node, link);
           break;
         }
         if (fp.stall_after >= 0 && nf > fp.stall_after) {
           // swallowed: sender layers believe it was delivered (a borrowed
-          // slot is still released — via msg's reuse/destruction)
+          // slot is still released — via msg's reuse/destruction). On a
+          // striped link the swallowed stripe seq additionally wedges
+          // reassembly, so the link presents as a black hole until the
+          // engine's go-back-N tears it down — the stall contract.
           st_obs_emit(node->obs_id, stobs::kEvFaultStall, link->id,
                       (uint64_t)nf);
           msg.reset();
           continue;
         }
-        if (fp.delay_pct > 0 && frand64(&link->fault_rng) < fp.delay_pct) {
+        if (fp.delay_pct > 0 && frand64(rng) < fp.delay_pct) {
           st_obs_emit(node->obs_id, stobs::kEvFaultDelay, link->id,
                       (uint64_t)fp.delay_ms);
+          flk.unlock();
           std::this_thread::sleep_for(
               std::chrono::duration<double>(fp.delay_ms / 1000.0));
+          flk.lock();
         }
-        if (fp.drop > 0 && frand64(&link->fault_rng) < fp.drop) {
+        if (fp.drop > 0 && frand64(rng) < fp.drop) {
           st_obs_emit(node->obs_id, stobs::kEvFaultDrop, link->id,
                       (uint64_t)nf);
-          msg.reset();
-          continue;
+          if (!striped) {
+            msg.reset();
+            continue;
+          }
+          // striped links must not leave a HOLE in the stripe-seq space
+          // (reassembly would wedge the whole link on one injected drop):
+          // a dropped message goes out as a 1-byte runt instead — the
+          // receiver's decode rejects it without consuming the ENGINE
+          // seq, so recovery is the same go-back-N retransmission as a
+          // true drop.
+          write_len = 1;
         }
-        if (fp.corrupt > 0 && msg.size() > 1 &&
-            frand64(&link->fault_rng) < fp.corrupt) {
+        if (fp.corrupt > 0 && msg.size() > 1 && write_len > 1 &&
+            frand64(rng) < fp.corrupt) {
           // flip one bit past the kind byte: lands in scales/words, the
           // receiver's decode-guard trust boundary. COPY-ON-WRITE for a
           // borrowed (zero-copy) payload: its bytes ARE the engine's
@@ -869,23 +1055,20 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
             msg.zdata = nullptr;  // release still fires at reset()
             msg.zlen = 0;
           }
-          size_t i = 1 + (size_t)(frand64(&link->fault_rng) *
-                                  (msg.owned.size() - 1));
+          size_t i = 1 + (size_t)(frand64(rng) * (msg.owned.size() - 1));
           if (i >= msg.owned.size()) i = msg.owned.size() - 1;
-          msg.owned[i] ^=
-              (uint8_t)(1u << (int)(frand64(&link->fault_rng) * 8));
+          msg.owned[i] ^= (uint8_t)(1u << (int)(frand64(rng) * 8));
           st_obs_emit(node->obs_id, stobs::kEvFaultCorrupt, link->id,
                       (uint64_t)i);
         }
         if (fp.trunc > 0 && !node->cfg.wire_compat && msg.size() > 2 &&
-            frand64(&link->fault_rng) < fp.trunc) {
+            write_len == msg.size() && frand64(rng) < fp.trunc) {
           // well-framed SHORT message (header announces the truncated
           // length): the receiver decodes, rejects, and ACKs it —
           // bounded per-frame loss, not a stream shear. Compat framing
           // is fixed-size, so truncation there would desync every later
           // frame; disabled.
-          write_len =
-              1 + (size_t)(frand64(&link->fault_rng) * (msg.size() - 1));
+          write_len = 1 + (size_t)(frand64(rng) * (msg.size() - 1));
           if (write_len > msg.size()) write_len = msg.size();
           st_obs_emit(node->obs_id, stobs::kEvFaultTruncate, link->id,
                       (uint64_t)write_len);
@@ -894,7 +1077,7 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
         // seq dedup, so a duplicated compat frame would double-apply with
         // no recovery path (comm/faults.py FaultPlan.wire_compat)
         if (fp.dup > 0 && !node->cfg.wire_compat &&
-            frand64(&link->fault_rng) < fp.dup) {
+            frand64(rng) < fp.dup) {
           write_reps = 2;
           st_obs_emit(node->obs_id, stobs::kEvFaultDup, link->id,
                       (uint64_t)nf);
@@ -917,55 +1100,167 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link) {
         tokens -= (double)msg.size();
       }
     }
+    // ---- batched submission (r11): on the clean native path (no fault
+    // plan, no pacing) opportunistically gather more queued messages and
+    // put the whole batch on the wire in ONE writev — length prefixes,
+    // stripe seqs and payloads (borrowed ring slots included) gather
+    // without copies, amortizing the syscall/wakeup cost per message.
+    OutMsg batch[kCoalesce];
+    int nb = 1;
+    batch[0] = std::move(msg);
+    if (!node->cfg.wire_compat && !fp.enabled && cap <= 0) {
+      while (nb < kCoalesce && link->sendq.pop(&batch[nb], 0.0)) nb++;
+    }
     bool ok = true;
-    for (int rep = 0; rep < write_reps && ok; rep++) {
-      if (node->cfg.wire_compat) {
-        ok = write_full(link->fd, msg.data(), write_len);
-      } else {
-        // one writev: [u32le length][payload] — the length prefix and the
-        // payload (possibly a borrowed ring slot) gather in one syscall
-        uint32_t len = (uint32_t)write_len;
-        uint8_t hdr[4] = {(uint8_t)len, (uint8_t)(len >> 8),
-                          (uint8_t)(len >> 16), (uint8_t)(len >> 24)};
-        struct iovec iov[2];
-        iov[0].iov_base = hdr;
-        iov[0].iov_len = 4;
-        iov[1].iov_base = (void*)msg.data();
-        iov[1].iov_len = write_len;
-        ok = writev_full(link->fd, iov, write_len ? 2 : 1);
+    if (node->cfg.wire_compat) {
+      for (int rep = 0; rep < write_reps && ok; rep++)
+        ok = write_full(fd, batch[0].data(), write_len);
+    } else {
+      // striped framing: [u32 len][u64 sseq][payload]; legacy: [len][..]
+      uint8_t hdrs[2 * kCoalesce][12];
+      struct iovec iov[4 * kCoalesce];
+      int niov = 0, nh = 0;
+      for (int rep = 0; rep < write_reps; rep++) {
+        for (int i = 0; i < nb; i++) {
+          size_t wl = i == 0 ? write_len : batch[i].size();
+          uint64_t sq = batch[i].sseq;
+          if (rep > 0) {
+            // an injected duplicate is a NEW transport message (fresh
+            // stripe seq) carrying the same engine payload — the
+            // engine-level dedup is what the fault exercises, and the
+            // stripe window must not swallow it first
+            sq = link->sseq_next.fetch_add(1, std::memory_order_relaxed);
+          }
+          uint8_t* H = hdrs[nh++];
+          uint32_t len = (uint32_t)wl;
+          std::memcpy(H, &len, 4);
+          size_t hlen = 4;
+          if (striped) {
+            std::memcpy(H + 4, &sq, 8);
+            hlen = 12;
+          }
+          iov[niov].iov_base = H;
+          iov[niov].iov_len = hlen;
+          niov++;
+          if (wl) {
+            iov[niov].iov_base = (void*)batch[i].data();
+            iov[niov].iov_len = wl;
+            niov++;
+          }
+        }
       }
+      ok = writev_full(fd, iov, niov);
     }
-    if (!ok) break;
-    if (have) {
-      // compat: one queued payload may carry K concatenated fixed-size
-      // frames (the engine's compat bursts) — count the frames actually
-      // put on the wire, so sender wire counts reconcile with both the
-      // receiver's per-frame re-framing and the engine's per-frame
-      // delivery counters (peer.metrics() taxonomy).
-      link->frames_out += node->cfg.wire_compat
-                              ? msg.size() /
-                                    (size_t)node->cfg.compat_frame_bytes
-                              : 1;
-    }
-    link->bytes_out += msg.size() + (node->cfg.wire_compat ? 0 : 4);
-    // recycle: borrowed slots go back to their ring (reset -> release);
-    // owned buffers go back to the link's tx free-list, capacity warm
-    if (msg.release) {
-      msg.reset();
-    } else if (msg.owned.capacity()) {
-      link->tx_pool.put(std::move(msg.owned));
-      msg.owned = std::vector<uint8_t>();
+    if (ok) {
+      for (int i = 0; i < nb; i++) {
+        // compat: one queued payload may carry K concatenated fixed-size
+        // frames (the engine's compat bursts) — count the frames actually
+        // put on the wire, so sender wire counts reconcile with both the
+        // receiver's per-frame re-framing and the engine's per-frame
+        // delivery counters (peer.metrics() taxonomy).
+        link->frames_out +=
+            node->cfg.wire_compat
+                ? batch[i].size() / (size_t)node->cfg.compat_frame_bytes
+                : 1;
+        link->bytes_out += batch[i].size() +
+                           (node->cfg.wire_compat ? 0 : (striped ? 12 : 4));
+        // recycle: borrowed slots go back to their ring (reset ->
+        // release); owned buffers to the link's tx free-list
+        if (batch[i].release) {
+          batch[i].reset();
+        } else if (batch[i].owned.capacity()) {
+          link->tx_pool.put(std::move(batch[i].owned));
+          batch[i].owned = std::vector<uint8_t>();
+        }
+      }
+    } else {
+      if (striped) {
+        // the socket died mid-batch: every message in hand re-routes to
+        // the surviving stripes (delivery-uncertain ones dedup at the
+        // receiver's reassembly window). Kill the stripe BEFORE
+        // requeueing: if this was the LAST stripe the link dies with it
+        // and requeue_msg drops the batch instead of livelocking on a
+        // full sendq that no surviving sender thread will ever drain
+        // (go-back-N re-delivers after the re-graft either way).
+        kill_stripe(node, link, sidx);
+        for (int i = 0; i < nb; i++)
+          requeue_msg(node, link, std::move(batch[i]));
+      }
+      break;
     }
   }
-  // a message popped (or half-processed) when the link died is released by
-  // msg's destructor; messages still queued are released when the Link —
-  // and with it the sendq deque — is destroyed after both I/O threads exit
-  kill_link(node, link);
-  link_io_exit(node, link);
+  // a message popped (or half-processed) when the stripe died is released
+  // by msg's/batch's destructors (or re-routed above); messages still
+  // queued are released when the Link — and with it the sendq deque — is
+  // destroyed after every I/O thread exits
+  kill_stripe(node, link, sidx);
+  stripe_io_exit(node, link, sidx);
 }
 
-void link_receiver_loop(Node* node, std::shared_ptr<Link> link) {
-  while (link->alive && !node->closing) {
+// Deliver one striped message into the link's in-order stream: park it in
+// the reorder map, then drain the consecutive run into recvq (one elected
+// drainer at a time — `delivering`). Returns false when the link must die
+// (queue closed under us).
+bool deliver_striped(Node* node, const std::shared_ptr<Link>& link,
+                     uint64_t sseq, std::vector<uint8_t>&& frame) {
+  std::unique_lock<std::mutex> lk(link->rmu);
+  // window backpressure: a stripe that runs too far ahead of the in-order
+  // point blocks here (bounding reassembly memory) until delivery
+  // advances — or its own liveness timeout kills it if rnext's stripe is
+  // truly dead
+  while (link->alive && !node->closing &&
+         sseq > link->rnext + kReorderWindow) {
+    link->rcv.wait_for(lk, std::chrono::milliseconds(100));
+  }
+  if (!link->alive || node->closing) return false;
+  if (sseq < link->rnext || link->reorder.count(sseq)) {
+    // duplicate of an already-delivered/parked message (a re-routed
+    // write whose first copy did land): drop, recycle the buffer
+    link->rx_pool.put(std::move(frame));
+    return true;
+  }
+  link->reorder.emplace(sseq, std::move(frame));
+  if (link->delivering) return true;
+  link->delivering = true;
+  while (!link->reorder.empty()) {
+    auto it = link->reorder.begin();
+    if (it->first < link->rnext) {
+      // a re-routed duplicate of the message the drainer had in flight
+      // (sseq == rnext while the lock was dropped for the recvq push, so
+      // the dedup check above missed it): already delivered — drop it,
+      // or this stale head blocks the == rnext test below forever
+      link->rx_pool.put(std::move(it->second));
+      link->reorder.erase(it);
+      continue;
+    }
+    if (it->first != link->rnext) break;
+    std::vector<uint8_t> f = std::move(it->second);
+    link->reorder.erase(it);
+    lk.unlock();
+    bool pushed = false;
+    while (link->alive && !node->closing) {
+      if (link->recvq.push(std::move(f), 0.5)) {
+        node->notify_data();
+        pushed = true;
+        break;
+      }
+    }
+    lk.lock();
+    if (!pushed) {
+      link->delivering = false;
+      return false;
+    }
+    link->rnext++;
+    link->rcv.notify_all();  // window waiters may proceed
+  }
+  link->delivering = false;
+  return true;
+}
+
+void link_receiver_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
+  const bool striped = link->nstripes > 1;
+  const int fd = link->stripe_fd[sidx];
+  while (link->alive && link->stripe_ok[sidx].load() && !node->closing) {
     // decode-side pool (r07): recycle rx buffers through the free list so
     // the steady state reads into warm, already-sized memory — the old
     // fresh-vector-per-message path paid an allocation plus page faults
@@ -974,24 +1269,34 @@ void link_receiver_loop(Node* node, std::shared_ptr<Link> link) {
     std::vector<uint8_t> frame = link->rx_pool.get(&hit);
     node->rx_acquires++;
     if (!hit) node->rx_pool_misses++;
+    uint64_t sseq = 0;
     if (node->cfg.wire_compat) {
       frame.resize((size_t)node->cfg.compat_frame_bytes);
-      if (!read_full(link->fd, frame.data(), frame.size())) break;
+      if (!read_full(fd, frame.data(), frame.size())) break;
     } else {
-      uint8_t hdr[4];
-      if (!read_full(link->fd, hdr, 4)) break;
+      uint8_t hdr[12];
+      if (!read_full(fd, hdr, 4)) break;
       uint32_t len = (uint32_t)hdr[0] | ((uint32_t)hdr[1] << 8) |
                      ((uint32_t)hdr[2] << 16) | ((uint32_t)hdr[3] << 24);
       if (len > kMaxPayload) break;  // protocol violation
-      if (len == 0) {                // keepalive
+      if (len == 0) {                // keepalive (no stripe seq)
         link->rx_pool.put(std::move(frame));
         continue;
       }
+      if (striped) {
+        if (!read_full(fd, hdr + 4, 8)) break;
+        std::memcpy(&sseq, hdr + 4, 8);
+      }
       frame.resize(len);
-      if (!read_full(link->fd, frame.data(), len)) break;
+      if (!read_full(fd, frame.data(), len)) break;
     }
-    link->bytes_in += frame.size() + (node->cfg.wire_compat ? 0 : 4);
+    link->bytes_in +=
+        frame.size() + (node->cfg.wire_compat ? 0 : (striped ? 12 : 4));
     link->frames_in++;
+    if (striped) {
+      if (!deliver_striped(node, link, sseq, std::move(frame))) break;
+      continue;
+    }
     // Block if the consumer is behind: TCP backpressure then paces the
     // peer, exactly like the reference's blocking frame loop. Never drop:
     // frames are cumulative deltas.
@@ -1002,9 +1307,9 @@ void link_receiver_loop(Node* node, std::shared_ptr<Link> link) {
       }
     }
   }
-  kill_link(node, link);
+  kill_stripe(node, link, sidx);
   node->notify_data();  // wake blocked consumers so they observe the death
-  link_io_exit(node, link);
+  stripe_io_exit(node, link, sidx);
 }
 
 // ---- topology: listener (reference do_listening, src/sharedtensor.c:
@@ -1026,13 +1331,75 @@ void listener_loop(Node* node, int listen_fd) {
     }
     set_common_sockopts(fd);
 
+    bool v4 = false;
+    int want_stripes = 1;
     if (!node->cfg.wire_compat) {
-      // native hello: magic + payload hint
-      uint8_t hello[8];
+      // native hello: magic, then the magic-specific tail (STT3: u32
+      // hint; STT4: u32 hint + u32 want_stripes; STTS: u64 token + u8
+      // stripe idx — an extra socket attaching to an accepted link)
+      uint8_t magic[4];
       set_recv_timeout(fd, 5.0);
-      if (!read_full(fd, hello, 8) || memcmp(hello, kMagic, 4) != 0) {
+      if (!read_full(fd, magic, 4)) {
         ::close(fd);
         continue;
+      }
+      if (memcmp(magic, kMagicS, 4) == 0) {
+        uint8_t rest[9];
+        if (!read_full(fd, rest, 9)) {
+          ::close(fd);
+          continue;
+        }
+        uint64_t token;
+        std::memcpy(&token, rest, 8);
+        int idx = rest[8];
+        std::shared_ptr<Link> sl;
+        {
+          std::lock_guard<std::mutex> lk(node->mu);
+          auto now = Clock::now();
+          auto& ps = node->pending_stripes;
+          for (size_t i = 0; i < ps.size();) {
+            if (ps[i].deadline < now || !ps[i].link->alive) {
+              ps.erase(ps.begin() + i);
+              continue;
+            }
+            if (ps[i].token == token) sl = ps[i].link;
+            i++;
+          }
+        }
+        // reject any index EVER attached (fd stays >= 0 after death; only
+        // this acceptor thread writes it for accepted links): a stripe
+        // death is permanent by design, and a replayed STTS re-attaching
+        // a dead index would reset stripe_io to 2 while the dead pair's
+        // exits still owe decrements — driving the refcount to 0 early
+        // and closing the NEW fd out from under its fresh I/O threads.
+        if (!sl || idx < 1 || idx >= sl->nstripes || !sl->alive ||
+            sl->stripe_fd[idx] >= 0) {
+          ::close(fd);
+          continue;
+        }
+        uint8_t yy = 'y';
+        if (!write_full(fd, &yy, 1)) {
+          ::close(fd);
+          continue;
+        }
+        attach_stripe(node, sl, idx, fd);
+        continue;
+      }
+      v4 = memcmp(magic, kMagic4, 4) == 0;
+      if (!v4 && memcmp(magic, kMagic, 4) != 0) {
+        ::close(fd);
+        continue;
+      }
+      uint8_t rest[8];
+      if (!read_full(fd, rest, v4 ? 8 : 4)) {
+        ::close(fd);
+        continue;
+      }
+      if (v4) {
+        uint32_t w;
+        std::memcpy(&w, rest + 4, 4);
+        want_stripes =
+            (int)(w < 1 ? 1 : (w > (uint32_t)kMaxStripes ? kMaxStripes : w));
       }
     }
 
@@ -1060,14 +1427,42 @@ void listener_loop(Node* node, int listen_fd) {
       }
     }
     if (slot >= 0) {
-      uint8_t y = 'Y';
-      if (!write_full(fd, &y, 1)) {
-        ::close(fd);
-        continue;
+      if (v4) {
+        // STT4 accept: 'Y' + [u8 granted][u64 token]; the joiner opens
+        // granted-1 extra sockets that attach via the STTS hello above
+        uint64_t token;
+        {
+          std::lock_guard<std::mutex> lk(node->mu);
+          node->token_rng ^= (uint64_t)fd * 0x9e3779b97f4a7c15ull;
+          frand64(&node->token_rng);
+          token = node->token_rng;
+        }
+        uint8_t reply[10];
+        reply[0] = 'Y';
+        reply[1] = (uint8_t)want_stripes;
+        std::memcpy(reply + 2, &token, 8);
+        if (!write_full(fd, reply, 10)) {
+          ::close(fd);
+          continue;
+        }
+        auto link = make_link(node, fd, /*is_uplink=*/0, &peer, want_stripes);
+        std::lock_guard<std::mutex> lk(node->mu);
+        node->child_slot[slot] = link;
+        if (want_stripes > 1)
+          node->pending_stripes.push_back(
+              {token, link,
+               Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                  std::chrono::seconds(15))});
+      } else {
+        uint8_t y = 'Y';
+        if (!write_full(fd, &y, 1)) {
+          ::close(fd);
+          continue;
+        }
+        auto link = make_link(node, fd, /*is_uplink=*/0, &peer);
+        std::lock_guard<std::mutex> lk(node->mu);
+        node->child_slot[slot] = link;
       }
-      auto link = make_link(node, fd, /*is_uplink=*/0, &peer);
-      std::lock_guard<std::mutex> lk(node->mu);
-      node->child_slot[slot] = link;
     } else if (redirect_to) {
       uint8_t n = 'N';
       sockaddr_in addr = redirect_to->peer_addr;
@@ -1088,8 +1483,16 @@ void listener_loop(Node* node, int listen_fd) {
 // redirects). Returns connected fd + the local endpoint of that socket, or
 // -1 with *became_master=true when nobody answers at the rendezvous.
 int join_walk(Node* node, sockaddr_in target, bool allow_master,
-              bool* became_master, sockaddr_in* local_endpoint) {
+              bool* became_master, sockaddr_in* local_endpoint,
+              int* out_granted, uint64_t* out_token,
+              sockaddr_in* out_final) {
   *became_master = false;
+  if (out_granted) *out_granted = 1;
+  if (out_token) *out_token = 0;
+  // STT4 hello iff this node wants stripes (a pre-r11 acceptor rejects it
+  // — explicit breakage, the magic-bump discipline; stripe_count=1 keeps
+  // the r10 wire byte-for-byte)
+  const bool v4 = !node->cfg.wire_compat && node->cfg.stripe_count > 1;
   for (int hops = 0; hops < 64; hops++) {
     int fd = ::socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -1;
@@ -1108,11 +1511,15 @@ int join_walk(Node* node, sockaddr_in target, bool allow_master,
       return -1;
     }
     if (!node->cfg.wire_compat) {
-      uint8_t hello[8];
-      memcpy(hello, kMagic, 4);
+      uint8_t hello[12];
+      memcpy(hello, v4 ? kMagic4 : kMagic, 4);
       uint32_t hint = (uint32_t)node->cfg.compat_frame_bytes;
       memcpy(hello + 4, &hint, 4);
-      if (!write_full(fd, hello, 8)) {
+      if (v4) {
+        uint32_t w = (uint32_t)node->cfg.stripe_count;
+        memcpy(hello + 8, &w, 4);
+      }
+      if (!write_full(fd, hello, v4 ? 12 : 8)) {
         ::close(fd);
         return -1;
       }
@@ -1130,6 +1537,20 @@ int join_walk(Node* node, sockaddr_in target, bool allow_master,
       return -1;
     }
     if (reply == 'Y') {
+      if (v4) {
+        // STT4 accept tail: [u8 granted][u64 token]
+        uint8_t ext[9];
+        if (!read_full(fd, ext, 9)) {
+          ::close(fd);
+          return -1;
+        }
+        int g = ext[0];
+        if (g < 1) g = 1;
+        if (g > kMaxStripes) g = kMaxStripes;
+        if (out_granted) *out_granted = g;
+        if (out_token) std::memcpy(out_token, ext + 1, 8);
+      }
+      if (out_final) *out_final = target;
       socklen_t len = sizeof *local_endpoint;
       getsockname(fd, (sockaddr*)local_endpoint, &len);
       set_recv_timeout(fd, node->cfg.peer_timeout_sec);
@@ -1148,6 +1569,37 @@ int join_walk(Node* node, sockaddr_in target, bool allow_master,
     target = next;
   }
   return -1;
+}
+
+// Open the granted-1 extra stripe sockets toward the accepting hop and
+// attach each via the STTS hello. A stripe that fails to connect/ack is
+// simply skipped — the link runs on whatever attached (degraded from
+// birth beats no link).
+void open_stripes(Node* node, const std::shared_ptr<Link>& link,
+                  sockaddr_in target, uint64_t token, int granted) {
+  for (int i = 1; i < granted && !node->closing && link->alive; i++) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) continue;
+    set_common_sockopts(fd);
+    if (!connect_with_timeout(fd, &target, node->cfg.connect_timeout_sec)) {
+      ::close(fd);
+      continue;
+    }
+    uint8_t hello[13];
+    memcpy(hello, kMagicS, 4);
+    std::memcpy(hello + 4, &token, 8);
+    hello[12] = (uint8_t)i;
+    uint8_t ack = 0;
+    set_recv_timeout(fd, node->cfg.connect_timeout_sec > 0
+                             ? node->cfg.connect_timeout_sec
+                             : 10.0);
+    if (!write_full(fd, hello, 13) || !read_full(fd, &ack, 1) ||
+        ack != 'y') {
+      ::close(fd);
+      continue;
+    }
+    attach_stripe(node, link, i, fd);
+  }
 }
 
 // Uplink died: re-graft through the rendezvous (fixes reference quirk Q8 —
@@ -1189,10 +1641,14 @@ void rejoin_loop(Node* node) {
           (0.5 + frand64(&node->jrng))));
       bool became_master = false;
       sockaddr_in local{};
+      int granted = 1;
+      uint64_t token = 0;
+      sockaddr_in final_t{};
       int fd = join_walk(node, node->rendezvous, /*allow_master=*/false,
-                         &became_master, &local);
+                         &became_master, &local, &granted, &token, &final_t);
       if (fd >= 0) {
-        make_link(node, fd, /*is_uplink=*/1, nullptr);
+        auto l = make_link(node, fd, /*is_uplink=*/1, nullptr, granted);
+        if (granted > 1) open_stripes(node, l, final_t, token, granted);
         rejoined = true;
         break;
       }
@@ -1263,6 +1719,7 @@ struct StConfigC {
   double rejoin_backoff_sec;
   double connect_timeout_sec;  // per-hop connect/reply bound (0 = blocking)
   double join_timeout_sec;     // total create-time join budget (0 = 30 s)
+  int32_t stripe_count;        // r11: sockets per logical link (1..8)
 };
 
 struct StEventC {
@@ -1302,9 +1759,18 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
   cfg.rejoin_backoff_sec = cfg_c->rejoin_backoff_sec;
   cfg.connect_timeout_sec = cfg_c->connect_timeout_sec;
   cfg.join_timeout_sec = cfg_c->join_timeout_sec;
+  // striping is native-framing only (the reference compat protocol has
+  // one stream per link by definition)
+  cfg.stripe_count = cfg_c->stripe_count < 1
+                         ? 1
+                         : (cfg_c->stripe_count > kMaxStripes
+                                ? kMaxStripes
+                                : cfg_c->stripe_count);
+  if (cfg.wire_compat) cfg.stripe_count = 1;
   cfg.fault = parse_fault_plan();  // env hook table, per-node at create
   node->jrng = (uint64_t)::getpid() * 0x9e3779b97f4a7c15ull +
                (uint64_t)Clock::now().time_since_epoch().count();
+  node->token_rng = node->jrng ^ 0xA5A5A5A5DEADBEEFull;
 
   hostent* server = gethostbyname(host);
   if (!server) {
@@ -1330,6 +1796,9 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
   bool became_master = false;
   int up_fd = -1;
   int listen_fd = -1;
+  int up_granted = 1;
+  uint64_t up_token = 0;
+  sockaddr_in up_final{};
   // Bounded join-or-become-master: a TOTAL deadline (join_timeout_sec)
   // replaces the old fixed 50-attempt loop, and retries back off
   // exponentially with +/-50% jitter — a herd of simultaneous joiners (or
@@ -1356,7 +1825,7 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
     became_master = false;
     sockaddr_in listen_addr{};
     up_fd = join_walk(node, target, /*allow_master=*/true, &became_master,
-                      &listen_addr);
+                      &listen_addr, &up_granted, &up_token, &up_final);
     if (up_fd < 0 && !became_master) continue;  // tree settling; retry
     if (became_master) listen_addr = target;  // master owns the rendezvous addr
 
@@ -1389,7 +1858,10 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
   node->active_threads += 2;
   std::thread(listener_loop, node, listen_fd).detach();
   std::thread(rejoin_loop, node).detach();
-  if (up_fd >= 0) make_link(node, up_fd, /*is_uplink=*/1, nullptr);
+  if (up_fd >= 0) {
+    auto l = make_link(node, up_fd, /*is_uplink=*/1, nullptr, up_granted);
+    if (up_granted > 1) open_stripes(node, l, up_final, up_token, up_granted);
+  }
   if (is_master) *is_master = became_master ? 1 : 0;
   if (became_master) node->emit(3, 0, 0);
   return node;
@@ -1440,7 +1912,14 @@ int32_t st_node_send(void* h, int32_t link_id, const uint8_t* data,
   node->tx_acquires++;
   if (!hit) node->tx_pool_misses++;
   msg.owned.assign(data, data + len);
-  if (link->sendq.push(std::move(msg), timeout_sec)) return 1;
+  Link* lp = link.get();
+  if (link->sendq.push_hook(std::move(msg), timeout_sec, [lp](OutMsg& m) {
+        // stripe-seq stamp, under the queue mutex at insertion (r11): a
+        // stamped seq is always eventually written, so reassembly never
+        // waits on a hole
+        m.sseq = lp->sseq_next.fetch_add(1, std::memory_order_relaxed);
+      }))
+    return 1;
   return 0;
 }
 
@@ -1469,7 +1948,10 @@ int32_t st_node_send_zc(void* h, int32_t link_id, const uint8_t* data,
   msg.zlen = (uint32_t)len;
   msg.release = release;
   msg.ctx = ctx;
-  if (link->sendq.push(std::move(msg), timeout_sec)) {
+  Link* lp = link.get();
+  if (link->sendq.push_hook(std::move(msg), timeout_sec, [lp](OutMsg& m) {
+        m.sseq = lp->sseq_next.fetch_add(1, std::memory_order_relaxed);
+      })) {
     node->zc_msgs++;
     return 1;
   }
@@ -1517,6 +1999,30 @@ void st_node_pool_stats(void* h, uint64_t* out5) {
   out5[2] = node->rx_acquires.load();
   out5[3] = node->rx_pool_misses.load();
   out5[4] = node->zc_msgs.load();
+}
+
+// r11 per-link stripe telemetry: out4[0] = negotiated stripe count,
+// out4[1] = live stripes, out4[2] = stripe deaths on this link,
+// out4[3] = messages re-routed off a dying stripe. Returns -1 for an
+// unknown link.
+int32_t st_node_stripe_stats(void* h, int32_t link_id, uint64_t* out4) {
+  auto* node = (Node*)h;
+  for (int i = 0; i < 4; i++) out4[i] = 0;
+  if (!node) return -1;
+  std::shared_ptr<Link> link;
+  {
+    std::lock_guard<std::mutex> lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it == node->links.end()) return -1;
+    link = it->second;
+  }
+  out4[0] = (uint64_t)link->nstripes;
+  out4[1] = (uint64_t)(link->stripes_live.load() < 0
+                           ? 0
+                           : link->stripes_live.load());
+  out4[2] = link->stripe_deaths.load();
+  out4[3] = link->reroutes.load();
+  return 0;
 }
 
 int32_t st_node_poll_events(void* h, StEventC* out, int32_t cap,

@@ -79,6 +79,21 @@ def load_engine() -> Optional[ctypes.CDLL]:
         lib.st_engine_compat_regraft.argtypes = [
             ctypes.c_void_p, ctypes.c_int32,
         ]
+        # r11 adaptive precision + cascade quantize (set between create
+        # and start; see stengine.cpp st_engine_set_codec)
+        lib.st_engine_set_codec.restype = None
+        lib.st_engine_set_codec.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_double,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int32,
+        ]
+        lib.st_engine_link_allow_sign2.restype = ctypes.c_int32
+        lib.st_engine_link_allow_sign2.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.st_engine_link_precision.restype = ctypes.c_int32
+        lib.st_engine_link_precision.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+        ]
         lib.st_engine_start.restype = None
         lib.st_engine_start.argtypes = [ctypes.c_void_p]
         lib.st_engine_seal.restype = None
@@ -184,6 +199,11 @@ class EngineTensor:
         ack_timeout_sec: float = 0.0,  # go-back-N timer; see TransportConfig
         ack_retry_limit: int = 8,  # rounds before black-hole teardown
         trace_wire: bool = True,  # r09 v2 framing (compat.WIRE_VERSION)
+        precision_mode: int = 0,  # r11: 0 fixed 1-bit, 1 adaptive, 2 sign2
+        precision_up_ratio: float = 1.05,  # governor growth threshold (CodecConfig default)
+        precision_down_ratio: float = 0.5,  # governor quiet threshold
+        precision_interval_sec: float = 0.1,  # governor beat
+        cascade_frames: int = 1,  # r11: frames quantized per memory pass
     ):
         from ..ops.codec_np import _layout, flatten_np
 
@@ -218,6 +238,16 @@ class EngineTensor:
         )
         if not self._h:
             raise RuntimeError("st_engine_create failed")
+        # r11 codec config BEFORE start (the sender thread reads it
+        # unlocked; the tx-slot ring re-sizes for the widest sign2 burst)
+        self._lib.st_engine_set_codec(
+            self._h,
+            precision_mode,
+            precision_up_ratio,
+            precision_down_ratio,
+            precision_interval_sec,
+            cascade_frames,
+        )
         # reused across poll_ctrl calls (a per-call create_string_buffer
         # would zero-fill recv_cap bytes every ~2 ms idle pass); sized to
         # the largest wire message so a deferred CHUNK never truncates
@@ -281,7 +311,13 @@ class EngineTensor:
     def add(self, delta: Any) -> None:
         from ..ops.codec_np import flatten_np
 
-        u = np.ascontiguousarray(flatten_np(delta, self.spec), np.float32)
+        # copy=False: st_engine_add consumes u synchronously (one pooled
+        # accumulate under add_mu), so a single-leaf unpadded f32 delta
+        # goes straight through — the zeros+copy flatten was two full
+        # table passes per add() on the production throughput path
+        u = np.ascontiguousarray(
+            flatten_np(delta, self.spec, copy=False), np.float32
+        )
         self._lib.st_engine_add(self._handle(), u)
 
     def new_link(self, link_id: int, seed: bool = True, rx_init: int = 0) -> None:
@@ -344,6 +380,23 @@ class EngineTensor:
         )
         if r == 0:
             raise DuplicateLink(f"link {link_id} already exists")
+
+    def link_allow_sign2(self, link_id: int, allow: bool = True) -> None:
+        """r11: record that the peer on this link advertised sign2 (2-bit)
+        decode capability (compat.SYNC_FLAG_SIGN2 / WELCOME flags), so the
+        adaptive-precision governor may upshift it. Links without the call
+        stay 1-bit forever — the mixed-tree safety default."""
+        if self._h:
+            self._lib.st_engine_link_allow_sign2(
+                self._h, link_id, 1 if allow else 0
+            )
+
+    def link_precision(self, link_id: int) -> int:
+        """The governor's current wire precision for the link (1 or 2; 0 =
+        unknown link / closed engine) — the st_link_precision gauge."""
+        if not self._h:
+            return 0
+        return int(self._lib.st_engine_link_precision(self._h, link_id))
 
     def stash_carry(self, link_id: int) -> bool:
         """Park a dead uplink's residual in the engine's LIVE carry slot —
@@ -484,15 +537,19 @@ class EngineTensor:
         msgs_out, msgs_in, tx_slot_acquires, tx_slot_alloc_events,
         tx_slots_allocated, retx_msgs, dedup_discards, rtt_ns_total,
         rtt_msgs, hops_sum, hops_msgs, staleness_ns_last, traced_msgs_in,
-        sub_msgs_out, sub_fresh_out]
+        sub_msgs_out, sub_fresh_out, prec_upshifts, prec_downshifts,
+        frames2_out, frames2_in]
         — [5..7] are the r07 tx-ring pool stats (steady state: acquires
         grow, alloc_events stay flat); [8..11] the r08 obs aggregates
         (go-back-N retransmits, dup/gap discards, ACK round-trip ns sum +
         sample count); [12..15] the r09 trace aggregates (hop-count sum +
         sample count, latest apply-time staleness ns, traced applied
         messages); [16..17] the r10 serving aggregates (unledgered
-        subscriber data messages sent, FRESH drain marks delivered)."""
-        out = np.zeros(18, np.uint64)
+        subscriber data messages sent, FRESH drain marks delivered);
+        [18..21] the r11 adaptive-precision aggregates (governor
+        upshifts/downshifts, sign2 frames sent/applied — subsets of
+        frames_out/frames_in)."""
+        out = np.zeros(22, np.uint64)
         if self._h:
             self._lib.st_engine_counters(self._h, out)
         return out
@@ -538,6 +595,10 @@ class EngineTensor:
             "st_traced_msgs_in_total": int(c[15]),
             "st_sub_msgs_out_total": int(c[16]),
             "st_sub_fresh_out_total": int(c[17]),
+            "st_precision_upshifts_total": int(c[18]),
+            "st_precision_downshifts_total": int(c[19]),
+            "st_frames2_out_total": int(c[20]),
+            "st_frames2_in_total": int(c[21]),
         }
 
     @property

@@ -300,6 +300,8 @@ def to_env(cfg: FaultConfig) -> dict[str, str]:
         parts.append(f"sever_after={cfg.sever_after_frames}")
     if cfg.only_link > 0:
         parts.append(f"only_link={cfg.only_link}")
+    if cfg.only_stripe >= 0:
+        parts.append(f"only_stripe={cfg.only_stripe}")
     env = {"ST_FAULT_PLAN": ",".join(parts)}
     if cfg.crash_point:
         env["ST_FAULT_CRASH"] = f"{cfg.crash_point}:{max(1, cfg.crash_after)}"

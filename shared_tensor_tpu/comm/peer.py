@@ -363,6 +363,19 @@ class SharedTensorPeer:
         self._engine_links: set[int] = set()
         from .engine import EngineTensor, engine_eligible
 
+        # r11 adaptive precision: on iff the engine owns the data plane,
+        # native framing, and the config/env policy allows it
+        # (compat.sign2_mode — ST_SIGN2=0 is the escape hatch). The
+        # capability is advertised in SYNC/WELCOME; emission additionally
+        # gates per link on the PEER's advertisement.
+        from ..compat import sign2_mode
+
+        self._sign2_mode = (
+            sign2_mode(self.config)
+            if engine_eligible(self.config) and not tcfg.wire_compat
+            else 0
+        )
+        self._sign2 = self._sign2_mode != 0
         if engine_eligible(self.config):
             try:
                 self.st = EngineTensor(
@@ -379,6 +392,13 @@ class SharedTensorPeer:
                     ack_timeout_sec=tcfg.ack_timeout_sec,
                     ack_retry_limit=tcfg.ack_retry_limit,
                     trace_wire=self._trace_wire,
+                    precision_mode=self._sign2_mode,
+                    precision_up_ratio=codec.precision_up_ratio,
+                    precision_down_ratio=codec.precision_down_ratio,
+                    precision_interval_sec=codec.precision_interval_sec,
+                    cascade_frames=(
+                        codec.cascade_frames if not tcfg.wire_compat else 1
+                    ),
                 )
                 self._engine = self.st
                 # Vacuous-chaos guard: Config.faults WIRE knobs inject in
@@ -410,6 +430,8 @@ class SharedTensorPeer:
             except Exception as e:
                 log.warning("native engine unavailable, using python tier: %s", e)
         if self._engine is None:
+            self._sign2 = False  # the python tier neither decodes nor
+            # advertises sign2 — peers stay 1-bit toward us automatically
             # the burst was sized for the engine (fill the wire budget);
             # if the engine did not actually construct, the Python tier
             # must re-size — at the cap it would pay up to 255 synchronous
@@ -457,6 +479,9 @@ class SharedTensorPeer:
         self._sub_links: dict[int, Optional[tuple[int, int]]] = {}
         self._pending_sub: dict[int, Optional[tuple[int, int]]] = {}
         self._sub_fresh: dict[int, float] = {}
+        # r11 sign2 capability flags gathered during handshakes, consumed
+        # at attach time (link id -> the peer advertised sign2 decode)
+        self._peer_sign2: dict[int, bool] = {}
         # replica state_version at each ranged link's last residual mask
         # (skip the full-table mask copy on idle passes)
         self._sub_mask_ver: dict[int, int] = {}
@@ -695,6 +720,26 @@ class SharedTensorPeer:
             if s is not None:
                 out[_schema.link_key("st_link_send_queue", link)] = s.send_queue
                 out[_schema.link_key("st_link_recv_queue", link)] = s.recv_queue
+            # r11 stripe telemetry (per logical link): negotiated and
+            # surviving socket counts + stripe lifecycle totals
+            st = self.node.stripe_stats(link)
+            if st is not None and st["stripes"] > 1:
+                out[_schema.link_key("st_stripe_count", link)] = st["stripes"]
+                out[_schema.link_key("st_stripe_live", link)] = st["live"]
+                out["st_stripe_deaths_total"] = (
+                    out.get("st_stripe_deaths_total", 0) + st["deaths"]
+                )
+                out["st_stripe_reroutes_total"] = (
+                    out.get("st_stripe_reroutes_total", 0) + st["reroutes"]
+                )
+        # r11 per-link wire precision (engine tier; 1-bit everywhere else)
+        if self._engine is not None:
+            for link in self.st.link_ids:
+                if link < 0:
+                    continue
+                prec = self._engine.link_precision(link)
+                if prec > 0:
+                    out[_schema.link_key("st_link_precision", link)] = prec
         return out
 
     def metrics(
@@ -1975,6 +2020,17 @@ class SharedTensorPeer:
             self._engine_links.add(link)
         else:
             self.st.new_link_diff(link, snap)
+        self._arm_sign2(link)
+
+    def _arm_sign2(self, link: int) -> None:
+        """r11: arm the adaptive-precision governor for this link iff BOTH
+        ends advertised sign2 (ours is config/env-gated via self._sign2)."""
+        if (
+            self._engine is not None
+            and self._sign2
+            and self._peer_sign2.pop(link, False)
+        ):
+            self._engine.link_allow_sign2(link)
 
     def _attach_sub(self, link: int, rng: Optional[tuple[int, int]]) -> None:
         """Attach — or RE-seed, the resync path — a read-only subscriber
@@ -1996,6 +2052,7 @@ class SharedTensorPeer:
         On the engine tier, attach and subscriber mode are ONE atomic
         native call (st_engine_attach_sub) for the same no-ledgered-window
         reason."""
+        self._peer_sign2.pop(link, None)  # subscriber links stay 1-bit
         resync = link in self._sub_links
         if resync:
             if self._engine is not None:
@@ -2067,6 +2124,7 @@ class SharedTensorPeer:
             self._engine_links.add(link)
         else:
             self.st.new_link(link, seed=False)
+        self._arm_sign2(link)
 
     # native-mode join handshake, child side
     def _start_join(self, uplink: int) -> None:
@@ -2093,8 +2151,15 @@ class SharedTensorPeer:
             # values_now - sent_snapshot, which is exactly carry + whatever
             # lands during the handshake (the live slot keeps absorbing)
         self._sent_snapshot = snap
+        from ..compat import SYNC_FLAG_SIGN2
+
         self._send_blocking(
-            uplink, wire.encode_sync(self.st.spec, self._wire_version)
+            uplink,
+            wire.encode_sync(
+                self.st.spec,
+                self._wire_version,
+                flags=SYNC_FLAG_SIGN2 if self._sign2 else 0,
+            ),
         )
         # crash point: SYNC sent, snapshot not — the parent holds a pending
         # handshake buffer for a child that just died mid-walk
@@ -2170,8 +2235,13 @@ class SharedTensorPeer:
                 self._pending.pop(link, None)
                 self._pending_sub.pop(link, None)
             else:
-                from ..compat import SYNC_FLAG_READ_ONLY
+                from ..compat import SYNC_FLAG_READ_ONLY, SYNC_FLAG_SIGN2
 
+                # r11: remember the joiner's sign2 decode capability for
+                # the attach that follows DONE
+                self._peer_sign2[link] = bool(
+                    wire.sync_flags(payload) & SYNC_FLAG_SIGN2
+                )
                 if wire.sync_flags(payload) & SYNC_FLAG_READ_ONLY:
                     # r10 read-only subscriber handshake — possibly a
                     # RESYNC on a live link (seq gap repair): a RANGE
@@ -2227,10 +2297,28 @@ class SharedTensorPeer:
                 # echoing the mass back upward, a permanent +M divergence.
                 # An add() landing between the two calls is safe: it's in
                 # `values` by attach time, so the diff seed carries it.
-                self._send_blocking(link, bytes([wire.WELCOME]))
+                # The WELCOME carries OUR capability flags (r11 trailing
+                # byte — pre-r11 children dispatch on the kind byte alone
+                # and ignore it).
+                from ..compat import SYNC_FLAG_SIGN2
+
+                self._send_blocking(
+                    link,
+                    wire.encode_welcome(
+                        SYNC_FLAG_SIGN2 if self._sign2 else 0
+                    ),
+                )
                 self._attach_diff(link, snap)
                 self._wake.set()
         elif kind == wire.WELCOME:
+            from ..compat import SYNC_FLAG_SIGN2
+
+            # r11: the parent's capability flags ride the WELCOME tail (a
+            # pre-r11 parent's bare WELCOME reads back as 0 — the uplink
+            # then stays 1-bit)
+            self._peer_sign2[link] = bool(
+                wire.welcome_flags(payload) & SYNC_FLAG_SIGN2
+            )
             snap = self._sent_snapshot
             self._sent_snapshot = None
             if snap is not None:

@@ -37,6 +37,7 @@ class _StConfigC(ctypes.Structure):
         ("rejoin_backoff_sec", ctypes.c_double),
         ("connect_timeout_sec", ctypes.c_double),
         ("join_timeout_sec", ctypes.c_double),
+        ("stripe_count", ctypes.c_int32),  # r11: sockets per logical link
     ]
 
 
@@ -132,6 +133,12 @@ def _load() -> ctypes.CDLL:
         ctypes.c_void_p,
         ctypes.POINTER(ctypes.c_uint64),
     ]
+    lib.st_node_stripe_stats.restype = ctypes.c_int32
+    lib.st_node_stripe_stats.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
     lib.st_node_recv.restype = ctypes.c_int32
     lib.st_node_recv.argtypes = [
         ctypes.c_void_p,
@@ -216,6 +223,7 @@ class TransportNode:
             rejoin_backoff_sec=0.2,
             connect_timeout_sec=cfg.connect_timeout_sec,
             join_timeout_sec=cfg.join_timeout_sec,
+            stripe_count=cfg.stripe_count,
         )
         is_master = ctypes.c_int32(0)
         self._h = self._lib.st_node_create(
@@ -318,6 +326,22 @@ class TransportNode:
             "rx_acquires": out[2],
             "rx_misses": out[3],
             "zc_msgs": out[4],
+        }
+
+    def stripe_stats(self, link_id: int) -> Optional[dict]:
+        """r11 per-link stripe telemetry: negotiated/live socket counts +
+        stripe lifecycle totals (deaths, re-routed messages). None for an
+        unknown link or a closed node."""
+        if not self._h:
+            return None
+        out = (ctypes.c_uint64 * 4)()
+        if self._lib.st_node_stripe_stats(self._h, link_id, out) < 0:
+            return None
+        return {
+            "stripes": int(out[0]),
+            "live": int(out[1]),
+            "deaths": int(out[2]),
+            "reroutes": int(out[3]),
         }
 
     def stats(self, link_id: int) -> Optional[LinkStats]:

@@ -188,6 +188,15 @@ def frame_payload_bytes(spec: TableSpec) -> int:
     return 4 * spec.num_leaves + 4 * (spec.total // 32)
 
 
+def frame_payload2_bytes(spec: TableSpec) -> int:
+    """Bytes of one sign2 (2-bit, r11) frame body: [scales L*4]
+    [sign words W*4][mag words W*4]. Emitted by the native engine only
+    (kind byte's 0x80 precision bit, capability-gated per link); sized
+    here so every peer's receive bound covers the widest single sign2
+    DATA message a capable sender may emit."""
+    return 4 * spec.num_leaves + 8 * (spec.total // 32)
+
+
 def burst_wire_bytes(spec: TableSpec) -> int:
     """Max BURST message size for this spec — v2 (traced) header: this
     feeds every receive-buffer bound, and 13 bytes short means a full
@@ -199,16 +208,21 @@ def burst_wire_bytes(spec: TableSpec) -> int:
 
 def frame_wire_bytes(spec: TableSpec) -> int:
     """Max payload size of any native-mode message for this spec (covers
-    the v2 trace headers, the bounded DIGEST control message, and the r10
+    the v2 trace headers, the bounded DIGEST control message, the r10
     RDATA framing — whose range header is 8 bytes longer than DATA's, so a
     near-full-range subscription on a burst-cap-1 table would otherwise
     exceed every other bound by a few bytes and be silently truncated at
-    the transport: the exact r09 burst_wire_bytes failure class)."""
+    the transport: the exact r09 burst_wire_bytes failure class — and the
+    r11 sign2 single-frame width, which exceeds the 1-bit burst bound on
+    burst-cap-1 tables for the same reason; sign2 BURSTS are capped by the
+    sender against this same bound)."""
     data = DATA_HDR_T + frame_payload_bytes(spec)
+    data2 = DATA_HDR_T + frame_payload2_bytes(spec)
     rdata = RDATA_HDR_T + frame_payload_bytes(spec)
     chunk = 1 + struct.calcsize(_CHUNK_HDR) + CHUNK_BYTES
     return max(
-        data, rdata, chunk, burst_wire_bytes(spec), 1 + DIGEST_MAX_BYTES
+        data, data2, rdata, chunk, burst_wire_bytes(spec),
+        1 + DIGEST_MAX_BYTES
     )
 
 
@@ -601,6 +615,23 @@ def sync_flags(payload: bytes) -> int:
     read-write peer with no range subscription."""
     base = 2 + struct.calcsize(_SYNC_FMT)
     return payload[base] if len(payload) > base else 0
+
+
+def encode_welcome(flags: int = 0) -> bytes:
+    """WELCOME with an r11 trailing capability-flags byte (same tolerant-
+    extension discipline as the SYNC version/flags bytes: every receiver
+    has always dispatched WELCOME on the kind byte alone, so pre-r11 peers
+    ignore the tail and a pre-r11 parent's bare 1-byte WELCOME reads back
+    as flags 0). Carries the PARENT-side capability advertisement —
+    today: compat.SYNC_FLAG_SIGN2, so a child knows whether its uplink
+    may be upshifted to the 2-bit codec."""
+    return bytes([WELCOME, flags & 0xFF])
+
+
+def welcome_flags(payload: bytes) -> int:
+    """The parent's advertised capability flags (0 for a pre-r11 bare
+    WELCOME)."""
+    return payload[1] if len(payload) > 1 else 0
 
 
 # -- r10 serving-tier messages ----------------------------------------------

@@ -75,6 +75,29 @@ WIRE_VERSION = WIRE_VERSION_V2  # what this build emits by default
 
 SYNC_FLAG_READ_ONLY = 0x01
 SYNC_FLAG_RANGE = 0x02
+# r11: the joiner can DECODE sign2 (2-bit) DATA/BURST frames (the kind
+# byte's 0x80 precision bit; native engine tier only — python-tier peers
+# never set it and therefore never receive a 2-bit frame). The parent's
+# side of the same advertisement rides a WELCOME trailing flags byte
+# (wire.encode_welcome) — pre-r11 peers send a bare 1-byte WELCOME, which
+# reads back as flags 0, so emission toward them stays 1-bit and mixed
+# trees interop without configuration. ST_SIGN2=0 force-disables both the
+# advertisement and the governor (the A/B escape hatch, like
+# ST_WIRE_TRACE=0).
+SYNC_FLAG_SIGN2 = 0x04
+
+
+def sign2_mode(config: "Config | None" = None) -> int:
+    """The engine's precision mode per config/env policy: 0 = fixed 1-bit
+    (ST_SIGN2=0 or CodecConfig.adaptive_precision=False), 1 = telemetry-
+    adaptive (default), 2 = sign2 pinned on every capable link (ST_SIGN2=2
+    — the A/B arm). Engine-tier capability is checked by the caller."""
+    env = os.environ.get("ST_SIGN2", "1")
+    if env == "0":
+        return 0
+    if config is not None and not config.codec.adaptive_precision:
+        return 0
+    return 2 if env == "2" else 1
 
 
 def wire_protocol_version(config: Config | None = None) -> int:

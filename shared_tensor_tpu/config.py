@@ -51,6 +51,41 @@ class CodecConfig:
     #: behavior, which its peers' liveness expects — so the codec layer never
     #: needs to synthesize idle frames itself.
     suppress_zero_frames: bool = True
+    #: r11 telemetry-adaptive link precision (native engine, native
+    #: framing): the per-link residual-RMS telemetry (st_residual_norm's
+    #: source) drives each link's wire precision — a link whose residual
+    #: stops decaying upshifts to the sign2 2-bit codec (sign + magnitude
+    #: bit selecting +/-s or +/-3s; the measured-best lab codec, promoted
+    #: from parallel/ici_lab.py), a quiet link downshifts back to 1-bit.
+    #: Emission is capability-gated per link (compat.SYNC_FLAG_SIGN2 /
+    #: WELCOME flags), so mixed trees with pre-r11 or python-tier peers
+    #: stay 1-bit toward those peers automatically; decoders on this
+    #: release accept both widths unconditionally. ST_SIGN2=0 in the
+    #: environment force-disables (the A/B / escape hatch, like
+    #: ST_WIRE_TRACE).
+    adaptive_precision: bool = True
+    #: Governor thresholds/beat: upshift after 2 consecutive beats where
+    #: the link's residual RMS GROWS past up_ratio * previous (the link is
+    #: falling behind the mass arriving — chaos, retransmission storms, a
+    #: saturated peer); downshift after 2 beats below down_ratio *
+    #: previous (or quiesced). A healthy saturated link (flat rms at the
+    #: wire's equilibrium) deliberately stays 1-bit.
+    precision_up_ratio: float = 1.05
+    precision_down_ratio: float = 0.5
+    precision_interval_sec: float = 0.1
+    #: r11 cascade quantize (native engine): frames quantized per MEMORY
+    #: PASS over the residual. Frame 0's scales are measured as always;
+    #: frames 1..k-1 take the halving schedule the measured sequence
+    #: converges to, so K frames cost one table read + one write instead
+    #: of K (the measured 1 Mi wall was the pass count, not bandwidth).
+    #: Scales ride the wire — receivers are oblivious, any peer decodes.
+    #: 1 = the r10 per-frame re-measured schedule. The committed sweep
+    #: (ENGINE_SWEEP_r11.json, 1 Mi loopback) reads 47.5 GB/s equiv @1,
+    #: 71.7 @8, then flat within box noise through 32 — the amortization
+    #: saturates by ~8; 32 stays the default for the finer drain lattice
+    #: (the extra sub-rms refinement levels are free in the same pass and
+    #: the endgame merges them in fewer single-frame passes).
+    cascade_frames: int = 32
 
 
 @dataclasses.dataclass(frozen=True)
@@ -113,6 +148,22 @@ class TransportConfig:
     #: declared a black hole and torn down for re-graft. Values <= 0
     #: coerce to 1 round, identically on both data planes.
     ack_retry_limit: int = 8
+    #: r11 multi-socket link striping (native framing only): each logical
+    #: link runs over this many TCP connections, with messages round-robin
+    #: striped across them (a per-message stripe sequence reassembles the
+    #: stream in order at the receiver) and per-stripe sender/receiver
+    #: threads — on fat pipes / loopback one stream's kernel path is a
+    #: single-core bottleneck. A dead stripe degrades the link to the
+    #: survivors when its loss is visible to the SENDER: messages still in
+    #: hand re-route to the surviving sockets. A stripe that dies with
+    #: already-written-but-undelivered wire data leaves a stripe-seq hole
+    #: no survivor can fill — that link tears down cleanly via the
+    #: engine's go-back-N (quarantine -> carry -> re-graft), it does not
+    #: wedge; the LAST stripe's death is the link's either way. Joining
+    #: with stripe_count > 1 uses the STT4 hello, which a
+    #: pre-r11 acceptor rejects — keep 1 (the default; wire-identical to
+    #: r10) to join older trees. 1..8.
+    stripe_count: int = 1
     #: Per-link send quarantine: after this many CONSECUTIVE failed send
     #: attempts (~0.1 s each — i.e. ~N/10 seconds of a full send queue with
     #: zero drained bytes) the link is torn down and re-grafted instead of
@@ -127,6 +178,10 @@ class TransportConfig:
         if not 1 <= self.max_children <= 16:
             raise ValueError(
                 f"max_children must be in 1..16, got {self.max_children}"
+            )
+        if not 1 <= self.stripe_count <= 8:
+            raise ValueError(
+                f"stripe_count must be in 1..8, got {self.stripe_count}"
             )
 
 
@@ -196,6 +251,11 @@ class FaultConfig:
     #: and runs clean, which is how the deterministic carry tests let the
     #: recovery path prove itself. 0 = every link.
     only_link: int = 0
+    #: >= 0: restrict ALL (native-tier) faults to this stripe index of each
+    #: striped link — the r11 per-stripe chaos arm. ``sever_after_frames``
+    #: then kills just that SOCKET: the link must degrade to the surviving
+    #: stripes (messages re-route) instead of dying. -1 = every stripe.
+    only_stripe: int = -1
     #: Named protocol point at which to kill the peer process (os._exit):
     #: "mid-join-walk" (SYNC sent, snapshot not), "mid-burst" (frames
     #: ledgered, message not yet on the wire), "between-apply-and-ack"

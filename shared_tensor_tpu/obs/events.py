@@ -61,6 +61,12 @@ CODE_NAMES: dict[int, str] = {
     # possibly range-filtered; arg = subscribed word count). The python
     # tier emits the same name — plus "sub_resync" — directly.
     31: "sub_attach",
+    # 32: r11 adaptive-precision governor flipped a link's wire precision
+    # (arg = the new precision, 1 or 2). 33: one stripe socket of a
+    # striped link died (arg = stripe index) and the link degraded to the
+    # survivors — the LAST stripe's death shows up as link_down instead.
+    32: "precision_shift",
+    33: "stripe_down",
 }
 NAME_CODES = {v: k for k, v in CODE_NAMES.items()}
 

@@ -84,6 +84,23 @@ SCHEMA: dict[str, tuple[str, str]] = {
     "st_sub_links": ("gauge", "writer: attached read-only subscriber links"),
     "st_sub_msgs_out_total": ("counter", "writer: unledgered data messages sent to subscriber links"),
     "st_sub_fresh_out_total": ("counter", "writer: FRESH drain marks delivered to subscriber links"),
+    # r11 data plane: multi-socket link striping + telemetry-adaptive
+    # precision. st_stripe_count/live are per-link gauges (negotiated vs
+    # surviving sockets); deaths/reroutes count stripe teardowns and the
+    # messages re-routed off a dying stripe. st_link_precision is the
+    # governor's current wire precision for the link (1 = sign-bit,
+    # 2 = sign2); upshifts/downshifts count its flips (ring event
+    # precision_shift carries each one); st_frames2_* are the sign2
+    # subsets of st_frames_*_total.
+    "st_stripe_count": ("gauge", "negotiated sockets striping the link (per-link)"),
+    "st_stripe_live": ("gauge", "surviving stripe sockets on the link (per-link)"),
+    "st_stripe_deaths_total": ("counter", "stripe sockets torn down (link degraded to survivors)"),
+    "st_stripe_reroutes_total": ("counter", "messages re-routed off a dying stripe to survivors"),
+    "st_link_precision": ("gauge", "wire precision the governor chose for the link (1=sign, 2=sign2)"),
+    "st_precision_upshifts_total": ("counter", "governor upshifts to the sign2 2-bit codec"),
+    "st_precision_downshifts_total": ("counter", "governor downshifts back to 1-bit"),
+    "st_frames2_out_total": ("counter", "sign2 (2-bit) frames sent (subset of st_frames_out_total)"),
+    "st_frames2_in_total": ("counter", "sign2 (2-bit) frames applied (subset of st_frames_in_total)"),
     # per-link series (rendered via link_key)
     "st_link_bytes_out_total": ("counter", "wire bytes sent on the link (incl. framing/keepalives)"),
     "st_link_bytes_in_total": ("counter", "wire bytes received on the link"),

@@ -121,6 +121,32 @@ def _native() -> Optional[ctypes.CDLL]:
             _f32p, _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64,
             _f64p, _f64p, _f64p,
         ]
+        # r11 cascade quantize: K halving frames in ONE pass (scales ride
+        # the wire, so the sender-chosen schedule is protocol-legal); the
+        # sign2 (2-bit) twins carry sign + magnitude planes per frame.
+        lib.stc_quantize_ef_cascade.restype = None
+        lib.stc_quantize_ef_cascade.argtypes = [
+            _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64,
+            ctypes.c_int32, _f32p, _u32p, ctypes.c_int64,
+            _f64p, _f64p, _f64p,
+        ]
+        lib.stc_quantize2_ef_cascade.restype = None
+        lib.stc_quantize2_ef_cascade.argtypes = [
+            _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64,
+            ctypes.c_int32, _f32p, _u32p, ctypes.c_int64, ctypes.c_int64,
+            _f64p, _f64p, _f64p,
+        ]
+        lib.stc_apply_frames2.restype = None
+        lib.stc_apply_frames2.argtypes = [
+            _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32, _f32p, _u32p,
+            _f64p_opt, _f64p_opt, _f64p_opt,
+        ]
+        lib.stc_apply_frame2.restype = None
+        lib.stc_apply_frame2.argtypes = [
+            _f32p, _f32p, _i64p, _i64p, _i64p, ctypes.c_int64,
+            ctypes.c_int64, _f32p, _u32p,
+        ]
         _LIB = lib
     except Exception:  # no toolchain / build failure: numpy fallback
         _LIB = None
@@ -163,12 +189,21 @@ def _leaf_slices(spec: TableSpec):
         off += p
 
 
-def flatten_np(tree, spec: TableSpec) -> np.ndarray:
+def flatten_np(tree, spec: TableSpec, *, copy: bool = True) -> np.ndarray:
     """Numpy twin of ops.table.flatten (pytree -> padded flat f32 buffer,
     padding exactly 0). The host tier must never run jax array ops: merely
     creating a jnp array initializes the XLA CPU client, whose thread pool
     contends with the C codec loops (measured 2.7x slower frames on a
-    1-vCPU host). jax.tree_util is pure Python and backend-free."""
+    1-vCPU host). jax.tree_util is pure Python and backend-free.
+
+    ``copy=False`` (r11): a caller that only READS the result before
+    returning control (the engine add hot path — st_engine_add consumes
+    ``u`` synchronously) may receive the caller's own buffer when the
+    tree is a single unpadded C-contiguous f32 leaf — at 1 Mi the
+    zeros+copy here was two full table passes per add() on the
+    production throughput path (the add cadence is what feeds the
+    sender's frame rate). Never pass the result anywhere that retains
+    it; the default copies as before."""
     import jax
 
     leaves, treedef = jax.tree.flatten(tree)
@@ -176,6 +211,15 @@ def flatten_np(tree, spec: TableSpec) -> np.ndarray:
         raise ValueError(
             f"tree structure {treedef} does not match spec {spec.treedef}"
         )
+    if not copy and len(leaves) == 1 and spec.num_leaves == 1:
+        flat = np.ravel(np.asarray(leaves[0])).astype(np.float32, copy=False)
+        if flat.shape[0] != spec.ns[0]:
+            raise ValueError(
+                f"leaf has {flat.shape[0]} elements, spec expects "
+                f"{spec.ns[0]}"
+            )
+        if flat.shape[0] == spec.total and flat.flags.c_contiguous:
+            return flat
     out = np.zeros(spec.total, np.float32)
     for (off, n, _), leaf in zip(_leaf_slices(spec), leaves):
         flat = np.ravel(np.asarray(leaf)).astype(np.float32, copy=False)
@@ -427,3 +471,82 @@ def accumulate_table_np(
     return tuple(
         np.clip(np.asarray(a, np.float32) + u, -3.0e38, 3.0e38) for a in arrays
     )
+
+
+# ---- r11 sign2 (2-bit sign/magnitude) reference twins -----------------------
+#
+# PURE-numpy semantic references for the engine tier's sign2 kernels
+# (stc_quantize2_ef_cascade / stc_apply_frames2) — deliberately NO native
+# fast path: these exist so the parity tests can pin the C loops (and the
+# JAX lab step, parallel/ici_lab.build_sign2_sync_step) against an
+# independent implementation of the codec-lab Sign2 rule:
+#   neg = r <= 0 (zero-negative, quirk Q3), big = |r| > 2s,
+#   sent = +/- (3s if big else s), r' = r - sent on live lanes with s > 0.
+# Wire layout per frame: [scales L*4][sign words W*4][mag words W*4].
+
+
+def quantize2_table_np(
+    residual: np.ndarray,
+    spec: TableSpec,
+    policy: ScalePolicy = ScalePolicy.POW2_RMS,
+    per_leaf: bool = True,
+    scales: "np.ndarray | None" = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """One sign2 sender frame: returns (scales f32[L], sign_words
+    u32[total//32], mag_words u32[total//32], new_residual). Pass
+    ``scales`` to quantize at given scales (the cross-tier parity
+    discipline: bit-identical GIVEN the same scales)."""
+    r = np.ascontiguousarray(residual, np.float32)
+    if scales is None:
+        scales = compute_scales_np(r, spec, policy, per_leaf)
+    live = _live_mask_np(spec)
+    s_el = _scale_per_element(np.asarray(scales, np.float32), spec)
+    neg = r <= 0
+    big = np.abs(r) > np.float32(2.0) * s_el
+    sign_words = (
+        np.packbits(neg & live, bitorder="little").view("<u4").astype(np.uint32)
+    )
+    mag_words = (
+        np.packbits(big & live, bitorder="little").view("<u4").astype(np.uint32)
+    )
+    mag = np.where(big, np.float32(3.0) * s_el, s_el)
+    sent = np.where(neg, -mag, mag)
+    new_r = np.where(
+        live & (s_el > 0), r - sent, np.where(live, r, 0.0)
+    ).astype(np.float32)
+    return np.asarray(scales, np.float32), sign_words, mag_words, new_r
+
+
+def apply2_table_np(
+    arrays: tuple[np.ndarray, ...],
+    scales: np.ndarray,  # f32[K, L]
+    words: np.ndarray,  # u32[K, 2 * total//32]: sign plane then mag plane
+    spec: TableSpec,
+) -> tuple[np.ndarray, ...]:
+    """Receiver reference for K sign2 frames: delta = s * (1-2*neg) *
+    (1+2*big) summed across frames, clip once (the fused-apply summation
+    order)."""
+    k = np.asarray(scales).shape[0]
+    w = spec.total // 32
+    live = _live_mask_np(spec)
+    delta = np.zeros(spec.total, np.float32)
+    for i in range(k):
+        row = np.asarray(scales[i], np.float32)
+        if not row.any():
+            continue
+        wrow = np.ascontiguousarray(words[i]).view(np.uint32)
+        neg = np.unpackbits(
+            np.ascontiguousarray(wrow[:w]).view(np.uint8), bitorder="little"
+        )[: spec.total].astype(np.float32)
+        big = np.unpackbits(
+            np.ascontiguousarray(wrow[w:]).view(np.uint8), bitorder="little"
+        )[: spec.total].astype(np.float32)
+        s_el = _scale_per_element(row, spec)
+        delta += s_el * (1.0 - 2.0 * neg) * (1.0 + 2.0 * big)
+    delta[~live] = 0.0
+    out = []
+    for a in arrays:
+        v = np.clip(np.asarray(a, np.float32) + delta, -_SAT, _SAT)
+        v[~live] = 0.0
+        out.append(v)
+    return tuple(out)

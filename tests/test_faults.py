@@ -553,3 +553,99 @@ def test_handshake_traffic_is_never_faulted():
     finally:
         j.close()
         m.close()
+
+
+# ---------------------------------------------------------------------------
+# r11 multi-socket striping under chaos (per-stripe sever / stall arms)
+# ---------------------------------------------------------------------------
+
+
+def test_striped_link_survives_single_stripe_sever(monkeypatch):
+    """A striped link with one dead SOCKET must degrade to the surviving
+    stripes — messages re-route (reroutes counter), stripe_stats shows the
+    death on BOTH ends, the link itself stays up, and every queued update
+    still converges exactly (anything delivery-uncertain on the dead
+    socket is the reassembly window's dedup or the engine's go-back-N to
+    repair)."""
+    port = _free_port()
+    seed = jnp.full((1 << 14,), 1.0, jnp.float32)
+    env = faults.to_env(FaultConfig(
+        enabled=True, seed=9, sever_after_frames=3, only_link=1,
+        only_stripe=2,
+    ))
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg(stripe_count=4))
+    for k in env:
+        monkeypatch.delenv(k)
+    if m._engine is None:
+        m.close()
+        pytest.skip("native engine unavailable on this tier")
+    j = SharedTensorPeer(
+        "127.0.0.1", port, jnp.zeros_like(seed), _cfg(stripe_count=4)
+    )
+    try:
+        j.wait_ready(60.0)
+        _wait_converged([j], seed, tol=1e-5)
+        rng = np.random.default_rng(21)
+        total = np.asarray(seed)
+        for _ in range(12):
+            u = rng.normal(0, 0.5, 1 << 14).astype(np.float32)
+            total = total + u
+            m.add(jnp.asarray(u))
+            time.sleep(0.01)
+        _wait_converged([m, j], jnp.asarray(total), tol=1e-4)
+        ss = m.node.stripe_stats(1)
+        assert ss is not None and ss["stripes"] == 4
+        assert ss["deaths"] >= 1, "the injected stripe sever never fired"
+        assert ss["live"] == ss["stripes"] - ss["deaths"]
+        assert ss["reroutes"] >= 1, "no message re-routed off the dead stripe"
+        assert 1 in m.node.links, "the LINK must survive a stripe death"
+        # the peer's canonical metrics carry the stripe telemetry
+        mm = m.metrics(canonical=True)
+        assert mm.get("st_stripe_deaths_total", 0) >= 1
+    finally:
+        j.close()
+        m.close()
+
+
+def test_striped_link_stall_tears_down_cleanly_not_wedged(monkeypatch):
+    """The OTHER failure shape: a stripe that silently swallows messages
+    (stall) wedges reassembly — the whole link must then go down the
+    go-back-N black-hole teardown -> carry -> re-graft path in bounded
+    time and converge exactly, never hang. (A swallowed stripe seq is a
+    permanent hole; no per-stripe recovery exists for it by design — the
+    ledger's retransmissions land behind the hole.)"""
+    port = _free_port()
+    seed = jnp.full((4096,), 2.0, jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg(stripe_count=2))
+    if m._engine is None:
+        m.close()
+        pytest.skip("native engine unavailable on this tier")
+    env = faults.to_env(FaultConfig(
+        enabled=True, seed=4, stall_after_frames=6, only_link=1,
+        only_stripe=1,
+    ))
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    j = SharedTensorPeer(
+        "127.0.0.1", port, jnp.zeros_like(seed),
+        _cfg(stripe_count=2, ack_timeout_sec=1.0, ack_retry_limit=2),
+    )
+    for k in env:
+        monkeypatch.delenv(k)
+    try:
+        j.wait_ready(60.0)
+        _wait_converged([j], seed, tol=1e-5)
+        delta = jnp.asarray(
+            np.random.default_rng(8).normal(size=(4096,)).astype(np.float32)
+        )
+        j.add(delta)
+        # frames past the 6th on stripe 1 of the joiner's uplink vanish;
+        # reassembly at the master wedges on the hole; the joiner's
+        # go-back-N declares the link a black hole, tears it down, and the
+        # carry re-grafts on a fresh (clean) link id
+        _wait_converged([m, j], seed + delta, tol=1e-5, timeout=120.0)
+    finally:
+        j.close()
+        m.close()

@@ -721,9 +721,9 @@ def test_engine_repr_after_destroy_is_string():
         m.close()  # destroys the engine
         r = repr(eng)
         assert isinstance(r, str) and "destroyed" in r
-        # counters after destroy: zeros, not a crash (18-wide since the
-        # r10 serving-aggregate widening)
-        assert eng._counters().tolist() == [0] * 18
+        # counters after destroy: zeros, not a crash (22-wide since the
+        # r11 adaptive-precision widening)
+        assert eng._counters().tolist() == [0] * 22
         assert eng.link_obs(1) is None
         assert eng.link_ids == ()
         assert eng.inflight_total() == 0

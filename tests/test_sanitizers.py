@@ -156,6 +156,44 @@ def test_serve_suite_under_asan_ubsan():
 
 
 @pytest.mark.slow
+def test_striped_adaptive_suite_under_asan_ubsan():
+    """r11 satellite: striping + adaptive precision are new native hot
+    code on every plane — per-stripe sender/receiver threads and the
+    reassembly window (sttransport.cpp), the sign2 pack/unpack kernels and
+    the cascade quantizer (stcodec.c), the precision-bit frame format and
+    the governor's beat (stengine.cpp). Run the sign2 suite (kernel
+    parity, pinned/mixed pairs, the governor-upshift loop) AND the
+    per-stripe chaos tests (sever -> degrade-to-survivors, stall ->
+    go-back-N teardown) against the sanitizer builds so ASan/UBSan watch
+    every stripe buffer handoff and 2-bit plane write while the faults
+    fire under them."""
+    asan = _runtime("libasan.so")
+    ubsan = _runtime("libubsan.so")
+    if asan is None or ubsan is None:
+        pytest.skip("gcc sanitizer runtimes unavailable")
+    build = subprocess.run(
+        ["make", "-C", str(NATIVE), "sanitize"],
+        capture_output=True, text=True, timeout=300,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"sanitize build failed: {build.stderr[-500:]}")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "tests/test_sign2.py",
+            "tests/test_faults.py", "-q", "-k",
+            "sign2 or cascade or governor or stripe",
+            "-p", "no:cacheprovider",
+        ],
+        env=_san_env(asan, ubsan), capture_output=True, text=True,
+        timeout=540, cwd=str(REPO),
+    )
+    err_tail = proc.stderr[-4000:]
+    assert "AddressSanitizer" not in proc.stderr, err_tail
+    assert "runtime error:" not in proc.stderr, err_tail  # UBSan findings
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-2000:], err_tail)
+
+
+@pytest.mark.slow
 def test_chaos_soak_native_arm_under_asan_ubsan():
     asan = _runtime("libasan.so")
     ubsan = _runtime("libubsan.so")

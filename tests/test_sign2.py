@@ -1,0 +1,395 @@
+"""r11 sign2 (2-bit) codec + adaptive precision: cross-tier parity and
+engine-tier behavior.
+
+Parity discipline (same as the existing codec parity tests): tiers are
+bit-identical GIVEN the same scales — scales are sender-chosen and ride
+the wire, so each test feeds one tier's scales into the other and demands
+byte-equal planes/residuals/applies. Three independent implementations are
+pinned against each other: the JAX pod-tier lab step
+(parallel/ici_lab.build_sign2_sync_step — the measured-best design this PR
+promotes), the pure-numpy reference twins (ops/codec_np.quantize2_table_np
+/ apply2_table_np), and the C engine kernels (stc_quantize2_ef_cascade /
+stc_apply_frames2).
+"""
+
+import ctypes
+import os
+import socket
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shared_tensor_tpu.ops import codec_np
+from shared_tensor_tpu.ops.table import make_spec
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _native():
+    lib = codec_np._native()
+    if lib is None:
+        pytest.skip("native libstcodec.so unavailable")
+    return lib
+
+
+def _dp(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+# ---- kernel-level parity: C vs the numpy reference twins -------------------
+
+
+def test_sign2_c_kernels_match_numpy_reference():
+    """stc_quantize2_ef_cascade(k=1) and stc_apply_frames2 are byte-equal
+    to the pure-numpy Sign2 rule on a ragged multi-leaf table (pool path
+    included at 1 Mi)."""
+    lib = _native()
+    for template in (
+        np.zeros(1 << 14, np.float32),
+        {"a": np.zeros(999, np.float32), "b": np.zeros((1 << 19) + 5, np.float32)},
+    ):
+        spec = make_spec(template)
+        offs, ns, padded = codec_np._layout(spec)
+        L, W = spec.num_leaves, spec.total // 32
+        rng = np.random.default_rng(11)
+        live = codec_np._live_mask_np(spec)
+        r = np.zeros(spec.total, np.float32)
+        r[live] = rng.normal(0, 1, int(live.sum())).astype(np.float32)
+        scales, sw, mw, nr = codec_np.quantize2_table_np(r, spec)
+        r2 = np.empty_like(r)
+        words = np.empty(2 * W, np.uint32)
+        pa = np.zeros(L)
+        ps = np.zeros(L)
+        pb = np.zeros(L)
+        lib.stc_quantize2_ef_cascade(
+            r, r2, offs, ns, padded, L, 1, scales, words, 2 * W, W, pa, ps, pb
+        )
+        assert np.array_equal(words[:W], sw), "sign plane"
+        assert np.array_equal(words[W:], mw), "magnitude plane"
+        assert np.array_equal(r2, nr), "post-quantize residual"
+        # fused partials == a standalone rescan of the result
+        a2 = np.zeros(L)
+        s2 = np.zeros(L)
+        b2 = np.zeros(L)
+        lib.stc_scale_partials(r2, offs, ns, L, a2, s2, b2)
+        np.testing.assert_allclose(ps, s2, rtol=1e-12)
+        np.testing.assert_array_equal(pa, a2)
+        # apply parity (values + the rollback path)
+        v = np.zeros(spec.total, np.float32)
+        v[live] = rng.normal(0, 1, int(live.sum())).astype(np.float32)
+        (want,) = codec_np.apply2_table_np(
+            (v,), scales.reshape(1, -1), words.reshape(1, -1), spec
+        )
+        got = np.empty_like(v)
+        lib.stc_apply_frames2(
+            v, got, offs, ns, padded, L, W, 1, scales, words, None, None, None
+        )
+        assert np.array_equal(got, want)
+        # rollback: re-applying the frame to the residual restores the
+        # pre-quantize state (the ledger _unapply discipline; same
+        # float-rounding class as the 1-bit codec)
+        back = np.empty_like(r2)
+        lib.stc_apply_frame2(r2, back, offs, ns, padded, L, W, scales, words)
+        np.testing.assert_allclose(back, r, atol=4e-6)
+
+
+def test_sign2_engine_kernels_match_ici_lab_jax_reference():
+    """Engine-tier sign2 pack/unpack vs the JAX pod-tier lab on shared
+    random state: run one build_sign2_sync_step step on a 2-peer mesh,
+    then reproduce each peer's quantize AND the cross-peer apply with the
+    C kernels at the LAB'S scales — planes, residuals and applied values
+    must match byte-for-byte (pack_bits and the C packing share the
+    LSB-first u32 wire contract)."""
+    lib = _native()
+    from shared_tensor_tpu.ops.packing import LANES, pack_bits  # noqa: F401
+    from shared_tensor_tpu.parallel import add_updates, init_state
+    from shared_tensor_tpu.parallel.ici_lab import build_sign2_sync_step
+    from tests._mesh import make_mesh
+
+    n_peer, n = 2, 4096
+    mesh = make_mesh(n_peer, 2)
+    tpl = {"w": jnp.zeros((n,), jnp.float32)}
+    spec = make_spec(tpl)
+    offs, ns, padded = codec_np._layout(spec)
+    L, W = spec.num_leaves, spec.total // 32
+    rng = np.random.default_rng(3)
+    ups = jnp.asarray(
+        np.stack([rng.normal(0, 1, spec.total) for _ in range(n_peer)]),
+        jnp.float32,
+    )
+    state = add_updates(init_state(mesh, spec, tpl), ups)
+    r_before = np.asarray(state.residual)  # (n_peer, total)
+    v_before = np.asarray(state.values)
+    step = build_sign2_sync_step(mesh, spec)
+    state2, scales = step(state)
+    scales = np.asarray(scales, np.float32)  # (n_peer, L)
+    r_after = np.asarray(state2.residual)
+    v_after = np.asarray(state2.values)
+
+    c_words = []
+    for p in range(n_peer):
+        r2 = np.empty(spec.total, np.float32)
+        words = np.empty(2 * W, np.uint32)
+        pa = np.zeros(L)
+        ps = np.zeros(L)
+        pb = np.zeros(L)
+        lib.stc_quantize2_ef_cascade(
+            np.ascontiguousarray(r_before[p]), r2, offs, ns, padded, L, 1,
+            np.ascontiguousarray(scales[p]), words, 2 * W, W, pa, ps, pb,
+        )
+        assert np.array_equal(r2, r_after[p]), f"peer {p} residual"
+        c_words.append(words)
+    for p in range(n_peer):
+        q = 1 - p  # the one other peer (no reduction-order ambiguity)
+        got = np.empty(spec.total, np.float32)
+        lib.stc_apply_frames2(
+            np.ascontiguousarray(v_before[p]), got, offs, ns, padded, L, W,
+            1, np.ascontiguousarray(scales[q]), c_words[q], None, None, None,
+        )
+        assert np.array_equal(got, v_after[p]), f"peer {p} values"
+
+
+def test_cascade_matches_sequential_quantize_at_same_schedule():
+    """The r11 cascade kernel is pure fusion: K frames in one pass are
+    byte-equal to K sequential stc_quantize calls at the same scales."""
+    lib = _native()
+    spec = make_spec(np.zeros(1 << 15, np.float32))
+    offs, ns, padded = codec_np._layout(spec)
+    W = spec.total // 32
+    rng = np.random.default_rng(5)
+    r = rng.normal(0, 1, spec.total).astype(np.float32)
+    s0 = codec_np.compute_scales_np(r, spec)
+    k = 5
+    sch = np.ascontiguousarray(
+        np.stack([s0 * np.float32(0.5**j) for j in range(k)])
+    )
+    cw = np.empty(k * W, np.uint32)
+    rc = np.empty_like(r)
+    pa = np.zeros(1)
+    ps = np.zeros(1)
+    pb = np.zeros(1)
+    lib.stc_quantize_ef_cascade(
+        r, rc, offs, ns, padded, 1, k, sch, cw, W, pa, ps, pb
+    )
+    rr = r.copy()
+    for j in range(k):
+        row = np.ascontiguousarray(sch[j])
+        wj = np.empty(W, np.uint32)
+        ro = np.empty_like(rr)
+        lib.stc_quantize(rr, ro, offs, ns, padded, 1, row, wj)
+        assert np.array_equal(wj, cw[j * W : (j + 1) * W]), f"frame {j}"
+        rr = ro
+    assert np.array_equal(rc, rr)
+
+
+# ---- engine-tier behavior ---------------------------------------------------
+
+
+def _mk_pair(port, n=1 << 14, env_master=None, env_child=None, cfg=None):
+    from shared_tensor_tpu.comm.peer import create_or_fetch
+
+    tpl = jnp.zeros((n,), jnp.float32)
+    saved = {}
+
+    def _with(env, fn):
+        for k, v in (env or {}).items():
+            saved[k] = os.environ.get(k)
+            os.environ[k] = v
+        try:
+            return fn()
+        finally:
+            for k in (env or {}):
+                if saved[k] is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = saved[k]
+
+    m = _with(env_master, lambda: create_or_fetch("127.0.0.1", port, tpl, cfg))
+    c = _with(env_child, lambda: create_or_fetch("127.0.0.1", port, tpl, cfg))
+    return m, c
+
+
+def _drain(peers, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(
+            all(p.st.residual_rms(li) == 0 for li in p.st.link_ids)
+            and (p._engine is None or p._engine.inflight_total() == 0)
+            for p in peers
+        ):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_sign2_pinned_pair_converges_and_counts_frames2():
+    """Two engine peers pinned to sign2 (ST_SIGN2=2): the stream runs at
+    2 bits, frames2 counters move on both ends, and the tree converges to
+    the float envelope."""
+    from shared_tensor_tpu.comm.peer import create_or_fetch  # noqa: F401
+
+    env = {"ST_SIGN2": "2"}
+    m, c = _mk_pair(_free_port(), env_master=env, env_child=env)
+    try:
+        if m._engine is None or c._engine is None:
+            pytest.skip("native engine unavailable")
+        rng = np.random.default_rng(0)
+        total = np.zeros(1 << 14, np.float32)
+        for _ in range(6):
+            u = rng.normal(0, 1, 1 << 14).astype(np.float32)
+            total += u
+            m.add(jnp.asarray(u))
+        assert _drain([m, c]), "did not quiesce"
+        a = np.asarray(m.read())
+        b = np.asarray(c.read())
+        np.testing.assert_allclose(a, b, atol=2e-5)
+        np.testing.assert_allclose(a, total, atol=1e-3)
+        cm, cc = m._engine._counters(), c._engine._counters()
+        assert int(cm[20]) > 0, "master sent no sign2 frames"
+        assert int(cc[21]) > 0, "child applied no sign2 frames"
+        assert m._engine.link_precision(next(iter(m.st.link_ids))) == 2
+    finally:
+        m.close()
+        c.close()
+
+
+def test_sign2_mixed_tree_interop_with_disabled_peer():
+    """Mixed tree: an adaptive/pinned-sign2 peer paired with an ST_SIGN2=0
+    peer. The disabled peer never advertises, so the capable peer must
+    stay 1-bit toward it (frames2 == 0 on the wire in BOTH directions) and
+    the pair converges — the capability gate in action."""
+    m, c = _mk_pair(
+        _free_port(),
+        env_master={"ST_SIGN2": "2"},
+        env_child={"ST_SIGN2": "0"},
+    )
+    try:
+        if m._engine is None or c._engine is None:
+            pytest.skip("native engine unavailable")
+        rng = np.random.default_rng(1)
+        for _ in range(4):
+            m.add(jnp.asarray(rng.normal(0, 1, 1 << 14), jnp.float32))
+            c.add(jnp.asarray(rng.normal(0, 1, 1 << 14), jnp.float32))
+        assert _drain([m, c]), "did not quiesce"
+        np.testing.assert_allclose(
+            np.asarray(m.read()), np.asarray(c.read()), atol=2e-5
+        )
+        cm, cc = m._engine._counters(), c._engine._counters()
+        assert int(cm[20]) == 0 and int(cc[20]) == 0, "sign2 leaked"
+        assert m._engine.link_precision(next(iter(m.st.link_ids))) == 1
+    finally:
+        m.close()
+        c.close()
+
+
+def test_governor_upshifts_under_sustained_residual_and_emits_event():
+    """The closed telemetry loop: a BYTE-BOUND link (token-bucket cap —
+    the honest stand-in for a saturated NIC) whose residual RMS refuses
+    to decay upshifts to sign2; the flip lands in the upshift counter,
+    the st_link_precision gauge and the precision_shift ring event. The
+    byte-bound gate is load-bearing: an uncapped loopback link is
+    frame-bound, where sign2 would just halve the frame rate, and the
+    governor must not engage there (test_governor_stays_quiet below)."""
+    from shared_tensor_tpu.config import CodecConfig, Config, TransportConfig
+
+    cfg = Config(
+        transport=TransportConfig(
+            # ~16 1-bit frames/s at 16 Ki: the add schedule below outruns
+            # the wire by construction, so the sendq backpressures and
+            # the residual grows — the byte-bound regime
+            bandwidth_cap_bytes_per_sec=1 << 15,
+            ack_timeout_sec=2.0,
+        ),
+        codec=CodecConfig(
+            precision_interval_sec=0.02,
+            # any non-decay counts as a stall: upshift after 2 beats
+            precision_up_ratio=0.05,
+            precision_down_ratio=0.0001,
+        ),
+    )
+    m, c = _mk_pair(_free_port(), cfg=cfg)
+    try:
+        if m._engine is None or c._engine is None:
+            pytest.skip("native engine unavailable")
+        rng = np.random.default_rng(2)
+        deadline = time.time() + 20
+        upshifted = False
+        while time.time() < deadline and not upshifted:
+            m.add(jnp.asarray(rng.normal(0, 1, 1 << 14), jnp.float32))
+            time.sleep(0.005)
+            upshifted = int(m._engine._counters()[18]) > 0
+        assert upshifted, "governor never upshifted under sustained load"
+        link = next(iter(m.st.link_ids))
+        assert m._engine.link_precision(link) == 2
+        # the flip is visible in the canonical metrics and as a ring event
+        # in the process flight recorder (the peer's recv loop drains the
+        # native ring into the hub)
+        metrics = m.metrics(canonical=True)
+        assert metrics.get("st_precision_upshifts_total", 0) > 0
+        from shared_tensor_tpu import obs as _obs
+
+        hub = _obs.hub()
+        hub.poll_native()
+        deadline2 = time.time() + 5
+        while time.time() < deadline2:
+            if any(
+                e.name == "precision_shift" for e in hub.recorder.timeline()
+            ):
+                break
+            time.sleep(0.1)
+            hub.poll_native()
+        assert any(
+            e.name == "precision_shift" for e in hub.recorder.timeline()
+        ), "precision_shift event missing from the flight recorder"
+        assert _drain([m, c]), "did not quiesce after the burst"
+    finally:
+        m.close()
+        c.close()
+
+
+def test_governor_stays_quiet_on_frame_bound_link():
+    """The byte-bound gate's other half (the r11 bimodal-bench
+    regression): an UNCAPPED loopback link under the same sustained add
+    load is frame-bound — sends never backpressure — so the governor
+    must never upshift no matter how the startup-transient rms ramps
+    (sign2 there would just halve the frame rate for the same applied
+    mass). Same aggressive thresholds as the upshift test; the only
+    difference is the absent byte pressure."""
+    from shared_tensor_tpu.config import CodecConfig, Config
+
+    cfg = Config(
+        codec=CodecConfig(
+            precision_interval_sec=0.02,
+            precision_up_ratio=0.05,
+            precision_down_ratio=0.0001,
+        )
+    )
+    m, c = _mk_pair(_free_port(), cfg=cfg)
+    try:
+        if m._engine is None or c._engine is None:
+            pytest.skip("native engine unavailable")
+        rng = np.random.default_rng(4)
+        t_end = time.time() + 3.0
+        while time.time() < t_end:
+            m.add(jnp.asarray(rng.normal(0, 1, 1 << 14), jnp.float32))
+            time.sleep(0.005)
+        cm = m.metrics(canonical=True, _warn=False)
+        assert cm.get("st_precision_upshifts_total", 0) == 0, (
+            "governor upshifted a frame-bound link"
+        )
+        assert int(m._engine._counters()[20]) == 0, "sign2 frames leaked"
+        assert m._engine.link_precision(next(iter(m.st.link_ids))) == 1
+        assert _drain([m, c]), "did not quiesce after the burst"
+    finally:
+        m.close()
+        c.close()
