@@ -29,6 +29,23 @@ tree is never wedged by any of it (exact convergence + full drain with the
 subscribers attached). Emits the subscriber tallies alongside the r09
 telemetry checks.
 
+r12 ``--kill-restore`` arm (the cluster-lifecycle acceptance artifact):
+mid-soak — updates still in flight under the chaotic node's 25% drop
+schedule — the root takes a consistent-cut snapshot (the barrier
+completes THROUGH the chaos: markers/acks ride the control plane, which
+the r06 rule keeps outside every chaos class), then the WHOLE tree is
+killed, restarted from its shards (one node deliberately restarted with
+v1 wire emission — the version-skew chaos arm: old and new nodes must
+interop mid-upgrade), soaked further under the same chaos, and compared
+against an UNINTERRUPTED arm that applies the identical add schedule.
+Gates: the restored tree re-converges to the pre-kill mass inside
+ST_RESTORE_BUDGET_S (default 45 s), both arms' final replicas agree
+within the chaos-proportional bound (drop chaos + go-back-N converge
+exactly, so the bound is float-accumulation slack), the snapshot barrier
+itself stays sub-budget, chaos fired and was repaired in the restored
+tree, and the version skew was real (mixed st_wire_version mid-restart).
+Writes CHAOS_r12.json; wired into suite_load.sh as the lifecycle gate.
+
 r11 ``--stripes N`` arm: every link in the tree runs striped over N
 sockets, and the chaotic node's plan SEVERS ONE STRIPE SOCKET of its
 uplink mid-stream (``only_stripe`` + ``sever_after_frames`` on top of the
@@ -74,6 +91,15 @@ if "--stripes" in sys.argv:
     i = sys.argv.index("--stripes")
     STRIPES = int(sys.argv[i + 1])
     del sys.argv[i : i + 2]
+KILL_RESTORE = os.environ.get("ST_CLUSTER_KILL_RESTORE", "0") == "1"
+if "--kill-restore" in sys.argv:
+    KILL_RESTORE = True
+    sys.argv.remove("--kill-restore")
+#: Wall-clock budget for the full-cluster restore: first restarted create
+#: to every node re-converged on the pre-kill mass.
+RESTORE_BUDGET_S = float(os.environ.get("ST_RESTORE_BUDGET_S", "45"))
+#: Snapshot-barrier budget (marker flood + drain-to-quiesce + shard I/O).
+SNAP_BUDGET_S = float(os.environ.get("ST_SNAP_BUDGET_S", "30"))
 # frames the chaotic node's targeted stripe carries before its sever fires
 # (one constant: both the injected FaultConfig and the artifact cite it)
 SEVER_AFTER = 4
@@ -96,8 +122,207 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def run_kill_restore(art_path: str) -> int:
+    """The r12 lifecycle acceptance arm (module docstring)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from shared_tensor_tpu.comm import faults
+    from shared_tensor_tpu.comm.peer import create_or_fetch
+    from shared_tensor_tpu.config import (
+        Config, FaultConfig, LifecycleConfig, ObsConfig, TransportConfig,
+    )
+
+    chaos_idx = NODES - 1
+    skew_idx = 1  # restarted with v1 emission (the version-skew arm)
+    seed = jnp.zeros((N,), jnp.float32)
+    env = faults.to_env(
+        FaultConfig(enabled=True, seed=SEED, drop_pct=0.25, only_link=1)
+    )
+    rng = np.random.default_rng(SEED)
+    # ONE add schedule, shared by both arms: phase 1 (pre-snapshot) and
+    # phase 2 (post-restore). The uninterrupted arm applies the identical
+    # deltas, so "same mass as an uninterrupted run" is a pairwise replica
+    # comparison, not just totals.
+    p1 = [rng.uniform(-0.5, 0.5, N).astype(np.float32) for _ in range(ADDS)]
+    p2 = [
+        rng.uniform(-0.5, 0.5, N).astype(np.float32)
+        for _ in range(max(4, ADDS // 2))
+    ]
+    total1 = np.sum(p1, axis=0, dtype=np.float64)
+    total_all = total1 + np.sum(p2, axis=0, dtype=np.float64)
+
+    def cfg(i: int, restore: str = "", skew: bool = False) -> Config:
+        return Config(
+            lifecycle=LifecycleConfig(
+                node_name=f"n{i}", restore_path=restore,
+            ),
+            transport=TransportConfig(
+                peer_timeout_sec=20.0, ack_timeout_sec=0.4
+            ),
+            obs=ObsConfig(digest_interval_sec=0.2, trace_wire=not skew),
+        )
+
+    def build(port, restore_dir=None, skew=False):
+        peers = []
+        for i in range(NODES):
+            if i == chaos_idx:
+                os.environ["ST_FAULT_PLAN"] = env["ST_FAULT_PLAN"]
+            try:
+                peers.append(
+                    create_or_fetch(
+                        "127.0.0.1", port, seed,
+                        cfg(
+                            i,
+                            restore=(
+                                os.path.join(restore_dir, f"shard_n{i}.npz")
+                                if restore_dir
+                                else ""
+                            ),
+                            skew=skew and i == skew_idx,
+                        ),
+                        timeout=60.0,
+                    )
+                )
+            finally:
+                os.environ.pop("ST_FAULT_PLAN", None)
+        return peers
+
+    def soak(peers, deltas, origin_a=0, origin_b=chaos_idx):
+        for i, d in enumerate(deltas):
+            peers[origin_a if i % 2 else origin_b].add(jnp.asarray(d))
+            time.sleep(0.015)
+
+    def converge(peers, total, budget):
+        deadline = time.time() + budget
+        while time.time() < deadline:
+            if all(
+                np.allclose(np.asarray(p.read()), total, atol=1e-3)
+                for p in peers
+            ):
+                return True
+            time.sleep(0.05)
+        return False
+
+    out = {
+        "bench": "cluster_chaos_kill_restore",
+        "nodes": NODES,
+        "n": N,
+        "adds": {"phase1": len(p1), "phase2": len(p2)},
+        "seed": SEED,
+        "chaos": {"drop_pct": 0.25, "node_index": chaos_idx},
+        "skew_node": skew_idx,
+        "budgets": {
+            "restore_sec": RESTORE_BUDGET_S, "snapshot_sec": SNAP_BUDGET_S,
+        },
+    }
+    snapdir = tempfile.mkdtemp(prefix="st_snap_r12_")
+    # ---- kill-restore arm -------------------------------------------------
+    peers = build(_free_port())
+    try:
+        out["engine_tier"] = all(p._engine is not None for p in peers)
+        soak(peers, p1)
+        # snapshot MID-SOAK: in-flight residual mass under active drop
+        # chaos — the barrier must drain and capture through it
+        t0 = time.monotonic()
+        res = peers[0].snapshot_cluster(snapdir, timeout=SNAP_BUDGET_S)
+        snap_dur = time.monotonic() - t0
+        out["snapshot"] = {
+            "ok": res["ok"], "nodes": res["nodes"],
+            "duration_sec": snap_dur,
+        }
+    finally:
+        for p in peers:
+            p.close()  # the whole-cluster kill
+    t0 = time.monotonic()
+    peers = build(_free_port(), restore_dir=snapdir, skew=True)
+    try:
+        restored = converge(peers, total1, RESTORE_BUDGET_S)
+        restore_dur = time.monotonic() - t0
+        out["restore"] = {
+            "reconverged_pre_kill_mass": restored,
+            "duration_sec": restore_dur,
+        }
+        # version skew is live mid-restart: one v1 emitter among v2 peers
+        versions = sorted({p._wire_version for p in peers})
+        out["restore"]["wire_versions"] = versions
+        soak(peers, p2)
+        kr_converged = converge(peers, total_all, 120.0)
+        kr_final = np.asarray(peers[0].read(), np.float64)
+        drained = all(p.drain(timeout=30.0, tol=1e-30) for p in peers)
+        snaps = [p.metrics(canonical=True) for p in peers]
+        retx = sum(int(s.get("st_retransmit_msgs_total", 0)) for s in snaps)
+        out["restored_arm"] = {
+            "converged": kr_converged,
+            "drained": drained,
+            "retransmits": retx,
+            "restore_total": sum(
+                int(s.get("st_restore_total", 0)) for s in snaps
+            ),
+        }
+    finally:
+        for p in peers:
+            p.close()
+    # ---- uninterrupted arm (identical schedule, no kill) ------------------
+    peers = build(_free_port())
+    try:
+        soak(peers, p1)
+        soak(peers, p2)
+        un_converged = converge(peers, total_all, 120.0)
+        un_final = np.asarray(peers[0].read(), np.float64)
+        out["uninterrupted_arm"] = {"converged": un_converged}
+    finally:
+        for p in peers:
+            p.close()
+    # ---- verdict ----------------------------------------------------------
+    # drop chaos + go-back-N converge EXACTLY, so the arms' bound is float
+    # accumulation slack, not a chaos allowance (chaos_soak's corrupt-class
+    # bounds don't apply — no corrupt faults here)
+    dev = float(np.max(np.abs(kr_final - un_final)))
+    out["arms_max_deviation"] = dev
+    out["bound"] = 1e-3
+    out["pass"] = bool(
+        out["snapshot"]["ok"]
+        and out["snapshot"]["duration_sec"] <= SNAP_BUDGET_S
+        and out["restore"]["reconverged_pre_kill_mass"]
+        and out["restore"]["duration_sec"] <= RESTORE_BUDGET_S
+        and len(out["restore"]["wire_versions"]) == 2  # skew was real
+        and out["restored_arm"]["converged"]
+        and out["restored_arm"]["drained"]
+        and out["restored_arm"]["retransmits"] >= 1  # chaos repaired
+        and out["uninterrupted_arm"]["converged"]
+        and dev <= out["bound"]
+    )
+    doc = json.dumps(out, indent=2)
+    print(doc)
+    if not os.path.isabs(art_path):
+        art_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            art_path,
+        )
+    with open(art_path, "w") as f:
+        f.write(doc + "\n")
+    print(
+        f"cluster_chaos --kill-restore: snapshot "
+        f"{out['snapshot']['duration_sec']:.2f}s, restore "
+        f"{out['restore']['duration_sec']:.2f}s, arms max dev {dev:.2e} -> "
+        f"{'PASS' if out['pass'] else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return 0 if out["pass"] else 1
+
+
 def main() -> int:
     art_path = sys.argv[1] if len(sys.argv) > 1 else "CHAOS_r09.json"
+    if KILL_RESTORE:
+        return run_kill_restore(
+            sys.argv[1] if len(sys.argv) > 1 else "CHAOS_r12.json"
+        )
     import jax
     import jax.numpy as jnp
     import numpy as np

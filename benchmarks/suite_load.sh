@@ -76,18 +76,37 @@ if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_SERVE:-1}" = "1" ]; then
     >/dev/null 2>>"$OUT" || FAILED=1
 fi
 
+# Lifecycle gate (r12): the kill-and-restore chaos arm — consistent-cut
+# snapshot mid-soak under drop chaos, whole-tree kill, restart from shards
+# (one node version-skewed to v1 emission: the rolling-upgrade interop
+# proof), and a final-replica comparison against an uninterrupted arm
+# applying the identical add schedule. Fails the suite if the snapshot
+# barrier or the restore blows its time budget (ST_SNAP_BUDGET_S /
+# ST_RESTORE_BUDGET_S) or the arms diverge. Runs AFTER the perf-floor
+# gate so the committed CHAOS artifact always rides a passing floor in
+# the same suite run. ST_SUITE_LIFECYCLE=0 skips.
+if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_LIFECYCLE:-1}" = "1" ]; then
+  LIFE_OUT="${ST_SUITE_LIFECYCLE_OUT:-CHAOS_r12.json}"
+  JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py "$LIFE_OUT" \
+    --kill-restore >/dev/null 2>>"$OUT" || FAILED=1
+fi
+
 # Sanitizer arm (r11): striping + adaptive precision put new hot code in
 # all three native libs (per-stripe sender/receiver threads + reassembly,
 # sign2 pack/unpack + cascade kernels, the precision governor). Run the
 # striped+adaptive sanitizer test (ASan+UBSan via make -C native sanitize;
 # the sign2 suite + the per-stripe chaos tests) as part of the loaded
 # suite so a latent memory bug in the new planes turns the suite red, not
-# just the nightly. ST_SUITE_SAN=0 skips (e.g. a box without the gcc
-# sanitizer runtimes — the test itself also skips cleanly there).
+# just the nightly. r12 adds the lifecycle sanitizer arm in the same
+# invocation: the snapshot barrier's one-mutex bulk captures race the
+# live codec threads — exactly ASan territory. ST_SUITE_SAN=0 skips
+# (e.g. a box without the gcc sanitizer runtimes — the tests themselves
+# also skip cleanly there).
 if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_SAN:-1}" = "1" ]; then
-  echo "--- sanitizer arm (striped+adaptive) ---" >>"$OUT"
+  echo "--- sanitizer arm (striped+adaptive + lifecycle) ---" >>"$OUT"
   JAX_PLATFORMS=cpu python -m pytest \
     tests/test_sanitizers.py::test_striped_adaptive_suite_under_asan_ubsan \
+    tests/test_sanitizers.py::test_lifecycle_suite_under_asan_ubsan \
     -m slow -q -p no:cacheprovider >>"$OUT" 2>&1 || FAILED=1
 fi
 exit "$FAILED"

@@ -489,6 +489,24 @@ struct Engine {
   std::deque<std::pair<int32_t, std::vector<uint8_t>>> ctrl;
 
   std::atomic<bool> stop{false};
+  // Sender pass counter (r12): incremented at the top of every sender-loop
+  // iteration. st_engine_pause's synchronous wait uses it to bound the one
+  // in-flight pass that may still enqueue data produced from pre-pause
+  // state — the barrier's SNAP marker must follow the sender's LAST data
+  // message on every link, and a marker enqueued while a pass is mid-
+  // flight would otherwise be overtaken (consistent-cut ordering).
+  std::atomic<uint64_t> sender_pass{0};
+  // r12 lifecycle quiesce (st_engine_pause): the sender produces NO new
+  // data frames while paused — quantize/encode/send of fresh residual mass
+  // stops, so the cluster-wide consistent cut can drain every in-flight
+  // ledger to empty. Everything else keeps running: ACK processing,
+  // go-back-N retransmission (in-flight delivery must COMPLETE for the
+  // barrier to quiesce), control traffic, and FRESH beats on already-
+  // drained subscriber links (they only fire when the residual is empty,
+  // so a paused-but-current subscriber keeps verifying its bound instead
+  // of going stale — and a paused-with-mass one gets no mark, so a read
+  // across the cut can never falsely verify).
+  std::atomic<bool> paused{false};
   // Sealed ingress (graceful-leave step 1): DATA/BURST messages are popped
   // and DISCARDED — not applied, not counted, not ACKed — so their senders'
   // ledgers keep them and re-deliver after our departure's re-graft. This
@@ -858,6 +876,7 @@ void sender_loop(Engine* e) {
   const uint64_t gov_interval_ns =
       e->gov_interval > 0 ? (uint64_t)(e->gov_interval * 1e9) : 100000000ull;
   while (!e->stop.load()) {
+    e->sender_pass.fetch_add(1);  // pass boundary (st_engine_pause sync)
     uint64_t seq_before;
     {
       std::lock_guard<std::mutex> lk(e->wmu);
@@ -976,6 +995,12 @@ void sender_loop(Engine* e) {
           lk2.gov_prev = rms;
           lk2.gov_last_ns = pass_ns;
         }
+        // r12 lifecycle quiesce: paused means no NEW production on any
+        // link (the struct comment). Placed after the FRESH beat (which
+        // only fires on a drained residual) and before the quantize path.
+        // seq_cst load: st_engine_pause's pass-boundary handshake counts
+        // on a pass that starts after the store observing it.
+        if (e->paused.load()) continue;
         if (!lk2.dirty) continue;
         // go-back-N send window: a full unacked ledger (stalled peer)
         // stops NEW production on this link; the residual keeps
@@ -2339,12 +2364,54 @@ __attribute__((visibility("default"))) int32_t st_engine_poll_ctrl(
   return n;
 }
 
+// r12 lifecycle quiesce: stop/resume NEW data production on the sender
+// (the Engine::paused struct comment). ACKs, go-back-N retransmission,
+// control traffic and drained-link FRESH beats keep running — the cluster
+// consistent-cut barrier (comm/peer.py) drains every in-flight ledger to
+// empty under this flag before any shard is captured.
+__attribute__((visibility("default"))) void st_engine_pause(void* h,
+                                                            int32_t p) {
+  if (!h) return;
+  auto* e = (Engine*)h;
+  e->paused.store(p != 0);
+  e->wake();
+  if (p) {
+    // SYNCHRONOUS pause: a sender pass that began before the store may
+    // still be quantizing pre-pause residual state into the sendq. Wait
+    // for two pass boundaries (the in-flight pass finishing + one full
+    // pass that observed the flag), so by return NO data message produced
+    // from pre-pause state can be enqueued after the caller's barrier
+    // marker. Bounded (2 s) so a stopped/stuck sender can't wedge the
+    // caller — the barrier's own quiesce gate still protects the capture.
+    uint64_t g0 = e->sender_pass.load();
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(2);
+    while (!e->stop.load() && e->sender_pass.load() < g0 + 2 &&
+           std::chrono::steady_clock::now() < deadline) {
+      e->wake();
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+}
+
 // Checkpoint restore: replace the replica and the residuals of links that
 // exist both in the engine and in the checkpoint, atomically (the inverse
-// of st_engine_snapshot_all; utils/checkpoint.load_shared).
-__attribute__((visibility("default"))) void st_engine_restore(
+// of st_engine_snapshot_ex; utils/checkpoint.load_shared). ``aux``
+// (nullable — 4 u64 per link, the snapshot_ex layout) restores each
+// surviving link's precision-governor state: wire precision (byte 0 of
+// aux[2]) and previous-RMS sample (aux[3], bit-cast double), with the
+// vote counters reset — the governor resumes from the checkpointed
+// verdict instead of a cold start. Live links' tx/rx wire seqs are NEVER
+// touched: the TCP streams they count are live and their counters moved
+// on — resetting them to checkpoint values would open a seq gap the
+// go-back-N machinery reads as a retransmission storm / black hole. The
+// quiesce barrier makes this sound: ledgers are drained empty before a
+// cluster restore, so both ends of every link agree without seq surgery
+// (the checkpointed seqs are persisted for the manifest's consistency
+// audit, not for replay).
+__attribute__((visibility("default"))) void st_engine_restore_ex(
     void* h, const float* values, int32_t n_links, const int32_t* ids,
-    const float* resids) {
+    const float* resids, const uint64_t* aux /* nullable */) {
   if (!h) return;
   auto* e = (Engine*)h;
   {
@@ -2352,7 +2419,7 @@ __attribute__((visibility("default"))) void st_engine_restore(
     fold_pending(e);  // pre-restore adds belong to the superseded state
     std::memcpy(e->values.data(), values, (size_t)e->total * 4);
     for (int32_t i = 0; i < n_links; i++) {
-      if (ids[i] == -1) {  // the carry pseudo-slot (snapshot_all)
+      if (ids[i] == -1) {  // the carry pseudo-slot (snapshot_ex)
         e->carry.assign((size_t)e->total, 0.0f);
         std::memcpy(e->carry.data(), resids + (size_t)i * e->total,
                     (size_t)e->total * 4);
@@ -2361,21 +2428,48 @@ __attribute__((visibility("default"))) void st_engine_restore(
       }
       auto it = e->links.find(ids[i]);
       if (it == e->links.end()) continue;
-      std::memcpy(it->second.resid.data(), resids + (size_t)i * e->total,
+      ELink& l = it->second;
+      std::memcpy(l.resid.data(), resids + (size_t)i * e->total,
                   (size_t)e->total * 4);
-      it->second.dirty = true;
-      it->second.pvalid = false;  // restore bypasses the fused kernels
+      l.dirty = true;
+      l.pvalid = false;  // restore bypasses the fused kernels
+      if (aux) {
+        int prec = (int)(aux[(size_t)i * 4 + 2] & 0xFF);
+        if (prec == 1 || prec == 2) l.prec = prec;
+        uint64_t gb = aux[(size_t)i * 4 + 3];
+        double gp;
+        std::memcpy(&gp, &gb, 8);
+        if (std::isfinite(gp)) l.gov_prev = gp;  // -1.0 sentinel included
+        l.gov_up = l.gov_down = 0;
+        l.gov_quiet = 0;
+        l.gov_bp = 0;
+      }
     }
   }
   ((Engine*)h)->wake();
 }
 
-// Consistent point-in-time (values, residuals) snapshot under ONE lock —
-// the checkpoint primitive (core.SharedTensor.snapshot_all). resid_out must
-// hold max_links * total floats; returns the number of links written.
-__attribute__((visibility("default"))) int32_t st_engine_snapshot_all(
+__attribute__((visibility("default"))) void st_engine_restore(
+    void* h, const float* values, int32_t n_links, const int32_t* ids,
+    const float* resids) {
+  st_engine_restore_ex(h, values, n_links, ids, resids, nullptr);
+}
+
+// Consistent point-in-time (values, residuals, link aux) snapshot under
+// ONE lock — the checkpoint primitive (core.SharedTensor.snapshot_all).
+// resid_out must hold max_links * total floats; aux_out (nullable) holds
+// 4 u64 per link: [0] tx wire seq (last DATA/BURST sent), [1] rx count
+// (last in-order wire seq accepted == the cumulative ACK value), [2] the
+// link's wire precision in byte 0 with flag bits at 8+ (bit 8 subscriber,
+// bit 9 peer-sign2-capable, bit 10 ranged), [3] the governor's previous
+// RMS sample bit-cast from double. One mutex acquisition makes the
+// capture atomic against the codec threads: a cascade quantize runs
+// entirely under e->mu, so sign2 residual planes and in-flight ledgered
+// frames can never tear the snapshot (tests/test_checkpoint.py pins the
+// byte-exact round trip). Returns the number of links written.
+__attribute__((visibility("default"))) int32_t st_engine_snapshot_ex(
     void* h, float* values_out, int32_t* ids_out, float* resid_out,
-    int32_t max_links) {
+    uint64_t* aux_out /* nullable */, int32_t max_links) {
   if (!h) return 0;
   auto* e = (Engine*)h;
   std::lock_guard<std::mutex> lk(e->mu);
@@ -2384,9 +2478,22 @@ __attribute__((visibility("default"))) int32_t st_engine_snapshot_all(
   int32_t n = 0;
   for (auto& kv : e->links) {
     if (n >= max_links) break;
+    ELink& l = kv.second;
     ids_out[n] = kv.first;
-    std::memcpy(resid_out + (size_t)n * e->total, kv.second.resid.data(),
+    std::memcpy(resid_out + (size_t)n * e->total, l.resid.data(),
                 (size_t)e->total * 4);
+    if (aux_out) {
+      uint64_t* a = aux_out + (size_t)n * 4;
+      a[0] = l.tx_seq;
+      a[1] = l.rx_count;
+      uint64_t flags = (l.subscriber ? 1u : 0u) | (l.peer_sign2 ? 2u : 0u) |
+                       (l.ranged ? 4u : 0u);
+      a[2] = (uint64_t)(l.prec & 0xFF) | (flags << 8);
+      double gp = l.gov_prev;
+      uint64_t gb;
+      std::memcpy(&gb, &gp, 8);
+      a[3] = gb;
+    }
     n++;
   }
   if (e->has_carry && n < max_links) {
@@ -2395,9 +2502,17 @@ __attribute__((visibility("default"))) int32_t st_engine_snapshot_all(
     ids_out[n] = -1;
     std::memcpy(resid_out + (size_t)n * e->total, e->carry.data(),
                 (size_t)e->total * 4);
+    if (aux_out) std::memset(aux_out + (size_t)n * 4, 0, 32);
     n++;
   }
   return n;
+}
+
+__attribute__((visibility("default"))) int32_t st_engine_snapshot_all(
+    void* h, float* values_out, int32_t* ids_out, float* resid_out,
+    int32_t max_links) {
+  return st_engine_snapshot_ex(h, values_out, ids_out, resid_out, nullptr,
+                               max_links);
 }
 
 }  // extern "C"

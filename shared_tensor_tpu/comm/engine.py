@@ -145,6 +145,19 @@ def load_engine() -> Optional[ctypes.CDLL]:
         lib.st_engine_restore.argtypes = [
             ctypes.c_void_p, _f32p, ctypes.c_int32, _i32p, _f32p,
         ]
+        # r12 lifecycle: quiesce + the extended checkpoint ABI (per-link
+        # tx/rx wire seqs, precision + governor state alongside residuals)
+        lib.st_engine_pause.restype = None
+        lib.st_engine_pause.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.st_engine_snapshot_ex.restype = ctypes.c_int32
+        lib.st_engine_snapshot_ex.argtypes = [
+            ctypes.c_void_p, _f32p, _i32p, _f32p, _u64p, ctypes.c_int32,
+        ]
+        lib.st_engine_restore_ex.restype = None
+        lib.st_engine_restore_ex.argtypes = [
+            ctypes.c_void_p, _f32p, ctypes.c_int32, _i32p, _f32p,
+            ctypes.c_void_p,  # aux (nullable -> void_p)
+        ]
         _LIB = lib
     except Exception:
         _LIB = None
@@ -490,6 +503,100 @@ class EngineTensor:
             self._handle(), values, ids, resids.reshape(-1), 64
         )
         return values, {int(ids[i]): resids[i].copy() for i in range(n)}
+
+    # -- r12 cluster lifecycle ----------------------------------------------
+
+    def pause(self, paused: bool = True) -> None:
+        """Quiesce (or resume) the sender's NEW data production — the
+        consistent-cut barrier primitive. In-flight delivery (ACKs,
+        go-back-N retransmission) and control traffic keep running, so a
+        paused engine drains its ledgers to empty; FRESH beats continue on
+        already-drained subscriber links only (st_engine_pause)."""
+        if self._h:  # pausing a destroyed engine is a no-op, not an error
+            self._lib.st_engine_pause(self._h, 1 if paused else 0)
+
+    def snapshot_ex(
+        self,
+    ) -> tuple[np.ndarray, dict[int, np.ndarray], dict[int, dict]]:
+        """snapshot_all plus each link's lifecycle aux state: ``tx_seq``
+        (last DATA/BURST wire seq sent), ``rx_count`` (last in-order seq
+        accepted == the cumulative ACK value), ``prec`` (governor wire
+        precision), ``sub``/``sign2``/``ranged`` capability flags and
+        ``gov_prev`` (the governor's previous RMS sample). One native lock
+        acquisition — atomic against in-flight cascade quantizes and sign2
+        frames (tests/test_checkpoint.py pins the byte-exact round trip).
+        The carry pseudo-link -1 carries no aux."""
+        values = np.empty(self.spec.total, np.float32)
+        ids = np.empty(64, np.int32)
+        resids = np.empty((64, self.spec.total), np.float32)
+        aux = np.zeros((64, 4), np.uint64)
+        n = self._lib.st_engine_snapshot_ex(
+            self._handle(), values, ids, resids.reshape(-1),
+            aux.reshape(-1), 64,
+        )
+        links: dict[int, np.ndarray] = {}
+        meta: dict[int, dict] = {}
+        for i in range(n):
+            lid = int(ids[i])
+            links[lid] = resids[i].copy()
+            if lid >= 0:
+                packed = int(aux[i, 2])
+                meta[lid] = {
+                    "tx_seq": int(aux[i, 0]),
+                    "rx_count": int(aux[i, 1]),
+                    "prec": packed & 0xFF,
+                    "sub": bool(packed >> 8 & 1),
+                    "sign2": bool(packed >> 9 & 1),
+                    "ranged": bool(packed >> 10 & 1),
+                    "gov_prev": float(
+                        np.uint64(aux[i, 3]).view(np.float64)
+                    ),
+                }
+        return values, links, meta
+
+    def restore_ex(
+        self,
+        values: np.ndarray,
+        links: dict[int, np.ndarray],
+        meta: Optional[dict[int, dict]] = None,
+    ) -> None:
+        """restore_state plus per-link governor state (``prec`` and
+        ``gov_prev`` from :meth:`snapshot_ex`'s meta). Live links' wire
+        seqs are deliberately NOT rewound — the TCP streams they count are
+        live; the barrier's drained-empty ledgers are what make a cluster
+        restore seq-consistent (st_engine_restore_ex docstring)."""
+        v = np.ascontiguousarray(values, np.float32)
+        if v.shape != (self.spec.total,):
+            raise ValueError(f"values shape {v.shape} != ({self.spec.total},)")
+        ids = np.asarray(sorted(links), np.int32)
+        resids = np.ascontiguousarray(
+            np.stack([np.asarray(links[i], np.float32) for i in ids])
+            if len(ids)
+            else np.zeros((0, self.spec.total), np.float32)
+        )
+        aux_ptr = None
+        if meta is not None:
+            aux = np.zeros((max(1, len(ids)), 4), np.uint64)
+            for i, lid in enumerate(ids):
+                m = meta.get(int(lid))
+                if m is None:
+                    continue
+                flags = (
+                    (1 if m.get("sub") else 0)
+                    | (2 if m.get("sign2") else 0)
+                    | (4 if m.get("ranged") else 0)
+                )
+                aux[i, 0] = np.uint64(m.get("tx_seq", 0))
+                aux[i, 1] = np.uint64(m.get("rx_count", 0))
+                aux[i, 2] = np.uint64((m.get("prec", 0) & 0xFF) | flags << 8)
+                aux[i, 3] = np.float64(m.get("gov_prev", -1.0)).view(
+                    np.uint64
+                )
+            aux = np.ascontiguousarray(aux.reshape(-1))
+            aux_ptr = aux.ctypes.data_as(ctypes.c_void_p)
+        self._lib.st_engine_restore_ex(
+            self._handle(), v, len(ids), ids, resids.reshape(-1), aux_ptr
+        )
 
     def restore_state(
         self, values: np.ndarray, links: dict[int, np.ndarray]

@@ -442,6 +442,42 @@ class SharedTensorPeer:
             elif self.config.frame_burst == 0 and self._burst > 1:
                 self._burst = min(self._burst, _python_tier_auto_burst(spec))
             self.st = SharedTensor(template, codec, seed_values=self.is_master)
+        # r12 cluster lifecycle (consistent-cut snapshot/restore, drain,
+        # operator surface). All barrier state is owned by the RECV thread
+        # (_lc_tick / the SNAP/SNAP_ACK/RESUME handlers); public APIs
+        # enqueue requests and wait on _lc_done. _paused gates NEW data
+        # production on both tiers (engine: st_engine_pause; python: the
+        # send loop) while in-flight delivery keeps draining — the
+        # consistent cut is "paused + every ledger empty".
+        self._lc_requests: deque = deque()
+        self._lc_api_mu = threading.Lock()  # serializes _lc_request callers
+        self._lc_op: Optional[dict] = None
+        self._lc_done = threading.Event()
+        self._lc_result: Optional[dict] = None
+        self._paused = False
+        self._pause_deadline = 0.0
+        self._snap_total = 0
+        self._snap_acks = 0
+        self._snap_last_dur = 0.0
+        self._restore_total = 0
+        self._drain_total = 0
+        self._draining = False
+        self._lc_errors = 0
+        self._ctl_last_poll = 0.0
+        self._restored_from: Optional[str] = None
+        # consistent-cut ordering state (python data plane): the send
+        # loop's pass counter (pause is synchronous across one in-flight
+        # pass — a pass already quantizing when the flag lands may still
+        # enqueue, and a barrier marker must never overtake its data) and
+        # the device pipeline's queued-frame gauge (markers only flood
+        # once the paused pipeline has fully drained into the sockets)
+        self._send_pass = 0
+        self._pipe_frames = 0
+        if self.config.lifecycle.restore_path:
+            # full-cluster restart path: load this node's shard BEFORE the
+            # data plane starts (threads are not running yet, so no lock
+            # ordering to worry about)
+            self._restore_at_startup(self.config.lifecycle.restore_path)
         self._ready = threading.Event()
         self._error: Optional[Exception] = None
         if self.is_master:
@@ -639,6 +675,755 @@ class SharedTensorPeer:
         self.close()
         return ok
 
+    # -- r12 cluster lifecycle (tentpole) ------------------------------------
+    #
+    # Consistent-cut protocol. The root pauses its own production, floods a
+    # wire.SNAP marker down every child link, and each node on SNAP: pauses,
+    # forwards the marker, waits for (a) every child's SNAP_ACK and (b) its
+    # own in-flight ledgers to drain empty, then captures its shard (or
+    # loads it — op "load" is the in-place restore) and acks up. Per-link
+    # FIFO makes this a Chandy-Lamport-style cut with EMPTY channels: the
+    # marker follows the sender's last pre-pause data, a child's SNAP_ACK
+    # follows its last pre-capture data, and "ledger empty" means
+    # everything we sent was applied — so at every capture instant both
+    # ends of every link agree on the stream position and nothing is in
+    # flight. No retransmission storm and no double-apply on restore, with
+    # no seq surgery. Control traffic is outside the chaos classes (r06
+    # rule), so a barrier completes deterministically even mid-chaos.
+
+    @property
+    def node_name(self) -> str:
+        """Stable lifecycle name (LifecycleConfig.node_name, or the
+        process-unique ``node-<obs_id>`` fallback)."""
+        return (
+            self.config.lifecycle.node_name or f"node-{self.node.obs_id}"
+        )
+
+    def snapshot_cluster(
+        self,
+        dirpath: str,
+        snap_id: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        """Root-initiated consistent-cut snapshot of the WHOLE tree into
+        ``dirpath`` (one shard per node + MANIFEST.json with per-node
+        sha256 digests). Blocks until the barrier completes; the tree is
+        resumed before this returns — on success, failure, or timeout (a
+        lifecycle op may fail, the cluster must never stay paused).
+        Returns the result dict (``manifest``, ``duration_sec``, ...)."""
+        if self._uplink is not None:
+            raise RuntimeError(
+                "snapshot_cluster is root-initiated: this node has an "
+                "uplink (use ctl against the root, or call it there)"
+            )
+        return self._lc_request(
+            {
+                "op": "save",
+                "dir": str(dirpath),
+                "id": str(snap_id or f"snap-{time.monotonic_ns():x}"),
+            },
+            timeout,
+        )
+
+    def restore_cluster(
+        self, dirpath: str, timeout: Optional[float] = None
+    ) -> dict:
+        """Root-initiated IN-PLACE restore of a live tree to the
+        consistent cut under ``dirpath``: same barrier as
+        :meth:`snapshot_cluster`, but at the quiesced instant every node
+        LOADS its shard (replica + surviving links' residuals + carry +
+        governor state) instead of writing one. Link wire seqs are never
+        rewound — the drained-empty ledgers are what make the restored
+        residuals pairwise consistent (st_engine_restore_ex). Subscriber
+        links are re-seeded from the restored replica, so no FRESH mark
+        can verify a read across the cut. Requires unchanged membership
+        since the snapshot for full fidelity: residuals of links that no
+        longer exist are dropped (their subtrees' own diff handshakes
+        already repaired that mass — the load_shared contract)."""
+        from ..utils import checkpoint as ckpt
+
+        problems = ckpt.verify_manifest(dirpath)
+        if problems:
+            raise ValueError(
+                f"snapshot at {dirpath} fails its manifest audit: "
+                + "; ".join(problems)
+            )
+        if self._uplink is not None:
+            raise RuntimeError("restore_cluster is root-initiated")
+        return self._lc_request(
+            {
+                "op": "load",
+                "dir": str(dirpath),
+                "id": str(ckpt.load_manifest(dirpath).get("snap_id", "?")),
+            },
+            timeout,
+        )
+
+    def drain_node(self, target: str) -> None:
+        """Planned migration: route a drain command (wire.CTL) down the
+        tree to ``target``, which then runs the r06-proven graceful exit —
+        seal ingress, drain everything it owes, close — and its children
+        re-graft through the quarantine → carry → re-graft path with zero
+        mass loss. Fire-and-forget: watch ``obs.top``'s drain row (or the
+        membership events) for completion."""
+        if self._uplink is not None:
+            raise RuntimeError("drain_node is root-initiated")
+        if self.config.transport.wire_compat:
+            raise RuntimeError(
+                "drain routing needs the native protocol's control plane"
+            )
+        if str(target) == self.node_name:
+            raise ValueError(
+                "cannot drain the root from itself — fail the root over "
+                "first (master failover) or drain its children instead"
+            )
+        doc = {"op": "drain", "target": str(target), "from": self.node_name}
+        if self._obs is not None:
+            self._obs.event("ctl_cmd", self.node.obs_id, detail="drain")
+        self._ctl_forward(doc, exclude=None)
+
+    def _lc_request(self, req: dict, timeout: Optional[float]) -> dict:
+        if self.config.transport.wire_compat:
+            raise RuntimeError(
+                "the lifecycle barrier needs the native protocol's typed "
+                "control plane — the reference wire format cannot carry it "
+                "(single-peer save_shared/load_shared still works)"
+            )
+        budget = (
+            timeout
+            if timeout is not None
+            else self.config.lifecycle.snapshot_timeout_sec
+        )
+        # one barrier at a time: _lc_done/_lc_result are a single slot, so
+        # concurrent API callers serialize here instead of a second
+        # request's overlap-refusal waking the first with a spurious
+        # failure while its barrier is still running. Results are also
+        # MATCHED to requests by uid: a caller that timed out leaves its
+        # barrier running, and its late result must never be handed to
+        # the next caller as that caller's own verdict.
+        import uuid as _uuid
+
+        req["req"] = _uuid.uuid4().hex
+        with self._lc_api_mu:
+            req["deadline"] = time.monotonic() + budget
+            req["budget_sec"] = budget
+            self._lc_done.clear()
+            self._lc_result = None
+            self._lc_requests.append(req)
+            self._wake.set()
+            deadline = time.monotonic() + budget + 10.0
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"lifecycle {req['op']} barrier did not complete "
+                        f"inside {budget}s (+grace)"
+                    )
+                if not self._lc_done.wait(min(remaining, 1.0)):
+                    continue
+                res = self._lc_result
+                if res is not None and res.get("req") == req["req"]:
+                    break
+                # a previously-abandoned barrier's late verdict: discard
+                # and keep waiting for OUR result
+                self._lc_done.clear()
+        if not res.get("ok"):
+            raise RuntimeError(
+                f"lifecycle {req['op']} failed: {res.get('error')}"
+            )
+        return res
+
+    def _set_paused(self, paused: bool) -> None:
+        """Quiesce (or resume) data production. Pausing is SYNCHRONOUS
+        across one in-flight sender pass on BOTH tiers: the engine's
+        st_engine_pause waits out its sender's pass boundary, and the
+        python tier waits for two _send_loop pass increments — a pass
+        already past its paused-check when the flag lands may still
+        enqueue data produced from pre-pause state, and the consistent
+        cut's SNAP marker must follow the last such message on every
+        link, never overtake it."""
+        if paused == self._paused:
+            return
+        self._paused = paused
+        if self._engine is not None:
+            self._engine.pause(paused)
+        elif paused and self._send_thread.is_alive():
+            g0 = self._send_pass
+            deadline = time.monotonic() + 2.0
+            while (
+                self._send_pass < g0 + 2
+                and time.monotonic() < deadline
+                and not self._stop.is_set()
+            ):
+                self._wake.set()
+                time.sleep(0.001)
+        self._pause_deadline = (
+            time.monotonic() + self.config.lifecycle.pause_timeout_sec
+            if paused
+            else 0.0
+        )
+        if self._obs is not None:
+            self._obs.event(
+                "lifecycle_pause" if paused else "lifecycle_resume",
+                self.node.obs_id,
+            )
+        self._wake.set()
+
+    def _lc_children(self, exclude: Optional[int] = None) -> list[int]:
+        """Writer links the barrier/CTL flood covers: every attached codec
+        link except the uplink, subscriber leaves (no shard, no drain —
+        they re-seed from scratch), and ``exclude`` (the marker's source)."""
+        up = self._uplink
+        return [
+            l
+            for l in self.st.link_ids
+            if l >= 0
+            and l != up
+            and l != exclude
+            and l not in self._sub_links
+        ]
+
+    def _ctl_forward(self, doc: dict, exclude: Optional[int]) -> None:
+        payload = wire.encode_lifecycle(wire.CTL, doc)
+        for link in self._lc_children(exclude):
+            try:
+                self._send_blocking(link, payload)
+            except Exception:
+                log.exception("CTL forward failed on link %d", link)
+
+    def _lc_begin(self, doc: dict, from_link: Optional[int]) -> None:
+        """Enter the barrier (recv thread only). ``from_link`` is the
+        uplink that delivered the SNAP marker; None = root-initiated."""
+        if self._lc_op is not None:
+            if doc.get("id") == self._lc_op["id"]:
+                return  # duplicate marker (e.g. replayed): already in it
+            msg = (
+                f"{self.node_name}: lifecycle barrier overlap "
+                f"({self._lc_op['id']} active, {doc.get('id')} refused)"
+            )
+            log.warning(msg)
+            self._lc_errors += 1
+            if from_link is None:
+                self._lc_result = {
+                    "ok": False, "error": msg, "req": doc.get("req"),
+                }
+                self._lc_done.set()
+            else:
+                # NACK so the parent's barrier completes with the error
+                # recorded instead of hanging on this subtree
+                self._send_blocking(
+                    from_link,
+                    wire.encode_lifecycle(
+                        wire.SNAP_ACK,
+                        {"id": doc.get("id"), "nodes": [], "errors": [msg]},
+                    ),
+                )
+            return
+        op = {
+            "op": doc.get("op", "save"),
+            "id": str(doc.get("id")),
+            "dir": str(doc.get("dir", "")),
+            "req": doc.get("req"),
+            "from": from_link,
+            "t0": time.monotonic(),
+            "deadline": doc.get("deadline"),
+            # the barrier's time budget: the root's remaining budget as
+            # carried by the marker; a budget-less marker (shouldn't
+            # happen from this build's roots) falls back to the LOCAL
+            # pause timeout — the conservative never-stay-paused default
+            "budget": float(
+                doc.get(
+                    "budget_sec",
+                    self.config.lifecycle.snapshot_timeout_sec
+                    if from_link is None
+                    else self.config.lifecycle.pause_timeout_sec,
+                )
+            ),
+            "waiting": set(self._lc_children(from_link)),
+            "entries": [],
+            "errors": [],
+            "marked": False,  # markers flood from _lc_tick once the
+            # paused data plane has fully flushed (ordering note there)
+            "captured": False,
+            "acked": False,  # SNAP_ACK delivered (retried until it is)
+        }
+        self._lc_op = op
+        self._set_paused(True)
+        # the pause safety deadline scales to the BARRIER's budget, not
+        # the bare pause_timeout: a deep tree's barrier legitimately
+        # outlives the default 30 s (slow drains), and a captured child
+        # auto-resuming mid-barrier would silently tear the cut the root
+        # then reports as ok. The marker carries the root's remaining
+        # budget down (+5 s RESUME-propagation grace); the deadline still
+        # bounds a dead-root wedge.
+        self._pause_deadline = time.monotonic() + op["budget"] + 5.0
+        if self._obs is not None:
+            self._obs.event(
+                "snap_begin", self.node.obs_id, arg=len(op["waiting"]),
+                detail=op["op"],
+            )
+
+    def _lc_mark_children(self, op: dict) -> None:
+        """Flood the SNAP marker down — only AFTER every data message this
+        node will ever send pre-cut has been DELIVERED: _set_paused already
+        synchronized the in-flight sender pass, the device-tier pipeline
+        gauge must read empty (a paused pipeline only drains), and every
+        unacked ledger must be empty. The ledger condition is what makes
+        the cut sound under LOSS: a chaos-dropped frame's go-back-N
+        retransmission would otherwise arrive AFTER the marker — applied
+        past the receiver's capture while our shard records it delivered,
+        i.e. mass in neither shard (fatal for the in-place restore, which
+        has no diff-join to re-derive it). Paused production + active
+        retransmission drain the ledgers in bounded time; a black-holed
+        link tears down at ack_retry_limit and leaves the barrier through
+        the LINK_DOWN error path."""
+        if self._engine is None and self._pipe_frames > 0:
+            return  # pipeline still draining; next tick re-checks
+        if self.st.inflight_total() != 0:
+            return  # undelivered pre-cut data; retransmission is on it
+        op["marked"] = True
+        now = time.monotonic()
+        remaining = (
+            op["deadline"] - now
+            if op["from"] is None and op.get("deadline")
+            else op["budget"] - (now - op["t0"])
+        )
+        fwd = wire.encode_lifecycle(
+            wire.SNAP,
+            {
+                "op": op["op"], "id": op["id"], "dir": op["dir"],
+                "parent": self.node_name,
+                # the root's remaining budget rides the marker so every
+                # node's pause deadline covers the WHOLE barrier
+                "budget_sec": max(5.0, remaining),
+            },
+        )
+        for link in list(op["waiting"]):
+            if not self._send_blocking(link, fwd):
+                op["waiting"].discard(link)
+                op["errors"].append(
+                    f"{self.node_name}: SNAP marker send failed on link "
+                    f"{link}"
+                )
+
+    def _lc_quiesced(self) -> bool:
+        """Paused AND nothing in flight: every unacked ledger empty (our
+        sends were applied by their receivers) and every transport send
+        queue drained (our markers/acks actually left)."""
+        if self.st.inflight_total() != 0:
+            return False
+        for link in self.node.links:
+            s = self.node.stats(link)
+            if s is not None and s.send_queue != 0:
+                return False
+        return True
+
+    def _lc_tick(self) -> None:
+        """One barrier-driving pass (recv thread, every loop iteration)."""
+        while self._lc_requests:
+            self._lc_begin(self._lc_requests.popleft(), None)
+        op = self._lc_op
+        now = time.monotonic()
+        if op is None:
+            if (
+                self._paused
+                and self._pause_deadline
+                and now > self._pause_deadline
+            ):
+                # never-leave-paused safety net (op state already gone)
+                log.warning("lifecycle pause expired with no barrier — resuming")
+                self._lc_errors += 1
+                self._set_paused(False)
+            self._ctl_poll(now)
+            return
+        if op["from"] is None:
+            if op.get("deadline") and now > op["deadline"]:
+                missing = sorted(op["waiting"])
+                op["errors"].append(
+                    f"{self.node_name}: barrier timeout "
+                    f"(awaiting links {missing})" if missing else
+                    f"{self.node_name}: barrier timeout (quiesce)"
+                )
+                self._lc_finish(ok=False)
+                return
+        elif now > self._pause_deadline:
+            # RESUME never arrived (root/parent died mid-barrier): unpause
+            # rather than stay frozen — the op is abandoned
+            log.warning(
+                "lifecycle barrier %s: no RESUME before the pause "
+                "deadline — auto-resuming", op["id"],
+            )
+            self._lc_errors += 1
+            self._lc_op = None
+            self._set_paused(False)
+            return
+        if not op["marked"]:
+            self._lc_mark_children(op)
+        if op["captured"]:
+            if op["from"] is not None and not op["acked"]:
+                # the SNAP_ACK send failed (or over-cap encode fell back)
+                # on an earlier tick: retry until delivered or the pause
+                # deadline abandons the barrier — a latched-but-unacked
+                # capture would otherwise wedge the parent into its
+                # timeout with no error naming the cause
+                self._lc_send_ack(op)
+            return
+        if (
+            not op["marked"]
+            or op["waiting"]
+            or not self._lc_quiesced()
+        ):
+            return
+        # subtree complete + locally quiesced: the cut instant for this node
+        try:
+            if op["op"] == "save":
+                entry = self._write_shard(op["dir"], op["id"])
+                op["entries"].append(entry)
+                self._snap_total += 1
+            else:
+                self._load_shard_inplace(op["dir"])
+                op["entries"].append(
+                    {"node": self.node_name, "restored": True}
+                )
+                self._restore_total += 1
+        except Exception as e:
+            log.exception("lifecycle %s failed at %s", op["op"], self.node_name)
+            op["errors"].append(f"{self.node_name}: {e!r}")
+            self._lc_errors += 1
+        op["captured"] = True
+        if op["from"] is not None:
+            self._lc_send_ack(op)
+            # stay paused until the root's RESUME releases the barrier
+        else:
+            self._lc_finish(ok=not op["errors"])
+
+    def _lc_send_ack(self, op: dict) -> None:
+        doc = {
+            "id": op["id"],
+            "nodes": op["entries"],
+            "errors": op["errors"],
+        }
+        try:
+            payload = wire.encode_lifecycle(wire.SNAP_ACK, doc)
+        except ValueError:
+            # subtree manifest exceeded the wire cap (clusters past the
+            # digest's own per-node bound): deliver the verdict with the
+            # entries dropped rather than wedging the whole barrier — the
+            # root fails it honestly, naming this node
+            doc = {
+                "id": op["id"],
+                "nodes": [],
+                "errors": op["errors"][:8]
+                + [
+                    f"{self.node_name}: subtree manifest exceeded the wire "
+                    f"cap ({len(op['entries'])} shard entries dropped)"
+                ],
+            }
+            payload = wire.encode_lifecycle(wire.SNAP_ACK, doc)
+        if self._send_blocking(op["from"], payload):
+            op["acked"] = True
+
+    def _lc_finish(self, ok: bool) -> None:
+        """Root only: write the manifest (save op), release the barrier
+        down the tree, resume, and hand the verdict to the waiter. Runs on
+        EVERY exit path — the cluster never stays paused."""
+        op = self._lc_op
+        assert op is not None and op["from"] is None
+        dur = time.monotonic() - op["t0"]
+        result: dict = {
+            "ok": ok,
+            "op": op["op"],
+            "id": op["id"],
+            "req": op.get("req"),
+            "dir": op["dir"],
+            "duration_sec": dur,
+            "nodes": len(op["entries"]),
+            "errors": op["errors"],
+        }
+        if op["errors"]:
+            result["error"] = "; ".join(str(e) for e in op["errors"])
+        if ok and op["op"] == "save":
+            from ..utils import checkpoint as ckpt
+
+            try:
+                result["manifest"] = ckpt.write_manifest(
+                    op["dir"], op["id"], op["entries"],
+                    extra={"root": self.node_name, "duration_sec": dur},
+                )
+            except OSError as e:
+                result["ok"] = False
+                result["error"] = f"manifest write failed: {e}"
+        self._snap_last_dur = dur
+        resume = wire.encode_lifecycle(wire.RESUME, {"id": op["id"]})
+        for link in self._lc_children():
+            self._send_blocking(link, resume)
+        self._lc_op = None
+        self._set_paused(False)
+        if self._obs is not None:
+            self._obs.event(
+                "snap_done", self.node.obs_id,
+                arg=result["nodes"], detail=op["op"],
+            )
+        self._lc_result = result
+        self._lc_done.set()
+
+    def _write_shard(self, dirpath: str, snap_id: str) -> dict:
+        """Capture this node's shard at the (quiesced) cut instant. The
+        engine capture is ONE native lock acquisition (snapshot_ex), so
+        sign2 residual planes, in-flight cascade frames and governor state
+        cannot tear; the python tier's snapshot_all has the same contract
+        under its state lock."""
+        from ..utils import checkpoint as ckpt
+
+        up = self._uplink
+        if self._engine is not None:
+            values, links, meta = self._engine.snapshot_ex()
+        else:
+            values, links = self.st.snapshot_all()
+            values = np.asarray(values, np.float32)
+            meta = {}
+            with self._ack_mu:
+                tx = dict(self._tx_seq)
+            for lid in links:
+                if lid < 0:
+                    continue
+                meta[lid] = {
+                    "tx_seq": tx.get(lid, 0),
+                    "rx_count": self._rx_count.get(lid, 0),
+                    "prec": 1,
+                    "sub": lid in self._sub_links,
+                }
+        entries = []
+        for lid, resid in links.items():
+            if lid < 0:
+                entries.append(
+                    {
+                        "id": lid, "role": "carry",
+                        "resid": np.asarray(resid, np.float32),
+                    }
+                )
+                continue
+            m = meta.get(lid, {})
+            sub = bool(m.get("sub")) or lid in self._sub_links
+            entries.append(
+                {
+                    "id": lid,
+                    "role": "up" if lid == up else ("sub" if sub else "child"),
+                    "tx_seq": m.get("tx_seq", 0),
+                    "rx_count": m.get("rx_count", 0),
+                    "prec": m.get("prec", 1),
+                    # subscriber links persist meta only: a read-only leaf
+                    # re-seeds from scratch on restore
+                    "resid": None if sub else np.asarray(resid, np.float32),
+                }
+            )
+        entry = ckpt.save_cluster_shard(
+            dirpath,
+            self.node_name,
+            snap_id,
+            self.st.spec.layout_digest(),
+            values,
+            entries,
+            wire_version=self._wire_version,
+        )
+        if self._obs is not None:
+            self._obs.event(
+                "snap_shard", self.node.obs_id, arg=len(entries)
+            )
+        return entry
+
+    def _load_shard_inplace(self, dirpath: str) -> None:
+        """The in-place restore step (op "load"), at the quiesced barrier
+        instant: replica + surviving writer links' residuals + carry +
+        governor state from this node's shard, then a forced re-seed of
+        every subscriber link from the restored replica — across the cut a
+        subscriber's state is superseded and NO seq gap would ever expose
+        it (the falsely-verified-read hazard the lifecycle test pins)."""
+        import os as _os
+
+        from ..utils import checkpoint as ckpt
+
+        path = _os.path.join(dirpath, ckpt.shard_filename(self.node_name))
+        shard = ckpt.load_cluster_shard(path)
+        if shard["layout"] != self.st.spec.layout_digest():
+            raise ValueError(
+                f"shard {path} was written for a different table layout"
+            )
+        live = set(self.st.link_ids)
+        links: dict[int, np.ndarray] = {}
+        meta: dict[int, dict] = {}
+        for lid, ent in shard["links"].items():
+            if ent.get("role") == "carry":
+                if ent.get("resid") is not None:
+                    links[CARRY_LINK] = ent["resid"]
+                continue
+            if ent.get("role") == "sub" or ent.get("resid") is None:
+                continue
+            if lid in live:
+                links[lid] = ent["resid"]
+                meta[lid] = {"prec": ent.get("prec", 1)}
+        if self._engine is not None:
+            self._engine.restore_ex(shard["values"], links, meta)
+        else:
+            with self.st._lock:
+                self.st.values = self.st._asarray(shard["values"])
+                for lid, r in links.items():
+                    if lid in self.st._links or lid == CARRY_LINK:
+                        self.st._links[lid] = self.st._asarray(r)
+        for lid, rng in list(self._sub_links.items()):
+            self._attach_sub(lid, rng)
+        self._wake.set()
+
+    def _restore_at_startup(self, path: str) -> None:
+        """Full-cluster restart restore (LifecycleConfig.restore_path),
+        before the data plane starts. Values load into the replica; a
+        NON-master node's checkpointed uplink residual (+ carry) becomes
+        the re-graft carry, so the normal join handshake re-delivers
+        exactly the owed up-flow (snapshot claims ``values - carry`` as
+        tree-known; the diff seed covers the rest). The master drops its
+        carry — its replica is now the authoritative seed and every
+        child's diff join pulls the missing mass from it (the
+        BECAME_MASTER discipline). Child-link residuals are discarded on
+        BOTH: the children's own re-join diffs re-derive the down-flow
+        (checkpoint.restore_carry_from_shard)."""
+        from ..utils import checkpoint as ckpt
+
+        shard = ckpt.load_cluster_shard(path)
+        if shard["layout"] != self.st.spec.layout_digest():
+            raise ValueError(
+                f"restore shard {path} was written for a different table "
+                f"layout"
+            )
+        values = shard["values"]
+        carry = None if self.is_master else ckpt.restore_carry_from_shard(shard)
+        if self._engine is not None:
+            self._engine.restore_state(
+                values, {} if carry is None else {CARRY_LINK: carry}
+            )
+        else:
+            with self.st._lock:
+                self.st.values = self.st._asarray(values)
+                if carry is not None:
+                    self.st._links[CARRY_LINK] = self.st._asarray(carry)
+        self._restored_from = path
+        self._restore_total += 1
+        log.info(
+            "restored %s from shard %s (snap %s)%s",
+            self.node_name, path, shard["meta"].get("snap_id"),
+            "" if carry is None else " with re-graft carry",
+        )
+
+    def _start_drain(self) -> None:
+        """This node is the CTL drain target: run the graceful exit on a
+        helper thread (leave() blocks and joins the recv thread — it must
+        never run ON the recv thread)."""
+        if self._draining:
+            return
+        self._draining = True
+        self._drain_total += 1
+        if self._obs is not None:
+            self._obs.event("drain_begin", self.node.obs_id)
+        grace = self.config.lifecycle.drain_grace_sec
+
+        def _run():
+            try:
+                ok = self.leave(timeout=grace)
+                log.info(
+                    "drain of %s %s", self.node_name,
+                    "complete" if ok else "timed out (closed anyway)",
+                )
+            except Exception:
+                log.exception("drain of %s failed", self.node_name)
+
+        threading.Thread(target=_run, daemon=True, name="st-drain").start()
+
+    def _handle_ctl_msg(self, doc: dict, from_link: Optional[int]) -> None:
+        op = doc.get("op")
+        if op == "drain":
+            if doc.get("target") == self.node_name:
+                self._start_drain()
+            else:
+                self._ctl_forward(doc, exclude=from_link)
+        else:
+            log.warning("ignoring unknown CTL op %r", op)
+
+    def _ctl_poll(self, now: float) -> None:
+        """Root-side operator command channel: poll
+        ``LifecycleConfig.ctl_dir`` for a cmd.json written by
+        ``python -m shared_tensor_tpu.ctl`` and execute it on a worker
+        thread (a snapshot blocks on the barrier this recv thread drives)."""
+        lc = self.config.lifecycle
+        if not lc.ctl_dir or self._uplink is not None:
+            return
+        if now - self._ctl_last_poll < 0.25:
+            return
+        self._ctl_last_poll = now
+        import json as _json
+        import os as _os
+
+        cmd_path = _os.path.join(lc.ctl_dir, "cmd.json")
+        try:
+            with open(cmd_path) as f:
+                cmd = _json.load(f)
+            _os.unlink(cmd_path)  # claim
+        except (OSError, ValueError):
+            return  # absent, or mid-write; next poll gets it
+        if self._obs is not None:
+            self._obs.event(
+                "ctl_cmd", self.node.obs_id, detail=str(cmd.get("op"))
+            )
+        threading.Thread(
+            target=self._ctl_execute, args=(cmd,), daemon=True,
+            name="st-ctl",
+        ).start()
+
+    def _ctl_execute(self, cmd: dict) -> None:
+        import os as _os
+
+        res: dict = {"req_id": cmd.get("req_id"), "op": cmd.get("op")}
+        try:
+            op = cmd.get("op")
+            if op == "snapshot":
+                r = self.snapshot_cluster(cmd["dir"], cmd.get("id"))
+                res.update(
+                    ok=True, id=r["id"], nodes=r["nodes"],
+                    duration_sec=r["duration_sec"],
+                    manifest=r.get("manifest"),
+                )
+            elif op == "restore":
+                r = self.restore_cluster(cmd["dir"])
+                res.update(
+                    ok=True, id=r["id"], nodes=r["nodes"],
+                    duration_sec=r["duration_sec"],
+                )
+            elif op == "drain":
+                self.drain_node(cmd["target"])
+                res.update(ok=True, target=cmd["target"], initiated=True)
+            else:
+                res.update(ok=False, error=f"unknown ctl op {op!r}")
+        except Exception as e:
+            res.update(ok=False, error=str(e))
+        from ..utils.checkpoint import atomic_write_json
+
+        lc = self.config.lifecycle
+        path = _os.path.join(lc.ctl_dir, "result.json")
+        try:
+            atomic_write_json(path, res)
+        except Exception as e:
+            # the CLI is polling for SOME verdict: even a non-serializable
+            # result value must not leave it timing out undiagnosed
+            log.exception("ctl result write failed")
+            try:
+                atomic_write_json(
+                    path,
+                    {
+                        "req_id": res.get("req_id"), "ok": False,
+                        "error": f"result write failed: {e}",
+                    },
+                )
+            except Exception:
+                pass
+
     def close(self) -> None:
         """Leave the tree. Peers survive and re-graft (the reference prints an
         apology and exit(-1)s the entire process instead — quirk Q8)."""
@@ -677,6 +1462,22 @@ class SharedTensorPeer:
         out["st_sub_links"] = len(self._sub_links)
         out["st_sub_msgs_out_total"] = self._sub_msgs_out
         out["st_sub_fresh_out_total"] = self._sub_fresh_out
+        # r12 lifecycle telemetry (obs.top's lifecycle rows; schema.py).
+        # st_wire_version rides the per-node digest breakdown so
+        # ``ctl versions`` can audit a rolling upgrade from the root.
+        op = self._lc_op
+        out["st_wire_version"] = self._wire_version
+        out["st_lifecycle_paused"] = 1 if self._paused else 0
+        out["st_snapshot_in_progress"] = (
+            1 if op is not None and op.get("op") == "save" else 0
+        )
+        out["st_snapshot_shards_acked"] = self._snap_acks
+        out["st_snapshot_total"] = self._snap_total
+        out["st_snapshot_last_duration_seconds"] = self._snap_last_dur
+        out["st_restore_total"] = self._restore_total
+        out["st_drain_in_progress"] = 1 if self._draining else 0
+        out["st_drain_total"] = self._drain_total
+        out["st_lifecycle_errors_total"] = self._lc_errors
         if self._engine is not None:
             out.update(self._engine.obs_stats())
         out["st_corrupt_scales_zeroed_total"] = wire.corrupt_scales_zeroed()
@@ -907,6 +1708,8 @@ class SharedTensorPeer:
         pipe: dict[int, deque] = {}
         hot: set[int] = set()  # links whose last finished frame carried data
         while not self._stop.is_set():
+            self._send_pass += 1  # pass boundary (_set_paused's sync wait)
+            self._pipe_frames = sum(len(q) for q in pipe.values())
             sent_any = False
             links = [l for l in self.st.link_ids if l >= 0]  # skip CARRY_LINK
             for stale in [l for l in pipe if l not in links]:
@@ -915,9 +1718,24 @@ class SharedTensorPeer:
             for link in links:
                 if link in self._sub_links:
                     # r10 subscriber link: unledgered send path (no window,
-                    # no unacked entries, no retransmission) + FRESH beats
+                    # no unacked entries, no retransmission) + FRESH beats.
+                    # Paused (r12 quiesce): no production, but an already-
+                    # DRAINED link keeps its FRESH beat so a current
+                    # subscriber can still verify its bound across the
+                    # barrier (an undrained one gets no mark — a read
+                    # across the cut must refuse, never falsely verify).
+                    if self._paused:
+                        self._sub_fresh_beat(link)
+                        continue
                     if self._send_sub(link):
                         sent_any = True
+                    continue
+                if self._paused and not pipe.get(link):
+                    # r12 lifecycle quiesce: no NEW production. Frames
+                    # already dispatched into the device pipeline still
+                    # finish and send below (their error feedback is
+                    # applied; the barrier waits for their ACKs), the
+                    # pipeline just stops topping up.
                     continue
                 if not compat and self._window_full(link):
                     # go-back-N send window: a link whose unacked ledger is
@@ -968,8 +1786,11 @@ class SharedTensorPeer:
                 )
                 q = pipe.setdefault(link, deque())
                 # top up: a cold (idle) link risks one speculative frame per
-                # wake tick; a hot link keeps the full pipeline busy
-                target = depth if link in hot else 1
+                # wake tick; a hot link keeps the full pipeline busy —
+                # and a paused (r12 quiesce) one only drains, never refills
+                target = (
+                    0 if self._paused else depth if link in hot else 1
+                )
                 while len(q) < target:
                     df = (
                         self.st.begin_frame_burst_device(
@@ -1071,7 +1892,6 @@ class SharedTensorPeer:
         drain mark so the subscriber can keep verifying its staleness
         bound while nothing is being written."""
         rng = self._sub_links.get(link)
-        scfg = self.config.serve
         if rng is not None:
             # drop out-of-range residual BEFORE scale selection (the range
             # discipline — core.mask_link_residual docstring), but only
@@ -1112,20 +1932,7 @@ class SharedTensorPeer:
             frames = [f] if f is not None else []
         if not frames:
             self.st.ack_frame(link, seq)  # idle: no-op
-            now = time.monotonic()
-            if now - self._sub_fresh.get(link, 0.0) >= scfg.fresh_interval_sec:
-                with self._ack_mu:
-                    last_seq = self._tx_seq.get(link, 0)
-                try:
-                    if self.node.send(
-                        link,
-                        wire.encode_fresh(fresh_t, last_seq),
-                        timeout=0.0,
-                    ):
-                        self._sub_fresh[link] = now
-                        self._sub_fresh_out += 1
-                except BrokenPipeError:
-                    pass  # LINK_DOWN will clean the link up
+            self._sub_fresh_mark(link, fresh_t)
             return False
         trace = None
         if self._trace_wire:
@@ -1162,6 +1969,40 @@ class SharedTensorPeer:
         else:
             self.st.nack_frame(link)
         return ok
+
+    def _sub_fresh_mark(self, link: int, fresh_t: int) -> None:
+        """Send ONE FRESH drain mark, interval-throttled — the shared tail
+        of both freshness paths (the running sender's idle branch and the
+        paused-quiesce beat), so the mark's contract (carries the link's
+        last tx_seq, lossy zero-timeout send, bookkeeping) lives in one
+        place. ``fresh_t`` must have been stamped BEFORE the caller's
+        drained-residual determination (the _send_sub ordering note)."""
+        now = time.monotonic()
+        if now - self._sub_fresh.get(link, 0.0) < (
+            self.config.serve.fresh_interval_sec
+        ):
+            return
+        with self._ack_mu:
+            last_seq = self._tx_seq.get(link, 0)
+        try:
+            if self.node.send(
+                link, wire.encode_fresh(fresh_t, last_seq), timeout=0.0
+            ):
+                self._sub_fresh[link] = now
+                self._sub_fresh_out += 1
+        except BrokenPipeError:
+            pass  # LINK_DOWN will clean the link up
+
+    def _sub_fresh_beat(self, link: int) -> None:
+        """FRESH beat for a PAUSED sender (r12 quiesce): only a fully
+        drained residual may be marked fresh — a paused link still owing
+        mass gets no mark, so a subscriber read across the cut refuses
+        (StalenessError) instead of falsely verifying. Stamp captured
+        BEFORE the drained determination, same discipline as _send_sub."""
+        fresh_t = time.monotonic_ns()
+        if self.st.residual_rms(link) > 0.0:
+            return
+        self._sub_fresh_mark(link, fresh_t)
 
     def _register_data(self, link: int, ledger_seq: int, encode_into):
         """Allocate the link's next wire seq, encode the outgoing DATA/BURST
@@ -1446,6 +2287,13 @@ class SharedTensorPeer:
                     except Exception as e:
                         log.debug("digest publish failed: %s", e)
             busy = self._handle_events()
+            try:
+                # r12 lifecycle: drive any active barrier / operator
+                # command channel. Must never kill the recv loop — a
+                # failed lifecycle op resolves through its own error path.
+                self._lc_tick()
+            except Exception:
+                log.exception("lifecycle tick failed (recv thread continues)")
             if (
                 compat
                 and self._engine is not None
@@ -1687,6 +2535,11 @@ class SharedTensorPeer:
             self.metrics(canonical=True),
             time.monotonic_ns(),
         )
+        # r12: the lifecycle node name rides the per-node breakdown so the
+        # operator surface (ctl drain/versions) can address nodes by name
+        ent = doc["nodes"].get(str(int(self.node.obs_id)))
+        if ent is not None:
+            ent["name"] = self.node_name
         for child in list(self._child_digests.values()):
             aggregate.merge(doc, child)
         aggregate.bounded(doc)
@@ -1911,6 +2764,16 @@ class SharedTensorPeer:
                 self._pending[ev.link_id] = bytearray()
     def _on_membership_event(self, ev) -> None:
         if ev.kind == EventKind.LINK_DOWN:
+            # r12: a child dying mid-barrier must not hang the cut — its
+            # subtree's shards are simply absent (recorded as an error;
+            # the root's verdict then fails honestly instead of stalling)
+            op = self._lc_op
+            if op is not None and ev.link_id in op["waiting"]:
+                op["waiting"].discard(ev.link_id)
+                op["errors"].append(
+                    f"{self.node_name}: child link {ev.link_id} died "
+                    f"mid-barrier"
+                )
             self._pending.pop(ev.link_id, None)
             self._engine_links.discard(ev.link_id)
             self._rx_scratch.pop(ev.link_id, None)
@@ -2338,6 +3201,45 @@ class SharedTensorPeer:
             self._child_digests[link] = wire.decode_digest(payload)
             if self._obs is not None:
                 self._obs.digest_in.inc()
+        elif kind == wire.SNAP:
+            # r12 lifecycle barrier marker from our parent: per-link FIFO
+            # means every pre-pause data message on this link was applied
+            # before this handler runs — the consistent-cut property
+            self._lc_begin(wire.decode_lifecycle(payload), link)
+        elif kind == wire.SNAP_ACK:
+            doc = wire.decode_lifecycle(payload)
+            op = self._lc_op
+            if op is None or str(doc.get("id")) != op["id"]:
+                log.warning(
+                    "stray SNAP_ACK on link %d (id %s)", link, doc.get("id")
+                )
+                return
+            op["waiting"].discard(link)
+            op["entries"].extend(doc.get("nodes", []))
+            op["errors"].extend(doc.get("errors", []))
+            self._snap_acks += max(1, len(doc.get("nodes", [])))
+        elif kind == wire.RESUME:
+            doc = wire.decode_lifecycle(payload)
+            op = self._lc_op
+            if op is not None and str(doc.get("id")) != op["id"]:
+                # a RESUME for a barrier we never joined (we NACKed its
+                # SNAP, so our subtree never saw it either): releasing on
+                # it would unpause this node mid-cut of the barrier we ARE
+                # in. Our own barrier's RESUME — or the pause deadline —
+                # releases us.
+                log.warning(
+                    "ignoring RESUME for foreign barrier %s (active: %s)",
+                    doc.get("id"), op["id"],
+                )
+                return
+            # release the subtree FIRST: children must never stay paused
+            # because of our own state
+            for child in self._lc_children(exclude=link):
+                self._send_blocking(child, payload)
+            self._lc_op = None
+            self._set_paused(False)
+        elif kind == wire.CTL:
+            self._handle_ctl_msg(wire.decode_lifecycle(payload), link)
         elif kind == wire.REJECT:
             self._error = SpecMismatch(wire.decode_reject(payload))
             self._ready.set()  # unblock wait_ready, which re-raises

@@ -109,6 +109,18 @@ DIGEST = 8  # child -> parent: r09 in-band cluster metrics digest (JSON)
 RANGE = 9  # subscriber -> parent: word-range subscription (before DONE)
 FRESH = 10  # parent -> subscriber: freshness mark (residual fully drained)
 RDATA = 11  # parent -> subscriber: one frame sliced to the subscribed range
+# r12 cluster lifecycle (control plane — the r06 rule applies: chaos
+# classes never touch these, so a barrier completes deterministically).
+# SNAP floods the quiesce marker down the tree; per-link FIFO makes it a
+# consistent-cut marker (it follows the sender's last pre-pause data).
+# SNAP_ACK flows back up carrying the subtree's shard manifest entries;
+# RESUME releases the barrier top-down; CTL routes an operator command
+# (today: drain <node>) down the tree. All four carry bounded JSON bodies
+# (encode_lifecycle), sized under the DIGEST receive bound.
+SNAP = 12  # parent -> child: lifecycle barrier marker (JSON body)
+SNAP_ACK = 13  # child -> parent: barrier ack + subtree shard entries (JSON)
+RESUME = 14  # parent -> child: release the lifecycle barrier (JSON)
+CTL = 15  # parent -> child: routed operator command (JSON)
 
 _SYNC_FMT = "<IQ16s"  # num_leaves, total_n, layout digest
 _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
@@ -817,6 +829,35 @@ def decode_digest(payload: bytes) -> dict:
     doc = json.loads(payload[1:].decode("utf-8"))
     if not isinstance(doc, dict):
         raise ValueError("digest body is not a JSON object")
+    return doc
+
+
+def encode_lifecycle(kind: int, doc: dict) -> bytes:
+    """One r12 lifecycle control message (SNAP / SNAP_ACK / RESUME / CTL):
+    kind byte + a bounded JSON body. JSON for the same reason as DIGEST —
+    off-hot-path operator traffic whose debuggability matters more than
+    bytes. The DIGEST_MAX_BYTES cap keeps every peer's receive bound
+    (frame_wire_bytes) valid; a SNAP_ACK whose subtree manifest exceeds it
+    means a cluster past the digest's own per-node bound — raise rather
+    than truncate (a silently partial manifest would verify as complete)."""
+    import json
+
+    if kind not in (SNAP, SNAP_ACK, RESUME, CTL):
+        raise ValueError(f"{kind} is not a lifecycle message kind")
+    body = json.dumps(doc, separators=(",", ":")).encode()
+    if len(body) > DIGEST_MAX_BYTES:
+        raise ValueError(
+            f"lifecycle message is {len(body)} bytes, cap {DIGEST_MAX_BYTES}"
+        )
+    return bytes([kind]) + body
+
+
+def decode_lifecycle(payload: bytes) -> dict:
+    import json
+
+    doc = json.loads(payload[1:].decode("utf-8"))
+    if not isinstance(doc, dict):
+        raise ValueError("lifecycle message body is not a JSON object")
     return doc
 
 

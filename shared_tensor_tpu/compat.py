@@ -86,6 +86,25 @@ SYNC_FLAG_RANGE = 0x02
 # ST_WIRE_TRACE=0).
 SYNC_FLAG_SIGN2 = 0x04
 
+# ---- r12 cluster-lifecycle control kinds ----------------------------------
+#
+# The consistent-cut barrier (wire.SNAP/SNAP_ACK/RESUME) and the routed
+# operator command (wire.CTL) are CONTROL-plane message kinds, following
+# the same tolerant-extension discipline as every protocol addition since
+# r09: a pre-r12 peer that receives one logs "unknown message kind" and
+# drops it without touching its data plane — nothing hangs, because the
+# barrier's failure mode is explicit (the initiating root times out, logs
+# which links never acked, and RESUMEs the rest; LifecycleConfig.
+# snapshot_timeout_sec / pause_timeout_sec are the two budgets). The
+# practical rolling-upgrade rule is therefore: finish upgrading the tree
+# before relying on cluster snapshots; everything ELSE (DATA/BURST
+# interop, digests, serve traffic) is version-gated independently and
+# works mid-upgrade — the ``ctl versions`` audit (per-node
+# st_wire_version gauge in the digest breakdown) shows exactly who still
+# emits what. MIGRATION.md carries the full runbook.
+
+LIFECYCLE_PROTOCOL = 1  # shard/manifest + barrier message format version
+
 
 def sign2_mode(config: "Config | None" = None) -> int:
     """The engine's precision mode per config/env policy: 0 = fixed 1-bit

@@ -339,6 +339,50 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class LifecycleConfig:
+    """r12 cluster lifecycle: consistent-cut snapshot/restore, bounded-time
+    restart, drain-node, and the ``python -m shared_tensor_tpu.ctl``
+    operator surface. The snapshot barrier is root-initiated
+    (``peer.snapshot_cluster``): a quiesce marker (wire.SNAP) floods down
+    the tree on the control plane, every node pauses NEW production,
+    drains its in-flight ledgers to empty, writes a per-node shard file
+    and acks up; the root assembles ``MANIFEST.json`` with per-node sha256
+    digests and releases the barrier (wire.RESUME)."""
+
+    #: Stable node name used for shard files (``shard_<name>.npz``) and as
+    #: the ``ctl drain`` target. "" = ``node-<obs_id>`` (process-unique but
+    #: NOT stable across restarts — set explicit names in any deployment
+    #: that intends to restore).
+    node_name: str = ""
+    #: Shard file to restore from BEFORE joining the tree (the full-cluster
+    #: restart path): values load into the replica, and a non-master node's
+    #: checkpointed uplink residual (+ old carry) becomes the re-graft
+    #: carry, so the join's diff handshake re-delivers exactly the owed
+    #: mass — no retransmission storm, no double-apply (README "Cluster
+    #: lifecycle"). "" = fresh start.
+    restore_path: str = ""
+    #: Root-side operator command channel: when set, a peer with no uplink
+    #: polls ``<ctl_dir>/cmd.json`` for commands written by
+    #: ``python -m shared_tensor_tpu.ctl`` (snapshot / restore / drain) and
+    #: writes ``<ctl_dir>/result.json`` back. File-based like
+    #: ObsConfig.cluster_json_path, so the CLI needs no socket into the
+    #: cluster. "" = disabled.
+    ctl_dir: str = ""
+    #: Root-side budget for one whole-cluster snapshot/restore barrier
+    #: (marker flood + drain-to-quiesce + shard I/O + acks). Past it the
+    #: root RESUMEs the tree anyway and reports failure — a lifecycle
+    #: operation may fail, but it must never leave the cluster paused.
+    snapshot_timeout_sec: float = 60.0
+    #: Safety net on every non-root node: if a barrier's RESUME never
+    #: arrives (root died mid-barrier), unpause after this long and log —
+    #: same never-leave-paused rule as the root's timeout.
+    pause_timeout_sec: float = 30.0
+    #: leave() budget for a routed ``ctl drain <node>`` (seal + drain +
+    #: close on the target node).
+    drain_grace_sec: float = 30.0
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Pod-tier (intra-slice) configuration: how the shared array is laid out
     across the local device mesh and which collective strategy syncs it."""
@@ -369,6 +413,11 @@ class Config:
     #: Read-path serving tier (r10): subscriber staleness bounds, FRESH
     #: beat pacing, range subscription.
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    #: Cluster lifecycle (r12): node naming, restart-restore, operator
+    #: command channel, barrier timeouts.
+    lifecycle: LifecycleConfig = dataclasses.field(
+        default_factory=LifecycleConfig
+    )
     #: Background sync frame pacing: target seconds between frames per link;
     #: 0 = free-running (reference behavior: fill all bandwidth, README.md:31).
     sync_interval_sec: float = 0.0

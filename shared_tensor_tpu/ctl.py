@@ -1,0 +1,194 @@
+"""``python -m shared_tensor_tpu.ctl`` — the cluster operator surface (r12).
+
+A stdlib-only control CLI over the two file channels the tree ROOT already
+serves: the live cluster digest JSON (``ObsConfig.cluster_json_path`` — the
+same file ``obs.top`` tails) for read-only views, and the lifecycle command
+directory (``LifecycleConfig.ctl_dir``) for operations. Like ``obs.top`` it
+never opens a socket into the cluster: it can run anywhere that shares the
+files (same host, NFS, a kubectl-cp loop).
+
+Commands::
+
+    python -m shared_tensor_tpu.ctl --file /tmp/st_cluster.json status
+    python -m shared_tensor_tpu.ctl --file /tmp/st_cluster.json versions
+    python -m shared_tensor_tpu.ctl --ctl-dir /tmp/st_ctl snapshot --dir D
+    python -m shared_tensor_tpu.ctl --ctl-dir /tmp/st_ctl restore  --dir D
+    python -m shared_tensor_tpu.ctl --ctl-dir /tmp/st_ctl drain NODE
+    python -m shared_tensor_tpu.ctl verify --dir D        # offline audit
+
+``status``/``versions`` read the digest; ``snapshot``/``restore``/``drain``
+write ``<ctl_dir>/cmd.json`` (atomically) and poll ``<ctl_dir>/result.json``
+for the root's verdict; ``verify`` audits a snapshot directory against its
+manifest (shards present, sha256 digests match) with no cluster at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import uuid
+
+from .obs import top as _top
+
+
+def _read_digest(path: str) -> dict:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"cannot read cluster digest {path}: {e}")
+
+
+def _node_val(m: dict, base: str) -> float:
+    return _top._node_val(m, base)
+
+
+def cmd_status(args) -> int:
+    doc = _read_digest(args.file)
+    print(_top.render(doc, None, 0.0))
+    return 0
+
+
+def cmd_versions(args) -> int:
+    """Per-node wire-version audit — the rolling-upgrade view. A healthy
+    steady-state cluster shows one version; two versions mid-upgrade is
+    expected (decoders accept both framings — compat.py); anything the
+    digest has not seen yet shows as '?'."""
+    doc = _read_digest(args.file)
+    nodes = doc.get("nodes", {})
+    versions: dict[int, list[str]] = {}
+    for nid in sorted(nodes, key=int):
+        v = int(_node_val(nodes[nid].get("m", {}), "st_wire_version"))
+        label = nodes[nid].get("name") or nid
+        versions.setdefault(v, []).append(str(label))
+    for v in sorted(versions):
+        label = f"v{v}" if v else "?"
+        print(f"wire {label}: {len(versions[v])} node(s) — "
+              f"{', '.join(versions[v])}")
+    if len([v for v in versions if v]) > 1:
+        print("MIXED versions: rolling upgrade in progress (interop is "
+              "version-gated — see MIGRATION.md's runbook)")
+    return 0
+
+
+def _send_cmd(ctl_dir: str, cmd: dict, timeout: float) -> dict:
+    from .utils.checkpoint import atomic_write_json
+
+    cmd = dict(cmd, req_id=uuid.uuid4().hex)
+    atomic_write_json(os.path.join(ctl_dir, "cmd.json"), cmd)
+    res_path = os.path.join(ctl_dir, "result.json")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(res_path) as f:
+                res = json.load(f)
+            if res.get("req_id") == cmd["req_id"]:
+                return res
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.25)
+    raise SystemExit(
+        f"no result from the root within {timeout}s — is a root peer "
+        f"polling LifecycleConfig.ctl_dir={ctl_dir}?"
+    )
+
+
+def _print_result(res: dict) -> int:
+    print(json.dumps(res, indent=2))
+    return 0 if res.get("ok") else 1
+
+
+def cmd_snapshot(args) -> int:
+    return _print_result(
+        _send_cmd(
+            args.ctl_dir,
+            {"op": "snapshot", "dir": os.path.abspath(args.dir)},
+            args.timeout,
+        )
+    )
+
+
+def cmd_restore(args) -> int:
+    return _print_result(
+        _send_cmd(
+            args.ctl_dir,
+            {"op": "restore", "dir": os.path.abspath(args.dir)},
+            args.timeout,
+        )
+    )
+
+
+def cmd_drain(args) -> int:
+    return _print_result(
+        _send_cmd(
+            args.ctl_dir,
+            {"op": "drain", "target": args.node},
+            args.timeout,
+        )
+    )
+
+
+def cmd_verify(args) -> int:
+    from .utils import checkpoint as ckpt
+
+    problems = ckpt.verify_manifest(args.dir)
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}")
+        return 1
+    doc = ckpt.load_manifest(args.dir)
+    print(
+        f"OK: snapshot {doc.get('snap_id')} — {len(doc.get('nodes', []))} "
+        f"shard(s), digests match"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m shared_tensor_tpu.ctl",
+        description="cluster lifecycle operator CLI (r12)",
+    )
+    ap.add_argument(
+        "--file",
+        default="/tmp/st_cluster.json",
+        help="cluster digest JSON the root writes "
+        "(ObsConfig.cluster_json_path)",
+    )
+    ap.add_argument(
+        "--ctl-dir",
+        default="/tmp/st_ctl",
+        help="lifecycle command directory the root polls "
+        "(LifecycleConfig.ctl_dir)",
+    )
+    ap.add_argument(
+        "--timeout", type=float, default=120.0,
+        help="seconds to wait for the root's verdict on an operation",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status", help="render the live cluster digest once")
+    sub.add_parser("versions", help="per-node wire-version audit")
+    p = sub.add_parser("snapshot", help="consistent-cut snapshot of the tree")
+    p.add_argument("--dir", required=True, help="snapshot output directory")
+    p = sub.add_parser("restore", help="in-place restore of a live tree")
+    p.add_argument("--dir", required=True, help="snapshot directory")
+    p = sub.add_parser("drain", help="gracefully drain one node out")
+    p.add_argument("node", help="target node name (LifecycleConfig.node_name)")
+    p = sub.add_parser("verify", help="offline snapshot-manifest audit")
+    p.add_argument("--dir", required=True, help="snapshot directory")
+    args = ap.parse_args(argv)
+    return {
+        "status": cmd_status,
+        "versions": cmd_versions,
+        "snapshot": cmd_snapshot,
+        "restore": cmd_restore,
+        "drain": cmd_drain,
+        "verify": cmd_verify,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

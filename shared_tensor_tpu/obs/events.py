@@ -70,6 +70,26 @@ CODE_NAMES: dict[int, str] = {
 }
 NAME_CODES = {v: k for k, v in CODE_NAMES.items()}
 
+#: r12 cluster lifecycle events — PYTHON-tier only (the barrier protocol
+#: lives in comm/peer.py; the native engine's part is just the pause flag,
+#: which emits nothing). No native codes, so these are names rather than
+#: ABI numbers: snap_begin (entered a barrier; arg = children awaited,
+#: detail = op), snap_shard (shard captured; arg = link count), snap_done
+#: (root finished; arg = shard count), lifecycle_pause/lifecycle_resume
+#: (quiesce edges), drain_begin (routed drain accepted), ctl_cmd (operator
+#: command received; detail = op).
+LIFECYCLE_EVENT_NAMES = frozenset(
+    {
+        "snap_begin",
+        "snap_shard",
+        "snap_done",
+        "lifecycle_pause",
+        "lifecycle_resume",
+        "drain_begin",
+        "ctl_cmd",
+    }
+)
+
 #: Names the flight recorder treats as fault-injection hits (timeline
 #: accounting in the chaos soak keys on these).
 FAULT_EVENT_NAMES = frozenset(

@@ -101,6 +101,24 @@ SCHEMA: dict[str, tuple[str, str]] = {
     "st_precision_downshifts_total": ("counter", "governor downshifts back to 1-bit"),
     "st_frames2_out_total": ("counter", "sign2 (2-bit) frames sent (subset of st_frames_out_total)"),
     "st_frames2_in_total": ("counter", "sign2 (2-bit) frames applied (subset of st_frames_in_total)"),
+    # r12 cluster lifecycle (consistent-cut snapshot/restore, drain-node,
+    # rolling upgrade). Gauges ride the per-node digest breakdown, which
+    # is what obs.top's lifecycle rows and ``ctl versions`` read at the
+    # root: st_wire_version audits a mid-upgrade version skew per node,
+    # st_lifecycle_paused / st_snapshot_in_progress / st_drain_in_progress
+    # show who is inside a barrier or leaving, and
+    # st_snapshot_shards_acked shows barrier progress (subtree shard acks
+    # folded at each node so far).
+    "st_wire_version": ("gauge", "DATA/BURST framing version this node emits (compat.WIRE_VERSION; the ctl versions / rolling-upgrade audit)"),
+    "st_lifecycle_paused": ("gauge", "1 while the node's data production is quiesced by a lifecycle barrier"),
+    "st_snapshot_in_progress": ("gauge", "1 while a consistent-cut snapshot barrier is active at this node"),
+    "st_snapshot_shards_acked": ("gauge", "subtree shard acks folded into this node's barriers so far"),
+    "st_snapshot_total": ("counter", "consistent-cut shards this node captured"),
+    "st_snapshot_last_duration_seconds": ("gauge", "root: wall time of the last snapshot/restore barrier"),
+    "st_restore_total": ("counter", "shard restores applied (in-place barrier or restart load)"),
+    "st_drain_in_progress": ("gauge", "1 while this node is executing a routed drain (seal+drain+close)"),
+    "st_drain_total": ("counter", "routed drain commands this node accepted"),
+    "st_lifecycle_errors_total": ("counter", "lifecycle barrier/ctl failures (overlap, timeout, lost RESUME, shard I/O)"),
     # per-link series (rendered via link_key)
     "st_link_bytes_out_total": ("counter", "wire bytes sent on the link (incl. framing/keepalives)"),
     "st_link_bytes_in_total": ("counter", "wire bytes received on the link"),

@@ -76,6 +76,35 @@ def render(doc: dict, prev: dict | None, dt: float) -> str:
                 f"worst residual L2 {resid[0]:.4g} @ node {int(resid[1])}"
             )
         lines.append("  " + "   ".join(parts))
+    # r12 lifecycle rows: only rendered while something is happening —
+    # a snapshot barrier in progress (per-node paused/acked state), a
+    # drain underway, or a version skew worth knowing about mid-upgrade
+    lc_rows = []
+    versions = set()
+    for nid in sorted(nodes, key=int):
+        m = nodes[nid].get("m", {})
+        v = int(_node_val(m, "st_wire_version"))
+        if v:
+            versions.add(v)
+        state = []
+        if _node_val(m, "st_snapshot_in_progress") > 0:
+            state.append(
+                f"snapshotting (acks {int(_node_val(m, 'st_snapshot_shards_acked'))})"
+            )
+        elif _node_val(m, "st_lifecycle_paused") > 0:
+            state.append("paused (barrier)")
+        if _node_val(m, "st_drain_in_progress") > 0:
+            state.append("draining")
+        if state:
+            lc_rows.append(f"  node {nid}: " + ", ".join(state))
+    if lc_rows:
+        lines.append("  lifecycle:")
+        lines.extend(lc_rows)
+    if len(versions) > 1:
+        lines.append(
+            f"  lifecycle: MIXED wire versions {sorted(versions)} "
+            f"(rolling upgrade in progress?)"
+        )
     lines.append("")
     hdr = (
         f"{'node':>6} {'stale_s':>10} {'resid_L2':>10} {'hops':>5} "

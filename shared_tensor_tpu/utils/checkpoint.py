@@ -23,10 +23,12 @@ O(total / n_devices) peak host memory.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import tempfile
-from typing import TYPE_CHECKING
+import time
+from typing import TYPE_CHECKING, Optional
 
 import jax
 import numpy as np
@@ -226,6 +228,209 @@ def load_pod(
     return PeerSyncState(
         jax.device_put(values, sh), jax.device_put(residual, sh)
     )
+
+
+# ---- r12 cluster lifecycle: per-node shards + root manifest ---------------
+#
+# One consistent-cut snapshot of a whole tree = one shard file per node
+# (shard_<name>.npz) + MANIFEST.json at the root. A shard captures what the
+# quiesce barrier froze: the replica, every writer link's error-feedback
+# residual (sign2/cascade state included — the engine snapshot is one
+# mutex acquisition, comm/engine.py snapshot_ex), the re-graft carry, and
+# per-link aux (role, tx/rx wire seqs at the cut, governor precision).
+# Subscriber links persist META ONLY: a read-only leaf re-seeds from
+# scratch on restore, so its transient residual would be dead weight.
+#
+# The manifest records a sha256 per shard so ``ctl restore`` / the restart
+# path can audit a snapshot before trusting it. Per-link seqs are recorded
+# for POST-MORTEM inspection (the barrier's drained-ledger discipline makes
+# tx-on-uplink == parent's-rx-for-that-child at every capture; link ids are
+# node-local, so pairing them offline needs the operator's knowledge of the
+# topology — the audit does not attempt it). Plain .npz + JSON keeps both
+# inspectable, like every other format in this module.
+
+MANIFEST_NAME = "MANIFEST.json"
+
+
+def shard_filename(node_name: str) -> str:
+    """Shard file for a node name (sanitized: names land in filenames)."""
+    safe = "".join(
+        c if c.isalnum() or c in "-_." else "_" for c in str(node_name)
+    )
+    return f"shard_{safe}.npz"
+
+
+def file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def save_cluster_shard(
+    dirpath: str,
+    node_name: str,
+    snap_id: str,
+    layout_digest: bytes,
+    values: np.ndarray,
+    links: list[dict],
+    wire_version: int = 0,
+) -> dict:
+    """Write one node's shard. ``links`` entries: ``{"id", "role"
+    ("up"|"child"|"sub"|"carry"), "tx_seq", "rx_count", "prec",
+    "resid" (f32 array or None)}``. Returns the manifest entry
+    ``{"node", "file", "sha256", "bytes", "links"}``."""
+    os.makedirs(dirpath, exist_ok=True)
+    fname = shard_filename(node_name)
+    path = os.path.join(dirpath, fname)
+    arrays = {
+        "values": np.ascontiguousarray(values, np.float32),
+        "layout": np.frombuffer(layout_digest, dtype=np.uint8),
+    }
+    meta_links = []
+    for entry in links:
+        lid = int(entry["id"])
+        resid = entry.get("resid")
+        if resid is not None:
+            arrays[f"resid_{lid}"] = np.ascontiguousarray(resid, np.float32)
+        meta_links.append(
+            {
+                "id": lid,
+                "role": entry.get("role", "child"),
+                "tx_seq": int(entry.get("tx_seq", 0)),
+                "rx_count": int(entry.get("rx_count", 0)),
+                "prec": int(entry.get("prec", 1)),
+                "has_resid": resid is not None,
+            }
+        )
+    arrays["meta"] = np.frombuffer(
+        json.dumps(
+            {
+                "format": _FORMAT,
+                "kind": "cluster_shard",
+                "snap_id": str(snap_id),
+                "node": str(node_name),
+                "wire_version": int(wire_version),
+                "time": time.time(),
+                "links": meta_links,
+            }
+        ).encode(),
+        dtype=np.uint8,
+    )
+    _atomic_savez(path, **arrays)
+    return {
+        "node": str(node_name),
+        "file": fname,
+        "sha256": file_sha256(path),
+        "bytes": os.path.getsize(path),
+        "links": meta_links,
+    }
+
+
+def load_cluster_shard(path: str) -> dict:
+    """Read a shard back: ``{"values", "layout", "meta", "links":
+    {id: {"role", "tx_seq", "rx_count", "prec", "resid"-or-None}}}``."""
+    with np.load(path) as z:
+        meta = json.loads(z["meta"].tobytes().decode())
+        if meta.get("kind") != "cluster_shard":
+            raise ValueError(f"{path} is not a cluster shard")
+        values = np.asarray(z["values"], np.float32)
+        layout = z["layout"].tobytes()
+        links: dict[int, dict] = {}
+        for entry in meta.get("links", []):
+            lid = int(entry["id"])
+            links[lid] = dict(entry)
+            links[lid]["resid"] = (
+                np.asarray(z[f"resid_{lid}"], np.float32)
+                if entry.get("has_resid") and f"resid_{lid}" in z
+                else None
+            )
+    return {"values": values, "layout": layout, "meta": meta, "links": links}
+
+
+def restore_carry_from_shard(shard: dict) -> Optional[np.ndarray]:
+    """The re-graft carry a RESTARTED node re-joins with: its checkpointed
+    uplink residual plus any checkpointed carry. Only up-flow mass rides
+    the carry — child-link residuals are deliberately dropped, because the
+    children's own re-join diff handshakes re-derive exactly the down-flow
+    they are missing (summing both directions into one carry would deliver
+    the same add twice; see the README restore note)."""
+    out = None
+    for entry in shard["links"].values():
+        if entry.get("role") in ("up", "carry") and entry.get("resid") is not None:
+            r = np.asarray(entry["resid"], np.float32)
+            out = r if out is None else out + r
+    return out
+
+
+def atomic_write_json(path: str, doc: dict) -> str:
+    """tmp + rename JSON write — the one implementation every lifecycle
+    surface shares (manifest here, the peer's ctl result, the CLI's
+    command file), so cleanup-on-failure semantics can't drift between
+    hand-rolled copies."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path
+
+
+def write_manifest(
+    dirpath: str, snap_id: str, entries: list[dict], extra: dict | None = None
+) -> str:
+    doc = {
+        "format": _FORMAT,
+        "kind": "cluster_manifest",
+        "snap_id": str(snap_id),
+        "time": time.time(),
+        "nodes": sorted(entries, key=lambda e: e["node"]),
+    }
+    if extra:
+        doc.update(extra)
+    return atomic_write_json(os.path.join(dirpath, MANIFEST_NAME), doc)
+
+
+def load_manifest(dirpath: str) -> dict:
+    with open(os.path.join(dirpath, MANIFEST_NAME)) as f:
+        doc = json.load(f)
+    if doc.get("kind") != "cluster_manifest":
+        raise ValueError(f"{dirpath} holds no cluster manifest")
+    return doc
+
+
+def verify_manifest(dirpath: str) -> list[str]:
+    """Audit a snapshot directory against its manifest: manifest parses,
+    every shard present, every sha256 matches. (Per-link seqs are
+    recorded for post-mortem reading, not audited here — see the module
+    note: link ids are node-local, so pairing them needs topology
+    knowledge the snapshot doesn't carry.) Returns a list of problems
+    ([] = clean)."""
+    problems: list[str] = []
+    try:
+        doc = load_manifest(dirpath)
+    except (OSError, ValueError) as e:
+        return [f"manifest unreadable: {e}"]
+    for entry in doc.get("nodes", []):
+        path = os.path.join(dirpath, entry["file"])
+        if not os.path.exists(path):
+            problems.append(f"{entry['node']}: shard {entry['file']} missing")
+            continue
+        digest = file_sha256(path)
+        if digest != entry.get("sha256"):
+            problems.append(
+                f"{entry['node']}: shard digest mismatch "
+                f"({digest[:12]} != {entry.get('sha256', '')[:12]})"
+            )
+    return problems
 
 
 # ---- sharded (per-device) pod checkpoint ----------------------------------

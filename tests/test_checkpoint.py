@@ -1,6 +1,8 @@
 """Checkpoint/resume tests (SURVEY.md §5.4 — capability the reference
 lacks entirely)."""
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -49,6 +51,82 @@ def test_shared_layout_mismatch_rejected(tmp_path):
     other = SharedTensor({"x": jnp.zeros((5,))})
     with pytest.raises(ValueError, match="layout"):
         ckpt.load_shared(other, path)
+
+
+def test_engine_snapshot_roundtrip_sign2_cascade_inflight(monkeypatch):
+    """r12 satellite: the r04-era checkpoint path predates the r11 state —
+    sign2 (2-bit) wire frames, cascade quantize, the per-link precision
+    governor. Pin a pair with sign2 forced on (ST_SIGN2=2), stall the
+    joiner's uplink so 32 cascade-quantized sign2 messages sit LEDGERED
+    (in flight, error feedback already debited from the residual) and the
+    send window closes, then require snapshot_ex → restore_ex →
+    snapshot_ex to round-trip values, every residual, and the per-link
+    aux (seqs, precision capability) BYTE-EXACT — the one-mutex capture
+    must be atomic against all of it. Also pins restore_ex's governor
+    restore: a crafted prec/gov_prev survives into the next snapshot."""
+    from shared_tensor_tpu.comm import faults
+    from shared_tensor_tpu.comm.peer import create_or_fetch
+    from shared_tensor_tpu.config import Config, FaultConfig, TransportConfig
+    from tests._ports import free_port
+
+    monkeypatch.setenv("ST_SIGN2", "2")
+    env = faults.to_env(
+        FaultConfig(enabled=True, seed=3, stall_after_frames=0, only_link=1)
+    )
+    port = free_port()
+    seed = jnp.zeros((2048,), jnp.float32)
+    # ack_timeout 0: the stalled link must KEEP its ledger (a go-back-N
+    # teardown would roll the in-flight state away mid-test)
+    cfg = Config(transport=TransportConfig(ack_timeout_sec=0.0))
+    master = create_or_fetch("127.0.0.1", port, seed, cfg, timeout=30.0)
+    monkeypatch.setenv("ST_FAULT_PLAN", env["ST_FAULT_PLAN"])
+    child = create_or_fetch("127.0.0.1", port, seed, cfg, timeout=30.0)
+    monkeypatch.delenv("ST_FAULT_PLAN")
+    try:
+        if child._engine is None:
+            pytest.skip("native engine unavailable")
+        rng = np.random.default_rng(2)
+        for _ in range(40):
+            child.add(jnp.asarray(rng.uniform(-1, 1, 2048).astype(np.float32)))
+        # the stalled uplink ledgers every message unacked; production
+        # stops when either the 32-deep window closes or the cascade
+        # drains the residual — both leave a deep in-flight ledger
+        deadline = time.time() + 20.0
+        while time.time() < deadline and child.st.inflight_total() < 8:
+            time.sleep(0.05)
+        # wait for production to STOP (window closed or residual drained):
+        # the byte-exact round trip below needs the sender quiescent, or a
+        # post-restore quantize would legitimately mutate the residual
+        last = -1
+        while time.time() < deadline:
+            cur = child.st.frames_out
+            if cur == last:
+                break
+            last = cur
+            time.sleep(0.3)
+        inflight = child.st.inflight_total()
+        assert inflight >= 8, f"no in-flight ledger built up ({inflight})"
+        eng = child._engine
+        v1, l1, a1 = eng.snapshot_ex()
+        assert a1[1]["sign2"], "peer sign2 capability missing from aux"
+        assert a1[1]["tx_seq"] >= inflight and a1[1]["rx_count"] == 0
+        eng.restore_ex(v1, l1, a1)
+        v2, l2, a2 = eng.snapshot_ex()
+        np.testing.assert_array_equal(v1, v2)
+        assert set(l1) == set(l2)
+        for lid in l1:
+            np.testing.assert_array_equal(l1[lid], l2[lid])
+        assert a1 == a2
+        # governor restore: crafted precision + previous-RMS sample survive
+        crafted = {1: dict(a1[1], prec=2, gov_prev=0.25)}
+        eng.restore_ex(v1, l1, crafted)
+        assert eng.link_precision(1) == 2
+        _, _, a3 = eng.snapshot_ex()
+        assert a3[1]["prec"] == 2
+        assert a3[1]["gov_prev"] == pytest.approx(0.25)
+    finally:
+        child.close()
+        master.close()
 
 
 def test_pod_roundtrip_resumes_training(tmp_path):

@@ -194,6 +194,42 @@ def test_striped_adaptive_suite_under_asan_ubsan():
 
 
 @pytest.mark.slow
+def test_lifecycle_suite_under_asan_ubsan():
+    """r12 satellite: the lifecycle plane adds native surface — the
+    sender's pause gate, st_engine_snapshot_ex/restore_ex's one-mutex
+    bulk copies (values + every residual + per-link aux) racing the codec
+    threads, and the governor-state restore path. Run the lifecycle suite
+    (snapshot barrier under load, in-place restore, kill-restore restart,
+    routed drain, the subscriber arm) plus the engine checkpoint
+    round-trip against the sanitizer builds so ASan/UBSan watch every
+    capture while the data plane is live under it."""
+    asan = _runtime("libasan.so")
+    ubsan = _runtime("libubsan.so")
+    if asan is None or ubsan is None:
+        pytest.skip("gcc sanitizer runtimes unavailable")
+    build = subprocess.run(
+        ["make", "-C", str(NATIVE), "sanitize"],
+        capture_output=True, text=True, timeout=300,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"sanitize build failed: {build.stderr[-500:]}")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "tests/test_lifecycle.py",
+            "tests/test_checkpoint.py::"
+            "test_engine_snapshot_roundtrip_sign2_cascade_inflight",
+            "-q", "-p", "no:cacheprovider",
+        ],
+        env=_san_env(asan, ubsan), capture_output=True, text=True,
+        timeout=540, cwd=str(REPO),
+    )
+    err_tail = proc.stderr[-4000:]
+    assert "AddressSanitizer" not in proc.stderr, err_tail
+    assert "runtime error:" not in proc.stderr, err_tail  # UBSan findings
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-2000:], err_tail)
+
+
+@pytest.mark.slow
 def test_chaos_soak_native_arm_under_asan_ubsan():
     asan = _runtime("libasan.so")
     ubsan = _runtime("libubsan.so")
