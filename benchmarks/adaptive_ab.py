@@ -131,12 +131,12 @@ def _run_chaos_arm(adaptive: bool, rep: int, np, jnp) -> dict:
             t_next += 0.5
             samples.append((
                 round(now - t0, 2),
-                child.metrics(canonical=True, _warn=False)[
+                child.metrics()[
                     "st_residual_norm"
                 ],
             ))
         time.sleep(0.005)
-    cm = child.metrics(canonical=True, _warn=False)
+    cm = child.metrics()
     samples.append((round(time.time() - t0, 2), cm["st_residual_norm"]))
     half = [rn for (t, rn) in samples if t >= SECONDS / 2]
     run = {
@@ -194,8 +194,8 @@ def _run_mixed_arm(np, jnp) -> dict:
     ra = np.asarray(child_a.read()).astype(np.float64)
     rb = np.asarray(child_b.read()).astype(np.float64)
     rm = np.asarray(master.read()).astype(np.float64)
-    ma = child_a.metrics(canonical=True, _warn=False)
-    mb = child_b.metrics(canonical=True, _warn=False)
+    ma = child_a.metrics()
+    mb = child_b.metrics()
     out = {
         "drained": ok_drain,
         "frames2_in_capable": ma.get("st_frames2_in_total", 0),

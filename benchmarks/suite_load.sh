@@ -14,6 +14,34 @@ cd "$(dirname "$0")/.."
 RUNS="${1:-3}"
 OUT="${2:-/dev/stdout}"
 FAILED=0
+
+# Static-analysis gates (r13), FIRST so a red gate fails in seconds, not
+# after three 10-minute suite runs:
+#  - cross-tier lints (tools/): ABI/ctypes signatures + counter widths,
+#    wire kinds, obs event codes, metric-name schema coverage;
+#  - clang -Wthread-safety -Werror + .clang-tidy over the native tier
+#    (ST_SUITE_ANALYZE=0 skips; auto-skips when clang is absent — this
+#    image ships gcc only, CI images with clang get the full gate).
+if [ "${ST_SUITE_LINT:-1}" = "1" ]; then
+  echo "--- lint gate (ABI / wire / events / metrics) ---" >>"$OUT"
+  for l in lint_abi lint_wire lint_events lint_metrics; do
+    python "tools/$l.py" --repo . >>"$OUT" 2>&1 || FAILED=1
+  done
+  [ "$FAILED" -ne 0 ] && { echo "FAIL: lint gate red" >>"$OUT"; exit 1; }
+fi
+if [ "${ST_SUITE_ANALYZE:-1}" = "1" ]; then
+  if command -v "${CLANG:-clang}" >/dev/null 2>&1; then
+    echo "--- analyze gate (clang -Wthread-safety -Werror) ---" >>"$OUT"
+    make -C native analyze >>"$OUT" 2>&1 || FAILED=1
+    if command -v "${CLANG_TIDY:-clang-tidy}" >/dev/null 2>&1; then
+      make -C native tidy >>"$OUT" 2>&1 || FAILED=1
+    fi
+    [ "$FAILED" -ne 0 ] && { echo "FAIL: analyze gate red" >>"$OUT"; exit 1; }
+  else
+    echo "--- analyze gate skipped (no clang in this image) ---" >>"$OUT"
+  fi
+fi
+
 for i in $(seq 1 "$RUNS"); do
   START=$(date -u +%H:%M:%SZ)
   LOG=$(mktemp)
@@ -35,6 +63,22 @@ if [ "$FAILED" -eq 0 ]; then
   echo "PASS: $RUNS/$RUNS consecutive loaded runs green" >>"$OUT"
 else
   echo "FAIL: at least one run red (see above)" >>"$OUT"
+fi
+
+# TSan gate (r13): the engine, striping/sign2 and lifecycle suites under
+# ThreadSanitizer (make -C native tsan + LD_PRELOAD libtsan;
+# tests/test_sanitizers.py TSan arms). Ordered BEFORE the perf-floor gate:
+# a data race is a correctness red, and the bench should never ride on top
+# of one. Zero unsuppressed reports required; native/tsan.supp's target
+# state is empty. ST_SUITE_TSAN=0 skips (the tests also skip cleanly on a
+# box without the gcc TSan runtime).
+if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_TSAN:-1}" = "1" ]; then
+  echo "--- TSan gate (engine + striping/sign2 + lifecycle) ---" >>"$OUT"
+  JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_sanitizers.py::test_engine_suite_under_tsan \
+    tests/test_sanitizers.py::test_striped_sign2_suite_under_tsan \
+    tests/test_sanitizers.py::test_lifecycle_suite_under_tsan \
+    -m slow -q -p no:cacheprovider >>"$OUT" 2>&1 || FAILED=1
 fi
 
 # Perf-floor gate (r07): a green suite is necessary but not sufficient — a

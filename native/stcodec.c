@@ -33,6 +33,8 @@
 #include <stdint.h>
 #include <string.h>
 
+#include "st_annotations.h" /* clang -Wthread-safety vocabulary (no-op on gcc) */
+
 #define EXPORT __attribute__((visibility("default")))
 
 /* AVX-512 fast paths with RUNTIME dispatch. The reference's scalar loops run
@@ -51,11 +53,18 @@
 #include <immintrin.h>
 #define ST_AVX512 1
 static int st_has_avx512(void) {
+  /* relaxed atomics (TSan arm finding): two engine threads can run the
+   * first large-table kernels concurrently, and the lazy init of a plain
+   * int was a write/read race. Both writers store the same value, so
+   * relaxed ordering is sufficient — the guard is the access atomicity. */
   static int cached = -1;
-  if (cached < 0)
-    cached = __builtin_cpu_supports("avx512f") &&
-             __builtin_cpu_supports("avx512dq");
-  return cached;
+  int c = __atomic_load_n(&cached, __ATOMIC_RELAXED);
+  if (c < 0) {
+    c = __builtin_cpu_supports("avx512f") &&
+        __builtin_cpu_supports("avx512dq");
+    __atomic_store_n(&cached, c, __ATOMIC_RELAXED);
+  }
+  return c;
 }
 #define ST_TARGET_AVX512 __attribute__((target("avx512f,avx512dq")))
 /* The scalar loops are the only path on non-AVX-512 x86; without
@@ -118,13 +127,39 @@ static int st_has_avx512(void) {
 
 typedef void (*stc_seg_fn)(void *ctx, int64_t seg);
 
+/* pthread_mutex_t wrapped as a clang thread-safety "capability" so pool
+ * fields can carry ST_GUARDED_BY and the analysis checks the lock
+ * discipline (st_annotations.h; plain pthread types are not capabilities).
+ * Lock order: job_mu -> mu (the submitter wakes sleepers / sleeps on
+ * cv_done while holding job_mu); workers take mu alone. */
+typedef struct ST_CAPABILITY("mutex") stc_mutex {
+  pthread_mutex_t m;
+} stc_mutex_t;
+
+static inline void stc_mutex_lock(stc_mutex_t *mu) ST_ACQUIRE(*mu) {
+  pthread_mutex_lock(&mu->m);
+}
+static inline void stc_mutex_unlock(stc_mutex_t *mu) ST_RELEASE(*mu) {
+  pthread_mutex_unlock(&mu->m);
+}
+/* returns 0 on success, like pthread_mutex_trylock */
+static inline int stc_mutex_trylock(stc_mutex_t *mu) ST_TRY_ACQUIRE(0, *mu) {
+  return pthread_mutex_trylock(&mu->m);
+}
+
 static struct {
-  pthread_mutex_t mu;
+  stc_mutex_t mu;
   pthread_cond_t cv_job, cv_done;
-  pthread_mutex_t job_mu; /* serializes submitters (trylock) */
-  int started;            /* 0 = not yet, 1 = live, -1 = dead (fork child) */
-  int nworkers;
-  uint64_t gen;
+  stc_mutex_t job_mu; /* serializes submitters (trylock) */
+  /* 0 = not yet, 1 = live, -1 = dead (fork child / threading disabled).
+   * ATOMIC: stc_pool_up's fast path reads it lock-free on every
+   * large-table call (a plain int there was a data race against the
+   * slow path's locked write — exactly the bug class this PR's TSan arm
+   * exists to catch; the transition is monotonic 0 -> {1,-1} so the
+   * value a racy reader observes is still always valid). */
+  _Atomic int started;
+  int nworkers ST_GUARDED_BY(mu);
+  uint64_t gen ST_GUARDED_BY(job_mu);
   /* job fields are relaxed atomics published under the agen seqlock (see
    * below): plain fields raced the next submitter's writes once the
    * publish mutex was dropped — a worker preempted between adopting agen
@@ -143,7 +178,6 @@ static struct {
    * the tag, a pop whose generation no longer matches fails and the
    * straggler falls through to re-wait (ADVICE r05 finding 2). */
   _Atomic uint64_t next;
-  int64_t finished;
   /* r11 lock-free hot path: the per-job mutex round trips (publish
    * broadcast + every worker's start/finish acquisition) measured as
    * ~100 us of a ~250 us pass once the cascade cut the pass COUNT 8-fold
@@ -169,14 +203,10 @@ static struct {
    * must not be a plain-int data race */
   _Atomic int sleepers;
   _Atomic int sub_waiting;
-} g_pool = {PTHREAD_MUTEX_INITIALIZER, PTHREAD_COND_INITIALIZER,
-            PTHREAD_COND_INITIALIZER,  PTHREAD_MUTEX_INITIALIZER,
-            0,                         0,
-            0,                         0,
-            0,                         0,
-            0,                         0,
-            0,                         0,
-            0,                         0};
+} g_pool = {.mu = {PTHREAD_MUTEX_INITIALIZER},
+            .cv_job = PTHREAD_COND_INITIALIZER,
+            .cv_done = PTHREAD_COND_INITIALIZER,
+            .job_mu = {PTHREAD_MUTEX_INITIALIZER}};
 
 /* Pop one chunk index for generation `gen`, or -1 when the job is exhausted
  * OR the counter now belongs to a different generation (stale worker). */
@@ -206,7 +236,7 @@ static void *stc_pool_worker(void *arg) {
          * timedwait bounds the publisher's racy sleepers check — a
          * publish that misses a just-registering sleeper costs one tick,
          * never a lost wakeup. */
-        pthread_mutex_lock(&g_pool.mu);
+        stc_mutex_lock(&g_pool.mu);
         g_pool.sleepers++;
         while (atomic_load_explicit(&g_pool.agen, memory_order_acquire) ==
                seen) {
@@ -217,10 +247,10 @@ static void *stc_pool_worker(void *arg) {
             ts.tv_sec++;
             ts.tv_nsec -= 1000000000;
           }
-          pthread_cond_timedwait(&g_pool.cv_job, &g_pool.mu, &ts);
+          pthread_cond_timedwait(&g_pool.cv_job, &g_pool.mu.m, &ts);
         }
         g_pool.sleepers--;
-        pthread_mutex_unlock(&g_pool.mu);
+        stc_mutex_unlock(&g_pool.mu);
         break;
       }
       stc_cpu_relax();
@@ -260,9 +290,9 @@ static void *stc_pool_worker(void *arg) {
           if ((int64_t)((cur & 0xffffffffu) + (uint64_t)done) >= nseg &&
               atomic_load_explicit(&g_pool.sub_waiting,
                                    memory_order_acquire)) {
-            pthread_mutex_lock(&g_pool.mu);
+            stc_mutex_lock(&g_pool.mu);
             pthread_cond_broadcast(&g_pool.cv_done);
-            pthread_mutex_unlock(&g_pool.mu);
+            stc_mutex_unlock(&g_pool.mu);
           }
           break;
         }
@@ -272,7 +302,11 @@ static void *stc_pool_worker(void *arg) {
   return NULL;
 }
 
-static void stc_pool_child(void) { g_pool.started = -1; }
+static void stc_pool_child(void) {
+  /* fork child: single-threaded by definition, but keep the store atomic
+   * so the field has exactly one access discipline everywhere */
+  atomic_store_explicit(&g_pool.started, -1, memory_order_relaxed);
+}
 
 static int stc_pool_threads(void) {
   static int cached = 0;
@@ -288,15 +322,18 @@ static int stc_pool_threads(void) {
   return cached;
 }
 
-/* Ensure workers exist. Returns 0 when threading is unavailable. */
+/* Ensure workers exist. Returns 0 when threading is unavailable. The
+ * lock-free fast path is why `started` is atomic (its declaration): every
+ * large-table codec call lands here first. */
 static int stc_pool_up(void) {
-  if (g_pool.started == 1) return 1;
-  if (g_pool.started < 0) return 0;
-  pthread_mutex_lock(&g_pool.mu);
-  if (g_pool.started == 0) {
+  int st = atomic_load_explicit(&g_pool.started, memory_order_acquire);
+  if (st == 1) return 1;
+  if (st < 0) return 0;
+  stc_mutex_lock(&g_pool.mu);
+  if (atomic_load_explicit(&g_pool.started, memory_order_relaxed) == 0) {
     int nt = stc_pool_threads();
     if (nt <= 1) {
-      g_pool.started = -1;
+      atomic_store_explicit(&g_pool.started, -1, memory_order_release);
     } else {
       pthread_atfork(NULL, NULL, stc_pool_child);
       int spawned = 0;
@@ -308,11 +345,12 @@ static int stc_pool_up(void) {
         }
       }
       g_pool.nworkers = spawned;
-      g_pool.started = spawned > 0 ? 1 : -1;
+      atomic_store_explicit(&g_pool.started, spawned > 0 ? 1 : -1,
+                            memory_order_release);
     }
   }
-  int ok = g_pool.started == 1;
-  pthread_mutex_unlock(&g_pool.mu);
+  int ok = atomic_load_explicit(&g_pool.started, memory_order_relaxed) == 1;
+  stc_mutex_unlock(&g_pool.mu);
   return ok;
 }
 
@@ -321,7 +359,7 @@ static int stc_pool_up(void) {
  * whole loop inline (pool busy / dead / tiny job). */
 static int stc_pool_run(stc_seg_fn fn, void *ctx, int64_t nseg) {
   if (nseg < 2 || nseg >= (int64_t)1 << 32 || !stc_pool_up()) return 0;
-  if (pthread_mutex_trylock(&g_pool.job_mu) != 0) return 0;
+  if (stc_mutex_trylock(&g_pool.job_mu) != 0) return 0;
   /* job_mu serializes submitters, so gen is ours to bump; the fields
    * publish under the agen seqlock: odd tag first (the acq_rel RMW pins
    * the stores AFTER it), fields + tagged counters, then the new even
@@ -343,9 +381,9 @@ static int stc_pool_run(stc_seg_fn fn, void *ctx, int64_t nseg) {
    * JUST-registering sleeper, whose 2 ms timedwait tick re-checks agen —
    * bounded lag on an idle->busy edge, zero mutex traffic when hot */
   if (g_pool.sleepers > 0) {
-    pthread_mutex_lock(&g_pool.mu);
+    stc_mutex_lock(&g_pool.mu);
     pthread_cond_broadcast(&g_pool.cv_job);
-    pthread_mutex_unlock(&g_pool.mu);
+    stc_mutex_unlock(&g_pool.mu);
   }
   int64_t done = 0;
   for (;;) {
@@ -375,7 +413,7 @@ static int stc_pool_run(stc_seg_fn fn, void *ctx, int64_t nseg) {
         (int64_t)(atomic_load_explicit(&g_pool.afin, memory_order_acquire) &
                   0xffffffffu) < nseg) {
       atomic_store_explicit(&g_pool.sub_waiting, 1, memory_order_release);
-      pthread_mutex_lock(&g_pool.mu);
+      stc_mutex_lock(&g_pool.mu);
       while ((int64_t)(atomic_load_explicit(&g_pool.afin,
                                             memory_order_acquire) &
                        0xffffffffu) < nseg) {
@@ -386,13 +424,13 @@ static int stc_pool_run(stc_seg_fn fn, void *ctx, int64_t nseg) {
           ts.tv_sec++;
           ts.tv_nsec -= 1000000000;
         }
-        pthread_cond_timedwait(&g_pool.cv_done, &g_pool.mu, &ts);
+        pthread_cond_timedwait(&g_pool.cv_done, &g_pool.mu.m, &ts);
       }
-      pthread_mutex_unlock(&g_pool.mu);
+      stc_mutex_unlock(&g_pool.mu);
       atomic_store_explicit(&g_pool.sub_waiting, 0, memory_order_release);
     }
   }
-  pthread_mutex_unlock(&g_pool.job_mu);
+  stc_mutex_unlock(&g_pool.job_mu);
   return 1;
 }
 

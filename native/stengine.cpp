@@ -39,6 +39,9 @@
 #include <unistd.h>
 
 #include <cmath>
+
+#include "st_annotations.h"  // clang -Wthread-safety vocabulary (no-op on gcc)
+#include "st_cv.h"           // system-clock condvar deadlines (TSan arm)
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -202,19 +205,22 @@ struct TxSlot {
 };
 
 struct TxPool {
-  std::mutex mu;
-  std::vector<TxSlot*> free_;
-  std::vector<std::unique_ptr<TxSlot>> all_;
+  StMutex mu;
+  std::vector<TxSlot*> free_ ST_GUARDED_BY(mu);
+  std::vector<std::unique_ptr<TxSlot>> all_ ST_GUARDED_BY(mu);
+  // written between create and start only (st_engine_set_codec); the
+  // sender thread reads it unlocked after the start fence
   size_t slot_bytes = 0;   // 8 + burst * frame_bytes
   size_t keep_warm = 4;    // free slots retained with their buffer intact
-  size_t warm_ = 0;        // free_ entries with buf intact (all at the back)
+  size_t warm_ ST_GUARDED_BY(mu) = 0;  // free_ entries with buf intact
+                                       // (all at the back)
   std::atomic<uint64_t> acquires{0}, alloc_events{0};
 
   TxSlot* acquire() {
     acquires++;
     TxSlot* s;
     {
-      std::lock_guard<std::mutex> lk(mu);
+      StLockGuard lk(mu);
       if (!free_.empty()) {
         s = free_.back();
         free_.pop_back();
@@ -238,7 +244,7 @@ struct TxPool {
     // drain loop checks all refs under the same mutex, so it can never
     // observe "all drained" while a releaser sits between its decrement
     // and the free-list push (it would then free the pool under us)
-    std::lock_guard<std::mutex> lk(mu);
+    StLockGuard lk(mu);
     if (s->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
       if (warm_ >= keep_warm) {
         // bound idle memory: keep the slot object, drop its buffer — and
@@ -451,8 +457,17 @@ struct Engine {
 
   TxPool txpool;  // native-framing tx slot ring (see TxSlot)
 
-  std::vector<float> values;
-  std::map<int32_t, ELink> links;
+  // Data-plane mutex (mirrors the Python tier: ONE lock over values,
+  // residuals, ledgers; codec loops run under it, socket I/O outside it —
+  // except flush_acks/FRESH beats, which send with a ZERO timeout from
+  // under it by design). Declared before the fields it guards so the
+  // ST_GUARDED_BY references resolve.
+  StMutex mu;
+  std::vector<float> values ST_GUARDED_BY(mu);
+  // The whole ELink record — residual, ledger, governor state — is guarded
+  // by mu as a unit: the analysis checks every access to the map itself,
+  // and no code path retains an ELink reference across an unlock.
+  std::map<int32_t, ELink> links ST_GUARDED_BY(mu);
   // The re-graft carry as a LIVE slot (the reference's unconnected-slot
   // mechanism, src/sharedtensor.c:124-126/:338-342): a dead uplink's
   // rolled-back residual parks here and KEEPS accumulating add()/flood
@@ -460,9 +475,8 @@ struct Engine {
   // the re-graft, or the join snapshot presents it as tree-known state and
   // the parent's diff seed erases it everywhere (measured as tree-wide
   // loss in the churn soak before this existed).
-  std::vector<float> carry;
-  bool has_carry = false;
-  std::mutex mu;
+  std::vector<float> carry ST_GUARDED_BY(mu);
+  bool has_carry ST_GUARDED_BY(mu) = false;
 
   // r11 staged adds: st_engine_add used to take the data-plane mutex for
   // its two full-table passes, serializing every trainer add behind
@@ -474,19 +488,24 @@ struct Engine {
   // under e->mu at the next safe point). Lock order: e->mu -> add_mu,
   // never the reverse; add() takes only add_mu. The pending trace
   // re-seed stages through pend_gen the same way.
-  std::mutex add_mu;
-  std::vector<float> upend, ufold;  // pending accumulation + fold scratch
+  StMutex add_mu ST_ACQUIRED_AFTER(mu);
+  // upend: the trainers' staged accumulation (add_mu alone). ufold: the
+  // fold scratch — swapped in under BOTH locks (fold_pending), then read
+  // and re-zeroed under mu alone, so mu is its guard.
+  std::vector<float> upend ST_GUARDED_BY(add_mu);
+  std::vector<float> ufold ST_GUARDED_BY(mu);
   std::atomic<bool> has_pending{false};
   std::atomic<uint64_t> pend_gen{0};
 
   // sender wake (missed-wakeup-safe sequence counter)
-  std::mutex wmu;
+  StMutex wmu;
   std::condition_variable wcv;
-  uint64_t wseq = 0;
+  uint64_t wseq ST_GUARDED_BY(wmu) = 0;
 
   // control messages (non DATA/BURST/ACK) surfaced to Python
-  std::mutex cmu;
-  std::deque<std::pair<int32_t, std::vector<uint8_t>>> ctrl;
+  StMutex cmu;
+  std::deque<std::pair<int32_t, std::vector<uint8_t>>> ctrl
+      ST_GUARDED_BY(cmu);
 
   std::atomic<bool> stop{false};
   // Sender pass counter (r12): incremented at the top of every sender-loop
@@ -564,16 +583,16 @@ struct Engine {
   // design: residual coalescing means one outgoing message can carry many
   // generations' mass; it is stamped with the newest (README "Cluster
   // observability" documents the semantics).
-  uint32_t t_origin = 0;
-  uint64_t t_gen = 0;
-  uint32_t t_hops = 0;
-  bool t_has = false;
+  uint32_t t_origin ST_GUARDED_BY(mu) = 0;
+  uint64_t t_gen ST_GUARDED_BY(mu) = 0;
+  uint32_t t_hops ST_GUARDED_BY(mu) = 0;
+  bool t_has ST_GUARDED_BY(mu) = false;
   uint32_t obs_id = 0;  // the node's process-unique obs id (event tag)
   std::thread send_thread, recv_thread;
 
-  void wake() {
+  void wake() ST_EXCLUDES(wmu) {
     {
-      std::lock_guard<std::mutex> lk(wmu);
+      StLockGuard lk(wmu);
       wseq++;
     }
     wcv.notify_all();
@@ -583,11 +602,15 @@ struct Engine {
 // Fold the staged pending add (st_engine_add) into values + every
 // residual + the carry — the pre-r11 add body, run at the next safe
 // point by whoever holds e->mu. One atomic-bool check when idle.
-void fold_pending(Engine* e) {
+void fold_pending(Engine* e) ST_REQUIRES(e->mu) {
   if (!e->has_pending.load(std::memory_order_acquire)) return;
   {
-    std::lock_guard<std::mutex> alk(e->add_mu);
+    StLockGuard alk(e->add_mu);
     if (!e->has_pending.load(std::memory_order_relaxed)) return;
+    // fold scratch sized lazily HERE (under both locks — ufold is
+    // mu-guarded, and st_engine_add holds only add_mu)
+    if (e->ufold.size() != e->upend.size())
+      e->ufold.assign(e->upend.size(), 0.0f);
     // swap the accumulation buffer out (ufold is pre-zeroed — see the
     // fill below) so concurrent adds keep landing while we fold
     std::swap(e->upend, e->ufold);
@@ -679,7 +702,7 @@ bool any_nonzero(const float* s, int64_t L) {
 // frames straight out of the ledgered tx slot (the slot body offsets are
 // 4-aligned by construction — see TxSlot) and drop the ledger's pool
 // reference. Caller holds e->mu.
-void rollback_unacked(Engine* e, ELink& lk) {
+void rollback_unacked(Engine* e, ELink& lk) ST_REQUIRES(e->mu) {
   size_t per = (size_t)e->L * 4 + (size_t)e->W * 4;
   for (auto& msg : lk.unacked) {
     // frame stride follows the ledgered message's precision (r11): a
@@ -715,7 +738,7 @@ void rollback_unacked(Engine* e, ELink& lk) {
 // is k*2W — per frame, sign plane then magnitude plane). A receive batch
 // flushes on precision change, so one call is always homogeneous.
 void apply_batch(Engine* e, int32_t src_link, int32_t k, const float* scales,
-                 const uint32_t* words, int prec) {
+                 const uint32_t* words, int prec) ST_REQUIRES(e->mu) {
   // NOTE: dead links are NOT skipped here (only the I/O loops skip them):
   // a dead link's residual keeps accumulating until Python detaches it —
   // that residual IS the carry the re-graft owes, and mass applied in the
@@ -783,13 +806,14 @@ size_t frame_bytes(const Engine* e) {
 // fruitless rounds tear the link down (rollback -> dead -> drop) so
 // LINK_DOWN -> carry -> re-graft recovers every undelivered frame on a
 // fresh link instead of retrying forever.
-void retransmit_pass(Engine* e, const std::vector<int32_t>& ids) {
+void retransmit_pass(Engine* e, const std::vector<int32_t>& ids)
+    ST_EXCLUDES(e->mu) {
   auto now = EClock::now();
   for (int32_t id : ids) {
     std::vector<TxSlot*> tail;
     bool teardown = false;
     {
-      std::lock_guard<std::mutex> lk(e->mu);
+      StLockGuard lk(e->mu);
       auto it = e->links.find(id);
       if (it == e->links.end() || it->second.dead) continue;
       ELink& lk2 = it->second;
@@ -879,13 +903,13 @@ void sender_loop(Engine* e) {
     e->sender_pass.fetch_add(1);  // pass boundary (st_engine_pause sync)
     uint64_t seq_before;
     {
-      std::lock_guard<std::mutex> lk(e->wmu);
+      StLockGuard lk(e->wmu);
       seq_before = e->wseq;
     }
     bool sent_any = false;
     std::vector<int32_t> ids;
     {
-      std::lock_guard<std::mutex> lk(e->mu);
+      StLockGuard lk(e->mu);
       for (auto& kv : e->links)
         if (!kv.second.dead) ids.push_back(kv.first);
     }
@@ -906,7 +930,7 @@ void sender_loop(Engine* e) {
       uint64_t tr_g = 0;
       uint8_t tr_h = 0;
       {
-        std::lock_guard<std::mutex> lk(e->mu);
+        StLockGuard lk(e->mu);
         fold_pending(e);  // staged adds land before this link quantizes
         auto it = e->links.find(id);
         if (it == e->links.end() || it->second.dead) continue;
@@ -1382,7 +1406,7 @@ void sender_loop(Engine* e) {
           // undelivered: roll this message's frames back so a detach
           // returns the residual the subscriber is still owed, and mark
           // the link dead (membership is Python's call, as everywhere)
-          std::lock_guard<std::mutex> lk(e->mu);
+          StLockGuard lk(e->mu);
           auto it = e->links.find(id);
           if (it != e->links.end()) {
             for (int32_t f = 0; f < msg.nframes; f++)
@@ -1448,7 +1472,7 @@ void sender_loop(Engine* e) {
       if (bounces > 0 && e->prec_mode == 1) {
         // byte backpressure observed: feed the precision governor's
         // byte-bound gate (harvested at its next beat)
-        std::lock_guard<std::mutex> lk(e->mu);
+        StLockGuard lk(e->mu);
         auto it = e->links.find(id);
         if (it != e->links.end()) it->second.gov_bp += (uint32_t)bounces;
       }
@@ -1463,7 +1487,7 @@ void sender_loop(Engine* e) {
         // owes the full residual (peer.py nack path on send failure).
         // Compat has no ledger — roll back this message's own frames
         // directly (stronger than the reference, which loses them).
-        std::lock_guard<std::mutex> lk(e->mu);
+        StLockGuard lk(e->mu);
         auto it = e->links.find(id);
         if (it != e->links.end()) {
           if (e->compat_bytes) {
@@ -1486,10 +1510,15 @@ void sender_loop(Engine* e) {
     if (!e->compat_bytes && e->ack_timeout > 0 && !e->stop.load())
       retransmit_pass(e, ids);
     if (!sent_any && !e->stop.load()) {
-      std::unique_lock<std::mutex> lk(e->wmu);
-      if (e->wseq <= seq_before) {
-        e->wcv.wait_for(lk, std::chrono::milliseconds(50),
-                        [&] { return e->wseq > seq_before || e->stop.load(); });
+      // explicit wait loop (not wait_for-with-predicate): the predicate
+      // lambda would read the wmu-guarded wseq from a context the
+      // thread-safety analysis treats as lock-free
+      StUniqueLock lk(e->wmu);
+      auto nap_deadline = st_cv_deadline(0.05);
+      while (e->wseq <= seq_before && !e->stop.load()) {
+        if (e->wcv.wait_until(lk.native(), nap_deadline) ==
+            std::cv_status::timeout)
+          break;
       }
     }
   }
@@ -1497,7 +1526,7 @@ void sender_loop(Engine* e) {
 
 // ---- receiver -------------------------------------------------------------
 
-void flush_acks(Engine* e, int32_t id, ELink& lk) {
+void flush_acks(Engine* e, int32_t id, ELink& lk) ST_REQUIRES(e->mu) {
   // cumulative + retried (a backpressure-dropped ACK must be re-offered or
   // the sender's ledger never drains — comm/peer.py _flush_acks)
   if (e->compat_bytes) return;  // the reference protocol has no ACKs
@@ -1521,7 +1550,7 @@ void receiver_loop(Engine* e) {
     bool busy = false;
     std::vector<int32_t> ids;
     {
-      std::lock_guard<std::mutex> lk(e->mu);
+      StLockGuard lk(e->mu);
       for (auto& kv : e->links)
         if (!kv.second.dead) ids.push_back(kv.first);
     }
@@ -1546,7 +1575,7 @@ void receiver_loop(Engine* e) {
       // batch — msgs tracks acceptances not yet folded in by flush)
       uint64_t rx_base = 0;
       {
-        std::lock_guard<std::mutex> lk(e->mu);
+        StLockGuard lk(e->mu);
         auto it = e->links.find(id);
         if (it != e->links.end()) rx_base = it->second.rx_count;
       }
@@ -1554,7 +1583,7 @@ void receiver_loop(Engine* e) {
       bwords.clear();
       auto flush = [&]() {
         if (batchk == 0 && msgs == 0) return;
-        std::lock_guard<std::mutex> lk(e->mu);
+        StLockGuard lk(e->mu);
         auto it = e->links.find(id);
         if (it == e->links.end()) return;
         if (batchk > 0) {
@@ -1612,7 +1641,7 @@ void receiver_loop(Engine* e) {
         if (n < 0) {
           // dead + drained; rollback happens at detach (or the sender's
           // failed send) — membership/carry is Python's call
-          std::lock_guard<std::mutex> lk(e->mu);
+          StLockGuard lk(e->mu);
           auto it = e->links.find(id);
           if (it != e->links.end()) it->second.dead = true;
           break;
@@ -1741,7 +1770,7 @@ void receiver_loop(Engine* e) {
         } else if (kind == kAck && n == 9) {
           uint64_t count;
           std::memcpy(&count, buf.data() + 1, 8);
-          std::lock_guard<std::mutex> lk(e->mu);
+          StLockGuard lk(e->mu);
           auto it = e->links.find(id);
           if (it != e->links.end()) {
             ELink& lk2 = it->second;
@@ -1774,7 +1803,7 @@ void receiver_loop(Engine* e) {
           // control-plane message (handshake retries, REJECT, unknown):
           // preserve ordering — flush data first — then hand to Python
           flush();
-          std::lock_guard<std::mutex> lk(e->cmu);
+          StLockGuard lk(e->cmu);
           e->ctrl.emplace_back(
               id, std::vector<uint8_t>(buf.data(), buf.data() + n));
         }
@@ -1783,7 +1812,7 @@ void receiver_loop(Engine* e) {
       flush();
       {
         // retry any previously-backpressured ACK even on idle passes
-        std::lock_guard<std::mutex> lk(e->mu);
+        StLockGuard lk(e->mu);
         auto it = e->links.find(id);
         if (it != e->links.end()) flush_acks(e, id, it->second);
       }
@@ -1892,7 +1921,7 @@ __attribute__((visibility("default"))) int32_t st_engine_link_allow_sign2(
     void* h, int32_t link_id, int32_t allow) {
   if (!h) return 0;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->mu);
+  StLockGuard lk(e->mu);
   auto it = e->links.find(link_id);
   if (it == e->links.end()) return 0;
   it->second.peer_sign2 = allow != 0;
@@ -1905,7 +1934,7 @@ __attribute__((visibility("default"))) int32_t st_engine_link_precision(
     void* h, int32_t link_id) {
   if (!h) return 0;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->mu);
+  StLockGuard lk(e->mu);
   auto it = e->links.find(link_id);
   if (it == e->links.end()) return 0;
   if (e->prec_mode == 2) return it->second.peer_sign2 ? 2 : 1;
@@ -1950,7 +1979,7 @@ __attribute__((visibility("default"))) void st_engine_destroy(void* h) {
   // entries (no rollback — the engine is dying, there is no residual left
   // to repair; Python detached/stashed everything it wanted first).
   {
-    std::lock_guard<std::mutex> lk(e->mu);
+    StLockGuard lk(e->mu);
     for (auto& kv : e->links) {
       for (auto& msg : kv.second.unacked)
         if (msg.slot) e->txpool.unref(msg.slot);
@@ -1968,7 +1997,7 @@ __attribute__((visibility("default"))) void st_engine_destroy(void* h) {
   for (int i = 0;; i++) {
     bool busy = false;
     {
-      std::lock_guard<std::mutex> lk(e->txpool.mu);
+      StLockGuard lk(e->txpool.mu);
       for (auto& s : e->txpool.all_)
         if (s->refs.load(std::memory_order_acquire) != 0) {
           busy = true;
@@ -1996,10 +2025,11 @@ __attribute__((visibility("default"))) void st_engine_add(void* h,
     // fold into values/residuals/carry — including the dead links whose
     // residual is the re-graft carry, and the fused partials refresh —
     // happens in fold_pending at the next data-plane safe point.
-    std::lock_guard<std::mutex> alk(e->add_mu);
+    StLockGuard alk(e->add_mu);
     if (e->upend.empty()) {
+      // ufold (the fold scratch) is sized lazily by fold_pending — it is
+      // mu-guarded and this path holds only add_mu
       e->upend.assign((size_t)e->total, 0.0f);
-      e->ufold.assign((size_t)e->total, 0.0f);
     }
     stc_accumulate_update_to(e->upend.data(), e->upend.data(), u,
                              e->off.data(), e->ns.data(), e->padded.data(),
@@ -2016,7 +2046,7 @@ __attribute__((visibility("default"))) void st_engine_read(void* h,
                                                            float* out) {
   if (!h) return;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->mu);
+  StLockGuard lk(e->mu);
   fold_pending(e);
   std::memcpy(out, e->values.data(), (size_t)e->total * 4);
 }
@@ -2032,7 +2062,7 @@ __attribute__((visibility("default"))) int32_t st_engine_attach(
   if (!h) return 0;
   auto* e = (Engine*)h;
   {
-    std::lock_guard<std::mutex> lk(e->mu);
+    StLockGuard lk(e->mu);
     fold_pending(e);  // the diff seed must include staged adds
     if (e->links.count(link_id)) return 0;  // already exists
     ELink& lk2 = e->links[link_id];
@@ -2071,7 +2101,7 @@ __attribute__((visibility("default"))) int32_t st_engine_attach_sub(
   auto* e = (Engine*)h;
   if (e->compat_bytes) return 0;
   {
-    std::lock_guard<std::mutex> lk(e->mu);
+    StLockGuard lk(e->mu);
     fold_pending(e);  // the sub seed must include staged adds
     if (e->links.count(link_id)) return 0;
     ELink& lk2 = e->links[link_id];
@@ -2119,7 +2149,7 @@ __attribute__((visibility("default"))) int32_t st_engine_compat_regraft(
   if (!h) return 0;
   auto* e = (Engine*)h;
   {
-    std::lock_guard<std::mutex> lk(e->mu);
+    StLockGuard lk(e->mu);
     fold_pending(e);
     if (e->links.count(link_id)) return 0;
     ELink& l = e->links[link_id];
@@ -2146,7 +2176,7 @@ __attribute__((visibility("default"))) int32_t st_engine_stash_carry(
     void* h, int32_t link_id) {
   if (!h) return 0;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->mu);
+  StLockGuard lk(e->mu);
   fold_pending(e);
   auto it = e->links.find(link_id);
   if (it == e->links.end()) return 0;
@@ -2174,7 +2204,7 @@ __attribute__((visibility("default"))) int32_t st_engine_take_carry_and_snapshot
     void* h, float* carry_out, float* values_out) {
   if (!h) return 0;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->mu);
+  StLockGuard lk(e->mu);
   fold_pending(e);
   if (values_out)
     std::memcpy(values_out, e->values.data(), (size_t)e->total * 4);
@@ -2193,7 +2223,7 @@ __attribute__((visibility("default"))) int32_t st_engine_detach(
     void* h, int32_t link_id, float* out_resid) {
   if (!h) return 0;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->mu);
+  StLockGuard lk(e->mu);
   fold_pending(e);
   auto it = e->links.find(link_id);
   if (it == e->links.end()) return 0;
@@ -2213,7 +2243,7 @@ __attribute__((visibility("default"))) void st_engine_inject(
   if (!h) return;
   auto* e = (Engine*)h;
   {
-    std::lock_guard<std::mutex> lk(e->mu);
+    StLockGuard lk(e->mu);
     // externally-decoded frames are python-tier 1-bit (the serve/handshake
     // paths never carry sign2)
     apply_batch(e, src_link, k, scales, words, 1);
@@ -2226,7 +2256,7 @@ __attribute__((visibility("default"))) int32_t st_engine_links(void* h,
                                                                int32_t cap) {
   if (!h) return 0;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->mu);
+  StLockGuard lk(e->mu);
   int32_t n = 0;
   for (auto& kv : e->links) {
     if (n >= cap) break;
@@ -2239,7 +2269,7 @@ __attribute__((visibility("default"))) double st_engine_residual_rms(
     void* h, int32_t link_id) {
   if (!h) return 0.0;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->mu);
+  StLockGuard lk(e->mu);
   fold_pending(e);
   auto it = e->links.find(link_id);
   if (it == e->links.end()) {
@@ -2275,7 +2305,7 @@ __attribute__((visibility("default"))) double st_engine_residual_rms(
 __attribute__((visibility("default"))) int64_t st_engine_inflight(void* h) {
   if (!h) return 0;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->mu);
+  StLockGuard lk(e->mu);
   int64_t n = 0;
   for (auto& kv : e->links) n += (int64_t)kv.second.unacked.size();
   return n;
@@ -2311,7 +2341,7 @@ __attribute__((visibility("default"))) void st_engine_counters(
   out22[5] = e->txpool.acquires.load();
   out22[6] = e->txpool.alloc_events.load();
   {
-    std::lock_guard<std::mutex> lk(e->txpool.mu);
+    StLockGuard lk(e->txpool.mu);
     out22[7] = (uint64_t)e->txpool.all_.size();
   }
   out22[8] = e->retx_msgs.load();
@@ -2340,7 +2370,7 @@ __attribute__((visibility("default"))) int32_t st_engine_link_obs(
   out2[0] = out2[1] = 0;
   if (!h) return 0;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->mu);
+  StLockGuard lk(e->mu);
   auto it = e->links.find(link_id);
   if (it == e->links.end()) return 0;
   out2[0] = it->second.stale_ns;
@@ -2354,7 +2384,7 @@ __attribute__((visibility("default"))) int32_t st_engine_poll_ctrl(
     void* h, int32_t* link_out, uint8_t* buf, int32_t cap) {
   if (!h) return 0;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->cmu);
+  StLockGuard lk(e->cmu);
   if (e->ctrl.empty()) return 0;
   auto& front = e->ctrl.front();
   *link_out = front.first;
@@ -2415,7 +2445,7 @@ __attribute__((visibility("default"))) void st_engine_restore_ex(
   if (!h) return;
   auto* e = (Engine*)h;
   {
-    std::lock_guard<std::mutex> lk(e->mu);
+    StLockGuard lk(e->mu);
     fold_pending(e);  // pre-restore adds belong to the superseded state
     std::memcpy(e->values.data(), values, (size_t)e->total * 4);
     for (int32_t i = 0; i < n_links; i++) {
@@ -2472,7 +2502,7 @@ __attribute__((visibility("default"))) int32_t st_engine_snapshot_ex(
     uint64_t* aux_out /* nullable */, int32_t max_links) {
   if (!h) return 0;
   auto* e = (Engine*)h;
-  std::lock_guard<std::mutex> lk(e->mu);
+  StLockGuard lk(e->mu);
   fold_pending(e);
   std::memcpy(values_out, e->values.data(), (size_t)e->total * 4);
   int32_t n = 0;

@@ -51,6 +51,9 @@
 #include <thread>
 #include <vector>
 
+#include "st_annotations.h"  // clang -Wthread-safety vocabulary (no-op on gcc)
+#include "st_cv.h"           // system-clock condvar deadlines (TSan arm)
+
 // Process-wide crash point (ST_FAULT_CRASH="name:N"): _exit(17) on the Nth
 // arrival at the named point. Parsed once; thread-safe countdown. Defined
 // ONCE for the whole .so and shared with stengine.cpp's protocol points
@@ -66,10 +69,10 @@ extern "C" __attribute__((visibility("default"))) void st_fault_crash_point(
   static std::atomic<int> armed{-1};  // -1 unparsed, 0 unarmed, 1 armed
   int a = armed.load(std::memory_order_relaxed);
   if (a == 0) return;
-  static std::mutex mu;
-  static std::string point;
-  static long remaining = 0;
-  std::lock_guard<std::mutex> lk(mu);
+  static StMutex mu;
+  static std::string point;    // under mu (function-locals cannot carry
+  static long remaining = 0;   // ST_GUARDED_BY; the guard below is the law)
+  StLockGuard lk(mu);
   if (armed.load(std::memory_order_relaxed) < 0) {
     const char* env = getenv("ST_FAULT_CRASH");
     if (env && *env) {
@@ -129,8 +132,10 @@ struct Ring {
   EventRec ev[kEvRingCap];
 };
 
-std::mutex g_reg_mu;         // ring registration + drain (rare paths only)
-std::vector<Ring*> g_rings;  // never freed; retired rings are re-adopted
+StMutex g_reg_mu;            // ring registration + drain (rare paths only)
+// never freed; retired rings are re-adopted (ring INTERNALS are the SPSC
+// head/tail atomics — only the list itself needs the registration mutex)
+std::vector<Ring*> g_rings ST_GUARDED_BY(g_reg_mu);
 std::atomic<int> g_enabled{[] {
   const char* e = getenv("ST_OBS");
   return (e && e[0] == '0' && !e[1]) ? 0 : 1;
@@ -161,7 +166,7 @@ inline uint64_t now_ns() {
 struct RingHolder {
   Ring* r;
   RingHolder() {
-    std::lock_guard<std::mutex> lk(g_reg_mu);
+    StLockGuard lk(g_reg_mu);
     for (Ring* cand : g_rings) {
       // acquire pairs with the dead owner's release store in ~RingHolder:
       // the adopter must observe the old thread's final head/record
@@ -270,7 +275,7 @@ extern "C" __attribute__((visibility("default"))) void st_obs_emit(
 extern "C" __attribute__((visibility("default"))) int32_t st_obs_drain(
     uint8_t* buf, int32_t cap_bytes) {
   int32_t written = 0;
-  std::lock_guard<std::mutex> lk(stobs::g_reg_mu);
+  StLockGuard lk(stobs::g_reg_mu);
   for (stobs::Ring* r : stobs::g_rings) {
     uint64_t t = r->tail.load(std::memory_order_relaxed);
     uint64_t h = r->head.load(std::memory_order_acquire);
@@ -498,13 +503,19 @@ class FrameQueue {
   // push with a stamp hook run under the queue mutex at insertion — the
   // r11 stripe-seq stamp site (a failed/timed-out push runs no hook, so
   // a stamped sequence is always eventually written).
+  // Explicit deadline loops (not wait_for-with-predicate) throughout this
+  // class: a predicate lambda reads the mu_-guarded queue state from a
+  // context the thread-safety analysis treats as lock-free.
   template <typename F>
   bool push_hook(T&& f, double timeout_sec, F&& hook) {
-    std::unique_lock<std::mutex> lk(mu_);
-    if (!not_full_.wait_for(lk, secs(timeout_sec),
-                            [&] { return closed_ || q_.size() < cap_; }))
-      return false;
-    if (closed_) return false;
+    StUniqueLock lk(mu_);
+    const auto deadline = st_cv_deadline(timeout_sec);
+    while (!closed_ && q_.size() >= cap_) {
+      if (not_full_.wait_until(lk.native(), deadline) ==
+          std::cv_status::timeout)
+        break;
+    }
+    if (closed_ || q_.size() >= cap_) return false;
     hook(f);
     q_.push_back(std::move(f));
     not_empty_.notify_one();
@@ -512,11 +523,14 @@ class FrameQueue {
   }
 
   bool pop(T* out, double timeout_sec) {
-    std::unique_lock<std::mutex> lk(mu_);
-    if (!not_empty_.wait_for(lk, secs(timeout_sec),
-                             [&] { return closed_ || !q_.empty(); }))
-      return false;
-    if (q_.empty()) return false;  // closed and drained
+    StUniqueLock lk(mu_);
+    const auto deadline = st_cv_deadline(timeout_sec);
+    while (!closed_ && q_.empty()) {
+      if (not_empty_.wait_until(lk.native(), deadline) ==
+          std::cv_status::timeout)
+        break;
+    }
+    if (q_.empty()) return false;  // timed out, or closed and drained
     *out = std::move(q_.front());
     q_.pop_front();
     not_full_.notify_one();
@@ -524,26 +538,23 @@ class FrameQueue {
   }
 
   size_t size() {
-    std::lock_guard<std::mutex> lk(mu_);
+    StLockGuard lk(mu_);
     return q_.size();
   }
 
   void close() {
-    std::lock_guard<std::mutex> lk(mu_);
+    StLockGuard lk(mu_);
     closed_ = true;
     not_empty_.notify_all();
     not_full_.notify_all();
   }
 
  private:
-  static std::chrono::duration<double> secs(double s) {
-    return std::chrono::duration<double>(s);
-  }
-  std::mutex mu_;
+  StMutex mu_;
   std::condition_variable not_empty_, not_full_;
-  std::deque<T> q_;
+  std::deque<T> q_ ST_GUARDED_BY(mu_);
   size_t cap_;
-  bool closed_ = false;
+  bool closed_ ST_GUARDED_BY(mu_) = false;
 };
 
 // Small free-list of byte buffers (capacity-preserving): the per-message
@@ -555,7 +566,7 @@ class BufPool {
 
   // a recycled buffer (capacity warm) or a fresh one; `hit` reports which
   std::vector<uint8_t> get(bool* hit) {
-    std::lock_guard<std::mutex> lk(mu_);
+    StLockGuard lk(mu_);
     if (!free_.empty()) {
       std::vector<uint8_t> b = std::move(free_.back());
       free_.pop_back();
@@ -567,14 +578,14 @@ class BufPool {
   }
 
   void put(std::vector<uint8_t>&& b) {
-    std::lock_guard<std::mutex> lk(mu_);
+    StLockGuard lk(mu_);
     if (free_.size() < keep_) free_.push_back(std::move(b));
     // else: drop — the deallocation is the bound, not a leak
   }
 
  private:
-  std::mutex mu_;
-  std::vector<std::vector<uint8_t>> free_;
+  StMutex mu_;
+  std::vector<std::vector<uint8_t>> free_ ST_GUARDED_BY(mu_);
   size_t keep_;
 };
 
@@ -593,7 +604,13 @@ struct Link {
   // because sseq tags survive); the LAST live stripe's death is the
   // link's.
   int nstripes = 1;
-  int stripe_fd[kMaxStripes] = {-1, -1, -1, -1, -1, -1, -1, -1};
+  // Atomic: the acceptor's attach_stripe (listener thread, replayed-STTS
+  // guard included) stores a stripe's fd while kill_link/kill_stripe and
+  // the sibling I/O threads read the array — a plain int here was a
+  // narrow but real data race (the fd VALUE is still stable from each
+  // reader's perspective: it is written once per attached stripe, and the
+  // idx-reuse guard rejects re-attachment).
+  std::atomic<int> stripe_fd[kMaxStripes];
   std::atomic<bool> stripe_ok[kMaxStripes] = {};
   std::atomic<int> stripe_io[kMaxStripes] = {};
   std::atomic<int> stripes_live{0};
@@ -603,14 +620,14 @@ struct Link {
   // rx reassembly (striped links only): out-of-order messages park in
   // `reorder` until `rnext` arrives; `delivering` elects one drainer; the
   // window condvar blocks readers that run too far ahead (backpressure).
-  std::mutex rmu;
+  StMutex rmu;
   std::condition_variable rcv;
-  std::map<uint64_t, std::vector<uint8_t>> reorder;
-  uint64_t rnext = 0;
-  bool delivering = false;
+  std::map<uint64_t, std::vector<uint8_t>> reorder ST_GUARDED_BY(rmu);
+  uint64_t rnext ST_GUARDED_BY(rmu) = 0;
+  bool delivering ST_GUARDED_BY(rmu) = false;
   // stripe senders share the per-link fault-plan state below; the mutex
   // is taken ONLY when the plan is enabled (chaos builds)
-  std::mutex fault_mu;
+  StMutex fault_mu;
   FrameQueue<OutMsg> sendq;
   FrameQueue<std::vector<uint8_t>> recvq;
   // r07 buffer recycling: tx buffers cycle enqueue -> socket write -> free
@@ -627,15 +644,18 @@ struct Link {
   // child's listen address for redirects.
   sockaddr_in peer_addr{};
   // fault-injection state (only touched when the node's plan is enabled;
-  // sender-loop-thread-local in practice)
-  uint64_t fault_rng = 0;
-  int64_t fault_frames = 0;  // data frames seen at this wire boundary
+  // stripe senders share it under fault_mu)
+  uint64_t fault_rng ST_GUARDED_BY(fault_mu) = 0;
+  // data frames seen at this wire boundary
+  int64_t fault_frames ST_GUARDED_BY(fault_mu) = 0;
 
   Link(size_t qdepth)
       : sendq(qdepth),
         recvq(qdepth),
         tx_pool(qdepth + 2),
-        rx_pool(qdepth + 2) {}
+        rx_pool(qdepth + 2) {
+    for (auto& f : stripe_fd) f.store(-1, std::memory_order_relaxed);
+  }
 };
 
 struct Node;
@@ -652,16 +672,17 @@ struct Node {
   std::atomic<bool> closing{false};
   std::atomic<int> active_threads{0};  // all detached; close() drains to 0
   int listen_fd = -1;
-  // Second listener bound to the rendezvous address after a master
-  // failover (rejoin_loop); -1 until then. Guarded by mu.
-  int rendezvous_listen_fd = -1;
 
-  std::mutex mu;  // guards links, child slots, next id
-  std::map<int32_t, std::shared_ptr<Link>> links;
-  std::shared_ptr<Link> child_slot[16];  // up to max_children (<=16)
-  int lrcounter = 0;
-  int32_t next_link_id = 1;
-  int32_t uplink_id = -1;
+  StMutex mu;  // guards membership: links, child slots, next id, role
+  // Second listener bound to the rendezvous address after a master
+  // failover (rejoin_loop); -1 until then.
+  int rendezvous_listen_fd ST_GUARDED_BY(mu) = -1;
+  std::map<int32_t, std::shared_ptr<Link>> links ST_GUARDED_BY(mu);
+  // up to max_children (<=16)
+  std::shared_ptr<Link> child_slot[16] ST_GUARDED_BY(mu);
+  int lrcounter ST_GUARDED_BY(mu) = 0;
+  int32_t next_link_id ST_GUARDED_BY(mu) = 1;
+  int32_t uplink_id ST_GUARDED_BY(mu) = -1;
   // r11: accepted-but-not-yet-attached stripe grants (listener 'STT4'
   // accept -> the joiner's 'STTS' stripe hellos resolve here). Guarded by
   // mu; entries expire after connect_timeout-ish and are pruned lazily.
@@ -670,11 +691,11 @@ struct Node {
     std::shared_ptr<Link> link;
     Clock::time_point deadline;
   };
-  std::vector<PendingStripe> pending_stripes;
-  uint64_t token_rng = 0;  // under mu (seeded at create)
+  std::vector<PendingStripe> pending_stripes ST_GUARDED_BY(mu);
+  uint64_t token_rng ST_GUARDED_BY(mu) = 0;  // seeded at create
 
-  std::mutex ev_mu;
-  std::deque<Event> events;
+  StMutex ev_mu;
+  std::deque<Event> events ST_GUARDED_BY(ev_mu);
   std::condition_variable ev_cv;
 
   // Data-arrival signal: bumped (and notified) whenever any link pushes a
@@ -682,14 +703,15 @@ struct Node {
   // for new input across all links instead of polling each queue — the
   // poll-interval latency floor the Python tier suffers from (50ms drain /
   // 2ms recv sleeps) has no reason to exist at this layer.
-  std::mutex data_mu;
+  StMutex data_mu;
   std::condition_variable data_cv;
-  uint64_t data_seq = 0;
+  uint64_t data_seq ST_GUARDED_BY(data_mu) = 0;
 
-  sockaddr_in rendezvous{};
-  bool is_master = false;
-  std::string last_error;
-  uint64_t jrng = 0;  // rejoin-backoff jitter stream (rejoin_loop only)
+  sockaddr_in rendezvous{};  // written once at create, before any thread
+  bool is_master ST_GUARDED_BY(mu) = false;
+  std::string last_error;  // create-time only (no thread yet)
+  uint64_t jrng = 0;  // rejoin-backoff jitter stream (rejoin_loop only;
+                      // create seeds it before the thread starts)
 
   // r07 pool observability (st_node_pool_stats): steady state must show
   // acquires growing while misses (fresh allocations) stay flat — the
@@ -698,18 +720,19 @@ struct Node {
   std::atomic<uint64_t> rx_acquires{0}, rx_pool_misses{0};
   std::atomic<uint64_t> zc_msgs{0};  // zero-copy (borrowed) sends enqueued
 
-  void notify_data() {
+  void notify_data() ST_EXCLUDES(data_mu) {
     {
-      std::lock_guard<std::mutex> lk(data_mu);
+      StLockGuard lk(data_mu);
       data_seq++;
     }
     data_cv.notify_all();
   }
 
-  void emit(int32_t kind, int32_t link_id, int32_t is_uplink) {
+  void emit(int32_t kind, int32_t link_id, int32_t is_uplink)
+      ST_EXCLUDES(ev_mu) {
     // membership events double as timeline events (codes 1..4 == kinds)
     st_obs_emit(obs_id, (uint32_t)kind, link_id, (uint64_t)is_uplink);
-    std::lock_guard<std::mutex> lk(ev_mu);
+    StLockGuard lk(ev_mu);
     events.push_back({kind, link_id, is_uplink});
     ev_cv.notify_all();
   }
@@ -842,7 +865,7 @@ std::shared_ptr<Link> make_link(Node* node, int fd, int32_t is_uplink,
   if (nstripes < 1) nstripes = 1;
   if (nstripes > kMaxStripes) nstripes = kMaxStripes;
   {
-    std::lock_guard<std::mutex> lk(node->mu);
+    StLockGuard lk(node->mu);
     link->id = node->next_link_id++;
     link->fd = fd;
     link->nstripes = nstripes;
@@ -867,12 +890,12 @@ void kill_link(Node* node, std::shared_ptr<Link> link) {
   link->sendq.close();
   link->recvq.close();
   {
-    std::lock_guard<std::mutex> lk(link->rmu);
+    StLockGuard lk(link->rmu);
   }
   link->rcv.notify_all();  // unblock window-waiting stripe readers
   bool was_uplink = false;
   {
-    std::lock_guard<std::mutex> lk(node->mu);
+    StLockGuard lk(node->mu);
     for (int i = 0; i < node->cfg.max_children; i++)
       if (node->child_slot[i] == link) node->child_slot[i] = nullptr;
     if (node->uplink_id == link->id) {
@@ -985,7 +1008,7 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
                       (kind0 == 0 || kind0 == 7 || kind0 == 11));
       if (is_data && (fp.only_link <= 0 || link->id == fp.only_link) &&
           (fp.only_stripe < 0 || sidx == fp.only_stripe)) {
-        std::unique_lock<std::mutex> flk(link->fault_mu);
+        StUniqueLock flk(link->fault_mu);
         if (!link->fault_rng)
           link->fault_rng =
               (fp.seed + 1) * 0x9e3779b97f4a7c15ull + (uint64_t)link->id;
@@ -1203,14 +1226,14 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
 // (queue closed under us).
 bool deliver_striped(Node* node, const std::shared_ptr<Link>& link,
                      uint64_t sseq, std::vector<uint8_t>&& frame) {
-  std::unique_lock<std::mutex> lk(link->rmu);
+  StUniqueLock lk(link->rmu);
   // window backpressure: a stripe that runs too far ahead of the in-order
   // point blocks here (bounding reassembly memory) until delivery
   // advances — or its own liveness timeout kills it if rnext's stripe is
   // truly dead
   while (link->alive && !node->closing &&
          sseq > link->rnext + kReorderWindow) {
-    link->rcv.wait_for(lk, std::chrono::milliseconds(100));
+    link->rcv.wait_until(lk.native(), st_cv_deadline(0.1));
   }
   if (!link->alive || node->closing) return false;
   if (sseq < link->rnext || link->reorder.count(sseq)) {
@@ -1354,7 +1377,7 @@ void listener_loop(Node* node, int listen_fd) {
         int idx = rest[8];
         std::shared_ptr<Link> sl;
         {
-          std::lock_guard<std::mutex> lk(node->mu);
+          StLockGuard lk(node->mu);
           auto now = Clock::now();
           auto& ps = node->pending_stripes;
           for (size_t i = 0; i < ps.size();) {
@@ -1408,7 +1431,7 @@ void listener_loop(Node* node, int listen_fd) {
     int slot = -1;
     std::shared_ptr<Link> redirect_to;
     {
-      std::lock_guard<std::mutex> lk(node->mu);
+      StLockGuard lk(node->mu);
       for (int i = 0; i < node->cfg.max_children; i++) {
         if (!node->child_slot[i]) {
           slot = i;
@@ -1432,7 +1455,7 @@ void listener_loop(Node* node, int listen_fd) {
         // granted-1 extra sockets that attach via the STTS hello above
         uint64_t token;
         {
-          std::lock_guard<std::mutex> lk(node->mu);
+          StLockGuard lk(node->mu);
           node->token_rng ^= (uint64_t)fd * 0x9e3779b97f4a7c15ull;
           frand64(&node->token_rng);
           token = node->token_rng;
@@ -1446,7 +1469,7 @@ void listener_loop(Node* node, int listen_fd) {
           continue;
         }
         auto link = make_link(node, fd, /*is_uplink=*/0, &peer, want_stripes);
-        std::lock_guard<std::mutex> lk(node->mu);
+        StLockGuard lk(node->mu);
         node->child_slot[slot] = link;
         if (want_stripes > 1)
           node->pending_stripes.push_back(
@@ -1460,7 +1483,7 @@ void listener_loop(Node* node, int listen_fd) {
           continue;
         }
         auto link = make_link(node, fd, /*is_uplink=*/0, &peer);
-        std::lock_guard<std::mutex> lk(node->mu);
+        StLockGuard lk(node->mu);
         node->child_slot[slot] = link;
       }
     } else if (redirect_to) {
@@ -1617,13 +1640,13 @@ void rejoin_loop(Node* node) {
   int failed_cycles = 0;
   while (!node->closing) {
     {
-      std::unique_lock<std::mutex> lk(node->ev_mu);
-      node->ev_cv.wait_for(lk, std::chrono::milliseconds(200));
+      StUniqueLock lk(node->ev_mu);
+      node->ev_cv.wait_until(lk.native(), st_cv_deadline(0.2));
     }
     if (node->closing) break;
     bool need;
     {
-      std::lock_guard<std::mutex> lk(node->mu);
+      StLockGuard lk(node->mu);
       need = !node->is_master && node->uplink_id < 0;
     }
     if (!need) {
@@ -1671,7 +1694,7 @@ void rejoin_loop(Node* node) {
         // never leak past shutdown.
         bool published = false;
         {
-          std::lock_guard<std::mutex> lk(node->mu);
+          StLockGuard lk(node->mu);
           if (!node->closing) {
             node->is_master = true;
             node->rendezvous_listen_fd = lfd;
@@ -1770,7 +1793,12 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
   cfg.fault = parse_fault_plan();  // env hook table, per-node at create
   node->jrng = (uint64_t)::getpid() * 0x9e3779b97f4a7c15ull +
                (uint64_t)Clock::now().time_since_epoch().count();
-  node->token_rng = node->jrng ^ 0xA5A5A5A5DEADBEEFull;
+  {
+    // no thread exists yet; the lock is for the analysis' benefit (and
+    // costs one uncontended acquisition at create)
+    StLockGuard lk(node->mu);
+    node->token_rng = node->jrng ^ 0xA5A5A5A5DEADBEEFull;
+  }
 
   hostent* server = gethostbyname(host);
   if (!server) {
@@ -1852,7 +1880,10 @@ void* st_node_create(const char* host, int port, const StConfigC* cfg_c,
     delete node;
     return nullptr;
   }
-  node->is_master = became_master;
+  {
+    StLockGuard lk(node->mu);  // pre-thread, for the analysis (see above)
+    node->is_master = became_master;
+  }
   node->listen_fd = listen_fd;
 
   node->active_threads += 2;
@@ -1897,7 +1928,7 @@ int32_t st_node_send(void* h, int32_t link_id, const uint8_t* data,
     return -1;
   std::shared_ptr<Link> link;
   {
-    std::lock_guard<std::mutex> lk(node->mu);
+    StLockGuard lk(node->mu);
     auto it = node->links.find(link_id);
     if (it == node->links.end()) return -1;
     link = it->second;
@@ -1937,7 +1968,7 @@ int32_t st_node_send_zc(void* h, int32_t link_id, const uint8_t* data,
   if (node->cfg.wire_compat) return -1;  // compat framing has no zc path
   std::shared_ptr<Link> link;
   {
-    std::lock_guard<std::mutex> lk(node->mu);
+    StLockGuard lk(node->mu);
     auto it = node->links.find(link_id);
     if (it == node->links.end()) return -1;
     link = it->second;
@@ -1968,7 +1999,7 @@ int32_t st_node_recv(void* h, int32_t link_id, uint8_t* buf, int32_t cap,
   auto* node = (Node*)h;
   std::shared_ptr<Link> link;
   {
-    std::lock_guard<std::mutex> lk(node->mu);
+    StLockGuard lk(node->mu);
     auto it = node->links.find(link_id);
     if (it == node->links.end()) return -1;
     link = it->second;
@@ -2011,7 +2042,7 @@ int32_t st_node_stripe_stats(void* h, int32_t link_id, uint64_t* out4) {
   if (!node) return -1;
   std::shared_ptr<Link> link;
   {
-    std::lock_guard<std::mutex> lk(node->mu);
+    StLockGuard lk(node->mu);
     auto it = node->links.find(link_id);
     if (it == node->links.end()) return -1;
     link = it->second;
@@ -2028,9 +2059,9 @@ int32_t st_node_stripe_stats(void* h, int32_t link_id, uint64_t* out4) {
 int32_t st_node_poll_events(void* h, StEventC* out, int32_t cap,
                             double timeout_sec) {
   auto* node = (Node*)h;
-  std::unique_lock<std::mutex> lk(node->ev_mu);
+  StUniqueLock lk(node->ev_mu);
   if (node->events.empty() && timeout_sec > 0) {
-    node->ev_cv.wait_for(lk, std::chrono::duration<double>(timeout_sec));
+    node->ev_cv.wait_until(lk.native(), st_cv_deadline(timeout_sec));
   }
   int32_t n = 0;
   while (n < cap && !node->events.empty()) {
@@ -2046,7 +2077,7 @@ int32_t st_node_poll_events(void* h, StEventC* out, int32_t cap,
 
 int32_t st_node_links(void* h, int32_t* out, int32_t cap) {
   auto* node = (Node*)h;
-  std::lock_guard<std::mutex> lk(node->mu);
+  StLockGuard lk(node->mu);
   int32_t n = 0;
   for (auto& kv : node->links) {
     if (n >= cap) break;
@@ -2057,7 +2088,7 @@ int32_t st_node_links(void* h, int32_t* out, int32_t cap) {
 
 int32_t st_node_uplink(void* h) {
   auto* node = (Node*)h;
-  std::lock_guard<std::mutex> lk(node->mu);
+  StLockGuard lk(node->mu);
   return node->uplink_id;
 }
 
@@ -2065,7 +2096,7 @@ int32_t st_node_stats(void* h, int32_t link_id, StStatsC* out) {
   auto* node = (Node*)h;
   std::shared_ptr<Link> link;
   {
-    std::lock_guard<std::mutex> lk(node->mu);
+    StLockGuard lk(node->mu);
     auto it = node->links.find(link_id);
     if (it == node->links.end()) return -1;
     link = it->second;
@@ -2084,7 +2115,7 @@ int32_t st_node_stats(void* h, int32_t link_id, StStatsC* out) {
 // blocking multi-link consumption without per-queue polling.
 uint64_t st_node_data_seq(void* h) {
   auto* node = (Node*)h;
-  std::lock_guard<std::mutex> lk(node->data_mu);
+  StLockGuard lk(node->data_mu);
   return node->data_seq;
 }
 
@@ -2094,10 +2125,16 @@ uint64_t st_node_data_seq(void* h) {
 // wakeup.
 uint64_t st_node_wait_data(void* h, uint64_t last_seq, double timeout_sec) {
   auto* node = (Node*)h;
-  std::unique_lock<std::mutex> lk(node->data_mu);
-  if (node->data_seq <= last_seq && timeout_sec > 0) {
-    node->data_cv.wait_for(lk, std::chrono::duration<double>(timeout_sec),
-                           [&] { return node->data_seq > last_seq; });
+  StUniqueLock lk(node->data_mu);
+  if (timeout_sec > 0) {
+    // explicit deadline loop (not wait_for-with-predicate): the predicate
+    // lambda would read the guarded data_seq from a context the
+    // thread-safety analysis treats as lock-free
+    const auto deadline = st_cv_deadline(timeout_sec);
+    while (node->data_seq <= last_seq &&
+           node->data_cv.wait_until(lk.native(), deadline) !=
+               std::cv_status::timeout) {
+    }
   }
   return node->data_seq;
 }
@@ -2107,7 +2144,7 @@ int32_t st_node_drop_link(void* h, int32_t link_id) {
   auto* node = (Node*)h;
   std::shared_ptr<Link> link;
   {
-    std::lock_guard<std::mutex> lk(node->mu);
+    StLockGuard lk(node->mu);
     auto it = node->links.find(link_id);
     if (it == node->links.end()) return -1;
     link = it->second;
@@ -2123,7 +2160,7 @@ void st_node_close(void* h) {
   ::close(node->listen_fd);
   int rv_fd;
   {
-    std::lock_guard<std::mutex> lk(node->mu);
+    StLockGuard lk(node->mu);
     rv_fd = node->rendezvous_listen_fd;
   }
   if (rv_fd >= 0) {
@@ -2132,7 +2169,7 @@ void st_node_close(void* h) {
   }
   std::vector<std::shared_ptr<Link>> links;
   {
-    std::lock_guard<std::mutex> lk(node->mu);
+    StLockGuard lk(node->mu);
     for (auto& kv : node->links) links.push_back(kv.second);
   }
   for (auto& l : links) kill_link(node, l);

@@ -33,7 +33,6 @@ from __future__ import annotations
 import logging
 import threading
 import time
-import warnings
 from collections import deque
 from typing import Any, Optional
 
@@ -75,13 +74,6 @@ SEND_WINDOW = 32
 #: a short prefix repairs it without re-shipping the whole window's bytes
 #: every round. Ditto in the native engine.
 RETX_PREFIX = 4
-
-#: Once-per-process latch for the legacy ``peer.metrics()`` deprecation
-#: warning (r09 satellite: the r08 alias keys were kept "for one release";
-#: this release says so out loud). Races on the flag are benign — worst
-#: case the warning fires twice.
-_legacy_metrics_warned = False
-
 
 def _python_tier_auto_burst(spec) -> int:
     """Auto burst for the PYTHON fallback tier: each burst frame is a full
@@ -1449,13 +1441,62 @@ class SharedTensorPeer:
     def ready(self) -> bool:
         return self._ready.is_set()
 
+    def _delivery_counts(self) -> tuple[int, int, int, int, int]:
+        """(frames_out, frames_in, updates, msgs_out, msgs_in) — ONE
+        engine-counter snapshot when native (separate reads would mix
+        instants and could show e.g. msgs_in > frames_in mid-run)."""
+        if self._engine is not None:
+            c = self._engine._counters()
+            return int(c[0]), int(c[1]), int(c[2]), int(c[3]), int(c[4])
+        fo, fi = self.st.frames_out, self.st.frames_in
+        up = self.st.updates
+        if self.config.transport.wire_compat:
+            # no ACK ledger in the reference protocol: one frame == one
+            # message (metrics() taxonomy)
+            return fo, fi, up, fo, fi
+        with self._ack_mu:
+            mo = sum(self._acked.values()) + sum(
+                len(v) for v in self._unacked.values()
+            )
+            mi = sum(self._rx_count.values())
+        return fo, fi, up, mo, mi
+
     def _obs_collect(self) -> dict:
         """Registry collector: the canonical-schema view of everything this
         peer can report that is not a live histogram — sampled once per
         snapshot/scrape (obs/schema.py is the name authority)."""
         import math
 
-        out = _schema.canonicalize(self.metrics(_warn=False))
+        out: dict = {}
+        fo, fi, up, mo, mi = self._delivery_counts()
+        out["st_frames_out_total"] = fo
+        out["st_frames_in_total"] = fi
+        out["st_updates_total"] = up
+        out["st_msgs_out_total"] = mo
+        out["st_msgs_in_total"] = mi
+        out["st_inflight_msgs"] = self.st.inflight_total()
+        # r07 buffer-pool planes — the zero-per-message-allocation
+        # assertion: in steady state the acquire counters grow while the
+        # alloc/miss counters stay flat (every buffer is a reuse).
+        # st_tx_slot_* is the frame-slot ring (engine tx ring, or
+        # wire.FramePool on the Python tier); st_transport_* is the C
+        # transport's per-link tx/rx recycling.
+        if self._engine is not None:
+            p = self._engine.pool_stats()
+            out["st_tx_slot_acquires_total"] = p["tx_slot_acquires"]
+            out["st_tx_slot_alloc_events_total"] = p["tx_slot_alloc_events"]
+            out["st_tx_slots_allocated"] = p["tx_slots_allocated"]
+        elif self._tx_pool is not None:
+            p = self._tx_pool.stats()
+            out["st_tx_slot_acquires_total"] = p["tx_slot_acquires"]
+            out["st_tx_slot_alloc_events_total"] = p["tx_slot_alloc_events"]
+            out["st_tx_slots_allocated"] = p["tx_slots_free"]
+        tp = self.node.pool_stats()
+        out["st_transport_tx_acquires_total"] = tp["tx_acquires"]
+        out["st_transport_tx_misses_total"] = tp["tx_misses"]
+        out["st_transport_rx_acquires_total"] = tp["rx_acquires"]
+        out["st_transport_rx_misses_total"] = tp["rx_misses"]
+        out["st_transport_zc_msgs_total"] = tp["zc_msgs"]
         # r10 writer-side serving gauges/counters. The python-tier counts
         # are authoritative only on the python tier (the engine's C sender
         # owns them otherwise and obs_stats() below overrides).
@@ -1519,6 +1560,21 @@ class SharedTensorPeer:
         for link in self.node.links:
             s = self.node.stats(link)
             if s is not None:
+                out[_schema.link_key("st_link_bytes_out_total", link)] = (
+                    s.bytes_out
+                )
+                out[_schema.link_key("st_link_bytes_in_total", link)] = (
+                    s.bytes_in
+                )
+                out[_schema.link_key("st_link_wire_msgs_out_total", link)] = (
+                    s.frames_out
+                )
+                out[_schema.link_key("st_link_wire_msgs_in_total", link)] = (
+                    s.frames_in
+                )
+                out[_schema.link_key("st_link_residual_rms", link)] = (
+                    self.st.residual_rms(link)
+                )
                 out[_schema.link_key("st_link_send_queue", link)] = s.send_queue
                 out[_schema.link_key("st_link_recv_queue", link)] = s.recv_queue
             # r11 stripe telemetry (per logical link): negotiated and
@@ -1544,124 +1600,64 @@ class SharedTensorPeer:
         return out
 
     def metrics(
-        self, canonical: bool = False, cluster: bool = False,
-        _warn: bool = True,
+        self, canonical: bool = True, cluster: bool = False
     ) -> dict:
         """Observability the reference entirely lacks (SURVEY.md §5.5).
 
-        ``canonical=True`` returns the r08 flat canonical-schema view
-        (obs/schema.py): every key below plus the engine delivery
-        aggregates and queue-depth gauges, under ``st_*`` names.
+        Returns the flat canonical-schema view (obs/schema.py is the name
+        authority): delivery counters, buffer-pool planes, per-link
+        gauges, engine aggregates — all under ``st_*`` names.
         ``cluster=True`` (r09) returns the merged WHOLE-TREE digest from
         this node's vantage — own registry + every subtree digest
-        (obs/aggregate.py); at the root that is the cluster. The legacy
-        nested shape below was kept "for one release" in r08 and now
-        emits a DeprecationWarning once per process — move to
-        ``canonical=True`` (byte-equal values under the documented alias
-        mapping, schema.DEPRECATED_ALIASES).
+        (obs/aggregate.py); at the root that is the cluster.
+
+        The r08 legacy NESTED shape (``frames_out`` / ``delivery.*`` /
+        ``links[i].*`` keys) was kept "for one release" as a deprecated
+        alias view and is REMOVED as of r13 — ``canonical=False`` raises,
+        and tools/lint_metrics.py forbids the alias keys from returning.
+        The canonical twins carry byte-equal values: the removal renamed
+        keys, never accounting.
 
         Counter taxonomy (ONE definition per number, reconcilable across
         layers — round-3 verdict Weak #6):
 
-        - ``frames_out`` / ``frames_in`` — CODEC frames: non-idle quantized
-          frames handed toward the wire / applied from it. A burst message
-          carries many; idle (all-zero-scale) frames count nowhere.
-          Invariant: a quiesced single-writer pair has
-          ``sender.frames_out == receiver.frames_in``.
-        - ``delivery.msgs_out`` / ``delivery.msgs_in`` — wire DATA/BURST
+        - ``st_frames_out_total`` / ``st_frames_in_total`` — CODEC frames:
+          non-idle quantized frames handed toward the wire / applied from
+          it. A burst message carries many; idle (all-zero-scale) frames
+          count nowhere. Invariant: a quiesced single-writer pair has
+          ``sender frames_out == receiver frames_in``.
+        - ``st_msgs_out_total`` / ``st_msgs_in_total`` — wire DATA/BURST
           messages sent / received (what the ACK ledger tracks; an
           undecodable data message still counts on the receive side).
-        - ``delivery.inflight_msgs`` — sent-but-unacked messages; 0 after a
-          successful :meth:`drain`. Acked messages =
-          ``msgs_out - inflight_msgs``. Wire-compat exception: the
-          reference protocol has no ACK (delivery degrades to
-          ack-on-enqueue), so there one frame == one message —
-          ``msgs_* == frames_*`` and ``inflight_msgs`` is always 0.
-        - ``links[i].wire_msgs_out/in`` — transport-level messages on the
-          socket: data AND control (ACK/SYNC/CHUNK/...), excluding
-          keepalives; ``>= `` the data-message counts above by exactly the
-          control traffic. ``bytes_*`` include framing and keepalives.
-          Wire-compat caveat: a compat keepalive IS a real zero-scale frame
-          on the wire, indistinguishable at the transport layer — so the
+        - ``st_inflight_msgs`` — sent-but-unacked messages; 0 after a
+          successful :meth:`drain`. Acked messages = msgs_out - inflight.
+          Wire-compat exception: the reference protocol has no ACK
+          (delivery degrades to ack-on-enqueue), so there one frame == one
+          message — msgs == frames and inflight is always 0.
+        - ``st_link_wire_msgs_out_total{link=}`` / ``..in..`` —
+          transport-level messages on the socket: data AND control
+          (ACK/SYNC/CHUNK/...), excluding keepalives; >= the data-message
+          counts above by exactly the control traffic.
+          ``st_link_bytes_*`` include framing and keepalives. Wire-compat
+          caveat: a compat keepalive IS a real zero-scale frame on the
+          wire, indistinguishable at the transport layer — so the
           RECEIVE-side wire count includes idle-period keepalives there
           (the send side still excludes them).
         """
         if cluster:
             return self.cluster_metrics()
-        if canonical:
-            # the registry snapshot merges the collector (this peer's
-            # sampled counters) with the LIVE instruments (histograms,
-            # python-tier delivery counters); with obs disabled the
-            # collector view alone still serves the schema
-            if self._obs is not None:
-                return self._obs.registry.snapshot()
-            return self._obs_collect()
-        if _warn:
-            global _legacy_metrics_warned
-            if not _legacy_metrics_warned:
-                _legacy_metrics_warned = True
-                warnings.warn(
-                    "the nested peer.metrics() shape is a deprecated alias "
-                    "surface (r08); use metrics(canonical=True) — values "
-                    "are byte-equal under schema.DEPRECATED_ALIASES",
-                    DeprecationWarning,
-                    stacklevel=2,
-                )
-        if self._engine is not None:
-            # ONE snapshot for every engine counter: separate reads would
-            # mix instants and could show e.g. msgs_in > frames_in mid-run
-            c = self._engine._counters()
-            frames_out, frames_in, updates = int(c[0]), int(c[1]), int(c[2])
-            msgs_out, msgs_in = int(c[3]), int(c[4])
-        elif self.config.transport.wire_compat:
-            # no ACK ledger in the reference protocol: one frame == one
-            # message (see taxonomy above)
-            frames_out, frames_in = self.st.frames_out, self.st.frames_in
-            updates = self.st.updates
-            msgs_out, msgs_in = frames_out, frames_in
-        else:
-            frames_out, frames_in = self.st.frames_out, self.st.frames_in
-            updates = self.st.updates
-            with self._ack_mu:
-                msgs_out = sum(self._acked.values()) + sum(
-                    len(v) for v in self._unacked.values()
-                )
-                msgs_in = sum(self._rx_count.values())
-        # r07 buffer-pool stats — the zero-per-message-allocation assertion:
-        # in steady state the acquire counters grow while the alloc/miss
-        # counters stay flat (every buffer is a reuse). "tx_slot_*" is the
-        # frame-slot ring (engine tx ring, or wire.FramePool on the Python
-        # tier); "transport" is the C transport's per-link tx/rx recycling.
-        if self._engine is not None:
-            pool = self._engine.pool_stats()
-        elif self._tx_pool is not None:
-            pool = self._tx_pool.stats()
-        else:
-            pool = {}
-        pool["transport"] = self.node.pool_stats()
-        out = {
-            "frames_out": frames_out,
-            "frames_in": frames_in,
-            "updates": updates,
-            "delivery": {
-                "msgs_out": msgs_out,
-                "msgs_in": msgs_in,
-                "inflight_msgs": self.st.inflight_total(),
-            },
-            "pool": pool,
-            "links": {},
-        }
-        for link in self.node.links:
-            s = self.node.stats(link)
-            if s is not None:
-                out["links"][link] = {
-                    "bytes_out": s.bytes_out,
-                    "bytes_in": s.bytes_in,
-                    "wire_msgs_out": s.frames_out,
-                    "wire_msgs_in": s.frames_in,
-                    "residual_rms": self.st.residual_rms(link),
-                }
-        return out
+        if not canonical:
+            raise ValueError(
+                "the legacy nested peer.metrics() shape was removed (r13);"
+                " consume the canonical st_* schema (obs/schema.py)"
+            )
+        # the registry snapshot merges the collector (this peer's sampled
+        # counters) with the LIVE instruments (histograms, python-tier
+        # delivery counters); with obs disabled the collector view alone
+        # still serves the schema
+        if self._obs is not None:
+            return self._obs.registry.snapshot()
+        return self._obs_collect()
 
     def __enter__(self):
         return self

@@ -6,9 +6,11 @@ ABI, ``frames_out`` again (but meaning wire MESSAGES) in the transport's
 ``LinkStats`` — and the r07 pool stats added two more ad-hoc dicts. This
 module is the single source of truth: every telemetry surface (registry
 snapshots, the Prometheus exposition, the flight recorder's postmortem
-header) speaks these names; the legacy keys survive one release as
-documented aliases (:data:`DEPRECATED_ALIASES`, consumed by
-``peer.metrics()``'s default legacy shape).
+header) speaks these names. The r08 legacy nested ``peer.metrics()``
+aliases were carried "for one release", overstayed to r12, and are REMOVED
+as of r13 — ``peer.metrics()`` serves only this schema, and
+tools/lint_metrics.py fails the suite if a non-schema metric name (or a
+legacy alias key) reappears anywhere in the package.
 
 Naming rules (Prometheus conventions):
 
@@ -140,60 +142,6 @@ PROCESS_GLOBAL = frozenset(
     }
 )
 
-#: Legacy ``peer.metrics()`` key -> canonical name, kept ONE release as
-#: deprecated aliases. Paths are dotted into the legacy nested dict;
-#: ``links.*`` paths map per-link with the link id as the {link=} label.
-DEPRECATED_ALIASES: dict[str, str] = {
-    "frames_out": "st_frames_out_total",
-    "frames_in": "st_frames_in_total",
-    "updates": "st_updates_total",
-    "delivery.msgs_out": "st_msgs_out_total",
-    "delivery.msgs_in": "st_msgs_in_total",
-    "delivery.inflight_msgs": "st_inflight_msgs",
-    "pool.tx_slot_acquires": "st_tx_slot_acquires_total",
-    "pool.tx_slot_alloc_events": "st_tx_slot_alloc_events_total",
-    "pool.tx_slots_allocated": "st_tx_slots_allocated",
-    "pool.tx_slots_free": "st_tx_slots_allocated",
-    "pool.transport.tx_acquires": "st_transport_tx_acquires_total",
-    "pool.transport.tx_misses": "st_transport_tx_misses_total",
-    "pool.transport.rx_acquires": "st_transport_rx_acquires_total",
-    "pool.transport.rx_misses": "st_transport_rx_misses_total",
-    "pool.transport.zc_msgs": "st_transport_zc_msgs_total",
-    "links.*.bytes_out": "st_link_bytes_out_total",
-    "links.*.bytes_in": "st_link_bytes_in_total",
-    "links.*.wire_msgs_out": "st_link_wire_msgs_out_total",
-    "links.*.wire_msgs_in": "st_link_wire_msgs_in_total",
-    "links.*.residual_rms": "st_link_residual_rms",
-}
-
-
 def link_key(name: str, link: int) -> str:
     """Canonical per-link series key: ``st_link_..._total{link="3"}``."""
     return f'{name}{{link="{int(link)}"}}'
-
-
-def canonicalize(legacy: dict) -> dict:
-    """Flatten a legacy ``peer.metrics()`` dict into canonical keys. Every
-    numeric leaf of the legacy shape is covered (tests assert this), so the
-    canonical view loses nothing the old one had."""
-    out: dict = {}
-
-    def walk(prefix: str, node) -> None:
-        for k, v in node.items():
-            path = f"{prefix}{k}" if not prefix else f"{prefix}.{k}"
-            if isinstance(v, dict):
-                walk(path, v)
-            elif path in DEPRECATED_ALIASES:
-                out[DEPRECATED_ALIASES[path]] = v
-            # unknown leaves fall through silently only if numeric-less;
-            # tests enforce schema coverage of the real metrics() shape
-
-    links = legacy.get("links", {})
-    top = {k: v for k, v in legacy.items() if k != "links"}
-    walk("", top)
-    for link, stats in links.items():
-        for k, v in stats.items():
-            alias = DEPRECATED_ALIASES.get(f"links.*.{k}")
-            if alias is not None:
-                out[link_key(alias, link)] = v
-    return out

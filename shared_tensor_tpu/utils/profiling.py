@@ -7,9 +7,10 @@ Three small tools:
   TensorBoard-loadable device trace of whatever ran inside (the fused sync
   step, the codec kernels, a training loop).
 - :class:`RateMeter`: turns the framework's monotonically-increasing
-  counters (SharedTensor.frames_in/out, peer.metrics()["links"][..]["bytes_*"])
-  into rates over a sliding window — frames/s, wire B/s, equivalent
-  fp32-delta B/s, the §6 quantities.
+  counters (SharedTensor.frames_in/out, the canonical
+  ``st_link_bytes_*_total{link=}`` series from ``peer.metrics()``) into
+  rates over a sliding window — frames/s, wire B/s, equivalent fp32-delta
+  B/s, the §6 quantities.
 - :func:`effective_bits`: measured bits/element/frame from a residual-RMS
   trajectory — the matched-approximation-error yardstick (BASELINE.md's
   convergence table; 1.0 for the reference on homogeneous data).

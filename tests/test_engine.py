@@ -284,13 +284,16 @@ def test_counter_taxonomy_reconciles_across_layers():
     time.sleep(0.5)  # b's final ACK/apply settles
     ma, mb = a.metrics(), b.metrics()
     # codec frames: all dispatched frames were applied at the receiver
-    assert ma["frames_out"] == mb["frames_in"], (ma, mb)
+    assert ma["st_frames_out_total"] == mb["st_frames_in_total"], (ma, mb)
     # data messages: everything sent was delivered and acknowledged
-    assert ma["delivery"]["inflight_msgs"] == 0
-    assert ma["delivery"]["msgs_out"] == mb["delivery"]["msgs_in"], (ma, mb)
+    assert ma["st_inflight_msgs"] == 0
+    assert ma["st_msgs_out_total"] == mb["st_msgs_in_total"], (ma, mb)
     # transport wire messages include control traffic on top of data
-    wire_out = sum(l["wire_msgs_out"] for l in ma["links"].values())
-    assert wire_out >= ma["delivery"]["msgs_out"]
+    wire_out = sum(
+        v for k, v in ma.items()
+        if k.startswith("st_link_wire_msgs_out_total{")
+    )
+    assert wire_out >= ma["st_msgs_out_total"]
     # corruption-zeroed (all-zero-scale) frames count NOWHERE: a sender
     # never emits one (idle suppression), so counting it at the receiver
     # would present reconciliation drift exactly while an operator debugs

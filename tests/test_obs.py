@@ -135,43 +135,40 @@ def test_registry_jsonl_sink(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def _count_numeric_leaves(d) -> int:
-    n = 0
-    for v in d.values():
-        if isinstance(v, dict):
-            n += _count_numeric_leaves(v)
-        else:
-            n += 1
-    return n
-
-
 def test_schema_covers_real_metrics_shape():
-    """Every numeric leaf of the REAL legacy peer.metrics() shape must map
-    to a canonical name (satellite: one documented schema; legacy keys are
-    deprecated aliases, not a parallel namespace)."""
+    """Every key the REAL peer.metrics() serves must be documented in the
+    schema (satellite: one documented namespace — there is no legacy
+    alias surface left to hide a stray name in)."""
     port = _free_port()
     seed = jnp.zeros((4096,), jnp.float32)
     m = create_or_fetch("127.0.0.1", port, seed, _cfg())
     c = create_or_fetch("127.0.0.1", port, seed, _cfg())
     try:
         m.add(jnp.ones((4096,), jnp.float32))
-        _wait(lambda: c.metrics()["frames_in"] > 0, msg="frames to flow")
-        legacy = m.metrics()
-        canon = schema.canonicalize(legacy)
-        assert _count_numeric_leaves(legacy) == len(canon), (
-            "canonicalize dropped a legacy leaf", legacy, canon)
-        # every canonical key is in the documented schema (per-link keys
-        # strip their {link=} label first)
-        for k in canon:
+        _wait(
+            lambda: c.metrics()["st_frames_in_total"] > 0,
+            msg="frames to flow",
+        )
+        full = m.metrics()
+        assert full, "metrics() produced nothing"
+        # every key is in the documented schema (per-link keys strip
+        # their {link=} label first)
+        for k in full:
             base = k.split("{", 1)[0]
             assert base in schema.SCHEMA, f"{k} not documented in SCHEMA"
-        # the canonical view is what metrics(canonical=True) serves, plus
-        # engine aggregates and queue gauges
-        full = m.metrics(canonical=True)
-        assert set(canon) <= set(full)
-        assert "st_retransmit_msgs_total" in full
-        assert "st_ack_rtt_seconds_count" in full
-        assert full["st_frames_out_total"] == legacy["frames_out"]
+        # the delivery taxonomy plus the engine aggregates and per-link
+        # wire gauges all ride the one surface
+        for must in (
+            "st_frames_out_total",
+            "st_msgs_out_total",
+            "st_inflight_msgs",
+            "st_tx_slot_acquires_total",
+            "st_transport_tx_acquires_total",
+            "st_retransmit_msgs_total",
+            "st_ack_rtt_seconds_count",
+        ):
+            assert must in full, f"metrics() lost {must}"
+        assert any(k.startswith("st_link_wire_msgs_out_total{") for k in full)
         # the registry's Prometheus rendering includes collector metrics
         if m._obs is not None:
             text = m._obs.registry.prometheus_text()
@@ -181,10 +178,12 @@ def test_schema_covers_real_metrics_shape():
         c.close()
 
 
-def test_schema_alias_table_is_consistent():
-    for legacy, canon in schema.DEPRECATED_ALIASES.items():
-        base = canon.split("{", 1)[0]
-        assert base in schema.SCHEMA, (legacy, canon)
+def test_schema_link_key_and_legacy_surface_removed():
+    """r13 satellite: the r08 nested alias surface is GONE — the schema
+    module no longer carries an alias table, and asking metrics() for the
+    legacy shape raises instead of silently serving stale names."""
+    assert not hasattr(schema, "DEPRECATED_ALIASES")
+    assert not hasattr(schema, "canonicalize")
     assert schema.link_key("st_link_send_queue", 3) == 'st_link_send_queue{link="3"}'
 
 
@@ -233,44 +232,24 @@ def test_schema_lint_every_emitted_st_name_is_documented():
         assert must in emitted, f"scan missed {must}"
 
 
-def test_legacy_metrics_alias_deprecation_and_byte_equality():
-    """r09 satellite: the r08 legacy ``peer.metrics()`` alias keys now emit
-    a DeprecationWarning once per process, and every alias value is
-    byte-equal to its canonical twin (the aliases are a VIEW, never a
-    parallel accounting)."""
-    import warnings
-
-    from shared_tensor_tpu.comm import peer as peer_mod
-
+def test_legacy_metrics_shape_removed():
+    """r13 satellite: the r08 nested alias shape was kept "for one
+    release" and overstayed three — it is now REMOVED, loudly. The
+    default call serves the canonical schema; explicitly asking for the
+    legacy shape raises with a pointer to the schema, and the canonical/
+    cluster surfaces behave identically to before."""
     port = _free_port()
     m = create_or_fetch("127.0.0.1", port, jnp.zeros((256,), jnp.float32), _cfg())
     try:
         m.add(jnp.ones((256,), jnp.float32))
-        peer_mod._legacy_metrics_warned = False
-        with warnings.catch_warnings(record=True) as caught:
-            warnings.simplefilter("always")
-            legacy = m.metrics()
-            again = m.metrics()
-        deps = [w for w in caught if issubclass(w.category, DeprecationWarning)]
-        assert len(deps) == 1, "once per process, not per call"
-        assert "canonical=True" in str(deps[0].message)
-        del again
-        # a linkless quiesced master: the legacy and canonical surfaces
-        # sample identical state — alias values must be EXACTLY equal
-        canon = m.metrics(canonical=True)
-        flat = schema.canonicalize(legacy)
-        assert flat, "canonicalize produced nothing"
-        for key, val in flat.items():
-            assert canon[key] == val, (key, canon[key], val)
-        # canonical/cluster paths never warn
-        peer_mod._legacy_metrics_warned = False
-        with warnings.catch_warnings(record=True) as caught:
-            warnings.simplefilter("always")
-            m.metrics(canonical=True)
-            m.metrics(cluster=True)
-        assert not [
-            w for w in caught if issubclass(w.category, DeprecationWarning)
-        ]
+        full = m.metrics()
+        assert full == m.metrics(canonical=True)
+        assert "st_frames_out_total" in full
+        assert "frames_out" not in full  # the alias keys are truly gone
+        assert "delivery" not in full
+        with pytest.raises(ValueError, match="removed"):
+            m.metrics(canonical=False)
+        assert isinstance(m.metrics(cluster=True), dict)
     finally:
         m.close()
 
@@ -547,8 +526,9 @@ def test_obs_disabled_is_inert():
         )
         try:
             assert m._obs is None  # peer pays one None-check per site
-            # the legacy metrics surface is independent of obs
-            assert "frames_out" in m.metrics()
+            # the canonical metrics surface is independent of obs (the
+            # collector serves the schema without a registry)
+            assert "st_frames_out_total" in m.metrics()
         finally:
             m.close()
         # the native ring's emission flag was flipped too

@@ -84,7 +84,9 @@ def test_example_lua_roundtrip():
             joiner.add(d2)
             _wait_converged([master, joiner], seed + d1 + d2)
             m = master.metrics()
-            assert m["frames_out"] > 0 and m["frames_in"] > 0
+            assert (
+                m["st_frames_out_total"] > 0 and m["st_frames_in_total"] > 0
+            )
 
 
 def test_four_peer_tree_consistency():
@@ -591,9 +593,9 @@ def test_device_tier_burst_path(monkeypatch):
         np.testing.assert_allclose(np.asarray(a.read()["w"]), want, atol=1e-6)
         np.testing.assert_allclose(np.asarray(b.read()["w"]), want, atol=1e-6)
         m = a.metrics()
-        assert m["frames_out"] > 0
+        assert m["st_frames_out_total"] > 0
         # burst economy: strictly fewer wire data messages than frames
-        assert m["delivery"]["msgs_out"] < m["frames_out"], m
+        assert m["st_msgs_out_total"] < m["st_frames_out_total"], m
     finally:
         a.close()
         b.close()

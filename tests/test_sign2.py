@@ -383,7 +383,7 @@ def test_governor_stays_quiet_on_frame_bound_link():
         while time.time() < t_end:
             m.add(jnp.asarray(rng.normal(0, 1, 1 << 14), jnp.float32))
             time.sleep(0.005)
-        cm = m.metrics(canonical=True, _warn=False)
+        cm = m.metrics()
         assert cm.get("st_precision_upshifts_total", 0) == 0, (
             "governor upshifted a frame-bound link"
         )

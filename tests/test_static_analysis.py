@@ -1,0 +1,235 @@
+"""Cross-tier lints + the clang analyze build (r13 tentpole).
+
+Each lint gets the r09 schema-lint negative-test discipline: it must pass
+on the real tree AND fail, by name, on a seeded violation written to a
+temp copy — a lint that cannot go red is decoration, not a gate. The
+seeded trees copy only the files each lint reads (tools/lint_*.py parse
+fixed relative paths under --repo).
+
+The analyze smoke compiles all three native files under clang's
+-Wthread-safety -Werror (the st_annotations.h contract) and runs the
+checked-in .clang-tidy; both skip when clang is absent (this image ships
+gcc only — the TSan arm in test_sanitizers.py is the dynamic half that
+always runs).
+"""
+
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+TOOLS = REPO / "tools"
+sys.path.insert(0, str(TOOLS))
+
+import lint_abi  # noqa: E402
+import lint_events  # noqa: E402
+import lint_metrics  # noqa: E402
+import lint_wire  # noqa: E402
+
+#: every file any lint reads, relative to the repo root
+_LINT_INPUTS = [
+    "native/stengine.cpp",
+    "native/sttransport.cpp",
+    "shared_tensor_tpu/comm/wire.py",
+    "shared_tensor_tpu/comm/engine.py",
+    "shared_tensor_tpu/comm/transport.py",
+    "shared_tensor_tpu/obs/events.py",
+    "shared_tensor_tpu/obs/schema.py",
+]
+
+
+def _seed_tree(tmp_path: pathlib.Path, full_package: bool = False):
+    root = tmp_path / "repo"
+    for rel in _LINT_INPUTS:
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+    if full_package:  # lint_metrics rglobs the whole package + native/
+        for src in (REPO / "shared_tensor_tpu").rglob("*.py"):
+            rel = src.relative_to(REPO)
+            dst = root / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy(src, dst)
+        for ext in ("*.c", "*.cpp", "*.h"):
+            for src in (REPO / "native").glob(ext):
+                dst = root / "native" / src.name
+                dst.parent.mkdir(parents=True, exist_ok=True)
+                shutil.copy(src, dst)
+    return root
+
+
+def _edit(root: pathlib.Path, rel: str, old: str, new: str) -> None:
+    p = root / rel
+    text = p.read_text()
+    assert old in text, f"seed-edit anchor missing from {rel}: {old!r}"
+    p.write_text(text.replace(old, new))
+
+
+def _cli(tool: str, repo: pathlib.Path) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, str(TOOLS / tool), "--repo", str(repo)],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+# ---- green on the real tree (importable form + the CLI wiring) ------------
+
+
+@pytest.mark.parametrize(
+    "mod", [lint_abi, lint_wire, lint_events, lint_metrics]
+)
+def test_lint_passes_on_tree(mod):
+    findings = mod.run(REPO)
+    assert findings == [], findings
+
+
+def test_lint_cli_green_exit_codes():
+    for tool in ("lint_abi.py", "lint_wire.py", "lint_events.py",
+                 "lint_metrics.py"):
+        r = _cli(tool, REPO)
+        assert r.returncode == 0, (tool, r.stdout, r.stderr)
+        assert "OK" in r.stdout
+
+
+# ---- red on seeded violations ---------------------------------------------
+
+
+def test_wire_lint_flags_renumbered_kind(tmp_path):
+    root = _seed_tree(tmp_path)
+    _edit(root, "native/stengine.cpp",
+          "constexpr uint8_t kAck = 6;", "constexpr uint8_t kAck = 5;")
+    findings = lint_wire.run(root)
+    assert any("kAck" in f and "ACK" in f for f in findings), findings
+    r = _cli("lint_wire.py", root)
+    assert r.returncode == 1 and "kAck" in r.stdout
+
+
+def test_wire_lint_flags_fault_injector_kind_set(tmp_path):
+    # a data kind the fault injector no longer matches: chaos silently
+    # stops covering it at the native wire boundary
+    root = _seed_tree(tmp_path)
+    _edit(root, "native/sttransport.cpp",
+          "(kind0 == 0 || kind0 == 7 || kind0 == 11)",
+          "(kind0 == 0 || kind0 == 7)")
+    findings = lint_wire.run(root)
+    assert any("is_data" in f for f in findings), findings
+
+
+def test_event_lint_flags_unknown_and_drifted_code(tmp_path):
+    root = _seed_tree(tmp_path)
+    # stengine re-declares kEvQuarantine; renumbering it yields BOTH an
+    # unknown code and a cross-file drift — the lint must name both
+    _edit(root, "native/stengine.cpp",
+          "constexpr uint32_t kEvQuarantine = 12;",
+          "constexpr uint32_t kEvQuarantine = 55;")
+    findings = lint_events.run(root)
+    assert any("55" in f and "CODE_NAMES" in f for f in findings), findings
+    assert any("drifted" in f for f in findings), findings
+
+
+def test_abi_lint_flags_narrowed_counter_buffer(tmp_path):
+    # the recurring widening class: native writes out22[21], python
+    # allocates fewer slots -> garbage reads beyond the buffer
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/comm/engine.py",
+          "out = np.zeros(22, np.uint64)", "out = np.zeros(18, np.uint64)")
+    findings = lint_abi.run(root)
+    assert any("st_engine_counters" in f and "18" in f for f in findings), (
+        findings
+    )
+
+
+def test_abi_lint_flags_dropped_argtype(tmp_path):
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/comm/engine.py",
+          "ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,\n"
+          "            ctypes.c_int32, ctypes.c_uint64,",
+          "ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,\n"
+          "            ctypes.c_int32,")
+    findings = lint_abi.run(root)
+    assert any(
+        "st_engine_attach" in f and "count" in f for f in findings
+    ), findings
+
+
+def test_abi_lint_flags_retyped_struct_field(tmp_path):
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/comm/transport.py",
+          '("bandwidth_cap_bps", ctypes.c_int64)',
+          '("bandwidth_cap_bps", ctypes.c_int32)')
+    findings = lint_abi.run(root)
+    assert any("StConfigC" in f for f in findings), findings
+
+
+def test_metrics_lint_flags_undocumented_name(tmp_path):
+    root = _seed_tree(tmp_path, full_package=True)
+    _edit(root, "shared_tensor_tpu/comm/peer.py",
+          "def metrics(",
+          'UNDOC = "st_totally_undocumented_metric"\n    def metrics(')
+    findings = lint_metrics.run(root)
+    assert any("st_totally_undocumented_metric" in f for f in findings), (
+        findings
+    )
+
+
+def test_metrics_lint_flags_legacy_alias_reintroduction(tmp_path):
+    root = _seed_tree(tmp_path, full_package=True)
+    _edit(root, "shared_tensor_tpu/comm/peer.py",
+          "def metrics(",
+          'LEGACY = {"frames_out": 0}\n    def metrics(')
+    findings = lint_metrics.run(root)
+    assert any("frames_out" in f and "legacy" in f for f in findings), (
+        findings
+    )
+
+
+# ---- clang analyze / clang-tidy smoke (skipped without clang) -------------
+
+
+def _have(tool: str) -> bool:
+    return shutil.which(tool) is not None
+
+
+@pytest.mark.skipif(not _have("clang"), reason="clang not installed")
+def test_native_analyze_build_is_clean():
+    """All three native files must compile clean under
+    -Wthread-safety -Werror — the st_annotations.h lock contract is a
+    build gate wherever clang exists, not documentation."""
+    r = subprocess.run(
+        ["make", "-C", str(REPO / "native"), "analyze"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.skipif(not _have("clang-tidy"), reason="clang-tidy not installed")
+def test_native_clang_tidy_is_clean():
+    r = subprocess.run(
+        ["make", "-C", str(REPO / "native"), "tidy"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_tsan_supp_entries_are_justified():
+    """The suppressions file's target state is empty; any entry must carry
+    the written (a)/(b)/(c) justification block the header demands."""
+    text = (REPO / "native" / "tsan.supp").read_text()
+    entries = [
+        l for l in text.splitlines()
+        if l.strip() and not l.strip().startswith("#")
+    ]
+    for entry in entries:
+        kind, _, pat = entry.partition(":")
+        assert kind in ("race", "mutex", "signal", "deadlock", "thread",
+                        "called_from_lib"), f"malformed suppression {entry!r}"
+        # justification discipline: the pattern must be discussed in a
+        # comment block naming report, reason and removal condition
+        assert pat.strip() in text.split(entry)[0], (
+            f"suppression {entry!r} has no written justification above it"
+        )
+    # the file documents the policy itself
+    assert "TARGET STATE: EMPTY" in text

@@ -1,0 +1,56 @@
+"""Shared plumbing for the cross-tier lints (tools/lint_*.py).
+
+These lints follow the r09 schema-lint discipline (tests/test_obs.py
+``test_schema_lint_every_emitted_st_name_is_documented``): parse BOTH sides
+of a contract from source text — never import the module under test, so a
+seeded-violation tree (tests/test_static_analysis.py) lints exactly like
+the real one — and fail by NAME with the file that violates. Every lint is
+a standalone script: ``python tools/lint_X.py [--repo DIR]`` exits 0 clean
+/ 1 with findings on stdout, and ``run(repo) -> list[str]`` is the
+importable form the tests and suite gate use.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+
+def read(repo: pathlib.Path, rel: str) -> str:
+    return (repo / rel).read_text(errors="replace")
+
+
+def strip_c_comments(text: str) -> str:
+    """Drop // and /* */ comments (string literals in the native sources
+    never contain comment markers; good enough for constant/decl parsing)."""
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    return re.sub(r"//[^\n]*", " ", text)
+
+
+def strip_py_comments(text: str) -> str:
+    return re.sub(r"#[^\n]*", " ", text)
+
+
+def c_int(tok: str) -> int:
+    """Parse a C integer literal (decimal or hex, optional u/l suffix)."""
+    tok = tok.strip().rstrip("uUlL")
+    return int(tok, 16) if tok.lower().startswith("0x") else int(tok, 10)
+
+
+def main(run, repo_flag_default: str = ".") -> None:
+    repo = pathlib.Path(repo_flag_default)
+    args = sys.argv[1:]
+    if args and args[0] == "--repo":
+        repo = pathlib.Path(args[1])
+    elif args:
+        repo = pathlib.Path(args[0])
+    findings = run(repo.resolve())
+    name = pathlib.Path(sys.argv[0]).name
+    if findings:
+        for f in findings:
+            print(f"{name}: {f}")
+        print(f"{name}: FAIL ({len(findings)} finding(s))")
+        sys.exit(1)
+    print(f"{name}: OK")
+    sys.exit(0)

@@ -1,0 +1,401 @@
+#!/usr/bin/env python
+"""ABI lint: ctypes declarations vs the native C declarations.
+
+The ctypes boundary is where this repo's recurring silent-mismatch class
+lives: the ``st_engine_counters`` out-array widened 8 -> 12 -> 16 -> 18 ->
+22 across rounds, and each widening had to touch stengine.cpp, engine.py's
+buffer size and the index map in the same commit — nothing but review
+checked they agree. Same for every argtypes list (a dropped or re-typed
+parameter reads garbage off the stack, usually *plausible* garbage) and
+the ctypes.Structure mirrors of the native config/event/stats structs.
+
+Checked, per function the Python tier declares argtypes for:
+  - a native definition exists (stengine.cpp or sttransport.cpp);
+  - parameter COUNTS match;
+  - parameter KINDS match position-by-position (pointer pointee dtype for
+    ndpointers, integer width/signedness for scalars, double, funcptr;
+    c_void_p is the deliberate wildcard — nullable pointers use it);
+  - restype matches.
+Plus:
+  - out-array widths: a native parameter named ``outN`` promises N slots;
+    the max literal index the native body writes must be N-1, and every
+    Python buffer allocated for that call must hold exactly N;
+  - ctypes.Structure mirrors (_StConfigC/_StEventC/_StStatsC) match the
+    native struct field-for-field.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+if __package__ in (None, ""):
+    import _lintlib as L
+else:
+    from . import _lintlib as L
+
+# ---- native side -----------------------------------------------------------
+
+_NATIVE_RET = {
+    "void": "void",
+    "void*": "ptr:any",
+    "int32_t": "i32",
+    "int64_t": "i64",
+    "uint32_t": "u32",
+    "uint64_t": "u64",
+    "double": "f64",
+}
+
+
+def _norm_ptr(base: str) -> str:
+    base = base.replace("const", "").replace("struct", "").strip()
+    return {
+        "void": "ptr:any",
+        "char": "ptr:char",
+        "uint8_t": "ptr:char",  # byte buffers cross as c_char_p/void_p
+        "float": "ptr:float",
+        "double": "ptr:double",
+        "int32_t": "ptr:int32",
+        "int64_t": "ptr:int64",
+        "uint32_t": "ptr:uint32",
+        "uint64_t": "ptr:uint64",
+        "StConfigC": "ptr:struct:StConfigC",
+        "StEventC": "ptr:struct:StEventC",
+        "StStatsC": "ptr:struct:StStatsC",
+    }.get(base, f"ptr:{base}")
+
+
+def _parse_native_params(raw: str) -> list[tuple[str, str]]:
+    """-> [(kind, param_name_or_empty)] — splits at depth-0 commas so
+    function-pointer parameters stay whole."""
+    params, depth, cur = [], 0, ""
+    for ch in raw:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            params.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        params.append(cur)
+    out: list[tuple[str, str]] = []
+    for p in params:
+        p = p.strip()
+        if not p or p == "void":
+            continue
+        if "(*" in p:  # function pointer
+            out.append(("funcptr", ""))
+            continue
+        # drop defaulted args and comments already stripped; split name
+        m = re.match(
+            r"(?:const\s+)?(?:struct\s+)?([A-Za-z_]\w+)\s*(\*?)\s*"
+            r"(?:const\s+)?([A-Za-z_]\w*)?$",
+            p.replace("* ", "*").replace(" *", "*").replace("*", "* ", 1)
+            if "*" in p
+            else p,
+        )
+        if not m:
+            out.append((f"unparsed:{p}", ""))
+            continue
+        base, star, name = m.group(1), m.group(2), m.group(3) or ""
+        if base == "int":
+            base = "int32_t"  # the ABI uses int only for st_node_create port
+        out.append((_norm_ptr(base) if star else _NATIVE_RET.get(base, base),
+                    name))
+    return out
+
+
+def native_functions(text: str) -> dict[str, dict]:
+    """name -> {ret, params: [(kind, name)], body} for every st_* function
+    DEFINITION (brace-balanced bodies; handles multi-line signatures)."""
+    out: dict[str, dict] = {}
+    for m in re.finditer(r"\b(st_\w+)\s*\(", text):
+        name = m.group(1)
+        # must be a definition at statement level: find matching ')' then '{'
+        i, depth = m.end(), 1
+        while i < len(text) and depth:
+            if text[i] == "(":
+                depth += 1
+            elif text[i] == ")":
+                depth -= 1
+            i += 1
+        j = i
+        while j < len(text) and text[j] in " \t\n":
+            j += 1
+        if j >= len(text) or text[j] != "{":
+            continue  # a call or declaration, not a definition
+        # return type: the token(s) just before the name
+        head = text[: m.start()].rsplit(";", 1)[-1].rsplit("}", 1)[-1]
+        head = head.replace("__attribute__((visibility(\"default\")))", " ")
+        head = head.replace("extern \"C\"", " ").strip()
+        ret_tok = head.split()[-1] if head.split() else "void"
+        ret = _NATIVE_RET.get(
+            ret_tok.replace("*", "") + ("*" if "*" in ret_tok else ""),
+            _NATIVE_RET.get(ret_tok, ret_tok),
+        )
+        if ret_tok.endswith("*"):
+            ret = "ptr:any" if ret_tok == "void*" else _norm_ptr(
+                ret_tok[:-1]
+            )
+        # body: brace-balanced span starting at j
+        k, depth = j + 1, 1
+        while k < len(text) and depth:
+            if text[k] == "{":
+                depth += 1
+            elif text[k] == "}":
+                depth -= 1
+            k += 1
+        if name not in out:  # first definition wins (no overloads in C)
+            out[name] = {
+                "ret": ret,
+                "params": _parse_native_params(text[m.end() : i - 1]),
+                "body": text[j:k],
+            }
+    return out
+
+
+# ---- python side -----------------------------------------------------------
+
+_PY_KIND = {
+    "ctypes.c_void_p": "ptr:any",
+    "ctypes.c_char_p": "ptr:char",
+    "ctypes.c_int32": "i32",
+    "ctypes.c_int64": "i64",
+    "ctypes.c_uint32": "u32",
+    "ctypes.c_uint64": "u64",
+    "ctypes.c_double": "f64",
+    "ctypes.c_int": "i32",
+    "_f32p": "ptr:float",
+    "_u32p": "ptr:uint32",
+    "_u64p": "ptr:uint64",
+    "_i32p": "ptr:int32",
+    "_i64p": "ptr:int64",
+    "None": "void",
+}
+
+
+def _py_kind(tok: str) -> str:
+    tok = tok.strip()
+    m = re.match(r"ctypes\.POINTER\(ctypes\.(c_\w+)\)", tok)
+    if m:
+        return {
+            "c_int32": "ptr:int32",
+            "c_int64": "ptr:int64",
+            "c_uint32": "ptr:uint32",
+            "c_uint64": "ptr:uint64",
+            "c_float": "ptr:float",
+            "c_double": "ptr:double",
+        }.get(m.group(1), f"ptr:{m.group(1)}")
+    m = re.match(r"ctypes\.POINTER\((_\w+)\)", tok)
+    if m:
+        return f"ptr:struct:{m.group(1).lstrip('_')}"
+    if tok in ("_StConfigC", "_StEventC", "_StStatsC"):
+        return f"ptr:struct:{tok.lstrip('_')}"
+    return _PY_KIND.get(tok, f"unparsed:{tok}")
+
+
+def _split_top(raw: str) -> list[str]:
+    parts, depth, cur = [], 0, ""
+    for ch in raw:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        parts.append(cur)
+    return [p.strip() for p in parts if p.strip()]
+
+
+def py_declarations(text: str) -> dict[str, dict]:
+    decls: dict[str, dict] = {}
+    for name, val in re.findall(
+        r"lib\.(st_\w+)\.restype\s*=\s*([^\n]+)", text
+    ):
+        decls.setdefault(name, {})["ret"] = _py_kind(val.strip())
+    for name, raw in re.findall(
+        r"lib\.(st_\w+)\.argtypes\s*=\s*\[(.*?)\]", text, flags=re.S
+    ):
+        decls.setdefault(name, {})["params"] = [
+            _py_kind(t) for t in _split_top(raw)
+        ]
+    return decls
+
+
+def _compatible(py: str, nat: str) -> bool:
+    if py == nat:
+        return True
+    wild = {"ptr:any"}  # nullable/void pointers cross as c_void_p
+    if py in wild and nat.startswith(("ptr:", "funcptr")):
+        return True
+    if nat in wild and py.startswith(("ptr:", "funcptr")):
+        return True
+    # ctypes strings / raw byte buffers
+    if {py, nat} <= {"ptr:char", "ptr:uint8"}:
+        return True
+    return False
+
+
+def _struct_fields_native(text: str, name: str) -> list[str]:
+    m = re.search(r"struct\s+%s\s*\{(.*?)\};" % name, text, flags=re.S)
+    if not m:
+        return []
+    out = []
+    for line in m.group(1).split(";"):
+        line = line.strip()
+        if not line:
+            continue
+        toks = line.split()
+        base = toks[0]
+        for fname in re.findall(r"(\w+)\s*(?:,|$)", " ".join(toks[1:])):
+            out.append(_NATIVE_RET.get(base, base))
+    return out
+
+
+def _struct_fields_py(text: str, name: str) -> list[str]:
+    m = re.search(
+        r"class %s\(ctypes\.Structure\):\s*_fields_\s*=\s*\[(.*?)\]"
+        % name,
+        text,
+        flags=re.S,
+    )
+    if not m:
+        return []
+    return [
+        _py_kind("ctypes." + t)
+        for t in re.findall(r'\(\s*"\w+"\s*,\s*ctypes\.(c_\w+)\s*\)',
+                            m.group(0))
+    ]
+
+
+def run(repo: pathlib.Path) -> list[str]:
+    findings: list[str] = []
+    nat_text = L.strip_c_comments(
+        L.read(repo, "native/stengine.cpp")
+    ) + L.strip_c_comments(L.read(repo, "native/sttransport.cpp"))
+    nat = native_functions(nat_text)
+    py_sources = {
+        "comm/engine.py": L.strip_py_comments(
+            L.read(repo, "shared_tensor_tpu/comm/engine.py")
+        ),
+        "comm/transport.py": L.strip_py_comments(
+            L.read(repo, "shared_tensor_tpu/comm/transport.py")
+        ),
+    }
+    py: dict[str, dict] = {}
+    py_file: dict[str, str] = {}
+    for fname, text in py_sources.items():
+        for name, decl in py_declarations(text).items():
+            py.setdefault(name, {}).update(decl)
+            py_file[name] = fname
+
+    if len(nat) < 20:
+        findings.append(
+            f"parse floor: only {len(nat)} native st_* definitions found "
+            f"(pattern rot?)"
+        )
+    if len(py) < 20:
+        findings.append(
+            f"parse floor: only {len(py)} ctypes declarations found "
+            f"(pattern rot?)"
+        )
+
+    for name in sorted(py):
+        where = py_file.get(name, "?")
+        if name not in nat:
+            findings.append(
+                f"{where} declares {name} but no native definition exists"
+            )
+            continue
+        pd, nd = py[name], nat[name]
+        if "params" in pd:
+            nparams = [k for k, _ in nd["params"]]
+            if len(pd["params"]) != len(nparams):
+                findings.append(
+                    f"{name}: argtypes count {len(pd['params'])} != native "
+                    f"parameter count {len(nparams)} "
+                    f"({where} vs native declaration)"
+                )
+            else:
+                for i, (pk, nk) in enumerate(zip(pd["params"], nparams)):
+                    if not _compatible(pk, nk):
+                        findings.append(
+                            f"{name}: param {i} type mismatch — ctypes "
+                            f"{pk} vs native {nk} ({where})"
+                        )
+        if "ret" in pd and not _compatible(pd["ret"], nd["ret"]):
+            findings.append(
+                f"{name}: restype {pd['ret']} vs native return "
+                f"{nd['ret']} ({where})"
+            )
+
+    # ---- out-array widths (the st_engine_counters widening class) --------
+    for name, nd in sorted(nat.items()):
+        for kind, pname in nd["params"]:
+            m = re.match(r"out(\d+)$", pname)
+            if not m:
+                continue
+            width = int(m.group(1))
+            idxs = [
+                int(i)
+                for i in re.findall(r"\b%s\[(\d+)\]" % pname, nd["body"])
+            ]
+            if idxs and max(idxs) != width - 1:
+                findings.append(
+                    f"{name}: native body writes {pname}[{max(idxs)}] but "
+                    f"the parameter name promises exactly {width} slots"
+                )
+            # every python allocation feeding this call must hold width
+            for fname, text in py_sources.items():
+                for alloc in re.findall(
+                    r"(?:np\.(?:zeros|empty)\(\s*(\d+)|"
+                    r"\(ctypes\.c_uint64 \* (\d+)\)\(\))"
+                    r"(?:(?!def )[\s\S]){0,400}?lib\.%s\(" % name,
+                    text,
+                ):
+                    n = int(alloc[0] or alloc[1])
+                    if n != width:
+                        findings.append(
+                            f"{name}: {fname} allocates a {n}-slot buffer "
+                            f"for the native {width}-slot {pname}"
+                        )
+    # engine.py's _counters buffer feeds st_engine_counters through a
+    # helper; check its documented consumer indices stay in range
+    eng = py_sources["comm/engine.py"]
+    m = re.search(r"np\.zeros\((\d+), np\.uint64\)\s*\n\s*if self\._h:"
+                  r"\s*\n\s*self\._lib\.st_engine_counters", eng)
+    if m and "st_engine_counters" in nat:
+        width = int(m.group(1))
+        cidx = [int(i) for i in re.findall(r"\bc\[(\d+)\]", eng)]
+        if cidx and max(cidx) >= width:
+            findings.append(
+                f"engine.py indexes c[{max(cidx)}] of the "
+                f"{width}-slot counter snapshot"
+            )
+
+    # ---- ctypes.Structure mirrors ----------------------------------------
+    t_nat = L.strip_c_comments(L.read(repo, "native/sttransport.cpp"))
+    t_py = py_sources["comm/transport.py"]
+    for sname in ("StConfigC", "StEventC", "StStatsC"):
+        nf = _struct_fields_native(t_nat, sname)
+        pf = _struct_fields_py(t_py, "_" + sname)
+        if not nf or not pf:
+            findings.append(f"{sname}: struct parse failed (pattern rot?)")
+            continue
+        if nf != pf:
+            findings.append(
+                f"{sname}: field layout drifted — native {nf} vs "
+                f"ctypes {pf}"
+            )
+    return findings
+
+
+if __name__ == "__main__":
+    L.main(run)

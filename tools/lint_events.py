@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Event-code lint: obs/events.py CODE_NAMES vs the native kEv* constants.
+
+The obs event codes are ABI across three surfaces — sttransport.cpp,
+stengine.cpp (which re-declares the engine-side subset) and
+obs/events.py's CODE_NAMES decode table. A native event emitted under a
+code the Python table does not know decodes as ``code_N`` (a timeline
+nobody can read); two native files disagreeing on one name is worse — the
+same number means two different events. Both drifts become red gates here.
+
+Checked:
+  - every kEv* value in each native file is a key in CODE_NAMES;
+  - kEv* constants sharing a name across the two native files agree;
+  - no two kEv* in one file share a value;
+  - transport.py EventKind (membership kinds 1..4) ⊆ CODE_NAMES.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+if __package__ in (None, ""):
+    import _lintlib as L
+else:
+    from . import _lintlib as L
+
+
+#: kEv-prefixed constants that are NOT event codes (each with a reason;
+#: a stale entry here is itself worth a look).
+NOT_A_CODE = {
+    "kEvRingCap",  # per-thread ring capacity, not a code
+}
+
+
+def _kev(text: str) -> dict[str, int]:
+    return {
+        name: L.c_int(val)
+        for name, val in re.findall(
+            r"constexpr\s+uint32_t\s+(kEv\w+)\s*=\s*(\d+)\s*;",
+            text,
+        )
+        if name not in NOT_A_CODE
+    }
+
+
+def _code_names(text: str) -> dict[int, str]:
+    m = re.search(r"CODE_NAMES[^=]*=\s*\{(.*?)\n\}", text, flags=re.S)
+    if not m:
+        return {}
+    return {
+        int(code): name
+        for code, name in re.findall(r'(\d+)\s*:\s*"([a-z0-9_]+)"', m.group(1))
+    }
+
+
+def run(repo: pathlib.Path) -> list[str]:
+    findings: list[str] = []
+    files = {
+        "native/sttransport.cpp": _kev(
+            L.strip_c_comments(L.read(repo, "native/sttransport.cpp"))
+        ),
+        "native/stengine.cpp": _kev(
+            L.strip_c_comments(L.read(repo, "native/stengine.cpp"))
+        ),
+    }
+    names = _code_names(L.read(repo, "shared_tensor_tpu/obs/events.py"))
+
+    if not names:
+        findings.append("obs/events.py CODE_NAMES parse failed (pattern rot?)")
+        return findings
+    if sum(len(v) for v in files.values()) < 10:
+        findings.append("parse floor: fewer than 10 kEv* constants across "
+                        "the native files (pattern rot?)")
+
+    for fname, kev in files.items():
+        seen: dict[int, str] = {}
+        for cname, val in kev.items():
+            if val in seen:
+                findings.append(
+                    f"{fname}: {cname} and {seen[val]} share code {val}"
+                )
+            seen[val] = cname
+            if val not in names:
+                findings.append(
+                    f"{fname}: {cname} = {val} has no obs/events.py "
+                    f"CODE_NAMES entry (would decode as code_{val})"
+                )
+    shared = set(files["native/sttransport.cpp"]) & set(
+        files["native/stengine.cpp"]
+    )
+    for cname in sorted(shared):
+        a = files["native/sttransport.cpp"][cname]
+        b = files["native/stengine.cpp"][cname]
+        if a != b:
+            findings.append(
+                f"{cname} drifted between native files: sttransport.cpp "
+                f"says {a}, stengine.cpp says {b}"
+            )
+
+    # membership kinds: transport.py's EventKind enum doubles as timeline
+    # codes 1..4 (Node::emit feeds both surfaces with one number)
+    tpy = L.strip_py_comments(
+        L.read(repo, "shared_tensor_tpu/comm/transport.py")
+    )
+    m = re.search(r"class EventKind\(.*?\):\n((?:\s+\w+ = \d+\n)+)", tpy)
+    if not m:
+        findings.append("transport.py EventKind parse failed (pattern rot?)")
+    else:
+        for kname, val in re.findall(r"(\w+) = (\d+)", m.group(1)):
+            if int(val) not in names:
+                findings.append(
+                    f"transport.py EventKind.{kname} = {val} missing from "
+                    f"obs/events.py CODE_NAMES"
+                )
+    return findings
+
+
+if __name__ == "__main__":
+    L.main(run)

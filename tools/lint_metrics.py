@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Metric-name lint: every emitted st_* name is documented; legacy alias
+keys stay dead.
+
+Two contracts, both red gates:
+
+1. (the r09 schema-lint, promoted from test-only to a suite gate) every
+   ``st_*`` string literal in the Python package AND the native sources
+   must be a documented obs/schema.py SCHEMA name — a new metric cannot
+   ship undocumented.
+2. (r13) the r08 legacy nested ``peer.metrics()`` alias surface was
+   removed after overstaying its "one release" by three; this lint
+   forbids the alias machinery (``DEPRECATED_ALIASES``/``canonicalize``)
+   and the legacy metric keys from reappearing as dict keys in the
+   delivery-metrics modules. Resurrecting a parallel non-schema namespace
+   should fail CI by name, not slip in as "compat".
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+if __package__ in (None, ""):
+    import _lintlib as L
+else:
+    from . import _lintlib as L
+
+#: Non-metric st_* literals, each with a reason. Kept honest by the
+#: staleness check below: every entry must still occur in the scan.
+ALLOWED_NON_METRICS: dict[str, str] = {
+    "st_trace": "Chrome trace_event category tag (trace_export.py)",
+}
+
+#: The removed r08 legacy alias keys (and the machinery that served
+#: them). Any of these reappearing as a metrics dict key in the modules
+#: below is a finding.
+BANNED_TOKENS = ("DEPRECATED_ALIASES", "canonicalize")
+BANNED_LEGACY_KEYS = (
+    "frames_out", "frames_in", "updates", "msgs_out", "msgs_in",
+    "inflight_msgs", "wire_msgs_out", "wire_msgs_in", "residual_rms",
+    "delivery",
+)
+#: Modules whose dict-literal keys are metric names (the old nested shape
+#: lived here). Other modules use these words freely as attributes.
+LEGACY_KEY_SCOPE = ("shared_tensor_tpu/comm/peer.py",)
+
+
+def run(repo: pathlib.Path) -> list[str]:
+    findings: list[str] = []
+    pat = re.compile(r'["\'](st_[a-z0-9_]+)["\']')
+    sources = sorted((repo / "shared_tensor_tpu").rglob("*.py")) + [
+        p
+        for ext in ("*.c", "*.cpp", "*.h")
+        for p in sorted((repo / "native").glob(ext))
+    ]
+    if not sources:
+        return ["scan found no sources (wrong --repo?)"]
+    schema_text = L.read(repo, "shared_tensor_tpu/obs/schema.py")
+    documented = set(pat.findall(schema_text))
+    if len(documented) < 20:
+        findings.append(
+            f"parse floor: only {len(documented)} documented st_* names in "
+            f"obs/schema.py (pattern rot?)"
+        )
+    emitted: dict[str, set[str]] = {}
+    for path in sources:
+        rel = str(path.relative_to(repo))
+        if rel == "shared_tensor_tpu/obs/schema.py":
+            continue
+        for name in pat.findall(path.read_text(errors="replace")):
+            emitted.setdefault(name, set()).add(rel)
+    if not emitted:
+        findings.append("scan found no st_* literals (pattern rot?)")
+    for name in sorted(emitted):
+        if name in documented or name in ALLOWED_NON_METRICS:
+            continue
+        findings.append(
+            f"undocumented metric name {name!r} emitted in "
+            f"{sorted(emitted[name])} — add a SCHEMA row or an "
+            f"ALLOWED_NON_METRICS entry with a reason"
+        )
+    for stale in sorted(set(ALLOWED_NON_METRICS) - set(emitted)):
+        findings.append(f"allowlist entry {stale!r} is no longer emitted "
+                        f"anywhere — remove it")
+
+    # legacy alias surface must stay dead
+    for rel in ("shared_tensor_tpu/obs/schema.py",) + LEGACY_KEY_SCOPE:
+        text = L.strip_py_comments(L.read(repo, rel))
+        for tok in BANNED_TOKENS:
+            if re.search(r"\b%s\b" % tok, text):
+                findings.append(
+                    f"{rel}: legacy alias machinery {tok!r} reintroduced "
+                    f"(removed r13 — the canonical schema is the only "
+                    f"metrics surface)"
+                )
+    for rel in LEGACY_KEY_SCOPE:
+        text = L.strip_py_comments(L.read(repo, rel))
+        for key in BANNED_LEGACY_KEYS:
+            if re.search(r'["\']%s["\']\s*:' % key, text):
+                findings.append(
+                    f"{rel}: legacy metrics key {key!r} used as a dict "
+                    f"key again (removed r13 — use the st_* schema name)"
+                )
+    return findings
+
+
+if __name__ == "__main__":
+    L.main(run)

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Wire-kind lint: comm/wire.py constants vs the native receive switch.
+
+The native engine re-declares the wire message kinds and header sizes as
+C constants (stengine.cpp ``kData``/``kAck``/... , ``kDataHdrV1``/...),
+and the transport's fault injector hardcodes the data-kind set its wire
+boundary recognizes. A drift between any of these and comm/wire.py is a
+SILENT interop break (a renumbered kind decodes as garbage or as a
+different message class) — exactly the mismatch class this lint makes a
+red gate instead of a debugging session.
+
+Checked, by name:
+  - every mapped k* constant in stengine.cpp equals its wire.py twin;
+  - sttransport.cpp's ``is_data`` kind-literal set == {DATA, BURST, RDATA};
+  - stengine.cpp's RDATA header-size ternary == (RDATA_HDR_T, RDATA_HDR).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+if __package__ in (None, ""):
+    import _lintlib as L
+else:
+    from . import _lintlib as L
+
+#: native constant (stengine.cpp) -> python constant (comm/wire.py).
+#: Adding a new shared kind means adding a row here — the parse-floor
+#: check below fails if the native constant exists unmapped.
+NATIVE_TO_WIRE = {
+    "kData": "DATA",
+    "kAck": "ACK",
+    "kBurst": "BURST",
+    "kFresh": "FRESH",
+    "kRData": "RDATA",
+    "kDataHdrV1": "DATA_HDR",
+    "kBurstHdrV1": "BURST_HDR",
+    "kTraceBytes": "TRACE_BYTES",
+}
+
+
+def _native_constants(text: str) -> dict[str, int]:
+    """Every ``constexpr <int type> kName = <literal>;`` (incl. multi-
+    declarator lines like ``kDataHdrV1 = 5, kBurstHdrV1 = 6;``)."""
+    out: dict[str, int] = {}
+    for m in re.finditer(
+        r"constexpr\s+(?:uint8_t|uint32_t|uint64_t|size_t|int)\s+([^;]+);",
+        text,
+    ):
+        for name, val in re.findall(r"(k\w+)\s*=\s*(0x[0-9a-fA-F]+|\d+)",
+                                    m.group(1)):
+            out[name] = L.c_int(val)
+    return out
+
+
+def _py_constants(text: str) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for name, val in re.findall(
+        r"(?m)^([A-Z][A-Z0-9_]*)\s*=\s*(0x[0-9a-fA-F]+|\d+)\s*$", text
+    ):
+        # first binding wins (wire.py defines each exactly once)
+        out.setdefault(name, L.c_int(val))
+    # one resolution pass for derived constants (DATA_HDR_T = DATA_HDR +
+    # TRACE_BYTES and friends): sums of already-known names/literals
+    for name, expr in re.findall(
+        r"(?m)^([A-Z][A-Z0-9_]*)\s*=\s*([A-Z0-9_+ ]+?)\s*$", text
+    ):
+        if name in out:
+            continue
+        total = 0
+        for term in expr.split("+"):
+            term = term.strip()
+            if term.isdigit():
+                total += int(term)
+            elif term in out:
+                total += out[term]
+            else:
+                total = None
+                break
+        if total is not None:
+            out[name] = total
+    return out
+
+
+def run(repo: pathlib.Path) -> list[str]:
+    findings: list[str] = []
+    engine = L.strip_c_comments(L.read(repo, "native/stengine.cpp"))
+    transport = L.strip_c_comments(L.read(repo, "native/sttransport.cpp"))
+    wire = L.strip_py_comments(
+        L.read(repo, "shared_tensor_tpu/comm/wire.py")
+    )
+    nat = _native_constants(engine)
+    py = _py_constants(wire)
+
+    if len(nat) < 5:
+        findings.append(
+            f"parse floor: only {len(nat)} k* constants found in "
+            f"stengine.cpp (pattern rot?)"
+        )
+    for cname, pyname in NATIVE_TO_WIRE.items():
+        if cname not in nat:
+            findings.append(f"stengine.cpp no longer defines {cname} "
+                            f"(update NATIVE_TO_WIRE if renamed)")
+            continue
+        if pyname not in py:
+            findings.append(f"comm/wire.py no longer defines {pyname}")
+            continue
+        if nat[cname] != py[pyname]:
+            findings.append(
+                f"kind/size mismatch: stengine.cpp {cname}={nat[cname]} "
+                f"vs wire.py {pyname}={py[pyname]}"
+            )
+
+    # the transport fault injector's data-kind set (link_sender_loop
+    # ``is_data``): the literals it matches must be exactly the data kinds
+    # wire.py defines — a new data kind that is not added there silently
+    # escapes chaos coverage at the native wire boundary.
+    m = re.search(r"bool\s+is_data\s*=(.*?);", transport, flags=re.S)
+    if not m:
+        findings.append("sttransport.cpp: is_data expression not found "
+                        "(pattern rot?)")
+    else:
+        lits = {int(v) for v in re.findall(r"kind0\s*==\s*(\d+)", m.group(1))}
+        want = {py.get("DATA"), py.get("BURST"), py.get("RDATA")}
+        if lits != want:
+            findings.append(
+                f"sttransport.cpp is_data kind set {sorted(lits)} != "
+                f"wire.py data kinds {sorted(x for x in want if x is not None)}"
+            )
+
+    # the ranged-subscriber RDATA header ternary in the engine sender must
+    # match wire.py's RDATA_HDR_T/RDATA_HDR pair
+    m = re.search(r"hdr\s*=\s*e->trace_wire\s*\?\s*(\d+)\s*:\s*(\d+)", engine)
+    if not m:
+        findings.append("stengine.cpp: RDATA header ternary not found "
+                        "(pattern rot?)")
+    else:
+        t, v1 = int(m.group(1)), int(m.group(2))
+        if (t, v1) != (py.get("RDATA_HDR_T"), py.get("RDATA_HDR")):
+            findings.append(
+                f"RDATA header sizes: stengine.cpp ({t}, {v1}) != wire.py "
+                f"(RDATA_HDR_T={py.get('RDATA_HDR_T')}, "
+                f"RDATA_HDR={py.get('RDATA_HDR')})"
+            )
+    return findings
+
+
+if __name__ == "__main__":
+    L.main(run)
