@@ -57,15 +57,27 @@ tree reaches the exact total; a wedged link shows up as a convergence
 timeout and fails the run. Stripe telemetry (deaths, reroutes, live vs
 negotiated counts) is tallied in the artifact.
 
+r14 ``--shm`` arm (implies kill-restore): the 7-node tree runs with every
+writer link's data plane on same-host SHARED-MEMORY rings (the r14 lane —
+the normal state of a loopback cluster), under the same 25% drop schedule
+and whole-tree kill-restore. On top of the r12 gates it asserts the lanes
+were actually LIVE (st_shm_active == 2 at both ends of every writer link,
+real ring traffic) before the kill AND after the restart's from-scratch
+re-negotiation, and that the root's in-band digest is EXACT at the
+post-restore quiesce — the lane sits below the wire-seq layer, so no
+counter the digest aggregates may drift because of it.
+
 Emits one JSON document and writes it to argv[1] (default CHAOS_r09.json).
 Run:  JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py CHAOS_r09.json
       JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py CHAOS_r10.json \
           --subscribers 2
       JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py CHAOS_r11.json \
           --stripes 4
+      JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py CHAOS_r14.json \
+          --shm
 Knobs: ST_CLUSTER_NODES (default 7), ST_CLUSTER_N (2048),
 ST_CLUSTER_ADDS (40), ST_CLUSTER_SEED (9), ST_CLUSTER_SUBSCRIBERS (0),
-ST_CLUSTER_STRIPES (1).
+ST_CLUSTER_STRIPES (1), ST_CLUSTER_SHM (0).
 """
 
 import json
@@ -95,6 +107,17 @@ KILL_RESTORE = os.environ.get("ST_CLUSTER_KILL_RESTORE", "0") == "1"
 if "--kill-restore" in sys.argv:
     KILL_RESTORE = True
     sys.argv.remove("--kill-restore")
+# r14 ``--shm`` arm: the kill-restore chaos run additionally ASSERTS the
+# same-host shm lanes are live across the whole tree (every writer link's
+# data plane on rings, real shm message traffic), and that the root's
+# in-band digest is EXACT at the post-restore quiesce — the lane must be
+# invisible to every counter the digest aggregates. Implies kill-restore.
+SHM_ARM = os.environ.get("ST_CLUSTER_SHM", "0") == "1"
+if "--shm" in sys.argv:
+    SHM_ARM = True
+    sys.argv.remove("--shm")
+if SHM_ARM:
+    KILL_RESTORE = True
 #: Wall-clock budget for the full-cluster restore: first restarted create
 #: to every node re-converged on the pre-kill mass.
 RESTORE_BUDGET_S = float(os.environ.get("ST_RESTORE_BUDGET_S", "45"))
@@ -221,12 +244,28 @@ def run_kill_restore(art_path: str) -> int:
             "restore_sec": RESTORE_BUDGET_S, "snapshot_sec": SNAP_BUDGET_S,
         },
     }
+    def shm_tally(peers):
+        """(links_live, msgs, fallbacks) across the tree — a link counts
+        once per endpoint whose data plane is on the rings (state 2)."""
+        live, msgs = 0, 0
+        for p in peers:
+            m = p.metrics(canonical=True)
+            live += sum(
+                1 for k, v in m.items()
+                if k.startswith("st_shm_active") and v == 2
+            )
+            msgs += int(m.get("st_shm_msgs_out_total", 0))
+        return live, msgs
+
     snapdir = tempfile.mkdtemp(prefix="st_snap_r12_")
     # ---- kill-restore arm -------------------------------------------------
     peers = build(_free_port())
     try:
         out["engine_tier"] = all(p._engine is not None for p in peers)
         soak(peers, p1)
+        if SHM_ARM:
+            live, msgs = shm_tally(peers)
+            out["shm"] = {"pre_kill_lanes_live": live, "pre_kill_msgs": msgs}
         # snapshot MID-SOAK: in-flight residual mass under active drop
         # chaos — the barrier must drain and capture through it
         t0 = time.monotonic()
@@ -265,6 +304,30 @@ def run_kill_restore(art_path: str) -> int:
                 int(s.get("st_restore_total", 0)) for s in snaps
             ),
         }
+        if SHM_ARM:
+            # the RESTARTED tree re-negotiated its lanes from scratch, and
+            # the root's in-band digest must be EXACT at this quiesced
+            # instant — the lane is below the wire-seq layer, so no
+            # counter the digest aggregates may drift because of it
+            live, msgs = shm_tally(peers)
+            out["shm"]["restored_lanes_live"] = live
+            out["shm"]["restored_msgs"] = msgs
+            for _ in range(4):
+                for p in peers:
+                    if p._uplink is not None:
+                        p.push_digest()
+                time.sleep(0.4)
+            cluster = peers[0].metrics(cluster=True)
+            snaps = [p.metrics(canonical=True) for p in peers]
+            digest_exact = len(cluster["nodes"]) == NODES
+            dig = {}
+            for name in STABLE_COUNTERS:
+                want = sum(s.get(name, 0) for s in snaps)
+                got = cluster["counters"].get(name, 0)
+                dig[name] = {"cluster": got, "sum_of_registries": want}
+                digest_exact = digest_exact and got == want
+            out["shm"]["digest_exact_at_quiesce"] = bool(digest_exact)
+            out["shm"]["digest_counters"] = dig
     finally:
         for p in peers:
             p.close()
@@ -298,6 +361,21 @@ def run_kill_restore(art_path: str) -> int:
         and out["uninterrupted_arm"]["converged"]
         and dev <= out["bound"]
     )
+    if SHM_ARM:
+        # every writer link's data plane on rings at BOTH ends (2 per
+        # link), before the kill and again after the restart's fresh
+        # negotiation; real lane traffic; digest exact at quiesce
+        want_lanes = 2 * (NODES - 1)
+        out["shm"]["want_lanes"] = want_lanes
+        out["pass"] = bool(
+            out["pass"]
+            and out["shm"]["pre_kill_lanes_live"] >= want_lanes
+            and out["shm"]["restored_lanes_live"] >= want_lanes
+            and out["shm"]["pre_kill_msgs"] >= 1
+            and out["shm"]["restored_msgs"] >= 1
+            and out["shm"]["digest_exact_at_quiesce"]
+        )
+        out["bench"] = "cluster_chaos_kill_restore_shm"
     doc = json.dumps(out, indent=2)
     print(doc)
     if not os.path.isabs(art_path):

@@ -62,7 +62,11 @@ def _force_cpu():
 
 #: r11 link striping: sockets per logical link for the native arm
 #: (ST_ENGINE_BENCH_STRIPES; the stripe sweep drives this 1/2/4).
-STRIPES = int(os.environ.get("ST_ENGINE_BENCH_STRIPES", "4"))
+#: Default 1 since r14: the same-host shm lane is the loopback data plane
+#: now — extra TCP stripes only add idle keepalive threads beneath it
+#: (ENGINE_SWEEP_r14 carries the shm-vs-2-stripe-TCP comparison; run the
+#: TCP arms with ST_SHM=0).
+STRIPES = int(os.environ.get("ST_ENGINE_BENCH_STRIPES", "1"))
 #: r11 cascade depth (frames quantized per memory pass; 0 = the
 #: CodecConfig default). The sweep knob behind the committed retune.
 CASCADE = int(os.environ.get("ST_ENGINE_BENCH_CASCADE", "0"))

@@ -1,0 +1,98 @@
+"""ENGINE_SWEEP_r14 generator: the same-host shm lane vs the r12-shape
+2-stripe TCP point, interleaved per repeat so the box's drift (5-10%
+loopback noise, slow thermal/VM wander measured across this round) hits
+both arms alike. Arms:
+
+- shm:  the r14 default data plane (lane + aligned v3 framing +
+  zero-repack receive), stripe_count 1 — extra TCP stripes only idle
+  beneath a live lane;
+- tcp2: ST_SHM=0, stripe_count 2 — the r11/r12 loopback sweet spot
+  (striping saturated at 2 sockets on this box), on the SAME build, so
+  the comparison isolates the lane + r14 framing rather than crediting
+  them with r14's lane-independent gains (recv_zc, sendmmsg).
+
+Emits one JSON document to argv[1] (default ENGINE_SWEEP_r14.json).
+Run: JAX_PLATFORMS=cpu python benchmarks/engine_sweep_r14.py [out] [reps]
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SIZES = [4096, 65536, 1 << 19, 1 << 20, 1 << 21, 1 << 24]
+REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+
+
+def run_arm(sizes, shm: bool, stripes: int) -> list:
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        ST_ENGINE_BENCH_SIZES=",".join(str(s) for s in sizes),
+        ST_ENGINE_BENCH_STRIPES=str(stripes),
+    )
+    if not shm:
+        env["ST_SHM"] = "0"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "engine_bench.py")],
+        capture_output=True, text=True, env=env, timeout=1200, cwd=REPO,
+    )
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            rows = json.loads(line)["rows"]
+            for row in rows:
+                row["arm"] = "shm" if shm else "tcp-2stripe"
+            return rows
+    raise RuntimeError(f"bench arm produced no JSON: {r.stderr[-500:]}")
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "ENGINE_SWEEP_r14.json"
+    rows = []
+    for rep in range(REPS):
+        for shm, stripes in ((True, 1), (False, 2)):
+            for row in run_arm(SIZES, shm, stripes):
+                row["rep"] = rep
+                rows.append(row)
+            print(
+                f"rep {rep} {'shm' if shm else 'tcp2'} done",
+                file=sys.stderr, flush=True,
+            )
+    # per-size verdict: mean equiv GB/s per arm; shm_wins on the mean
+    verdict = {}
+    for n in SIZES:
+        means = {}
+        for arm in ("shm", "tcp-2stripe"):
+            vals = [
+                r["equiv_fp32_GBps"] for r in rows
+                if r["n"] == n and r["arm"] == arm
+            ]
+            means[arm] = round(sum(vals) / len(vals), 3) if vals else 0.0
+        verdict[str(n)] = {
+            **means, "shm_wins": means["shm"] > means["tcp-2stripe"],
+        }
+    doc = {
+        "bench": "engine_sweep_r14_shm_vs_tcp",
+        "tier": "host-native-engine",
+        "arms": {
+            "shm": "r14 default: shm lane + v3 aligned framing, 1 stripe",
+            "tcp-2stripe": "ST_SHM=0 (no lane, no r14 capability -> v2 "
+                           "framing), 2 TCP stripes — the r11/r12 loopback "
+                           "sweet spot on the same build",
+        },
+        "reps_per_point": REPS,
+        "rows": rows,
+        "verdict": verdict,
+        "shm_wins_at_sizes": [n for n in SIZES if verdict[str(n)]["shm_wins"]],
+    }
+    path = out_path if os.path.isabs(out_path) else os.path.join(REPO, out_path)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps(doc["verdict"], indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,20 @@
 //                  unordered leaves — no path acquires one under another
 //                  (kill_link takes Link::rmu and Node::mu SEQUENTIALLY,
 //                  never nested).
+//                  r14 additions, both leaves: stshm::Lane::tx_mu (the
+//                  shm ring's single-writer serialization across the
+//                  stripe-death promotion window; held across a whole
+//                  record write, including its bounded futex waits — the
+//                  ring head/tail atomics themselves are cross-process
+//                  and carry their ordering in the futex publish
+//                  protocol, not in any mutex) and Node::loan_mu (the
+//                  recv_zc loan registry; taken sequentially with
+//                  Node::mu, never nested). The shm segment's shared
+//                  header fields (joined/closed, Ring head/tail/seq
+//                  words) are interprocess atomics outside any
+//                  capability the analysis can see — their discipline is
+//                  documented at stshm::RingCtl and checked by the TSan
+//                  shm arm instead.
 //   stcodec.c      g_pool.job_mu -> g_pool.mu (submitter wake/completion
 //                  sleep); workers take g_pool.mu alone.
 //

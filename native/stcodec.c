@@ -1696,6 +1696,48 @@ static void apply_frames_seg(void *vctx, int64_t c) {
 }
 #endif
 
+/* shared tail of the fused-apply wrappers (flat and wire layouts build
+ * the same per-leaf pointer tables, then run identically) */
+static void apply_frames_run(af_ctx *x, int64_t n_leaves,
+                             const int64_t *padded, double *out_amax,
+                             double *out_ss, double *out_sabs) {
+#ifdef ST_POOL
+  int64_t total = 0;
+  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
+  if (total >= ST_PAR_MIN_ELEMS) {
+    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
+    double *pbuf =
+        out_amax ? (double *)malloc((size_t)nc * 3 * sizeof(double)) : NULL;
+    if (chunks && (!out_amax || pbuf)) {
+      stc_build_chunks(padded, n_leaves, chunks);
+      x->chunks = chunks;
+      x->camax = pbuf;
+      x->css = pbuf ? pbuf + nc : NULL;
+      x->csabs = pbuf ? pbuf + 2 * nc : NULL;
+      if (stc_pool_run(apply_frames_seg, x, nc)) {
+        if (out_amax)
+          reduce_chunk_partials(chunks, nc, n_leaves, x->camax, x->css,
+                                x->csabs, out_amax, out_ss, out_sabs);
+        free(chunks);
+        free(pbuf);
+        return;
+      }
+      x->camax = NULL;
+      x->css = NULL;
+      x->csabs = NULL;
+    }
+    free(chunks);
+    free(pbuf);
+  }
+#endif
+  for (int64_t i = 0; i < n_leaves; i++) {
+    apply_frames_leaf_range(x, i, 0, padded[i] / 32,
+                            out_amax ? &out_amax[i] : NULL,
+                            out_amax ? &out_ss[i] : NULL,
+                            out_amax ? &out_sabs[i] : NULL);
+  }
+}
+
 EXPORT void stc_apply_frames(const float *vin, float *vout, const int64_t *off,
                              const int64_t *ns, const int64_t *padded,
                              int64_t n_leaves, int64_t W, int32_t k,
@@ -1749,44 +1791,77 @@ EXPORT void stc_apply_frames(const float *vin, float *vout, const int64_t *off,
   x.wps = wps;
   x.svals = svals;
   x.am = am;
-#ifdef ST_POOL
-  int64_t total = 0;
-  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
-  if (total >= ST_PAR_MIN_ELEMS) {
-    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
-    double *pbuf =
-        out_amax ? (double *)malloc((size_t)nc * 3 * sizeof(double)) : NULL;
-    if (chunks && (!out_amax || pbuf)) {
-      stc_build_chunks(padded, n_leaves, chunks);
-      x.chunks = chunks;
-      x.camax = pbuf;
-      x.css = pbuf ? pbuf + nc : NULL;
-      x.csabs = pbuf ? pbuf + 2 * nc : NULL;
-      if (stc_pool_run(apply_frames_seg, &x, nc)) {
-        if (out_amax)
-          reduce_chunk_partials(chunks, nc, n_leaves, x.camax, x.css, x.csabs,
-                                out_amax, out_ss, out_sabs);
-        free(chunks);
-        free(pbuf);
-        free(wps);
-        free(svals);
-        free(am);
-        return;
-      }
-      x.camax = NULL;
-      x.css = NULL;
-      x.csabs = NULL;
+  apply_frames_run(&x, n_leaves, padded, out_amax, out_ss, out_sabs);
+  free(wps);
+  free(svals);
+  free(am);
+}
+
+/* r14: fused k-frame apply STRAIGHT FROM THE WIRE BODY — per frame f the
+ * layout is [scales L*4][words W*4] at body + f*stride (the v3 aligned
+ * framing guarantees body and stride are 4-aligned, so the typed loads
+ * are legal). Identical arithmetic to stc_apply_frames: the workers only
+ * ever see the per-leaf pointer table, which here points into the wire
+ * buffer instead of a repacked copy — the receive path's full-message
+ * repack (one read + one write of every wire byte) disappears. */
+EXPORT void stc_apply_frames_wire(const float *vin, float *vout,
+                                  const int64_t *off, const int64_t *ns,
+                                  const int64_t *padded, int64_t n_leaves,
+                                  int64_t W, int32_t k, const uint8_t *body,
+                                  int64_t stride, double *out_amax,
+                                  double *out_ss, double *out_sabs) {
+  if (k <= 0) return;
+  const uint32_t **wps =
+      (const uint32_t **)malloc((size_t)n_leaves * k * sizeof(uint32_t *));
+  float *svals = (float *)malloc((size_t)n_leaves * k * sizeof(float));
+  int32_t *am = (int32_t *)malloc((size_t)n_leaves * sizeof(int32_t));
+  if (!wps || !svals || !am) {
+    free(wps);
+    free(svals);
+    free(am);
+    for (int32_t f = 0; f < k; f++) {
+      const uint8_t *fb = body + (size_t)f * stride;
+      stc_apply_frame(f == 0 ? vin : vout, vout, off, ns, padded, n_leaves,
+                      (const float *)fb,
+                      (const uint32_t *)(fb + 4 * n_leaves));
     }
-    free(chunks);
-    free(pbuf);
+    if (out_amax)
+      stc_scale_partials(vout, off, ns, n_leaves, out_amax, out_ss, out_sabs);
+    return;
   }
-#endif
   for (int64_t i = 0; i < n_leaves; i++) {
-    apply_frames_leaf_range(&x, i, 0, padded[i] / 32,
-                            out_amax ? &out_amax[i] : NULL,
-                            out_amax ? &out_ss[i] : NULL,
-                            out_amax ? &out_sabs[i] : NULL);
+    int32_t m = 0;
+    for (int32_t f = 0; f < k; f++) {
+      const uint8_t *fb = body + (size_t)f * stride;
+      float s = ((const float *)fb)[i];
+      if (s == 0.0f) continue;
+      wps[(size_t)i * k + m] =
+          (const uint32_t *)(fb + 4 * n_leaves) + off[i] / 32;
+      svals[(size_t)i * k + m] = s;
+      m++;
+    }
+    am[i] = m;
   }
+  af_ctx x;
+  x.vin = vin;
+  x.vout = vout;
+  x.off = off;
+  x.ns = ns;
+  x.padded = padded;
+  x.W = W;
+  x.k = k;
+  x.scales = NULL; /* workers read only the pointer tables */
+  x.words = NULL;
+  x.camax = NULL;
+  x.css = NULL;
+  x.csabs = NULL;
+  x.wps = wps;
+  x.svals = svals;
+  x.am = am;
+  apply_frames_run(&x, n_leaves, padded, out_amax, out_ss, out_sabs);
+  free(wps);
+  free(svals);
+  free(am);
 }
 
 /* ======================================================================
@@ -2480,6 +2555,47 @@ static void apply2_frames_seg(void *vctx, int64_t c) {
 }
 #endif
 
+/* shared tail (see apply_frames_run) */
+static void apply2_frames_run(af2_ctx *x, int64_t n_leaves,
+                              const int64_t *padded, double *out_amax,
+                              double *out_ss, double *out_sabs) {
+#ifdef ST_POOL
+  int64_t total = 0;
+  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
+  if (total >= ST_PAR_MIN_ELEMS) {
+    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
+    double *pbuf =
+        out_amax ? (double *)malloc((size_t)nc * 3 * sizeof(double)) : NULL;
+    if (chunks && (!out_amax || pbuf)) {
+      stc_build_chunks(padded, n_leaves, chunks);
+      x->chunks = chunks;
+      x->camax = pbuf;
+      x->css = pbuf ? pbuf + nc : NULL;
+      x->csabs = pbuf ? pbuf + 2 * nc : NULL;
+      if (stc_pool_run(apply2_frames_seg, x, nc)) {
+        if (out_amax)
+          reduce_chunk_partials(chunks, nc, n_leaves, x->camax, x->css,
+                                x->csabs, out_amax, out_ss, out_sabs);
+        free(chunks);
+        free(pbuf);
+        return;
+      }
+      x->camax = NULL;
+      x->css = NULL;
+      x->csabs = NULL;
+    }
+    free(chunks);
+    free(pbuf);
+  }
+#endif
+  for (int64_t i = 0; i < n_leaves; i++) {
+    apply2_frames_leaf_range(x, i, 0, padded[i] / 32,
+                             out_amax ? &out_amax[i] : NULL,
+                             out_amax ? &out_ss[i] : NULL,
+                             out_amax ? &out_sabs[i] : NULL);
+  }
+}
+
 /* Fused k-frame sign2 apply (stc_apply_frames's 2-bit twin). words is
  * k * 2W: frame f's sign plane at f*2W, its magnitude plane at f*2W + W —
  * exactly the order the planes arrive inside a wire frame body. */
@@ -2530,44 +2646,63 @@ EXPORT void stc_apply_frames2(const float *vin, float *vout,
   x.mps = mps;
   x.svals = svals;
   x.am = am;
-#ifdef ST_POOL
-  int64_t total = 0;
-  int64_t nc = stc_count_chunks(padded, n_leaves, &total);
-  if (total >= ST_PAR_MIN_ELEMS) {
-    stc_chunk *chunks = (stc_chunk *)malloc((size_t)nc * sizeof(stc_chunk));
-    double *pbuf =
-        out_amax ? (double *)malloc((size_t)nc * 3 * sizeof(double)) : NULL;
-    if (chunks && (!out_amax || pbuf)) {
-      stc_build_chunks(padded, n_leaves, chunks);
-      x.chunks = chunks;
-      x.camax = pbuf;
-      x.css = pbuf ? pbuf + nc : NULL;
-      x.csabs = pbuf ? pbuf + 2 * nc : NULL;
-      if (stc_pool_run(apply2_frames_seg, &x, nc)) {
-        if (out_amax)
-          reduce_chunk_partials(chunks, nc, n_leaves, x.camax, x.css,
-                                x.csabs, out_amax, out_ss, out_sabs);
-        free(chunks);
-        free(pbuf);
-        free(sps);
-        free(svals);
-        free(am);
-        return;
-      }
-      x.camax = NULL;
-      x.css = NULL;
-      x.csabs = NULL;
-    }
-    free(chunks);
-    free(pbuf);
+  apply2_frames_run(&x, n_leaves, padded, out_amax, out_ss, out_sabs);
+  free(sps);
+  free(svals);
+  free(am);
+}
+
+/* r14: the sign2 twin of stc_apply_frames_wire — per frame f the wire
+ * body is [scales L*4][sign W*4][mag W*4] at body + f*stride (4-aligned
+ * by the v3 framing). */
+EXPORT void stc_apply_frames2_wire(const float *vin, float *vout,
+                                   const int64_t *off, const int64_t *ns,
+                                   const int64_t *padded, int64_t n_leaves,
+                                   int64_t W, int32_t k, const uint8_t *body,
+                                   int64_t stride, double *out_amax,
+                                   double *out_ss, double *out_sabs) {
+  if (k <= 0) return;
+  const uint32_t **sps =
+      (const uint32_t **)malloc((size_t)n_leaves * k * 2 * sizeof(uint32_t *));
+  float *svals = (float *)malloc((size_t)n_leaves * k * sizeof(float));
+  int32_t *am = (int32_t *)malloc((size_t)n_leaves * sizeof(int32_t));
+  if (!sps || !svals || !am) {
+    free(sps);
+    free(svals);
+    free(am);
+    return; /* OOM on tiny metadata arrays: nothing sane left to do */
   }
-#endif
+  const uint32_t **mps = sps + (size_t)n_leaves * k;
   for (int64_t i = 0; i < n_leaves; i++) {
-    apply2_frames_leaf_range(&x, i, 0, padded[i] / 32,
-                             out_amax ? &out_amax[i] : NULL,
-                             out_amax ? &out_ss[i] : NULL,
-                             out_amax ? &out_sabs[i] : NULL);
+    int32_t m = 0;
+    for (int32_t f = 0; f < k; f++) {
+      const uint8_t *fb = body + (size_t)f * stride;
+      float s = ((const float *)fb)[i];
+      if (s == 0.0f) continue;
+      const uint32_t *w = (const uint32_t *)(fb + 4 * n_leaves);
+      sps[(size_t)i * k + m] = w + off[i] / 32;
+      mps[(size_t)i * k + m] = w + W + off[i] / 32;
+      svals[(size_t)i * k + m] = s;
+      m++;
+    }
+    am[i] = m;
   }
+  af2_ctx x;
+  x.vin = vin;
+  x.vout = vout;
+  x.off = off;
+  x.ns = ns;
+  x.padded = padded;
+  x.W = W;
+  x.k = k;
+  x.camax = NULL;
+  x.css = NULL;
+  x.csabs = NULL;
+  x.sps = sps;
+  x.mps = mps;
+  x.svals = svals;
+  x.am = am;
+  apply2_frames_run(&x, n_leaves, padded, out_amax, out_ss, out_sabs);
   free(sps);
   free(svals);
   free(am);

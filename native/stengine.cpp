@@ -100,6 +100,16 @@ void stc_apply_frames2(const float*, float*, const int64_t*, const int64_t*,
                        const int64_t*, int64_t, int64_t, int32_t,
                        const float*, const uint32_t*, double*, double*,
                        double*);
+// r14 wire-layout fused applies: read scales/words straight from the
+// (4-aligned, v3-framed) wire body — no repack copy.
+void stc_apply_frames_wire(const float*, float*, const int64_t*,
+                           const int64_t*, const int64_t*, int64_t, int64_t,
+                           int32_t, const uint8_t*, int64_t, double*,
+                           double*, double*);
+void stc_apply_frames2_wire(const float*, float*, const int64_t*,
+                            const int64_t*, const int64_t*, int64_t, int64_t,
+                            int32_t, const uint8_t*, int64_t, double*,
+                            double*, double*);
 void stc_apply_frame2(const float*, float*, const int64_t*, const int64_t*,
                       const int64_t*, int64_t, int64_t, const float*,
                       const uint32_t*);
@@ -111,6 +121,12 @@ int32_t st_node_send(void*, int32_t, const uint8_t*, int32_t, double);
 int32_t st_node_send_zc(void*, int32_t, const uint8_t*, int32_t, double,
                         void (*)(void*), void*);
 int32_t st_node_recv(void*, int32_t, uint8_t*, int32_t, double);
+// r14 zero-copy receive: the transport LOANS the popped rx buffer (valid
+// until the next recv_zc/recv_done on the same link) instead of copying
+// it out — one full-message copy gone from the receive hot path, on every
+// lane (TCP, striped, shm).
+int32_t st_node_recv_zc(void*, int32_t, const uint8_t**, double);
+void st_node_recv_done(void*, int32_t);
 int32_t st_node_drop_link(void*, int32_t);
 uint64_t st_node_data_seq(void*);
 uint64_t st_node_wait_data(void*, uint64_t, double);
@@ -312,6 +328,20 @@ constexpr size_t kTraceBytes = 13;
 constexpr size_t kDataHdrV1 = 5, kBurstHdrV1 = 6;
 constexpr size_t kDataHdrV2 = kDataHdrV1 + kTraceBytes;   // 18
 constexpr size_t kBurstHdrV2 = kBurstHdrV1 + kTraceBytes;  // 19
+// r14 "aligned" v3 framing — ONE 24-byte header for DATA and BURST:
+// [kind u8][k u8][pad u16][seq u32][origin u32][gen u64][hops u8][pad*3].
+// Sized so the frame body lands 8-ALIGNED in the receiver's buffer, which
+// lets the fused apply read scales/words straight from the wire body
+// (stc_apply_frames_wire) — the receive path's full-message repack (one
+// read + one write of every wire byte) disappears. Emission is gated per
+// link on the peer's advertised r14 capability (the SYNC/WELCOME shm
+// flag doubles as the r14 marker — compat.py) AND on trace_wire (the
+// trace context is a fixed field here); decode is unconditional and
+// length-disambiguated from v1/v2 exactly like r09's bump: per is a
+// multiple of 4, and 24 ≡ 0 (mod 4) collides with neither 5/18 (kData)
+// nor 6/19 (kBurst). The trace context occupies bytes 8..20, the same
+// contiguous [origin u32][gen u64][hops u8] order v2 carries.
+constexpr size_t kHdrV3 = 24;
 // Header room reserved before a tx slot's 8-aligned frame body (was 8 in
 // r07; v2's largest header is 19 bytes, so the room grows to the next
 // multiple of 8 — the body stays aligned for the codec kernels).
@@ -410,6 +440,9 @@ struct ELink {
   // beats to lift, so a bursty storm doesn't flap the link) or the
   // residual quiesces.
   bool peer_sign2 = false;
+  // r14: the peer decodes the aligned v3 framing (advertised via the
+  // SYNC/WELCOME r14 capability flag; st_engine_link_wire_v3)
+  bool wire_v3 = false;
   int prec = 1;
   double gov_prev = -1.0;
   uint64_t gov_last_ns = 0;
@@ -791,6 +824,52 @@ void apply_batch(Engine* e, int32_t src_link, int32_t k, const float* scales,
   if (prec == 2) e->frames2_in += applied;
 }
 
+// apply_batch's r14 zero-repack twin: k frames applied STRAIGHT FROM the
+// v3 wire body (per frame f: [scales L*4][words ...] at body + f*stride;
+// the 24-byte aligned header guarantees the typed loads are legal). Same
+// flood/carry/accounting semantics — only the repack copy is gone. The
+// caller has already zeroed non-finite scales in place (the loaned rx
+// buffer is process-local transport memory, safe to sanitize). Caller
+// holds e->mu.
+void apply_batch_wire(Engine* e, int32_t src_link, int32_t k,
+                      const uint8_t* body, size_t stride, int prec)
+    ST_REQUIRES(e->mu) {
+  uint64_t applied = 0;
+  for (int32_t f = 0; f < k; f++)
+    if (any_nonzero((const float*)(body + (size_t)f * stride), e->L))
+      applied++;
+  if (applied == 0) return;
+  auto apply = [&](const float* in, float* out, double* pa, double* ps,
+                   double* pb) {
+    if (prec == 2)
+      stc_apply_frames2_wire(in, out, e->off.data(), e->ns.data(),
+                             e->padded.data(), e->L, e->W, k, body,
+                             (int64_t)stride, pa, ps, pb);
+    else
+      stc_apply_frames_wire(in, out, e->off.data(), e->ns.data(),
+                            e->padded.data(), e->L, e->W, k, body,
+                            (int64_t)stride, pa, ps, pb);
+  };
+  apply(e->values.data(), e->values.data(), nullptr, nullptr, nullptr);
+  for (auto& kv : e->links) {
+    if (kv.first == src_link) continue;
+    ELink& lk = kv.second;
+    if ((int64_t)lk.pamax.size() != e->L) {
+      lk.pamax.resize((size_t)e->L);
+      lk.pss.resize((size_t)e->L);
+      lk.psabs.resize((size_t)e->L);
+    }
+    apply(lk.resid.data(), lk.resid.data(), lk.pamax.data(), lk.pss.data(),
+          lk.psabs.data());
+    lk.pvalid = true;
+    lk.dirty = true;
+  }
+  if (e->has_carry)
+    apply(e->carry.data(), e->carry.data(), nullptr, nullptr, nullptr);
+  e->frames_in += applied;
+  if (prec == 2) e->frames2_in += applied;
+}
+
 // ---- sender ---------------------------------------------------------------
 
 size_t frame_bytes(const Engine* e) {
@@ -1105,7 +1184,7 @@ void sender_loop(Engine* e) {
           // message still fits every peer's receive bound (r11
           // wire.frame_wire_bytes sized recv_cap for it)
           int64_t cap2 =
-              ((int64_t)e->recv_cap - (int64_t)kBurstHdrV2) / (int64_t)fb;
+              ((int64_t)e->recv_cap - (int64_t)kHdrV3) / (int64_t)fb;
           if (cap2 < 1) cap2 = 1;
           if (bmax > cap2) bmax = (int)cap2;
         }
@@ -1282,15 +1361,33 @@ void sender_loop(Engine* e) {
           // [kind][u32 seq][u8 k], DATA [kind][u32 seq], each followed by
           // the 13-byte r09 trace context when trace_wire is on.
           uint32_t seq32 = (uint32_t)msg.seq;
-          size_t hdr = e->burst > 1
-                           ? (e->trace_wire ? kBurstHdrV2 : kBurstHdrV1)
-                           : (e->trace_wire ? kDataHdrV2 : kDataHdrV1);
+          // r14: aligned v3 toward peers that advertised the capability
+          // (24-byte header; trace context is a fixed field, so v3 also
+          // requires trace emission — the ST_WIRE_TRACE=0 pin keeps v1)
+          const bool v3 = lk2.wire_v3 && e->trace_wire;
+          size_t hdr = v3 ? kHdrV3
+                          : (e->burst > 1
+                                 ? (e->trace_wire ? kBurstHdrV2 : kBurstHdrV1)
+                                 : (e->trace_wire ? kDataHdrV2 : kDataHdrV1));
           slot->wire_off = (uint32_t)(kBodyOff - hdr);
           uint8_t* H = slot->buf.data() + slot->wire_off;
           size_t o;
           // r11: the kind byte's top bit marks sign2 frame bodies (see
           // kPrecBit) — set only toward peers that advertised the decode
           uint8_t pbit = mprec == 2 ? kPrecBit : 0;
+          if (v3) {
+            std::memset(H, 0, kHdrV3);
+            H[0] = (e->burst > 1 ? kBurst : kData) | pbit;
+            H[1] = (uint8_t)msg.nframes;
+            std::memcpy(H + 4, &seq32, 4);
+            uint32_t to = e->t_has ? e->t_origin : e->obs_id;
+            uint64_t tg = e->t_has ? e->t_gen : st_obs_now_ns();
+            uint8_t th =
+                e->t_has ? (uint8_t)(e->t_hops > 255 ? 255 : e->t_hops) : 0;
+            std::memcpy(H + 8, &to, 4);
+            std::memcpy(H + 12, &tg, 8);
+            H[20] = th;
+          } else {
           if (e->burst > 1) {
             H[0] = kBurst | pbit;
             std::memcpy(H + 1, &seq32, 4);
@@ -1312,6 +1409,7 @@ void sender_loop(Engine* e) {
             std::memcpy(H + o, &to, 4);
             std::memcpy(H + o + 4, &tg, 8);
             H[o + 12] = th;
+          }
           }
           slot->wire_len =
               (uint32_t)(hdr + (size_t)msg.nframes * fb);
@@ -1540,7 +1638,6 @@ void flush_acks(Engine* e, int32_t id, ELink& lk) ST_REQUIRES(e->mu) {
 }
 
 void receiver_loop(Engine* e) {
-  std::vector<uint8_t> buf((size_t)e->recv_cap);
   // batch accumulators (frames from one link applied in one pass)
   std::vector<float> bscales;
   std::vector<uint32_t> bwords;
@@ -1562,6 +1659,13 @@ void receiver_loop(Engine* e) {
     for (int32_t id : ids) {
       int32_t batchk = 0;
       int batch_prec = 1;  // r11: a batch is precision-homogeneous
+      // r14 zero-repack path: a v3 message pending direct-from-wire apply
+      // (the pointers borrow the current recv_zc loan, so it flushes
+      // before the next pop)
+      const uint8_t* wire_body = nullptr;
+      int32_t wire_k = 0;
+      int wire_prec = 1;
+      size_t wire_stride = 0;
       uint64_t msgs = 0;
       // last traced stamp accepted in this batch (+ per-batch aggregates):
       // folded into the engine's pending stamp and the link's staleness
@@ -1582,13 +1686,18 @@ void receiver_loop(Engine* e) {
       bscales.clear();
       bwords.clear();
       auto flush = [&]() {
-        if (batchk == 0 && msgs == 0) return;
+        if (batchk == 0 && msgs == 0 && wire_k == 0) return;
         StLockGuard lk(e->mu);
         auto it = e->links.find(id);
         if (it == e->links.end()) return;
         if (batchk > 0) {
           apply_batch(e, id, batchk, bscales.data(), bwords.data(),
                       batch_prec);
+        }
+        if (wire_k > 0) {
+          apply_batch_wire(e, id, wire_k, wire_body, wire_stride, wire_prec);
+          wire_k = 0;
+          wire_body = nullptr;
         }
         if (have_trace) {
           // advance the pending stamp: this node is now one hop further
@@ -1636,7 +1745,12 @@ void receiver_loop(Engine* e) {
         // window never starves; the table read still amortizes across
         // the full batch.
         if (batchk >= 256) break;
-        int32_t n = st_node_recv(e->node, id, buf.data(), e->recv_cap, 0.0);
+        // r14: zero-copy pop — `buf` borrows the transport's rx buffer
+        // until the next recv_zc/recv_done on this link; everything this
+        // iteration needs is either parsed or copied (batch vectors,
+        // ctrl queue) before the next pop releases it
+        const uint8_t* buf = nullptr;
+        int32_t n = st_node_recv_zc(e->node, id, &buf, 0.0);
         if (n == 0) break;
         if (n < 0) {
           // dead + drained; rollback happens at detach (or the sender's
@@ -1657,14 +1771,14 @@ void receiver_loop(Engine* e) {
           if ((size_t)n != (size_t)e->compat_bytes || e->sealed.load())
             continue;
           float sc;
-          std::memcpy(&sc, buf.data(), 4);
+          std::memcpy(&sc, buf, 4);
           if (sc == 0.0f || !std::isfinite(sc)) continue;
           msgs++;
           size_t bs = bscales.size(), bw = bwords.size();
           bscales.resize(bs + (size_t)e->L);  // L == 1 in compat
           bwords.resize(bw + (size_t)e->W, 0u);
           bscales[bs] = sc;
-          std::memcpy(bwords.data() + bw, buf.data() + 4,
+          std::memcpy(bwords.data() + bw, buf + 4,
                       (size_t)e->compat_bytes - 4);
           batchk++;
           continue;
@@ -1691,45 +1805,64 @@ void receiver_loop(Engine* e) {
           // sender's retransmission re-delivers it whole, and our
           // cumulative ACK is always exactly the last accepted seq.
           if (n < 5) continue;  // too short to carry a seq: undecodable
+          // v1/v2/v3 framing by exact length (per_rx is a multiple of 4;
+          // 5/18 for kData, 6/19 for kBurst, 24 for v3 — all distinct
+          // mod 4, so the sizes can never coincide): any sender's
+          // messages keep applying on any node (the version gates are
+          // about what we EMIT). The r11 precision bit selects the frame
+          // width FIRST (per vs per+4W), so the discriminations compose.
+          // v3 must be detected BEFORE the seq check — its seq field
+          // lives at byte 4, not 1.
+          size_t per_rx = p2 ? per + (size_t)e->W * 4 : per;
+          const bool v3 = (size_t)n >= kHdrV3 && buf[1] > 0 &&
+                          (size_t)n == kHdrV3 + (size_t)buf[1] * per_rx;
           uint32_t seq;
-          std::memcpy(&seq, buf.data() + 1, 4);
+          std::memcpy(&seq, buf + (v3 ? 4 : 1), 4);
           if (seq != (uint32_t)(rx_base + msgs + 1)) {  // dup/gap: discard
             e->dedup_discards++;
             st_obs_emit(e->obs_id, kEvDedupDiscard, id, (uint64_t)seq);
             continue;
           }
-          // v1 or v2 framing by exact length (per_rx is a multiple of 4,
-          // the trace context is 13 bytes — the sizes can never coincide),
-          // so a v1 sender's messages keep applying on a v2 node and vice
-          // versa (the r09 version gate is about what we EMIT). The r11
-          // precision bit selects the frame width FIRST (per vs per+4W),
-          // so the two discriminations compose without ambiguity.
-          size_t per_rx = p2 ? per + (size_t)e->W * 4 : per;
           int32_t k = 0;
           const uint8_t* p = nullptr;
           const uint8_t* trace = nullptr;  // 13-byte context, if present
-          if (kind == kData && (size_t)n == kDataHdrV1 + per_rx) {
+          if (v3) {
+            k = buf[1];
+            trace = buf + 8;  // [origin u32][gen u64][hops u8], v2 order
+            p = buf + kHdrV3;
+          } else if (kind == kData && (size_t)n == kDataHdrV1 + per_rx) {
             k = 1;
-            p = buf.data() + kDataHdrV1;
+            p = buf + kDataHdrV1;
           } else if (kind == kData && (size_t)n == kDataHdrV2 + per_rx) {
             k = 1;
-            trace = buf.data() + kDataHdrV1;
-            p = buf.data() + kDataHdrV2;
+            trace = buf + kDataHdrV1;
+            p = buf + kDataHdrV2;
           } else if (kind == kBurst && n >= 6 && buf[5] > 0 &&
                      (size_t)n == kBurstHdrV1 + (size_t)buf[5] * per_rx) {
             k = buf[5];
-            p = buf.data() + kBurstHdrV1;
+            p = buf + kBurstHdrV1;
           } else if (kind == kBurst && n >= 19 && buf[5] > 0 &&
                      (size_t)n == kBurstHdrV2 + (size_t)buf[5] * per_rx) {
             k = buf[5];
-            trace = buf.data() + kBurstHdrV1;
-            p = buf.data() + kBurstHdrV2;
+            trace = buf + kBurstHdrV1;
+            p = buf + kBurstHdrV2;
           } else {
             continue;  // undecodable: seq not consumed, await retransmit
           }
+          // r14 zero-repack routing: the direct-from-wire apply flushes
+          // PER MESSAGE (its pointers borrow the recv_zc loan), which
+          // forfeits the cross-message batch amortization — a pure loss
+          // on small tables where the per-pass table walk is cheap and
+          // clumped messages are common. Route v3 messages to the direct
+          // path only when the repack copy it deletes is the bigger cost
+          // (>= 1 MiB of wire body); below that they join the ordinary
+          // batch, whose per-frame parse handles the v3 body layout
+          // identically (p already points past the 24-byte header).
+          const bool direct =
+              v3 && (size_t)k * per_rx >= (size_t)(1 << 20);
           // a precision change flushes the pending batch (apply_batch is
           // homogeneous); rx_base tracking spans the flush safely
-          if (batchk > 0 && batch_prec != (p2 ? 2 : 1)) flush();
+          if (batchk > 0 && (direct || batch_prec != (p2 ? 2 : 1))) flush();
           batch_prec = p2 ? 2 : 1;
           msgs++;
           if (trace) {
@@ -1750,6 +1883,27 @@ void receiver_loop(Engine* e) {
                            (tr_origin << 8) | (hop > 255 ? 255 : hop));
             }
           }
+          if (direct) {
+            // r14 zero-repack apply: the 24-byte header 8-aligns the
+            // body, so the fused kernels read scales/words straight from
+            // the loaned wire buffer — no per-frame memcpy into batch
+            // vectors at all. Sanitize non-finite scales IN PLACE first
+            // (trust boundary; the loan is our own transport memory),
+            // then flush immediately: the borrowed pointers must not
+            // outlive this message's loan (released by the next pop).
+            for (int32_t f = 0; f < k; f++) {
+              float* s = const_cast<float*>(
+                  reinterpret_cast<const float*>(p + (size_t)f * per_rx));
+              for (int64_t i = 0; i < e->L; i++)
+                if (!std::isfinite(s[i])) s[i] = 0.0f;
+            }
+            wire_body = p;
+            wire_k = k;
+            wire_prec = p2 ? 2 : 1;
+            wire_stride = per_rx;
+            flush();
+            continue;
+          }
           size_t wk = p2 ? (size_t)e->W * 2 : (size_t)e->W;  // words/frame
           for (int32_t f = 0; f < k; f++) {
             size_t bs = bscales.size(), bw = bwords.size();
@@ -1769,7 +1923,7 @@ void receiver_loop(Engine* e) {
           }
         } else if (kind == kAck && n == 9) {
           uint64_t count;
-          std::memcpy(&count, buf.data() + 1, 8);
+          std::memcpy(&count, buf + 1, 8);
           StLockGuard lk(e->mu);
           auto it = e->links.find(id);
           if (it != e->links.end()) {
@@ -1805,11 +1959,13 @@ void receiver_loop(Engine* e) {
           flush();
           StLockGuard lk(e->cmu);
           e->ctrl.emplace_back(
-              id, std::vector<uint8_t>(buf.data(), buf.data() + n));
+              id, std::vector<uint8_t>(buf, buf + n));
         }
       }
       bool applied = batchk > 0;
       flush();
+      // the last loaned rx buffer is fully parsed/copied by now
+      st_node_recv_done(e->node, id);
       {
         // retry any previously-backpressured ACK even on idle passes
         StLockGuard lk(e->mu);
@@ -1904,7 +2060,7 @@ __attribute__((visibility("default"))) void st_engine_set_codec(
     // sign2 burst is capped to the receive bound, which can exceed the
     // 1-bit burst's bytes when the 1-bit cap was frame-count-limited
     size_t per2 = frame_bytes(e) + (size_t)e->W * 4;
-    int64_t cap2 = ((int64_t)e->recv_cap - (int64_t)kBurstHdrV2) /
+    int64_t cap2 = ((int64_t)e->recv_cap - (int64_t)kHdrV3) /
                    (int64_t)per2;
     if (cap2 < 1) cap2 = 1;
     if (cap2 > e->burst) cap2 = e->burst;
@@ -1925,6 +2081,22 @@ __attribute__((visibility("default"))) int32_t st_engine_link_allow_sign2(
   auto it = e->links.find(link_id);
   if (it == e->links.end()) return 0;
   it->second.peer_sign2 = allow != 0;
+  return 1;
+}
+
+// r14: the peer on link_id advertised the r14 capability (the SYNC/
+// WELCOME shm flag — compat.SYNC_FLAG_SHM doubles as the r14 marker) —
+// emission to it may use the aligned v3 framing, whose 24-byte header
+// lets the receiver apply frames straight from the wire body. Without
+// this call a link stays on v2 forever (mixed-tree safety default).
+__attribute__((visibility("default"))) int32_t st_engine_link_wire_v3(
+    void* h, int32_t link_id, int32_t allow) {
+  if (!h) return 0;
+  auto* e = (Engine*)h;
+  StLockGuard lk(e->mu);
+  auto it = e->links.find(link_id);
+  if (it == e->links.end()) return 0;
+  it->second.wire_v3 = allow != 0;
   return 1;
 }
 

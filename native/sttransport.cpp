@@ -28,25 +28,36 @@
 
 #include <arpa/inet.h>
 #include <fcntl.h>
+#include <linux/futex.h>
+#include <signal.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
 #include <sys/uio.h>
 #include <time.h>
 #include <unistd.h>
+
+#if defined(__x86_64__) && defined(__SSE2__)
+#include <emmintrin.h>  // NT stores for the shm ring bulk copies
+#endif
 
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
+#include <new>
 #include <string>
 #include <thread>
 #include <vector>
@@ -204,6 +215,12 @@ constexpr uint32_t kEvFaultSever = 26;
 // a striped link dying (arg = stripe index) while the link degrades to
 // the survivors.
 constexpr uint32_t kEvStripeDown = 33;
+// r14 same-host shared-memory lane: 34 fires once when a link's data plane
+// switches onto its shm rings (arg = ring bytes per direction); 35 when a
+// negotiated attach fails validation and the link stays on TCP (arg = an
+// errno-ish reason code — 1 open, 2 map, 3 header/token mismatch).
+constexpr uint32_t kEvShmLaneUp = 34;
+constexpr uint32_t kEvShmFallback = 35;
 // 30 (trace_apply) and 31 (sub_attach, r10 subscriber link mode) are
 // emitted by stengine.cpp; listed in obs/events.py CODE_NAMES like the
 // rest — the numeric values are ABI across all three surfaces.
@@ -291,6 +308,220 @@ extern "C" __attribute__((visibility("default"))) int32_t st_obs_drain(
   return written;
 }
 
+// ---- r14 same-host shared-memory lane ------------------------------------
+//
+// When both endpoints of a link live on one host (negotiated at the Python
+// tier's SYNC/WELCOME hello — compat.SYNC_FLAG_SHM + boot-id match, the
+// same tolerant-extension discipline as every capability since r09), the
+// link's DATA plane moves into a mapped /dev/shm segment: one SPSC byte
+// ring per direction, records framed [u32 len][u64 stripe_seq][payload],
+// futex wake with spin-before-sleep. The TCP connection STAYS UP as the
+// control/teardown/liveness channel — keepalives, join/seq semantics,
+// SNAP/RESUME, quarantine/carry/re-graft are all untouched; the lane
+// slots in below the wire-seq layer exactly as r11 striping did.
+//
+// Ordering across the lane switch:
+//  - striped links: every record carries the message's stripe seq, so the
+//    ring feeds the SAME reassembly window as the sockets
+//    (deliver_striped) — in-flight TCP messages and ring records
+//    interleave correctly with no barrier at all;
+//  - unstriped links: the single sender writes one SWITCH marker
+//    ([u32 kShmSwitchLen], a length no real frame can have) as its LAST
+//    data-plane byte on TCP, then moves to the ring; the receiver enables
+//    ring delivery only when the marker arrives in-stream, so the
+//    TCP-before / ring-after order is exact. The marker is only ever sent
+//    after a successful shm attach, i.e. never to a pre-r14 peer.
+//
+// Messages LARGER than the ring stream through it: the writer publishes
+// the record header, then payload chunks as space frees; the reader
+// drains chunks into its rx buffer as they appear. The ring therefore
+// bounds memory, not message size ("slots sized for max traced sign2
+// bursts" degrades gracefully when a burst outgrows the default).
+//
+// Teardown: either side stores hdr->closed and futex-wakes all wait
+// words (kill_link does this); a peer death is detected by the TCP
+// control channel exactly as before and tears the lane down with the
+// link. The segment file is unlinked by the JOINER the moment it maps
+// (leak-proof: after that the name cannot outlive the two mappings); the
+// creator unlinks at teardown if the joiner never arrived.
+namespace stshm {
+
+constexpr uint64_t kMagic = 0x535453484D313400ull;  // "STSHM14\0"
+constexpr uint32_t kVersion = 1;
+constexpr uint32_t kRecHdr = 12;  // u32 len + u64 sseq
+// SWITCH marker length value (unstriped links): above kMaxPayload, so it
+// can never collide with a real frame length.
+constexpr uint32_t kShmSwitchLen = 0xFFFFFFFDu;
+constexpr int kSpins = 2000;  // spin-before-sleep iterations
+
+inline int futex_wait(std::atomic<uint32_t>* w, uint32_t val,
+                      long timeout_ms) {
+  timespec ts;
+  ts.tv_sec = timeout_ms / 1000;
+  ts.tv_nsec = (timeout_ms % 1000) * 1000000L;
+  // non-PRIVATE futex: the word lives in a shared mapping, the waiter and
+  // waker are different processes
+  return (int)syscall(SYS_futex, (uint32_t*)w, FUTEX_WAIT, val, &ts,
+                      nullptr, 0);
+}
+
+inline void futex_wake_all(std::atomic<uint32_t>* w) {
+  syscall(SYS_futex, (uint32_t*)w, FUTEX_WAKE, INT32_MAX, nullptr, nullptr,
+          0);
+}
+
+// One direction's control block. head/tail are BYTE positions (monotonic
+// u64; offset = pos % ring_bytes). head_seq/tail_seq are the futex words
+// (bumped on every publish/consume). *_waiting gates the wake syscall so
+// the uncontended fast path never enters the kernel.
+struct alignas(64) RingCtl {
+  std::atomic<uint64_t> head;
+  std::atomic<uint32_t> head_seq;
+  std::atomic<uint32_t> rd_waiting;
+  char pad0[64 - 16];
+  std::atomic<uint64_t> tail;
+  std::atomic<uint32_t> tail_seq;
+  std::atomic<uint32_t> wr_waiting;
+  char pad1[64 - 16];
+};
+static_assert(sizeof(RingCtl) == 128, "two cachelines, no false sharing");
+
+// Segment header (one page); ring data follows at kDataOff and
+// kDataOff + ring_bytes. ring[0] carries creator->joiner, ring[1]
+// joiner->creator.
+struct Hdr {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t ring_bytes;
+  uint64_t token;
+  std::atomic<uint32_t> joined;  // joiner stores 1 after validating
+  std::atomic<uint32_t> closed;  // either side stores 1 at teardown
+  char pad[128 - 32];
+  RingCtl ring[2];
+};
+constexpr size_t kDataOff = 4096;
+static_assert(sizeof(Hdr) <= kDataOff, "header fits the first page");
+static_assert(std::atomic<uint64_t>::is_always_lock_free &&
+                  std::atomic<uint32_t>::is_always_lock_free,
+              "cross-process atomics must be lock-free");
+
+// One mapped lane attached to a Link. tx/rx pick the direction by role.
+struct Lane {
+  Hdr* hdr = nullptr;
+  uint8_t* data[2] = {nullptr, nullptr};
+  size_t map_len = 0;
+  uint32_t ring_bytes = 0;
+  int creator = 0;  // 1 = we created (tx on ring[0]), 0 = joined (ring[1])
+  std::string name;  // /dev/shm basename (creator keeps it for unlink)
+  std::atomic<bool> marker_sent{false};  // unstriped: SWITCH written (tx)
+  std::atomic<bool> rx_go{false};  // delivery enabled (striped: at map)
+  std::atomic<bool> ev_emitted{false};
+  // The ring is SPSC; the single writer is normally the lowest live
+  // stripe's sender thread. During a stripe death the writer role
+  // PROMOTES to the next live stripe, and the old and new writer can
+  // briefly overlap — tx_mu serializes whole records across that window
+  // (uncontended in steady state; record order across writers is
+  // reassembled by stripe seq exactly like socket stripes). Guards the
+  // tx ring's head position and record integrity; a leaf in the lock
+  // hierarchy (nothing is acquired under it).
+  StMutex tx_mu;
+  // lane counters (st_node_shm_stats; bytes/frames also fold into the
+  // link's existing wire counters so the taxonomy holds across lanes)
+  std::atomic<uint64_t> msgs_out{0}, msgs_in{0};
+  std::atomic<uint64_t> bytes_out{0}, bytes_in{0};
+  std::atomic<uint64_t> tx_waits{0}, rx_waits{0};
+
+  RingCtl& tx_ctl() { return hdr->ring[creator ? 0 : 1]; }
+  RingCtl& rx_ctl() { return hdr->ring[creator ? 1 : 0]; }
+  uint8_t* tx_data() { return data[creator ? 0 : 1]; }
+  uint8_t* rx_data() { return data[creator ? 1 : 0]; }
+
+  // tx is live once both sides are mapped (the joiner publishes
+  // hdr->joined; for the joiner itself that is immediate)
+  bool tx_ready() {
+    return hdr && hdr->closed.load(std::memory_order_relaxed) == 0 &&
+           hdr->joined.load(std::memory_order_acquire) != 0;
+  }
+
+  void close_and_wake() {
+    if (!hdr) return;
+    hdr->closed.store(1, std::memory_order_release);
+    for (int i = 0; i < 2; i++) {
+      futex_wake_all(&hdr->ring[i].head_seq);
+      futex_wake_all(&hdr->ring[i].tail_seq);
+    }
+  }
+
+  ~Lane() {
+    if (hdr) {
+      if (creator && hdr->joined.load(std::memory_order_relaxed) == 0 &&
+          !name.empty()) {
+        // joiner never arrived: reclaim the name (the joiner unlinks on a
+        // successful map — see st_node_shm_join)
+        std::string p = "/dev/shm/" + name;
+        ::unlink(p.c_str());
+      }
+      ::munmap((void*)hdr, map_len);
+    }
+  }
+};
+
+// Non-temporal bulk copy INTO the ring: the destination is only ever
+// read by the PEER process (another core, through L3/DRAM), so regular
+// stores waste a full read-for-ownership stream on bytes we will never
+// look at — at 4 MiB messages that is a third of the copy's memory
+// traffic. Weakly-ordered NT stores REQUIRE an sfence before the head
+// publish (shm_write_record does it); the scalar head/tail protocol is
+// untouched.
+inline void nt_copy(uint8_t* dst, const uint8_t* src, size_t n) {
+#if defined(__x86_64__) && defined(__SSE2__)
+  if (n >= 256) {
+    // align dst to 16 for the streaming stores
+    size_t head = ((uintptr_t)dst & 15) ? 16 - ((uintptr_t)dst & 15) : 0;
+    if (head) {
+      std::memcpy(dst, src, head);
+      dst += head;
+      src += head;
+      n -= head;
+    }
+    while (n >= 64) {
+      __m128i a, b, c, d;
+      std::memcpy(&a, src, 16);
+      std::memcpy(&b, src + 16, 16);
+      std::memcpy(&c, src + 32, 16);
+      std::memcpy(&d, src + 48, 16);
+      _mm_stream_si128((__m128i*)dst, a);
+      _mm_stream_si128((__m128i*)(dst + 16), b);
+      _mm_stream_si128((__m128i*)(dst + 32), c);
+      _mm_stream_si128((__m128i*)(dst + 48), d);
+      dst += 64;
+      src += 64;
+      n -= 64;
+    }
+  }
+#endif
+  std::memcpy(dst, src, n);
+}
+
+// wrap-aware copies between a ring's data area and a flat buffer
+inline void ring_put(uint8_t* base, uint32_t rb, uint64_t pos,
+                     const uint8_t* src, size_t n) {
+  size_t off = (size_t)(pos % rb);
+  size_t first = std::min(n, (size_t)rb - off);
+  nt_copy(base + off, src, first);
+  if (n > first) nt_copy(base, src + first, n - first);
+}
+
+inline void ring_get(const uint8_t* base, uint32_t rb, uint64_t pos,
+                     uint8_t* dst, size_t n) {
+  size_t off = (size_t)(pos % rb);
+  size_t first = std::min(n, (size_t)rb - off);
+  std::memcpy(dst, base + off, first);
+  if (n > first) std::memcpy(dst + first, base, n - first);
+}
+
+}  // namespace stshm
+
 namespace {
 
 using Clock = std::chrono::steady_clock;
@@ -324,10 +555,13 @@ constexpr int kMaxStripes = 8;
 // backpressure that bounds reassembly memory (a dead stripe holding the
 // window closed is eventually killed by its liveness timeout).
 constexpr uint64_t kReorderWindow = 4096;
-// Messages coalesced into one writev on the clean send path (faults and
-// pacing off): amortizes the syscall + wakeup cost across messages the
-// way the engine's bursts amortize framing.
-constexpr int kCoalesce = 8;
+// Messages coalesced into ONE kernel crossing on the clean send path
+// (faults and pacing off): r11 gathered up to 8 into a single writev;
+// r14 widens the batch and submits it as one sendmmsg — each queued
+// message keeps its own mmsghdr (header + payload iovecs, borrowed ring
+// slots included, no copies), so partial completion is handled
+// per-message instead of by re-walking one flat iovec window.
+constexpr int kCoalesce = 16;
 
 // ---- fault injection (env-gated hook table; comm/faults.py to_env) -------
 //
@@ -648,6 +882,12 @@ struct Link {
   uint64_t fault_rng ST_GUARDED_BY(fault_mu) = 0;
   // data frames seen at this wire boundary
   int64_t fault_frames ST_GUARDED_BY(fault_mu) = 0;
+  // r14 same-host shm lane (stshm::Lane), set ONCE under Node::mu by
+  // st_node_shm_serve/join and read lock-free everywhere after (the
+  // pointer never changes once non-null; the Lane's own fields are
+  // atomics or written before publication). Freed by ~Link, which runs
+  // only after every I/O thread dropped its shared_ptr.
+  std::atomic<stshm::Lane*> shm{nullptr};
 
   Link(size_t qdepth)
       : sendq(qdepth),
@@ -656,11 +896,15 @@ struct Link {
         rx_pool(qdepth + 2) {
     for (auto& f : stripe_fd) f.store(-1, std::memory_order_relaxed);
   }
+  ~Link() { delete shm.load(std::memory_order_acquire); }
 };
 
 struct Node;
 void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx);
 void link_receiver_loop(Node* node, std::shared_ptr<Link> link, int sidx);
+void shm_rx_loop(Node* node, std::shared_ptr<Link> link);
+bool deliver_striped(Node* node, const std::shared_ptr<Link>& link,
+                     uint64_t sseq, std::vector<uint8_t>&& frame);
 void listener_loop(Node* node, int listen_fd);
 void rejoin_loop(Node* node);
 
@@ -719,6 +963,16 @@ struct Node {
   std::atomic<uint64_t> tx_acquires{0}, tx_pool_misses{0};
   std::atomic<uint64_t> rx_acquires{0}, rx_pool_misses{0};
   std::atomic<uint64_t> zc_msgs{0};  // zero-copy (borrowed) sends enqueued
+
+  // r14 zero-copy receive loans (st_node_recv_zc): the popped rx buffer
+  // parks here, keyed by link id, until the NEXT recv_zc/recv_done on the
+  // same link releases it — so the borrowed pointer stays valid even if
+  // the Link itself is torn down mid-parse. Loans live on the NODE (not
+  // the Link) precisely for that teardown window. loan_mu is a leaf
+  // (nothing acquired under it); it is taken sequentially with mu, never
+  // nested.
+  StMutex loan_mu;
+  std::map<int32_t, std::vector<uint8_t>> loans ST_GUARDED_BY(loan_mu);
 
   void notify_data() ST_EXCLUDES(data_mu) {
     {
@@ -887,6 +1141,11 @@ void kill_link(Node* node, std::shared_ptr<Link> link) {
   if (!was_alive) return;
   for (int i = 0; i < link->nstripes; i++)
     if (link->stripe_fd[i] >= 0) ::shutdown(link->stripe_fd[i], SHUT_RDWR);
+  // shm lane down with the link: mark the segment closed and futex-wake
+  // both rings so a blocked peer writer/reader (and our own shm threads)
+  // observe the death instead of sleeping out their timeout slices
+  if (stshm::Lane* sl = link->shm.load(std::memory_order_acquire))
+    sl->close_and_wake();
   link->sendq.close();
   link->recvq.close();
   {
@@ -947,6 +1206,349 @@ void requeue_msg(Node* node, const std::shared_ptr<Link>& link,
   }
 }
 
+// ---- r14 shm lane I/O ----------------------------------------------------
+
+// Write one [u32 len][u64 sseq][payload] record into the link's shm tx
+// ring, streaming payload chunks as the reader frees space (a message
+// larger than the ring flows through it). While blocked on a full ring,
+// keepalives are injected on the TCP control socket so the lane's
+// backpressure never reads as link silence at the peer's liveness timer.
+// Returns false when the link/segment died mid-write.
+bool shm_write_record(Node* node, const std::shared_ptr<Link>& link,
+                      stshm::Lane* sl, int fd, uint64_t sseq,
+                      const uint8_t* payload, size_t len)
+    ST_EXCLUDES(sl->tx_mu) {
+  StLockGuard wlk(sl->tx_mu);  // writer-promotion window (Lane::tx_mu)
+  stshm::RingCtl& rc = sl->tx_ctl();
+  uint8_t* base = sl->tx_data();
+  const uint32_t rb = sl->ring_bytes;
+  uint64_t head = rc.head.load(std::memory_order_relaxed);
+  auto last_ka = Clock::now();
+
+  auto push_bytes = [&](const uint8_t* src, size_t n) -> bool {
+    while (n > 0) {
+      if (!link->alive || node->closing ||
+          sl->hdr->closed.load(std::memory_order_relaxed))
+        return false;
+      uint64_t tail = rc.tail.load(std::memory_order_acquire);
+      size_t free_b = (size_t)rb - (size_t)(head - tail);
+      if (free_b == 0) {
+        // spin-before-sleep, then a BOUNDED futex nap (teardown works by
+        // waking these words, but the bound means a lost wake costs
+        // 100 ms, never a hang)
+        bool moved = false;
+        for (int s = 0; s < stshm::kSpins; s++) {
+          if (rc.tail.load(std::memory_order_acquire) != tail) {
+            moved = true;
+            break;
+          }
+#if defined(__x86_64__)
+          __builtin_ia32_pause();
+#endif
+        }
+        if (!moved) {
+          sl->tx_waits.fetch_add(1, std::memory_order_relaxed);
+          uint32_t seq = rc.tail_seq.load(std::memory_order_acquire);
+          rc.wr_waiting.fetch_add(1, std::memory_order_seq_cst);
+          if (rc.tail.load(std::memory_order_acquire) == tail)
+            stshm::futex_wait(&rc.tail_seq, seq, 100);
+          rc.wr_waiting.fetch_sub(1, std::memory_order_relaxed);
+          auto now = Clock::now();
+          if (std::chrono::duration<double>(now - last_ka).count() >=
+              node->cfg.keepalive_sec) {
+            uint8_t z[4] = {0, 0, 0, 0};
+            if (!write_full(fd, z, 4)) return false;
+            link->bytes_out += 4;
+            last_ka = now;
+          }
+        }
+        continue;
+      }
+      size_t c = std::min(free_b, n);
+      stshm::ring_put(base, rb, head, src, c);
+      head += c;
+      src += c;
+      n -= c;
+#if defined(__x86_64__) && defined(__SSE2__)
+      _mm_sfence();  // NT stores must drain before the head publish
+#endif
+      rc.head.store(head, std::memory_order_release);
+      rc.head_seq.fetch_add(1, std::memory_order_seq_cst);
+      if (rc.rd_waiting.load(std::memory_order_seq_cst))
+        stshm::futex_wake_all(&rc.head_seq);
+    }
+    return true;
+  };
+
+  uint8_t hdr[stshm::kRecHdr];
+  uint32_t l32 = (uint32_t)len;
+  std::memcpy(hdr, &l32, 4);
+  std::memcpy(hdr + 4, &sseq, 8);
+  // fast path: the whole record fits the free span — ONE publish (and at
+  // most one wake) instead of separate header/payload publishes, so the
+  // reader wakes once per record, not once per part
+  {
+    uint64_t tail = rc.tail.load(std::memory_order_acquire);
+    if ((size_t)rb - (size_t)(head - tail) >= stshm::kRecHdr + len) {
+      stshm::ring_put(base, rb, head, hdr, stshm::kRecHdr);
+      if (len > 0)
+        stshm::ring_put(base, rb, head + stshm::kRecHdr, payload, len);
+      head += stshm::kRecHdr + len;
+#if defined(__x86_64__) && defined(__SSE2__)
+      _mm_sfence();  // NT stores must drain before the head publish
+#endif
+      rc.head.store(head, std::memory_order_release);
+      rc.head_seq.fetch_add(1, std::memory_order_seq_cst);
+      if (rc.rd_waiting.load(std::memory_order_seq_cst))
+        stshm::futex_wake_all(&rc.head_seq);
+      return true;
+    }
+  }
+  if (!push_bytes(hdr, stshm::kRecHdr)) return false;
+  if (len > 0 && !push_bytes(payload, len)) return false;
+  return true;
+}
+
+// Drain the link's shm rx ring. Records re-enter the EXACT delivery path
+// the sockets use — striped links through the sseq reassembly window
+// (TCP in-flights and ring records interleave correctly), unstriped
+// straight into recvq in ring order, gated on the SWITCH marker
+// (Lane::rx_go). Exits — and tears the link down, idempotently — on
+// teardown or a corrupt record.
+void shm_rx_loop(Node* node, std::shared_ptr<Link> link) {
+  stshm::Lane* sl = link->shm.load(std::memory_order_acquire);
+  stshm::RingCtl& rc = sl->rx_ctl();
+  const uint8_t* base = sl->rx_data();
+  const uint32_t rb = sl->ring_bytes;
+  uint64_t tail = rc.tail.load(std::memory_order_relaxed);
+  const bool striped = link->nstripes > 1;
+
+  // A served lane whose joiner never validates (boot-id collision, map
+  // failure — the documented keep-TCP fallback) must not cost a polling
+  // thread and a parked segment for the link's lifetime: past this
+  // deadline the creator closes the lane (tx can never activate on a
+  // closed header — a straggler joiner just stays on TCP too), reclaims
+  // the segment name, and this thread exits. 30 s dwarfs any legitimate
+  // join handshake.
+  const auto orphan_deadline = Clock::now() + std::chrono::seconds(30);
+  auto orphan_expired = [&]() -> bool {
+    return sl->creator != 0 &&
+           sl->hdr->joined.load(std::memory_order_acquire) == 0 &&
+           Clock::now() > orphan_deadline;
+  };
+
+  auto wait_avail = [&](size_t need) -> bool {
+    while (link->alive && !node->closing) {
+      if (orphan_expired()) return false;
+      uint64_t head = rc.head.load(std::memory_order_acquire);
+      if (head - tail >= need) return true;
+      if (sl->hdr->closed.load(std::memory_order_relaxed))
+        return false;  // checked AFTER head: drain what was published
+      bool moved = false;
+      for (int s = 0; s < stshm::kSpins; s++) {
+        if (rc.head.load(std::memory_order_acquire) != head) {
+          moved = true;
+          break;
+        }
+#if defined(__x86_64__)
+      __builtin_ia32_pause();
+#endif
+      }
+      if (moved) continue;
+      sl->rx_waits.fetch_add(1, std::memory_order_relaxed);
+      uint32_t seq = rc.head_seq.load(std::memory_order_acquire);
+      rc.rd_waiting.fetch_add(1, std::memory_order_seq_cst);
+      if (rc.head.load(std::memory_order_acquire) == head)
+        stshm::futex_wait(&rc.head_seq, seq, 100);
+      rc.rd_waiting.fetch_sub(1, std::memory_order_relaxed);
+    }
+    return false;
+  };
+  auto consume = [&](size_t n) {
+    tail += n;
+    rc.tail.store(tail, std::memory_order_release);
+    rc.tail_seq.fetch_add(1, std::memory_order_seq_cst);
+    if (rc.wr_waiting.load(std::memory_order_seq_cst))
+      stshm::futex_wake_all(&rc.tail_seq);
+  };
+
+  // Optional delivery coalescing (ST_SHM_COALESCE_US, default OFF): hold
+  // delivery until a few COMPLETE records are present or the window
+  // expires, then deliver back-to-back. Measured on this box it LOSES —
+  // the steady state is a closed loop paced by the go-back-N window, so
+  // any delivery delay delays ACKs and stalls the producer (65 Ki:
+  // 23.3 k f/s at hold 0 vs 19.4 k at 5 ms) — but the lever is the
+  // first thing to re-try on a box where consumer-side pass amortization
+  // dominates, so it stays env-gated rather than deleted.
+  static const uint64_t kHoldNs = [] {
+    const char* e = getenv("ST_SHM_COALESCE_US");
+    long us = e && *e ? atol(e) : 0;
+    if (us < 0) us = 0;
+    if (us > 50000) us = 50000;
+    return (uint64_t)us * 1000u;
+  }();
+  constexpr int kHoldMsgs = 4;
+  // complete records currently in the ring (capped at kHoldMsgs); walks
+  // record headers ahead of `tail` without consuming
+  auto complete_records = [&]() -> int {
+    uint64_t head = rc.head.load(std::memory_order_acquire);
+    uint64_t pos = tail;
+    int cnt = 0;
+    while (cnt < kHoldMsgs && pos + stshm::kRecHdr <= head) {
+      uint8_t lh[4];
+      stshm::ring_get(base, rb, pos, lh, 4);
+      uint32_t l;
+      std::memcpy(&l, lh, 4);
+      if (l > kMaxPayload) return cnt + 1;  // corrupt: let delivery red it
+      if (pos + stshm::kRecHdr + l > head) break;
+      cnt++;
+      pos += stshm::kRecHdr + l;
+    }
+    return cnt;
+  };
+  // read + deliver ONE record; 0 = delivered, 1 = teardown, 2 = corrupt
+  auto deliver_one = [&]() -> int {
+    if (!wait_avail(stshm::kRecHdr)) return 1;
+    uint8_t h[stshm::kRecHdr];
+    stshm::ring_get(base, rb, tail, h, stshm::kRecHdr);
+    uint32_t len;
+    uint64_t sseq;
+    std::memcpy(&len, h, 4);
+    std::memcpy(&sseq, h + 4, 8);
+    if (len > kMaxPayload) return 2;  // corrupt ring
+    consume(stshm::kRecHdr);
+    bool hit = false;
+    std::vector<uint8_t> frame = link->rx_pool.get(&hit);
+    node->rx_acquires++;
+    if (!hit) node->rx_pool_misses++;
+    frame.resize(len);
+    size_t got = 0;
+    while (got < len) {
+      if (!wait_avail(1)) return 1;  // mid-record teardown
+      uint64_t head = rc.head.load(std::memory_order_acquire);
+      size_t n = std::min((size_t)(head - tail), len - got);
+      stshm::ring_get(base, rb, tail, frame.data() + got, n);
+      got += n;
+      consume(n);
+    }
+    link->bytes_in += stshm::kRecHdr + len;
+    link->frames_in++;
+    sl->msgs_in.fetch_add(1, std::memory_order_relaxed);
+    sl->bytes_in.fetch_add(stshm::kRecHdr + len, std::memory_order_relaxed);
+    if (striped) {
+      if (!deliver_striped(node, link, sseq, std::move(frame))) return 1;
+      return 0;
+    }
+    while (link->alive && !node->closing) {
+      if (link->recvq.push(std::move(frame), 0.5)) {
+        node->notify_data();
+        return 0;
+      }
+    }
+    return 1;
+  };
+
+  bool clean = false;
+  while (link->alive && !node->closing) {
+    if (orphan_expired()) {
+      clean = true;  // the LINK stays up on TCP; only the lane dies
+      break;
+    }
+    if (!sl->rx_go.load(std::memory_order_acquire)) {
+      // unstriped pre-marker window: records may already sit in the ring;
+      // they wait for the marker's in-stream ordering point
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+      continue;
+    }
+    if (!wait_avail(stshm::kRecHdr)) {
+      clean = true;
+      break;
+    }
+    int avail = complete_records();
+    if (kHoldNs > 0 && avail >= 1 && avail < kHoldMsgs) {
+      uint64_t t0 = stobs::now_ns();
+      while (avail < kHoldMsgs && link->alive && !node->closing &&
+             !sl->hdr->closed.load(std::memory_order_relaxed) &&
+             stobs::now_ns() - t0 < kHoldNs) {
+        uint32_t seq = rc.head_seq.load(std::memory_order_acquire);
+        uint64_t h0 = rc.head.load(std::memory_order_acquire);
+        rc.rd_waiting.fetch_add(1, std::memory_order_seq_cst);
+        if (rc.head.load(std::memory_order_acquire) == h0)
+          stshm::futex_wait(&rc.head_seq, seq, 1);
+        rc.rd_waiting.fetch_sub(1, std::memory_order_relaxed);
+        avail = complete_records();
+      }
+    }
+    if (avail < 1) avail = 1;  // first record still streaming: deliver now
+    int rcod = 0;
+    for (int r = 0; r < avail && rcod == 0; r++) rcod = deliver_one();
+    if (rcod == 1) {
+      clean = true;
+      break;
+    }
+    if (rcod == 2) break;  // corrupt ring: kill the link below
+  }
+  if (orphan_expired()) {
+    // never joined: close the lane (tx can then never activate on
+    // either side) and reclaim the segment name now, not at link death
+    sl->close_and_wake();
+    if (!sl->name.empty()) {
+      std::string p = "/dev/shm/" + sl->name;
+      ::unlink(p.c_str());  // ~Lane's retry sees ENOENT, harmless
+    }
+  }
+  if (!clean && link->alive && !node->closing) {
+    // corrupt record length: the lane is unusable — tear the whole link
+    // down (idempotent) so go-back-N recovers on a fresh link
+    kill_link(node, link);
+  }
+  node->notify_data();  // wake blocked consumers to observe any death
+  --node->active_threads;
+}
+
+// Submit nm stream messages with as few sendmmsg calls as possible. On a
+// blocking socket each sendmsg completes fully except when interrupted by
+// a signal mid-copy — the sender threads block ALL signals precisely so
+// that cannot happen; the last completed message still gets a
+// finish-the-remainder writev as belt-and-braces, and a short write on
+// any EARLIER message of a batch (impossible with signals blocked) is a
+// sheared stream — fail the link rather than continue it.
+bool sendmmsg_full(int fd, struct mmsghdr* mm, int nm) {
+  int done = 0;
+  while (done < nm) {
+    int r = ::sendmmsg(fd, mm + done, (unsigned)(nm - done), 0);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (r == 0) return false;
+    for (int i = done; i < done + r; i++) {
+      struct msghdr* mh = &mm[i].msg_hdr;
+      size_t total = 0;
+      for (size_t v = 0; v < mh->msg_iovlen; v++)
+        total += mh->msg_iov[v].iov_len;
+      size_t sent = mm[i].msg_len;
+      if (sent == total) continue;
+      if (i != done + r - 1) return false;  // sheared mid-batch: kill link
+      struct iovec* iov = mh->msg_iov;
+      int cnt = (int)mh->msg_iovlen;
+      size_t n = sent;
+      while (cnt > 0 && n >= iov->iov_len) {
+        n -= iov->iov_len;
+        iov++;
+        cnt--;
+      }
+      if (cnt > 0) {
+        iov->iov_base = (uint8_t*)iov->iov_base + n;
+        iov->iov_len -= n;
+        if (!writev_full(fd, iov, cnt)) return false;
+      }
+    }
+    done += r;
+  }
+  return true;
+}
+
 void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
   const bool striped = link->nstripes > 1;
   const int fd = link->stripe_fd[sidx];
@@ -958,8 +1560,59 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
       node->cfg.bandwidth_cap_bps / (striped ? link->nstripes : 1);
   const FaultPlan& fp = node->cfg.fault;
 
+  // sendmmsg shear guard (see sendmmsg_full): a signal landing mid-sendmsg
+  // could short-write one message of a batch; these detached I/O threads
+  // never run Python signal handlers anyway (CPython delivers to the main
+  // thread), so block everything here.
+  {
+    sigset_t all;
+    sigfillset(&all);
+    pthread_sigmask(SIG_BLOCK, &all, nullptr);
+  }
   OutMsg msg;
   while (link->alive && link->stripe_ok[sidx].load() && !node->closing) {
+    // r14 shm lane: once live, the lane's single writer is the
+    // lowest-index LIVE stripe's sender (promotes on stripe death;
+    // Lane::tx_mu covers the brief overlap); every other stripe sender
+    // stops popping data and only keeps its socket's liveness flowing —
+    // TCP stays the control/teardown channel.
+    stshm::Lane* sl = node->cfg.wire_compat
+                          ? nullptr
+                          : link->shm.load(std::memory_order_acquire);
+    const bool shm_tx = sl != nullptr && sl->tx_ready();
+    if (shm_tx) {
+      int wr = 0;
+      while (wr < link->nstripes && !link->stripe_ok[wr].load()) wr++;
+      if (wr != sidx) {
+        // short-sliced idle so a writer-stripe death PROMOTES promptly
+        // (one uninterruptible keepalive_sec nap here froze the data
+        // plane for up to ~1 s per writer death); the keepalive itself
+        // still flows at keepalive cadence
+        auto ka_deadline =
+            Clock::now() +
+            std::chrono::duration<double>(node->cfg.keepalive_sec);
+        bool promoted = false;
+        while (Clock::now() < ka_deadline) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(20));
+          if (!link->alive || node->closing ||
+              !link->stripe_ok[sidx].load())
+            break;
+          int w2 = 0;
+          while (w2 < link->nstripes && !link->stripe_ok[w2].load()) w2++;
+          if (w2 == sidx) {
+            promoted = true;  // the writer role fell to us: resume popping
+            break;
+          }
+        }
+        if (!link->alive || node->closing || !link->stripe_ok[sidx].load())
+          break;
+        if (promoted) continue;
+        uint8_t z[4] = {0, 0, 0, 0};
+        if (!write_full(fd, z, 4)) break;
+        link->bytes_out += 4;
+        continue;
+      }
+    }
     bool have = link->sendq.pop(&msg, node->cfg.keepalive_sec);
     if (!link->alive || node->closing) break;
     if (!link->stripe_ok[sidx].load()) {
@@ -1123,11 +1776,61 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
         tokens -= (double)msg.size();
       }
     }
-    // ---- batched submission (r11): on the clean native path (no fault
-    // plan, no pacing) opportunistically gather more queued messages and
-    // put the whole batch on the wire in ONE writev — length prefixes,
-    // stripe seqs and payloads (borrowed ring slots included) gather
-    // without copies, amortizing the syscall/wakeup cost per message.
+    // ---- r14 shm lane send path: the message's bytes go straight from
+    // the borrowed tx slot (or owned buffer) into the ring record — the
+    // zero-copy TxSlot handoff into shm; the fault injector above already
+    // ran PER MESSAGE (runt/corrupt/dup/stall/sever), exactly as on the
+    // TCP lanes, so chaos coverage is lane-independent.
+    if (shm_tx) {
+      if (!striped && !sl->marker_sent.exchange(true)) {
+        // SWITCH marker: the last data-plane byte this link sends on TCP
+        // — the receiver enables ring delivery at exactly this point in
+        // the stream (striped links need no marker: ring records carry
+        // stripe seqs into the same reassembly window as the sockets)
+        uint8_t mk[4];
+        uint32_t ml = stshm::kShmSwitchLen;
+        std::memcpy(mk, &ml, 4);
+        if (!write_full(fd, mk, 4)) break;
+        link->bytes_out += 4;
+      }
+      if (!sl->ev_emitted.exchange(true))
+        st_obs_emit(node->obs_id, stobs::kEvShmLaneUp, link->id,
+                    (uint64_t)sl->ring_bytes);
+      bool sok = true;
+      for (int rep = 0; rep < write_reps && sok; rep++) {
+        uint64_t sq = msg.sseq;
+        size_t wl = write_len;
+        if (rep > 0) {
+          // injected duplicate: a NEW transport message (fresh stripe
+          // seq) carrying the same engine payload, like the TCP path
+          sq = link->sseq_next.fetch_add(1, std::memory_order_relaxed);
+          wl = msg.size();
+        }
+        sok = shm_write_record(node, link, sl, fd, sq, msg.data(), wl);
+        if (sok) {
+          sl->msgs_out.fetch_add(1, std::memory_order_relaxed);
+          sl->bytes_out.fetch_add(stshm::kRecHdr + wl,
+                                  std::memory_order_relaxed);
+        }
+      }
+      if (!sok) break;  // lane/link died mid-write: normal teardown path
+      link->frames_out += 1;
+      link->bytes_out += msg.size() + stshm::kRecHdr;
+      if (msg.release) {
+        msg.reset();
+      } else if (msg.owned.capacity()) {
+        link->tx_pool.put(std::move(msg.owned));
+        msg.owned = std::vector<uint8_t>();
+      }
+      continue;
+    }
+    // ---- batched submission (r11 writev -> r14 sendmmsg): on the clean
+    // native path (no fault plan, no pacing) opportunistically gather
+    // more queued messages and put the whole batch through ONE kernel
+    // crossing — each message keeps its own mmsghdr (length prefix,
+    // stripe seq and payload iovecs; borrowed ring slots gather without
+    // copies), so the syscall/wakeup cost amortizes across the batch and
+    // partial completion stays per-message (sendmmsg_full).
     OutMsg batch[kCoalesce];
     int nb = 1;
     batch[0] = std::move(msg);
@@ -1142,7 +1845,9 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
       // striped framing: [u32 len][u64 sseq][payload]; legacy: [len][..]
       uint8_t hdrs[2 * kCoalesce][12];
       struct iovec iov[4 * kCoalesce];
-      int niov = 0, nh = 0;
+      struct mmsghdr mm[2 * kCoalesce];
+      std::memset(mm, 0, sizeof mm);
+      int niov = 0, nh = 0, nm = 0;
       for (int rep = 0; rep < write_reps; rep++) {
         for (int i = 0; i < nb; i++) {
           size_t wl = i == 0 ? write_len : batch[i].size();
@@ -1162,6 +1867,7 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
             std::memcpy(H + 4, &sq, 8);
             hlen = 12;
           }
+          int first = niov;
           iov[niov].iov_base = H;
           iov[niov].iov_len = hlen;
           niov++;
@@ -1170,9 +1876,12 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
             iov[niov].iov_len = wl;
             niov++;
           }
+          mm[nm].msg_hdr.msg_iov = &iov[first];
+          mm[nm].msg_hdr.msg_iovlen = (size_t)(niov - first);
+          nm++;
         }
       }
-      ok = writev_full(fd, iov, niov);
+      ok = sendmmsg_full(fd, mm, nm);
     }
     if (ok) {
       for (int i = 0; i < nb; i++) {
@@ -1301,6 +2010,17 @@ void link_receiver_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
       if (!read_full(fd, hdr, 4)) break;
       uint32_t len = (uint32_t)hdr[0] | ((uint32_t)hdr[1] << 8) |
                      ((uint32_t)hdr[2] << 16) | ((uint32_t)hdr[3] << 24);
+      if (len == stshm::kShmSwitchLen) {
+        // r14 SWITCH marker (unstriped shm lane): every data message
+        // before this point arrived on TCP in order; everything after is
+        // in the ring — enable ring delivery at exactly this point. Only
+        // ever sent after a successful shm attach, so a pre-r14 peer can
+        // never see it.
+        if (stshm::Lane* msl = link->shm.load(std::memory_order_acquire))
+          msl->rx_go.store(true, std::memory_order_release);
+        link->rx_pool.put(std::move(frame));
+        continue;
+      }
       if (len > kMaxPayload) break;  // protocol violation
       if (len == 0) {                // keepalive (no stripe seq)
         link->rx_pool.put(std::move(frame));
@@ -2014,6 +2734,73 @@ int32_t st_node_recv(void* h, int32_t link_id, uint8_t* buf, int32_t cap,
   return n;
 }
 
+// Zero-copy receive (r14): like st_node_recv, but instead of copying into
+// the caller's buffer the popped rx buffer is LOANED — *out points at its
+// bytes and the return value is its length. The pointer stays valid until
+// the next st_node_recv_zc / st_node_recv_done on the same link (loans
+// live on the NODE, so a link torn down mid-parse cannot free them).
+// Exactly one loan per link; the native engine's receiver is the intended
+// caller (one message in hand at a time per link).
+int32_t st_node_recv_zc(void* h, int32_t link_id, const uint8_t** out,
+                        double timeout_sec) {
+  auto* node = (Node*)h;
+  *out = nullptr;
+  std::vector<uint8_t> prev;
+  {
+    StLockGuard lk(node->loan_mu);
+    auto it = node->loans.find(link_id);
+    if (it != node->loans.end()) {
+      prev = std::move(it->second);
+      node->loans.erase(it);
+    }
+  }
+  std::shared_ptr<Link> link;
+  {
+    StLockGuard lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it != node->links.end()) link = it->second;
+  }
+  if (prev.capacity() && link) link->rx_pool.put(std::move(prev));
+  if (!link) return -1;
+  std::vector<uint8_t> frame;
+  if (!link->recvq.pop(&frame, timeout_sec)) {
+    return link->alive ? 0 : -1;
+  }
+  int32_t n = (int32_t)frame.size();
+  {
+    StLockGuard lk(node->loan_mu);
+    auto& slot = node->loans[link_id];
+    slot = std::move(frame);
+    *out = slot.data();
+  }
+  return n;
+}
+
+// Release a link's outstanding recv_zc loan (recycling its buffer when
+// the link still exists). Call when done draining a link; harmless when
+// no loan is out.
+void st_node_recv_done(void* h, int32_t link_id) {
+  auto* node = (Node*)h;
+  if (!node) return;
+  std::vector<uint8_t> prev;
+  {
+    StLockGuard lk(node->loan_mu);
+    auto it = node->loans.find(link_id);
+    if (it != node->loans.end()) {
+      prev = std::move(it->second);
+      node->loans.erase(it);
+    }
+  }
+  if (!prev.capacity()) return;
+  std::shared_ptr<Link> link;
+  {
+    StLockGuard lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it != node->links.end()) link = it->second;
+  }
+  if (link) link->rx_pool.put(std::move(prev));
+}
+
 // r07 pool/zero-copy observability:
 // out[0..1] tx buffer acquires / misses (fresh allocations),
 // out[2..3] rx buffer acquires / misses, out[4] zero-copy sends enqueued.
@@ -2053,6 +2840,198 @@ int32_t st_node_stripe_stats(void* h, int32_t link_id, uint64_t* out4) {
                            : link->stripes_live.load());
   out4[2] = link->stripe_deaths.load();
   out4[3] = link->reroutes.load();
+  return 0;
+}
+
+// ---- r14 same-host shm lane ABI ------------------------------------------
+
+// CREATE the link's shm segment (the parent's half of the negotiated
+// attach): a /dev/shm file holding one header page + two rings of
+// ring_bytes each. Writes the segment basename into name_out and the
+// validation token into token_out; the peer passes both to
+// st_node_shm_join. The data plane switches lanes only once the joiner
+// has mapped and validated (Hdr::joined) — until then, and forever on
+// failure, the link keeps streaming on TCP. Returns 0, or -1 (bad
+// link/mode/state) / -2 (segment creation failed).
+int32_t st_node_shm_serve(void* h, int32_t link_id, int64_t ring_bytes,
+                          char* name_out, int32_t name_cap,
+                          uint64_t* token_out) {
+  auto* node = (Node*)h;
+  if (!node || node->cfg.wire_compat) return -1;
+  // a PER-STRIPE fault plan (only_stripe >= 0) is a TCP-striping
+  // diagnostic — the lane's single-writer data plane would mask it, so
+  // the chaos arm pins the link to TCP (link-wide fault classes apply on
+  // the lane writer and stay fully covered)
+  if (node->cfg.fault.enabled && node->cfg.fault.only_stripe >= 0)
+    return -1;
+  std::shared_ptr<Link> link;
+  {
+    StLockGuard lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it != node->links.end()) link = it->second;
+  }
+  if (!link || !link->alive ||
+      link->shm.load(std::memory_order_acquire) != nullptr)
+    return -1;
+  if (ring_bytes < (1 << 16)) ring_bytes = 1 << 16;
+  if (ring_bytes > (1 << 30)) ring_bytes = 1 << 30;
+  ring_bytes = (ring_bytes + 4095) & ~(int64_t)4095;
+
+  uint64_t tok;
+  {
+    StLockGuard lk(node->mu);
+    node->token_rng ^=
+        ((uint64_t)link_id << 32) * 0x9e3779b97f4a7c15ull + (uint64_t)getpid();
+    frand64(&node->token_rng);
+    tok = node->token_rng;
+  }
+  char name[96];
+  snprintf(name, sizeof name, "stshm-%d-%d-%016llx", (int)getpid(),
+           (int)link_id, (unsigned long long)tok);
+  if ((int32_t)strlen(name) + 1 > name_cap) return -1;
+  std::string path = std::string("/dev/shm/") + name;
+  int fd = ::open(path.c_str(), O_RDWR | O_CREAT | O_EXCL | O_CLOEXEC, 0600);
+  if (fd < 0) return -2;
+  size_t map_len = stshm::kDataOff + 2 * (size_t)ring_bytes;
+  if (::ftruncate(fd, (off_t)map_len) != 0) {
+    ::close(fd);
+    ::unlink(path.c_str());
+    return -2;
+  }
+  void* base =
+      ::mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) {
+    ::unlink(path.c_str());
+    return -2;
+  }
+  auto* hd = new (base) stshm::Hdr();  // placement-init the atomics
+  hd->magic = stshm::kMagic;
+  hd->version = stshm::kVersion;
+  hd->ring_bytes = (uint32_t)ring_bytes;
+  hd->token = tok;
+
+  auto* lane = new stshm::Lane();
+  lane->hdr = hd;
+  lane->data[0] = (uint8_t*)base + stshm::kDataOff;
+  lane->data[1] = (uint8_t*)base + stshm::kDataOff + (size_t)ring_bytes;
+  lane->map_len = map_len;
+  lane->ring_bytes = (uint32_t)ring_bytes;
+  lane->creator = 1;
+  lane->name = name;
+  // striped links reassemble by stripe seq, so ring delivery may start
+  // immediately; unstriped delivery waits for the in-stream SWITCH marker
+  lane->rx_go.store(link->nstripes > 1, std::memory_order_release);
+  link->shm.store(lane, std::memory_order_release);
+  node->active_threads += 1;
+  std::thread(shm_rx_loop, node, link).detach();
+  snprintf(name_out, (size_t)name_cap, "%s", name);
+  if (token_out) *token_out = tok;
+  return 0;
+}
+
+// JOIN the peer's shm segment by name+token (the child's half). On
+// success the segment name is immediately unlinked (it cannot outlive the
+// two mappings), Hdr::joined flips the creator's tx lane live, and this
+// side's tx activates at its sender's next pop. On ANY failure the link
+// keeps TCP and a shm_fallback event records why (arg: 1 open, 2 map,
+// 3 header/token mismatch).
+int32_t st_node_shm_join(void* h, int32_t link_id, const char* name,
+                         uint64_t token) {
+  auto* node = (Node*)h;
+  if (!node || node->cfg.wire_compat || !name) return -1;
+  // per-stripe chaos pins TCP on the joining side too (see shm_serve)
+  if (node->cfg.fault.enabled && node->cfg.fault.only_stripe >= 0)
+    return -1;
+  std::shared_ptr<Link> link;
+  {
+    StLockGuard lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it != node->links.end()) link = it->second;
+  }
+  if (!link || !link->alive ||
+      link->shm.load(std::memory_order_acquire) != nullptr)
+    return -1;
+  // the name is peer-supplied: confine it to our own flat namespace
+  if (strncmp(name, "stshm-", 6) != 0 || strchr(name, '/') != nullptr ||
+      strstr(name, "..") != nullptr || strlen(name) > 80) {
+    st_obs_emit(node->obs_id, stobs::kEvShmFallback, link_id, 3);
+    return -3;
+  }
+  std::string path = std::string("/dev/shm/") + name;
+  int fd = ::open(path.c_str(), O_RDWR | O_CLOEXEC);
+  if (fd < 0) {
+    st_obs_emit(node->obs_id, stobs::kEvShmFallback, link_id, 1);
+    return -1;
+  }
+  struct stat st;
+  if (::fstat(fd, &st) != 0 ||
+      (size_t)st.st_size < stshm::kDataOff + 2 * (1 << 16)) {
+    ::close(fd);
+    st_obs_emit(node->obs_id, stobs::kEvShmFallback, link_id, 2);
+    return -2;
+  }
+  size_t map_len = (size_t)st.st_size;
+  void* base =
+      ::mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) {
+    st_obs_emit(node->obs_id, stobs::kEvShmFallback, link_id, 2);
+    return -2;
+  }
+  auto* hd = (stshm::Hdr*)base;
+  if (hd->magic != stshm::kMagic || hd->version != stshm::kVersion ||
+      hd->token != token ||
+      stshm::kDataOff + 2 * (size_t)hd->ring_bytes != map_len) {
+    ::munmap(base, map_len);
+    st_obs_emit(node->obs_id, stobs::kEvShmFallback, link_id, 3);
+    return -3;
+  }
+  ::unlink(path.c_str());  // leak-proof: the name dies with this map
+
+  auto* lane = new stshm::Lane();
+  lane->hdr = hd;
+  lane->data[0] = (uint8_t*)base + stshm::kDataOff;
+  lane->data[1] = (uint8_t*)base + stshm::kDataOff + hd->ring_bytes;
+  lane->map_len = map_len;
+  lane->ring_bytes = hd->ring_bytes;
+  lane->creator = 0;
+  lane->rx_go.store(link->nstripes > 1, std::memory_order_release);
+  link->shm.store(lane, std::memory_order_release);
+  node->active_threads += 1;
+  std::thread(shm_rx_loop, node, link).detach();
+  // publish LAST: the creator's senders switch lanes on observing this
+  hd->joined.store(1, std::memory_order_release);
+  stshm::futex_wake_all(&hd->ring[0].head_seq);
+  return 0;
+}
+
+// r14 shm lane telemetry: out8[0] = lane state (0 = TCP only, 1 = segment
+// mapped, 2 = tx live), [1..2] = messages out/in over the lane, [3..4] =
+// lane bytes out/in (record headers included), [5] = ring bytes per
+// direction, [6..7] = tx/rx futex sleeps (the spin-before-sleep misses).
+// Returns -1 for an unknown link.
+int32_t st_node_shm_stats(void* h, int32_t link_id, uint64_t* out8) {
+  auto* node = (Node*)h;
+  for (int i = 0; i < 8; i++) out8[i] = 0;
+  if (!node) return -1;
+  std::shared_ptr<Link> link;
+  {
+    StLockGuard lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it == node->links.end()) return -1;
+    link = it->second;
+  }
+  stshm::Lane* sl = link->shm.load(std::memory_order_acquire);
+  if (!sl) return 0;
+  out8[0] = sl->tx_ready() ? 2 : 1;
+  out8[1] = sl->msgs_out.load();
+  out8[2] = sl->msgs_in.load();
+  out8[3] = sl->bytes_out.load();
+  out8[4] = sl->bytes_in.load();
+  out8[5] = (uint64_t)sl->ring_bytes;
+  out8[6] = sl->tx_waits.load();
+  out8[7] = sl->rx_waits.load();
   return 0;
 }
 

@@ -90,6 +90,10 @@ def load_engine() -> Optional[ctypes.CDLL]:
         lib.st_engine_link_allow_sign2.argtypes = [
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
         ]
+        lib.st_engine_link_wire_v3.restype = ctypes.c_int32
+        lib.st_engine_link_wire_v3.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ]
         lib.st_engine_link_precision.restype = ctypes.c_int32
         lib.st_engine_link_precision.argtypes = [
             ctypes.c_void_p, ctypes.c_int32,
@@ -401,6 +405,17 @@ class EngineTensor:
         stay 1-bit forever — the mixed-tree safety default."""
         if self._h:
             self._lib.st_engine_link_allow_sign2(
+                self._h, link_id, 1 if allow else 0
+            )
+
+    def link_wire_v3(self, link_id: int, allow: bool = True) -> None:
+        """r14: record that the peer on this link advertised the r14
+        capability (the SYNC/WELCOME shm flag), so emission to it may use
+        the aligned v3 framing — whose 24-byte header lets the receiver
+        apply frames straight from the wire body. Links without the call
+        stay on v2, the mixed-tree safety default."""
+        if self._h:
+            self._lib.st_engine_link_wire_v3(
                 self._h, link_id, 1 if allow else 0
             )
 

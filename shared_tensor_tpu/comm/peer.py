@@ -31,6 +31,8 @@ protocol for interop with C peers (SURVEY.md §2.3).
 from __future__ import annotations
 
 import logging
+import os
+import sys
 import threading
 import time
 from collections import deque
@@ -48,6 +50,32 @@ from . import faults, wire
 from .transport import EventKind, TransportNode
 
 log = logging.getLogger("shared_tensor_tpu.peer")
+
+_HOST_ID: Optional[bytes] = None
+
+
+def _shm_host_id() -> bytes:
+    """16-byte host identity for the r14 same-host shm-lane negotiation.
+    The Linux boot id is per-boot-unique ACROSS containers sharing a
+    kernel only when the container runtime namespaces it — but two
+    processes that CAN open the same /dev/shm path validate the segment
+    token anyway, so a boot-id collision can at worst cost one failed
+    attach (shm_fallback event) before the link keeps TCP."""
+    global _HOST_ID
+    if _HOST_ID is None:
+        try:
+            import uuid
+
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                _HOST_ID = uuid.UUID(f.read().strip()).bytes
+        except (OSError, ValueError):
+            import hashlib
+            import socket as _socket
+
+            _HOST_ID = hashlib.sha256(
+                _socket.gethostname().encode()
+            ).digest()[:16]
+    return _HOST_ID
 
 #: Pseudo-link id holding the re-graft carry as a LIVE slot in the Python
 #: tier's SharedTensor (the engine keeps its carry internally): a dead
@@ -510,6 +538,24 @@ class SharedTensorPeer:
         # r11 sign2 capability flags gathered during handshakes, consumed
         # at attach time (link id -> the peer advertised sign2 decode)
         self._peer_sign2: dict[int, bool] = {}
+        # r14 same-host shm lane: whether this peer may negotiate it at
+        # all, our host identity, and per-link whether the JOINER's SYNC
+        # advertised a matching host (consumed at WELCOME time, when the
+        # parent serves the segment). Negotiation is fail-safe — every
+        # mismatch keeps the link on TCP.
+        self._shm_ok = (
+            self.config.transport.shm_enabled
+            and not self.config.transport.wire_compat
+            and sys.platform.startswith("linux")
+            and os.path.isdir("/dev/shm")
+            and os.environ.get("ST_SHM", "1") != "0"
+        )
+        self._shm_host = _shm_host_id() if self._shm_ok else b""
+        self._peer_shm: dict[int, bool] = {}
+        # r14 capability per link (the peer advertised the SYNC/WELCOME
+        # shm flag at all — host match or not): gates the aligned v3
+        # framing toward it (engine.link_wire_v3)
+        self._peer_r14: dict[int, bool] = {}
         # replica state_version at each ranged link's last residual mask
         # (skip the full-table mask copy on idle passes)
         self._sub_mask_ver: dict[int, int] = {}
@@ -1589,6 +1635,24 @@ class SharedTensorPeer:
                 out["st_stripe_reroutes_total"] = (
                     out.get("st_stripe_reroutes_total", 0) + st["reroutes"]
                 )
+            # r14 shm-lane telemetry (per logical link): lane state plus
+            # the lane's own message/byte traffic (also folded into the
+            # link wire counters above — these isolate the shm share)
+            sh = self.node.shm_stats(link)
+            if sh is not None and sh["state"] > 0:
+                out[_schema.link_key("st_shm_active", link)] = sh["state"]
+                out["st_shm_msgs_out_total"] = (
+                    out.get("st_shm_msgs_out_total", 0) + sh["msgs_out"]
+                )
+                out["st_shm_msgs_in_total"] = (
+                    out.get("st_shm_msgs_in_total", 0) + sh["msgs_in"]
+                )
+                out["st_shm_bytes_out_total"] = (
+                    out.get("st_shm_bytes_out_total", 0) + sh["bytes_out"]
+                )
+                out["st_shm_bytes_in_total"] = (
+                    out.get("st_shm_bytes_in_total", 0) + sh["bytes_in"]
+                )
         # r11 per-link wire precision (engine tier; 1-bit everywhere else)
         if self._engine is not None:
             for link in self.st.link_ids:
@@ -2377,7 +2441,7 @@ class SharedTensorPeer:
                             # expected seq masked to u32: the wire field
                             # wraps at 2^32 while rx_count counts on
                             # (matching the native engine's compare)
-                            seq = wire.data_seq(payload)
+                            seq = wire.data_seq(payload, self.st.spec)
                             want = (
                                 self._rx_count.get(link, 0) + msgs + 1
                             ) & 0xFFFFFFFF
@@ -2881,6 +2945,28 @@ class SharedTensorPeer:
             self.st.new_link_diff(link, snap)
         self._arm_sign2(link)
 
+    def _shm_ring_bytes(self) -> int:
+        """Ring bytes per direction for this table: TWICE the max traced
+        sign2 burst (the largest wire message the engine can emit), so
+        the lane always pipelines >= 2 messages — floored at 1 MiB and
+        capped by TransportConfig.shm_ring_bytes. Sizing to the table
+        matters both ways on one memory system: a ring much smaller than
+        a burst runs the lane in lockstep, while one much larger than
+        needed cycles through DRAM instead of staying cache-resident
+        (measured at 1 Mi: a 16 MiB ring beats a 64 MiB one by ~8%)."""
+        want = 2 * (
+            wire.HDR_V3
+            + wire.burst_frames_cap(self.st.spec)
+            * wire.frame_payload2_bytes(self.st.spec)
+            + 64
+        )
+        # the user's cap is the OUTER bound (a memory-tight box setting
+        # 128 KiB must get 128 KiB rings, not the floor): floor first,
+        # cap last
+        return min(
+            self.config.transport.shm_ring_bytes, max(1 << 20, want)
+        )
+
     def _arm_sign2(self, link: int) -> None:
         """r11: arm the adaptive-precision governor for this link iff BOTH
         ends advertised sign2 (ours is config/env-gated via self._sign2)."""
@@ -2890,6 +2976,11 @@ class SharedTensorPeer:
             and self._peer_sign2.pop(link, False)
         ):
             self._engine.link_allow_sign2(link)
+        # r14: an r14 peer decodes the aligned v3 framing — emission to it
+        # may drop the repack copy from ITS receive path (same consume-at-
+        # attach discipline as the sign2 flag above)
+        if self._engine is not None and self._peer_r14.pop(link, False):
+            self._engine.link_wire_v3(link)
 
     def _attach_sub(self, link: int, rng: Optional[tuple[int, int]]) -> None:
         """Attach — or RE-seed, the resync path — a read-only subscriber
@@ -2912,6 +3003,8 @@ class SharedTensorPeer:
         native call (st_engine_attach_sub) for the same no-ledgered-window
         reason."""
         self._peer_sign2.pop(link, None)  # subscriber links stay 1-bit
+        self._peer_shm.pop(link, None)  # ...and keep TCP (no shm offer)
+        self._peer_r14.pop(link, None)  # ...and v2 framing
         resync = link in self._sub_links
         if resync:
             if self._engine is not None:
@@ -3010,14 +3103,21 @@ class SharedTensorPeer:
             # values_now - sent_snapshot, which is exactly carry + whatever
             # lands during the handshake (the live slot keeps absorbing)
         self._sent_snapshot = snap
-        from ..compat import SYNC_FLAG_SIGN2
+        from ..compat import SYNC_FLAG_SHM, SYNC_FLAG_SIGN2
 
+        # r14: advertise the same-host shm lane (flag + our host identity
+        # in the tolerant SYNC tail); a pre-r14 or cross-host parent just
+        # ignores it and the link stays on TCP
+        sflags = SYNC_FLAG_SIGN2 if self._sign2 else 0
+        if self._shm_ok:
+            sflags |= SYNC_FLAG_SHM
         self._send_blocking(
             uplink,
             wire.encode_sync(
                 self.st.spec,
                 self._wire_version,
-                flags=SYNC_FLAG_SIGN2 if self._sign2 else 0,
+                flags=sflags,
+                shm_host=self._shm_host,
             ),
         )
         # crash point: SYNC sent, snapshot not — the parent holds a pending
@@ -3034,7 +3134,7 @@ class SharedTensorPeer:
             # same go-back-N acceptance as the recv-loop data path (this
             # branch serves stray DATA routed through the control plane);
             # expected seq masked to the wire field's u32 wrap
-            if wire.data_seq(payload) != (
+            if wire.data_seq(payload, self.st.spec) != (
                 self._rx_count.get(link, 0) + 1
             ) & 0xFFFFFFFF:
                 return  # dup/gap: discard unapplied, await retransmission
@@ -3094,12 +3194,31 @@ class SharedTensorPeer:
                 self._pending.pop(link, None)
                 self._pending_sub.pop(link, None)
             else:
-                from ..compat import SYNC_FLAG_READ_ONLY, SYNC_FLAG_SIGN2
+                from ..compat import (
+                    SYNC_FLAG_READ_ONLY,
+                    SYNC_FLAG_SHM,
+                    SYNC_FLAG_SIGN2,
+                )
 
                 # r11: remember the joiner's sign2 decode capability for
                 # the attach that follows DONE
                 self._peer_sign2[link] = bool(
                     wire.sync_flags(payload) & SYNC_FLAG_SIGN2
+                )
+                # r14: same-host shm candidacy — the joiner advertised the
+                # lane AND its host identity matches ours (consumed at
+                # WELCOME time, when we serve the segment). The flag alone
+                # (host match or not) marks the peer r14 — it decodes the
+                # aligned v3 framing. Gated on OUR _shm_ok too: ST_SHM=0
+                # must pin this node to pre-r14 behavior END TO END (v2
+                # emission included — the documented A/B escape hatch).
+                self._peer_r14[link] = bool(
+                    self._shm_ok
+                    and wire.sync_flags(payload) & SYNC_FLAG_SHM
+                )
+                self._peer_shm[link] = bool(
+                    self._shm_ok
+                    and wire.sync_shm_host(payload) == self._shm_host
                 )
                 if wire.sync_flags(payload) & SYNC_FLAG_READ_ONLY:
                     # r10 read-only subscriber handshake — possibly a
@@ -3158,14 +3277,29 @@ class SharedTensorPeer:
                 # `values` by attach time, so the diff seed carries it.
                 # The WELCOME carries OUR capability flags (r11 trailing
                 # byte — pre-r11 children dispatch on the kind byte alone
-                # and ignore it).
-                from ..compat import SYNC_FLAG_SIGN2
+                # and ignore it) and, r14, the same-host shm segment
+                # offer: the segment is SERVED (created + mapped, rx ring
+                # armed) before the WELCOME ships, so the name the child
+                # reads is guaranteed to exist when it joins. A failed
+                # serve (no /dev/shm space, compat mode) degrades to a
+                # plain WELCOME — the link keeps TCP.
+                from ..compat import SYNC_FLAG_SHM, SYNC_FLAG_SIGN2
 
+                wflags = SYNC_FLAG_SIGN2 if self._sign2 else 0
+                shm_offer = None
+                # the flag marks US as r14 (the child may then emit the
+                # aligned v3 framing toward us) even when no segment
+                # offer follows (cross-host r14 tree, serve failure)
+                if self._shm_ok:
+                    wflags |= SYNC_FLAG_SHM
+                if self._peer_shm.pop(link, False):
+                    served = self.node.shm_serve(
+                        link, self._shm_ring_bytes()
+                    )
+                    if served is not None:
+                        shm_offer = (self._shm_host, served[1], served[0])
                 self._send_blocking(
-                    link,
-                    wire.encode_welcome(
-                        SYNC_FLAG_SIGN2 if self._sign2 else 0
-                    ),
+                    link, wire.encode_welcome(wflags, shm_offer)
                 )
                 self._attach_diff(link, snap)
                 self._wake.set()
@@ -3178,6 +3312,27 @@ class SharedTensorPeer:
             self._peer_sign2[link] = bool(
                 wire.welcome_flags(payload) & SYNC_FLAG_SIGN2
             )
+            # r14: the parent's flag marks it r14 (v3-framing decoder);
+            # gated on OUR _shm_ok so ST_SHM=0 pins v2 emission too (the
+            # documented pre-r14 escape hatch is end-to-end)
+            from ..compat import SYNC_FLAG_SHM
+
+            self._peer_r14[link] = bool(
+                self._shm_ok
+                and wire.welcome_flags(payload) & SYNC_FLAG_SHM
+            )
+            # ...and a same-host parent offered its shm segment — join it
+            # (map + token-validate); ANY failure keeps the uplink on TCP
+            # with a shm_fallback timeline event recording why
+            offer = wire.welcome_shm(payload)
+            if offer is not None and self._shm_ok:
+                o_host, o_token, o_name = offer
+                if o_host == self._shm_host:
+                    if not self.node.shm_join(link, o_name, o_token):
+                        log.info(
+                            "shm attach on uplink %d failed — keeping TCP "
+                            "(see the shm_fallback timeline event)", link,
+                        )
             snap = self._sent_snapshot
             self._sent_snapshot = None
             if snap is not None:

@@ -139,6 +139,31 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int32,
         ctypes.POINTER(ctypes.c_uint64),
     ]
+    # r14 same-host shm lane (negotiated at the peer tier's SYNC/WELCOME;
+    # the serve side creates the /dev/shm segment, the join side maps and
+    # validates it — on any failure the link simply stays on TCP)
+    lib.st_node_shm_serve.restype = ctypes.c_int32
+    lib.st_node_shm_serve.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.st_node_shm_join.restype = ctypes.c_int32
+    lib.st_node_shm_join.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+    ]
+    lib.st_node_shm_stats.restype = ctypes.c_int32
+    lib.st_node_shm_stats.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
     lib.st_node_recv.restype = ctypes.c_int32
     lib.st_node_recv.argtypes = [
         ctypes.c_void_p,
@@ -326,6 +351,57 @@ class TransportNode:
             "rx_acquires": out[2],
             "rx_misses": out[3],
             "zc_msgs": out[4],
+        }
+
+    def shm_serve(self, link_id: int, ring_bytes: int) -> Optional[tuple]:
+        """Create this link's same-host shm segment (the parent's half of
+        the r14 lane negotiation). Returns ``(name, token)`` to hand to
+        the peer, or None when the lane cannot be served (compat mode,
+        dead link, /dev/shm unavailable) — the link then stays on TCP."""
+        if not self._h:
+            return None
+        name = ctypes.create_string_buffer(96)
+        token = ctypes.c_uint64(0)
+        r = self._lib.st_node_shm_serve(
+            self._h, link_id, ring_bytes, name, len(name),
+            ctypes.byref(token),
+        )
+        if r != 0:
+            return None
+        return name.value.decode(), int(token.value)
+
+    def shm_join(self, link_id: int, name: str, token: int) -> bool:
+        """Map and validate the peer's shm segment (the child's half).
+        False — with the reason recorded as a ``shm_fallback`` timeline
+        event — means the link keeps TCP; negotiation failure is never an
+        error."""
+        if not self._h:
+            return False
+        return (
+            self._lib.st_node_shm_join(
+                self._h, link_id, name.encode(), token
+            )
+            == 0
+        )
+
+    def shm_stats(self, link_id: int) -> Optional[dict]:
+        """r14 shm-lane telemetry: lane state (0 = TCP only, 1 = segment
+        mapped, 2 = tx live), per-lane message/byte counters, ring size
+        and futex sleeps. None for an unknown link or a closed node."""
+        if not self._h:
+            return None
+        out = (ctypes.c_uint64 * 8)()
+        if self._lib.st_node_shm_stats(self._h, link_id, out) < 0:
+            return None
+        return {
+            "state": int(out[0]),
+            "msgs_out": int(out[1]),
+            "msgs_in": int(out[2]),
+            "bytes_out": int(out[3]),
+            "bytes_in": int(out[4]),
+            "ring_bytes": int(out[5]),
+            "tx_waits": int(out[6]),
+            "rx_waits": int(out[7]),
         }
 
     def stripe_stats(self, link_id: int) -> Optional[dict]:

@@ -122,6 +122,12 @@ SNAP_ACK = 13  # child -> parent: barrier ack + subtree shard entries (JSON)
 RESUME = 14  # parent -> child: release the lifecycle barrier (JSON)
 CTL = 15  # parent -> child: routed operator command (JSON)
 
+#: r14 shm/r14-capability flag bit — MUST equal compat.SYNC_FLAG_SHM
+#: (compat asserts the tie at import; defined here too because compat
+#: imports peer which imports this module, so wire cannot import compat).
+#: The bit gates the SYNC/WELCOME shm tails this module encodes/decodes.
+SHM_FLAG = 0x08
+
 _SYNC_FMT = "<IQ16s"  # num_leaves, total_n, layout digest
 _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
 
@@ -167,6 +173,15 @@ BURST_HDR = 6
 TRACE_BYTES = 13
 DATA_HDR_T = DATA_HDR + TRACE_BYTES  # 18
 BURST_HDR_T = BURST_HDR + TRACE_BYTES  # 19
+#: r14 "aligned" v3 framing (native engine tier): ONE 24-byte header for
+#: DATA and BURST — [kind u8][k u8][pad u16][seq u32][origin u32][gen u64]
+#: [hops u8][pad*3] — sized so the frame body lands 8-aligned in the
+#: receiver's buffer (the engine's zero-repack fused apply reads scales/
+#: words straight from it). Emitted only toward peers that advertised the
+#: r14 capability (compat.SYNC_FLAG_SHM doubles as the marker); decoded
+#: here unconditionally by exact length, like every framing before it
+#: (24 mod 4 = 0 collides with neither 5/18 nor 6/19).
+HDR_V3 = 24
 _TRACE_FMT = "<IQB"  # origin node id, origin monotonic ns, hop count
 
 #: Hard cap on one DIGEST message's JSON body. The digest is BOUNDED by
@@ -210,12 +225,15 @@ def frame_payload2_bytes(spec: TableSpec) -> int:
 
 
 def burst_wire_bytes(spec: TableSpec) -> int:
-    """Max BURST message size for this spec — v2 (traced) header: this
-    feeds every receive-buffer bound, and 13 bytes short means a full
-    traced burst is silently truncated at the transport, rejected as
-    undecodable without consuming its seq, and retransmitted identically
-    until go-back-N black-holes the link."""
-    return BURST_HDR_T + burst_frames_cap(spec) * frame_payload_bytes(spec)
+    """Max BURST message size for this spec — the LARGEST emitted header
+    (r14's 24-byte aligned v3 exceeds the 19-byte traced v2): this feeds
+    every receive-buffer bound, and even 5 bytes short means a full
+    burst from an r14 engine sender is silently truncated at the
+    transport, rejected as undecodable without consuming its seq, and
+    retransmitted identically until go-back-N black-holes the link —
+    the exact r09 failure class this function exists to prevent."""
+    hdr = max(BURST_HDR_T, HDR_V3)
+    return hdr + burst_frames_cap(spec) * frame_payload_bytes(spec)
 
 
 def frame_wire_bytes(spec: TableSpec) -> int:
@@ -228,8 +246,8 @@ def frame_wire_bytes(spec: TableSpec) -> int:
     r11 sign2 single-frame width, which exceeds the 1-bit burst bound on
     burst-cap-1 tables for the same reason; sign2 BURSTS are capped by the
     sender against this same bound)."""
-    data = DATA_HDR_T + frame_payload_bytes(spec)
-    data2 = DATA_HDR_T + frame_payload2_bytes(spec)
+    data = max(DATA_HDR_T, HDR_V3) + frame_payload_bytes(spec)
+    data2 = max(DATA_HDR_T, HDR_V3) + frame_payload2_bytes(spec)
     rdata = RDATA_HDR_T + frame_payload_bytes(spec)
     chunk = 1 + struct.calcsize(_CHUNK_HDR) + CHUNK_BYTES
     return max(
@@ -238,12 +256,24 @@ def frame_wire_bytes(spec: TableSpec) -> int:
     )
 
 
-def data_seq(payload: bytes) -> int:
-    """The per-link tx_seq of a DATA/BURST payload (module docstring)."""
+def data_seq(payload: bytes, spec: Optional[TableSpec] = None) -> int:
+    """The per-link tx_seq of a DATA/BURST payload (module docstring).
+    Pass ``spec`` when the sender may be an r14 engine peer: the v3
+    framing keeps its seq at byte 4 (after the k byte and alignment pad),
+    and only the exact-length test against the spec can tell the
+    framings apart."""
     if len(payload) < DATA_HDR:
         raise ValueError(
             f"{len(payload)}-byte data message is too short to carry a seq"
         )
+    if spec is not None and len(payload) > HDR_V3 and payload[1] > 0:
+        per = (
+            frame_payload2_bytes(spec)
+            if payload[0] & 0x80
+            else frame_payload_bytes(spec)
+        )
+        if len(payload) == HDR_V3 + payload[1] * per:
+            return struct.unpack_from("<I", payload, 4)[0]
     return struct.unpack_from("<I", payload, 1)[0]
 
 
@@ -257,6 +287,10 @@ def data_trace(
     n = len(payload)
     if not payload:
         return None
+    if n > HDR_V3 and payload[1] and n == HDR_V3 + payload[1] * per:
+        # r14 aligned framing: the trace context sits at bytes 8..20 in
+        # the same [origin u32][gen u64][hops u8] order as v2
+        return struct.unpack_from(_TRACE_FMT, payload, 8)
     if payload[0] == DATA:
         if n == DATA_HDR_T + per:
             return struct.unpack_from(_TRACE_FMT, payload, DATA_HDR)
@@ -415,10 +449,12 @@ def decode_frame(
         off = DATA_HDR
     elif len(payload) == DATA_HDR_T + per:
         off = DATA_HDR_T
+    elif len(payload) == HDR_V3 + per and payload[1] == 1:
+        off = HDR_V3  # r14 aligned framing, k == 1
     else:
         raise ValueError(
             f"DATA frame is {len(payload)} bytes, spec wants "
-            f"{DATA_HDR + per} or {DATA_HDR_T + per} "
+            f"{DATA_HDR + per}, {DATA_HDR_T + per} or {HDR_V3 + per} "
             f"(k={k}, words={w}) — peer table layout mismatch"
         )
     return _decode_one_frame(payload, off, spec, scratch)
@@ -566,12 +602,22 @@ def decode_burst(
     guard as decode_frame (non-finite scales zeroed)."""
     if len(payload) < BURST_HDR:
         raise ValueError(f"BURST message of {len(payload)} bytes has no header")
+    per = frame_payload_bytes(spec)
+    if payload[1] > 0 and len(payload) == HDR_V3 + payload[1] * per:
+        # r14 aligned framing: k lives at byte 1 (checked FIRST — byte 5
+        # is mid-seq here, so the v1/v2 k_frames read below would be
+        # garbage for a v3 message)
+        k_frames = payload[1]
+        hdr = HDR_V3
+        return [
+            _decode_one_frame(payload, hdr + i * per, spec, scratch)
+            for i in range(k_frames)
+        ]
     k_frames = payload[BURST_HDR - 1]
     if k_frames == 0:
         # encode_burst never emits k=0; accepting one would ACK a message
         # that delivered nothing (a frame-less BURST is corruption)
         raise ValueError("BURST with k_frames == 0")
-    per = frame_payload_bytes(spec)
     # v1 or v2 framing by exact length (see decode_frame)
     if len(payload) == BURST_HDR + k_frames * per:
         hdr = BURST_HDR
@@ -589,7 +635,12 @@ def decode_burst(
     ]
 
 
-def encode_sync(spec: TableSpec, wire_version: int = 1, flags: int = 0) -> bytes:
+def encode_sync(
+    spec: TableSpec,
+    wire_version: int = 1,
+    flags: int = 0,
+    shm_host: bytes = b"",
+) -> bytes:
     """Join request header. Since r09 a trailing version byte advertises
     the joiner's DATA/BURST framing (compat.WIRE_VERSION); pre-r09 parents
     decode with unpack_from and ignore the trailing byte, so the SYNC
@@ -600,13 +651,21 @@ def encode_sync(spec: TableSpec, wire_version: int = 1, flags: int = 0) -> bytes
     ``flags`` (r10, one more trailing byte — same tolerant-extension
     discipline) advertises handshake capabilities: compat.SYNC_FLAG_*
     (read-only subscriber, range subscription to follow). Pre-r10 parents
-    ignore it; pre-r10 SYNCs read back as flags 0."""
+    ignore it; pre-r10 SYNCs read back as flags 0.
+
+    ``shm_host`` (r14, 16 trailing bytes present iff flags carries
+    compat.SYNC_FLAG_SHM): the joiner's host identity (Linux boot id) for
+    the same-host shared-memory lane negotiation. A parent on the same
+    host answers with a segment offer in its WELCOME tail
+    (:func:`encode_welcome`); any other parent — pre-r14 included — just
+    ignores the bytes and the link stays on TCP."""
     return (
         bytes([SYNC])
         + struct.pack(
             _SYNC_FMT, spec.num_leaves, spec.total_n, spec.layout_digest()
         )
         + bytes([wire_version & 0xFF, flags & 0xFF])
+        + (shm_host[:16] if flags & SHM_FLAG else b"")
     )
 
 
@@ -629,21 +688,62 @@ def sync_flags(payload: bytes) -> int:
     return payload[base] if len(payload) > base else 0
 
 
-def encode_welcome(flags: int = 0) -> bytes:
+def sync_shm_host(payload: bytes) -> Optional[bytes]:
+    """The joiner's 16-byte host identity (r14 shm-lane negotiation), or
+    None when the SYNC predates r14 / the joiner did not advertise
+    compat.SYNC_FLAG_SHM."""
+    if not sync_flags(payload) & SHM_FLAG:
+        return None
+    base = 3 + struct.calcsize(_SYNC_FMT)
+    return bytes(payload[base : base + 16]) if len(payload) >= base + 16 \
+        else None
+
+
+def encode_welcome(flags: int = 0, shm_offer=None) -> bytes:
     """WELCOME with an r11 trailing capability-flags byte (same tolerant-
     extension discipline as the SYNC version/flags bytes: every receiver
     has always dispatched WELCOME on the kind byte alone, so pre-r11 peers
     ignore the tail and a pre-r11 parent's bare 1-byte WELCOME reads back
     as flags 0). Carries the PARENT-side capability advertisement —
-    today: compat.SYNC_FLAG_SIGN2, so a child knows whether its uplink
-    may be upshifted to the 2-bit codec."""
-    return bytes([WELCOME, flags & 0xFF])
+    compat.SYNC_FLAG_SIGN2 (the child's uplink may upshift to the 2-bit
+    codec) and, r14, compat.SYNC_FLAG_SHM with a same-host shared-memory
+    segment offer in the tail.
+
+    ``shm_offer`` (present iff flags carries compat.SYNC_FLAG_SHM) is
+    ``(host_id16, token, name)``: the parent's host identity, the
+    segment's validation token and its /dev/shm basename. Pre-r14
+    children ignore the tail entirely — the link then stays on TCP, which
+    is exactly the mixed-tree contract."""
+    out = bytes([WELCOME, flags & 0xFF])
+    if flags & SHM_FLAG and shm_offer is not None:
+        host, token, name = shm_offer
+        nb = name.encode()
+        out += (
+            host[:16].ljust(16, b"\0")
+            + struct.pack("<Q", token & 0xFFFFFFFFFFFFFFFF)
+            + bytes([len(nb) & 0xFF])
+            + nb
+        )
+    return out
 
 
 def welcome_flags(payload: bytes) -> int:
     """The parent's advertised capability flags (0 for a pre-r11 bare
     WELCOME)."""
     return payload[1] if len(payload) > 1 else 0
+
+
+def welcome_shm(payload: bytes) -> Optional[tuple]:
+    """The parent's shm segment offer ``(host_id16, token, name)`` from a
+    WELCOME tail, or None when absent/truncated (the link stays on TCP)."""
+    if not welcome_flags(payload) & SHM_FLAG or len(payload) < 2 + 16 + 8 + 1:
+        return None
+    host = bytes(payload[2:18])
+    (token,) = struct.unpack_from("<Q", payload, 18)
+    nlen = payload[26]
+    if len(payload) < 27 + nlen:
+        return None
+    return host, token, payload[27 : 27 + nlen].decode(errors="replace")
 
 
 # -- r10 serving-tier messages ----------------------------------------------

@@ -85,6 +85,26 @@ SYNC_FLAG_RANGE = 0x02
 # advertisement and the governor (the A/B escape hatch, like
 # ST_WIRE_TRACE=0).
 SYNC_FLAG_SIGN2 = 0x04
+# r14: the same-host shared-memory transport lane. A joiner sets this flag
+# and appends its 16-byte host identity (Linux boot id) to the SYNC tail;
+# a same-host r14 parent replies with a segment offer (host id + token +
+# /dev/shm name) in the WELCOME tail, and BOTH sides then attach the
+# link's data plane to SPSC shared-memory rings while TCP stays the
+# control/liveness channel. Every mismatch is a silent keep-TCP: pre-r14
+# peers ignore the trailing bytes entirely (the r09/r10 tolerant-extension
+# discipline), cross-host peers fail the boot-id match, and a failed
+# segment open/validation at attach time falls back with a shm_fallback
+# timeline event. ST_SHM=0 force-disables the lane end to end (the A/B
+# escape hatch, like ST_SIGN2/ST_WIRE_TRACE).
+SYNC_FLAG_SHM = 0x08
+# the wire module hardcodes the same bit (it cannot import this module —
+# compat -> peer -> wire would be a cycle); a silent drift between the two
+# would degrade every negotiation to permanent TCP fallback, so tie them
+# at import time
+from .comm import wire as _wire
+
+assert SYNC_FLAG_SHM == _wire.SHM_FLAG, "SYNC_FLAG_SHM drifted from wire.SHM_FLAG"
+del _wire
 
 # ---- r12 cluster-lifecycle control kinds ----------------------------------
 #

@@ -173,6 +173,30 @@ class TransportConfig:
     #: LINK_DOWN -> carry -> re-graft path the ledger already handles
     #: losslessly. 0 = never quarantine (retry until liveness timeout).
     quarantine_send_failures: int = 100
+    #: r14 same-host shared-memory lane. When both ends of a link are on
+    #: one host (boot-id match, advertised through the tolerant SYNC/
+    #: WELCOME capability extension — compat.SYNC_FLAG_SHM), the link's
+    #: DATA plane moves into SPSC rings in a mapped /dev/shm segment and
+    #: the TCP connection stays up as the control/liveness/teardown
+    #: channel — join, go-back-N seq accounting, SNAP/RESUME, quarantine/
+    #: carry/re-graft semantics are untouched. Negotiation is fail-safe:
+    #: any mismatch (pre-r14 peer, cross-host, /dev/shm unavailable,
+    #: validation failure) silently keeps the link on TCP, with a
+    #: ``shm_fallback`` timeline event recording why. ``ST_SHM=0`` in the
+    #: environment force-disables the lane (the A/B escape hatch, like
+    #: ST_SIGN2/ST_WIRE_TRACE).
+    shm_enabled: bool = True
+    #: CAP on bytes per shm ring DIRECTION (two rings per link). The peer
+    #: sizes each link's rings to its table — twice the max traced sign2
+    #: burst, floored at 1 MiB — and this cap bounds that (the sizing
+    #: matters both ways on one memory system: a ring smaller than a
+    #: burst runs the lane in lockstep — measured -9% at 16 Mi elements —
+    #: while one much larger than needed cycles through DRAM instead of
+    #: staying cache-resident — measured -8% at 1 Mi). Messages larger
+    #: than the ring still STREAM through it correctly; tmpfs allocates
+    #: pages lazily, so links touch only their high-water mark. Clamped
+    #: to 64 KiB .. 1 GiB and page-rounded by the native layer.
+    shm_ring_bytes: int = 1 << 26
 
     def __post_init__(self):
         if not 1 <= self.max_children <= 16:
@@ -182,6 +206,10 @@ class TransportConfig:
         if not 1 <= self.stripe_count <= 8:
             raise ValueError(
                 f"stripe_count must be in 1..8, got {self.stripe_count}"
+            )
+        if self.shm_ring_bytes < (1 << 16):
+            raise ValueError(
+                f"shm_ring_bytes must be >= 64 KiB, got {self.shm_ring_bytes}"
             )
 
 

@@ -67,6 +67,13 @@ CODE_NAMES: dict[int, str] = {
     # survivors — the LAST stripe's death shows up as link_down instead.
     32: "precision_shift",
     33: "stripe_down",
+    # 34/35: r14 same-host shm lane. shm_lane_up fires once per link when
+    # its data plane switches onto the shared-memory rings (arg = ring
+    # bytes per direction); shm_fallback records a negotiated attach that
+    # failed validation — the link stays on TCP (arg = reason: 1 segment
+    # open failed, 2 map/size failed, 3 header/token mismatch).
+    34: "shm_lane_up",
+    35: "shm_fallback",
 }
 NAME_CODES = {v: k for k, v in CODE_NAMES.items()}
 

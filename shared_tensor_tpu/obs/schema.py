@@ -103,6 +103,18 @@ SCHEMA: dict[str, tuple[str, str]] = {
     "st_precision_downshifts_total": ("counter", "governor downshifts back to 1-bit"),
     "st_frames2_out_total": ("counter", "sign2 (2-bit) frames sent (subset of st_frames_out_total)"),
     "st_frames2_in_total": ("counter", "sign2 (2-bit) frames applied (subset of st_frames_in_total)"),
+    # r14 same-host shm transport lane: st_shm_active is a per-link gauge
+    # (1 = segment mapped, 2 = the link's data plane is live on the shm
+    # rings); the *_total counters isolate the lane's share of the link
+    # wire traffic (also counted in st_link_wire_* — the lane slots in
+    # below the wire-seq layer, like striping). The ring events
+    # shm_lane_up / shm_fallback carry each lane switch and each
+    # negotiation failure reason.
+    "st_shm_active": ("gauge", "shm lane state for the link (1=mapped, 2=data plane live)"),
+    "st_shm_msgs_out_total": ("counter", "wire messages sent over shm rings (subset of st_link_wire_msgs_out_total)"),
+    "st_shm_msgs_in_total": ("counter", "wire messages received over shm rings (subset of st_link_wire_msgs_in_total)"),
+    "st_shm_bytes_out_total": ("counter", "bytes written into shm tx rings (record headers included)"),
+    "st_shm_bytes_in_total": ("counter", "bytes drained from shm rx rings (record headers included)"),
     # r12 cluster lifecycle (consistent-cut snapshot/restore, drain-node,
     # rolling upgrade). Gauges ride the per-node digest breakdown, which
     # is what obs.top's lifecycle rows and ``ctl versions`` read at the

@@ -214,6 +214,24 @@ def test_lifecycle_suite_under_tsan(tmp_path):
 
 
 @pytest.mark.slow
+def test_shm_suite_under_tsan(tmp_path):
+    """r14 tentpole: the same-host shm lane's concurrency surface — the
+    cross-process ring atomics and futex protocol, the lane-writer
+    promotion window (Lane::tx_mu), the SWITCH-marker handoff between the
+    socket receiver and the ring drain thread, the recv_zc loan registry —
+    under TSan through the shm transport + peer-tier negotiation suites
+    (fault teardown and SNAP/RESUME across live lanes included)."""
+    _run_tsan_arm(
+        tmp_path,
+        [
+            "tests/test_shm.py",
+            "tests/test_transport.py", "-k",
+            "shm or roundtrip or link_down",
+        ],
+    )
+
+
+@pytest.mark.slow
 def test_obs_suite_under_asan_ubsan():
     """r08 satellite: the obs event ring is lock-free SPSC code shared by
     every native thread — exactly where a memory-order bug is silent on

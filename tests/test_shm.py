@@ -1,0 +1,323 @@
+"""r14 same-host shm transport lane — peer/engine-tier contract tests.
+
+The lane is negotiated at the SYNC/WELCOME hello (compat.SYNC_FLAG_SHM +
+boot-id match) and slots in BELOW the wire-seq layer: join, go-back-N seq
+accounting, SNAP/RESUME lifecycle, quarantine/carry/re-graft must behave
+identically whether a link's data plane rides TCP or the rings. These
+tests pin exactly that:
+
+- negotiation + fallback: a same-host pair goes lane-live; a mixed tree
+  (one peer with the lane disabled — the pre-r14 stand-in, since a
+  disabled peer neither advertises nor offers, byte-identical to an old
+  one) silently keeps TCP and still converges exactly;
+- ring-full backpressure propagates like socket backpressure (sendq
+  fills, sends bounce, nothing is lost once the reader drains);
+- sever/stall fault injection on a lane-live link tears down into the
+  r06 quarantine/carry/re-graft path and converges exactly;
+- SNAP/RESUME: a consistent-cut cluster snapshot completes across
+  lane-live links (markers ride the same in-order stream).
+
+Transport-level ring mechanics (wrap, streaming, token validation) live
+in tests/test_transport.py.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shared_tensor_tpu.comm import faults
+from shared_tensor_tpu.comm.peer import SharedTensorPeer, create_or_fetch
+from shared_tensor_tpu.config import Config, FaultConfig, TransportConfig
+
+from tests._ports import free_port as _free_port
+
+
+def _cfg(fault: "FaultConfig | None" = None, shm: bool = True, **tkw):
+    tkw.setdefault("peer_timeout_sec", 10.0)
+    tkw.setdefault("shm_enabled", shm)
+    return Config(
+        transport=TransportConfig(**tkw),
+        faults=fault or FaultConfig(),
+    )
+
+
+def _wait_converged(peers, expect, tol=1e-6, timeout=90.0):
+    expect_leaves = jax.tree.leaves(expect)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(
+            all(
+                np.allclose(g, e, rtol=1e-4, atol=tol)
+                for g, e in zip(jax.tree.leaves(p.read()), expect_leaves)
+            )
+            for p in peers
+        ):
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        "no convergence: "
+        + "; ".join(
+            f"peer{i} head={np.asarray(jax.tree.leaves(p.read())[0])[:4]}"
+            for i, p in enumerate(peers)
+        )
+    )
+
+
+def _shm_live(peer) -> int:
+    """Count of this peer's links whose data plane is live on the rings."""
+    m = peer.metrics(canonical=True)
+    return sum(
+        1 for k, v in m.items() if k.startswith("st_shm_active") and v == 2
+    )
+
+
+def _wait_lane_live(peers, want=1, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(_shm_live(p) >= want for p in peers):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_same_host_pair_negotiates_lane_and_converges():
+    """The normal state of a loopback pair: the SYNC/WELCOME hello takes
+    the link's data plane onto the rings (st_shm_active == 2 at BOTH
+    ends), real traffic flows over them, and convergence stays exact."""
+    port = _free_port()
+    seed = jnp.full((1 << 13,), 1.0, jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg())
+    j = SharedTensorPeer("127.0.0.1", port, jnp.zeros_like(seed), _cfg())
+    try:
+        j.wait_ready(60.0)
+        _wait_converged([j], seed, tol=1e-5)
+        assert _wait_lane_live([m, j]), "shm lane never went live"
+        rng = np.random.default_rng(3)
+        total = np.asarray(seed)
+        for _ in range(8):
+            u = rng.normal(0, 0.5, 1 << 13).astype(np.float32)
+            total = total + u
+            m.add(jnp.asarray(u))
+        _wait_converged([m, j], jnp.asarray(total), tol=1e-4)
+        mm = m.metrics(canonical=True)
+        assert mm.get("st_shm_msgs_out_total", 0) >= 1, (
+            "lane live but no shm traffic — data still on TCP?"
+        )
+    finally:
+        j.close()
+        m.close()
+
+
+def test_mixed_tree_pre_r14_peer_keeps_tcp():
+    """Negotiation fallback: a parent with the lane disabled neither
+    parses the SYNC advertisement nor offers a segment (the pre-r14
+    stand-in — an old parent ignores the same trailing bytes), so the
+    r14 joiner keeps TCP silently and the pair still converges exactly.
+    Same in the other orientation: an r14 parent never offers to a
+    non-advertising joiner."""
+    port = _free_port()
+    seed = jnp.full((4096,), 2.0, jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg(shm=False))
+    j = SharedTensorPeer("127.0.0.1", port, jnp.zeros_like(seed), _cfg())
+    try:
+        j.wait_ready(60.0)
+        _wait_converged([j], seed, tol=1e-5)
+        m.add(jnp.full((4096,), 1.0, jnp.float32))
+        _wait_converged([m, j], jnp.full((4096,), 3.0, jnp.float32), 1e-4)
+        assert _shm_live(m) == 0 and _shm_live(j) == 0
+        assert "st_shm_msgs_out_total" not in m.metrics(canonical=True)
+    finally:
+        j.close()
+        m.close()
+
+    # reverse orientation: non-advertising joiner under an r14 parent
+    port = _free_port()
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg())
+    j = SharedTensorPeer(
+        "127.0.0.1", port, jnp.zeros_like(seed), _cfg(shm=False)
+    )
+    try:
+        j.wait_ready(60.0)
+        _wait_converged([j], seed, tol=1e-5)
+        assert _shm_live(m) == 0 and _shm_live(j) == 0
+    finally:
+        j.close()
+        m.close()
+
+
+def test_shm_sever_tears_down_into_carry_and_regraft(monkeypatch):
+    """A sever fault firing ON a lane-live link must take the same r06
+    recovery road as a TCP link: link death -> rollback -> carry ->
+    re-graft (the re-grafted link, pinned chaos-free via only_link,
+    re-negotiates its own fresh lane) -> exact convergence. Nothing the
+    dead lane swallowed may be lost."""
+    port = _free_port()
+    seed = jnp.full((1 << 13,), 1.0, jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg())
+    env = faults.to_env(
+        FaultConfig(enabled=True, seed=11, sever_after_frames=6,
+                    only_link=1)
+    )
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    j = SharedTensorPeer("127.0.0.1", port, jnp.zeros_like(seed), _cfg())
+    for k in env:
+        monkeypatch.delenv(k)
+    try:
+        j.wait_ready(60.0)
+        _wait_converged([j], seed, tol=1e-5)
+        assert _wait_lane_live([j]), "lane never live before the sever"
+        up0 = j.node.uplink
+        rng = np.random.default_rng(17)
+        total = np.asarray(seed, np.float64)
+        # the JOINER adds: its uplink sender (the lane writer) trips the
+        # sever at the 6th data frame
+        for _ in range(12):
+            u = rng.normal(0, 0.5, 1 << 13).astype(np.float32)
+            total = total + u
+            j.add(jnp.asarray(u))
+            time.sleep(0.02)
+        _wait_converged(
+            [m, j], jnp.asarray(total, jnp.float32), tol=1e-4, timeout=120.0
+        )
+        assert j.node.uplink != up0, (
+            "uplink id unchanged — the sever never tore the lane-live "
+            "link down"
+        )
+        assert _wait_lane_live([j]), "the re-grafted link has no lane"
+    finally:
+        j.close()
+        m.close()
+
+
+def test_shm_stall_blackholes_into_quarantine_path(monkeypatch):
+    """The stall class (messages silently swallowed at the lane writer,
+    sender believes delivered): the engine's go-back-N must declare the
+    link a black hole in bounded time, tear it down, and recover every
+    frame through carry/re-graft — identical to the TCP stall contract."""
+    port = _free_port()
+    seed = jnp.full((4096,), 2.0, jnp.float32)
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg())
+    env = faults.to_env(
+        FaultConfig(enabled=True, seed=5, stall_after_frames=4,
+                    only_link=1)
+    )
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    j = SharedTensorPeer(
+        "127.0.0.1", port, jnp.zeros_like(seed),
+        _cfg(ack_timeout_sec=1.0, ack_retry_limit=2),
+    )
+    for k in env:
+        monkeypatch.delenv(k)
+    try:
+        j.wait_ready(60.0)
+        _wait_converged([j], seed, tol=1e-5)
+        assert _wait_lane_live([j]), "lane never live before the stall"
+        up0 = j.node.uplink
+        delta = jnp.full((4096,), 0.25, jnp.float32)
+        total = np.asarray(seed) + 8 * np.asarray(delta)
+        for _ in range(8):
+            j.add(delta)
+            time.sleep(0.02)
+        # bounded-time teardown + exact recovery (black hole -> carry)
+        _wait_converged(
+            [m, j], jnp.asarray(total), tol=1e-4, timeout=120.0
+        )
+        jm = j.metrics(canonical=True)
+        assert jm.get("st_retransmit_msgs_total", 0) >= 1, (
+            "go-back-N never retransmitted into the stalled lane"
+        )
+        assert j.node.uplink != up0, (
+            "stalled lane-live link was never torn down (black hole)"
+        )
+    finally:
+        j.close()
+        m.close()
+
+
+def test_snapshot_cluster_across_live_shm_links(tmp_path):
+    """r12 SNAP/RESUME across lane-live links: the barrier markers ride
+    the same in-order stream as data (per-link FIFO is the consistent-cut
+    property), so a cluster snapshot must complete with every shard
+    captured while the lanes stay up — and streaming must resume after
+    RESUME with the lanes still live."""
+    port = _free_port()
+    seed = jnp.zeros((4096,), jnp.float32)
+    peers = [
+        create_or_fetch("127.0.0.1", port, seed, _cfg())
+        if i == 0
+        else SharedTensorPeer("127.0.0.1", port, seed, _cfg())
+        for i in range(3)
+    ]
+    try:
+        for p in peers[1:]:
+            p.wait_ready(60.0)
+        assert _wait_lane_live(peers[1:]), "lanes never live in the tree"
+        rng = np.random.default_rng(7)
+        total = np.zeros(4096, np.float64)
+        for i in range(6):
+            u = rng.uniform(-0.5, 0.5, 4096).astype(np.float32)
+            total += u
+            peers[i % 3].add(jnp.asarray(u))
+        # converge BEFORE the barrier: a joiner still re-syncing a churned
+        # handshake (loaded-box join race) would miss the cut and the
+        # nodes-count assertion would flake on the churn, not the lane
+        _wait_converged(
+            peers, jnp.asarray(total, jnp.float32), tol=1e-4, timeout=120.0
+        )
+        res = peers[0].snapshot_cluster(str(tmp_path), timeout=45.0)
+        assert res["ok"], res
+        assert res["nodes"] >= 3
+        # post-RESUME: streaming continues over the SAME lanes
+        for i in range(4):
+            u = rng.uniform(-0.5, 0.5, 4096).astype(np.float32)
+            total += u
+            peers[i % 3].add(jnp.asarray(u))
+        _wait_converged(
+            peers, jnp.asarray(total, jnp.float32), tol=1e-4, timeout=120.0
+        )
+        assert all(_shm_live(p) >= 1 for p in peers[1:]), (
+            "a lane died across SNAP/RESUME"
+        )
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_ring_full_backpressure_bounds_not_loses():
+    """A tiny ring under a burst: the writer blocks (spin -> futex), the
+    sendq fills, sends bounce — and once the reader drains, EVERYTHING
+    arrives in order. The lane's backpressure is the same contract as a
+    full socket buffer, with the TCP keepalive holding liveness the
+    whole time."""
+    port = _free_port()
+    seed = jnp.zeros((1 << 15,), jnp.float32)  # 32 Ki elems, ~132 KiB frames
+    cfgs = dict(shm_ring_bytes=1 << 16)  # 64 KiB ring << one burst
+    m = create_or_fetch("127.0.0.1", port, seed, _cfg(**cfgs))
+    j = SharedTensorPeer(
+        "127.0.0.1", port, jnp.zeros_like(seed), _cfg(**cfgs)
+    )
+    try:
+        j.wait_ready(60.0)
+        assert _wait_lane_live([m, j]), "shm lane never went live"
+        rng = np.random.default_rng(23)
+        total = np.zeros(1 << 15, np.float64)
+        for _ in range(10):
+            u = rng.normal(0, 0.5, 1 << 15).astype(np.float32)
+            total += u
+            m.add(jnp.asarray(u))
+        _wait_converged(
+            [m, j], jnp.asarray(total, jnp.float32), tol=1e-4, timeout=120.0
+        )
+        sh = [
+            v for k, v in m.metrics(canonical=True).items()
+            if k.startswith("st_shm_active")
+        ]
+        assert sh and max(sh) == 2
+    finally:
+        j.close()
+        m.close()

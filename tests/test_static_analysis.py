@@ -130,6 +130,48 @@ def test_event_lint_flags_unknown_and_drifted_code(tmp_path):
     assert any("drifted" in f for f in findings), findings
 
 
+def test_event_lint_flags_renamed_shm_event(tmp_path):
+    # r14: the shm chaos tallies key on the EXACT names shm_lane_up /
+    # shm_fallback — a rename keeps the numeric code valid (no unknown-
+    # code finding) yet silently zeroes every tally; the lint must red
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/obs/events.py",
+          '34: "shm_lane_up"', '34: "shm_lane_went_up"')
+    findings = lint_events.run(root)
+    assert any("shm_lane_up" in f for f in findings), findings
+
+
+def test_abi_lint_flags_dropped_shm_declaration(tmp_path):
+    # r14 bidirectional-family rule: a native st_node_shm_* entry point
+    # with no ctypes declaration = the lane silently never negotiates
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/comm/transport.py",
+          "lib.st_node_shm_join.restype", "lib.st_node_shm_join_x.restype")
+    _edit(root, "shared_tensor_tpu/comm/transport.py",
+          "lib.st_node_shm_join.argtypes",
+          "lib.st_node_shm_join_x.argtypes")
+    findings = lint_abi.run(root)
+    assert any(
+        "st_node_shm_join" in f and "bidirectional" in f for f in findings
+    ), findings
+    # ...and the renamed python-side declaration is itself flagged as
+    # having no native definition (the pre-existing direction)
+    assert any("st_node_shm_join_x" in f for f in findings), findings
+
+
+def test_abi_lint_flags_shm_stats_width_drift(tmp_path):
+    # the out-array discipline covers the new shm stats: native writing
+    # past the promised out8 width must red exactly like st_engine_counters
+    root = _seed_tree(tmp_path)
+    _edit(root, "native/sttransport.cpp",
+          "out8[7] = sl->rx_waits.load();",
+          "out8[7] = sl->rx_waits.load();\n  out8[8] = 0;")
+    findings = lint_abi.run(root)
+    assert any(
+        "st_node_shm_stats" in f and "out8" in f for f in findings
+    ), findings
+
+
 def test_abi_lint_flags_narrowed_counter_buffer(tmp_path):
     # the recurring widening class: native writes out22[21], python
     # allocates fewer slots -> garbage reads beyond the buffer

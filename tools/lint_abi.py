@@ -380,6 +380,28 @@ def run(repo: pathlib.Path) -> list[str]:
                 f"{width}-slot counter snapshot"
             )
 
+    # ---- reverse presence: the r14 shm ABI family ------------------------
+    # The original lint only walks Python -> native (an argtypes list with
+    # no native definition). The shm lane added native entry points whose
+    # ONLY caller is the negotiation path in peer.py — a native shm
+    # function that silently loses its ctypes declaration (or gets
+    # renamed on one side) would turn the whole lane into permanent
+    # TCP-fallback with no red anywhere. Families listed here must be
+    # declared on BOTH sides.
+    _BIDIRECTIONAL_FAMILIES = ("st_node_shm_",)
+    for name in sorted(nat):
+        if name.startswith(_BIDIRECTIONAL_FAMILIES) and name not in py:
+            findings.append(
+                f"{name}: native definition exists but no ctypes "
+                f"declaration does — the shm lane would silently never "
+                f"negotiate (bidirectional-family rule)"
+            )
+    if not any(n.startswith("st_node_shm_") for n in nat):
+        findings.append(
+            "parse floor: no native st_node_shm_* definitions found "
+            "(pattern rot, or the r14 lane ABI was removed?)"
+        )
+
     # ---- ctypes.Structure mirrors ----------------------------------------
     t_nat = L.strip_c_comments(L.read(repo, "native/sttransport.cpp"))
     t_py = py_sources["comm/transport.py"]

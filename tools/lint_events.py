@@ -98,6 +98,18 @@ def run(repo: pathlib.Path) -> list[str]:
                 f"says {a}, stengine.cpp says {b}"
             )
 
+    # r14 shm lane: the two lane events must exist under their canonical
+    # NAMES, not just any name — the chaos arms and the shm tests tally
+    # the timeline by name, so a silent rename (the numeric code still
+    # valid) would zero their counts without a red anywhere else
+    by_name = {v: k for k, v in names.items()}
+    for want in ("shm_lane_up", "shm_fallback"):
+        if want not in by_name:
+            findings.append(
+                f"obs/events.py CODE_NAMES lost the '{want}' event — the "
+                f"shm chaos tallies key on this exact name"
+            )
+
     # membership kinds: transport.py's EventKind enum doubles as timeline
     # codes 1..4 (Node::emit feeds both surfaces with one number)
     tpy = L.strip_py_comments(
