@@ -145,6 +145,33 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _conformance(hub) -> dict:
+    """r15 trace-conformance gate: drain the native ring one last time
+    and replay the run's merged timeline through the protocol specs'
+    trace acceptors (tools/protospec). The explorer proves the model;
+    this proves the live run still matches the model — a violation here
+    fails the chaos arm exactly like a convergence failure would.
+    ST_CLUSTER_TIMELINE_OUT additionally pins the raw timeline to a
+    file (the committed conformance regression fixtures)."""
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools",
+        ),
+    )
+    from protospec.conformance import check_timeline
+
+    hub.poll_native()
+    timeline_out = os.environ.get("ST_CLUSTER_TIMELINE_OUT", "")
+    if timeline_out:
+        hub.export_timeline(timeline_out)
+    report = check_timeline(hub.recorder.timeline())
+    if timeline_out:
+        report["timeline_out"] = timeline_out
+    return report
+
+
 def run_kill_restore(art_path: str) -> int:
     """The r12 lifecycle acceptance arm (module docstring)."""
     import tempfile
@@ -155,11 +182,20 @@ def run_kill_restore(art_path: str) -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
+    from shared_tensor_tpu import obs
     from shared_tensor_tpu.comm import faults
     from shared_tensor_tpu.comm.peer import create_or_fetch
     from shared_tensor_tpu.config import (
         Config, FaultConfig, LifecycleConfig, ObsConfig, TransportConfig,
     )
+
+    # full-run event capture for the r15 trace-conformance gate (the
+    # default postmortem window would roll early barrier events out and
+    # fake pause/resume imbalances)
+    hub = obs.hub()
+    hub.poll_native()
+    hub.recorder.clear()
+    hub.recorder.set_capacity(500_000)
 
     chaos_idx = NODES - 1
     skew_idx = 1  # restarted with v1 emission (the version-skew arm)
@@ -346,11 +382,17 @@ def run_kill_restore(art_path: str) -> int:
     # drop chaos + go-back-N converge EXACTLY, so the arms' bound is float
     # accumulation slack, not a chaos allowance (chaos_soak's corrupt-class
     # bounds don't apply — no corrupt faults here)
+    conf = _conformance(hub)
+    out["conformance"] = conf
     dev = float(np.max(np.abs(kr_final - un_final)))
     out["arms_max_deviation"] = dev
     out["bound"] = 1e-3
     out["pass"] = bool(
-        out["snapshot"]["ok"]
+        conf["pass"]
+        # >= 1 ROUTED event: a timeline none of whose events reaches an
+        # acceptor (e.g. after an event rename) verifies nothing
+        and conf["routed_events"] >= 1
+        and out["snapshot"]["ok"]
         and out["snapshot"]["duration_sec"] <= SNAP_BUDGET_S
         and out["restore"]["reconverged_pre_kill_mass"]
         and out["restore"]["duration_sec"] <= RESTORE_BUDGET_S
@@ -388,7 +430,9 @@ def run_kill_restore(art_path: str) -> int:
     print(
         f"cluster_chaos --kill-restore: snapshot "
         f"{out['snapshot']['duration_sec']:.2f}s, restore "
-        f"{out['restore']['duration_sec']:.2f}s, arms max dev {dev:.2e} -> "
+        f"{out['restore']['duration_sec']:.2f}s, arms max dev {dev:.2e}, "
+        f"conformance {conf['events']} events/"
+        f"{len(conf['violations'])} violations -> "
         f"{'PASS' if out['pass'] else 'FAIL'}",
         file=sys.stderr,
     )
@@ -649,8 +693,15 @@ def main() -> int:
         if trace_out:
             trace_export.export_file(trace_out, timeline)
             out["trace_export"] = trace_out
+        conf = _conformance(hub)
+        out["conformance"] = conf
         out["pass"] = bool(
-            all(converged)
+            conf["pass"]
+            # >= 1 ROUTED event: a timeline none of whose events
+            # reaches an acceptor (after an event rename, say)
+            # verifies nothing
+            and conf["routed_events"] >= 1
+            and all(converged)
             and drained
             and out["injected"]["fault_drop"] >= 1
             and out["injected"]["retransmit"] >= 1
@@ -691,7 +742,9 @@ def main() -> int:
     print(
         f"cluster_chaos: {out.get('trace_paths', {}).get('paths', 0)} paths, "
         f"contiguous {out.get('trace_paths', {}).get('contiguous_frac', 0):.3f}, "
-        f"digest_exact={out.get('digest_exact')} -> "
+        f"digest_exact={out.get('digest_exact')}, conformance "
+        f"{len(out.get('conformance', {}).get('violations', []))} "
+        f"violations -> "
         f"{'PASS' if out['pass'] else 'FAIL'}",
         file=sys.stderr,
     )

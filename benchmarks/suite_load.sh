@@ -15,36 +15,63 @@ RUNS="${1:-3}"
 OUT="${2:-/dev/stdout}"
 FAILED=0
 
-# Static-analysis gates (r13), FIRST so a red gate fails in seconds, not
-# after three 10-minute suite runs:
+# Static gate umbrella (r13 lints + analyze, r15 adds the protocol
+# model checker and folds all three under ST_SUITE_STATIC), FIRST so a
+# red gate fails in seconds, not after three 10-minute suite runs:
 #  - cross-tier lints (tools/): ABI/ctypes signatures + counter widths,
-#    wire kinds, obs event codes, metric-name schema coverage;
+#    wire kinds incl. the r14 v3/SWITCH/sendmmsg rows, obs event codes,
+#    metric-name schema coverage + dynamic-name ban, python-tier lock
+#    discipline (lint_locks);
 #  - clang -Wthread-safety -Werror + .clang-tidy over the native tier
 #    (ST_SUITE_ANALYZE=0 skips; auto-skips when clang is absent — this
-#    image ships gcc only, CI images with clang get the full gate).
-if [ "${ST_SUITE_LINT:-1}" = "1" ]; then
-  echo "--- lint gate (ABI / wire / events / metrics) ---" >>"$OUT"
-  for l in lint_abi lint_wire lint_events lint_metrics; do
-    python "tools/$l.py" --repo . >>"$OUT" 2>&1 || FAILED=1
-  done
-  [ "$FAILED" -ne 0 ] && { echo "FAIL: lint gate red" >>"$OUT"; exit 1; }
-fi
-if [ "${ST_SUITE_ANALYZE:-1}" = "1" ]; then
-  if command -v "${CLANG:-clang}" >/dev/null 2>&1; then
-    echo "--- analyze gate (clang -Wthread-safety -Werror) ---" >>"$OUT"
-    make -C native analyze >>"$OUT" 2>&1 || FAILED=1
-    if command -v "${CLANG_TIDY:-clang-tidy}" >/dev/null 2>&1; then
-      make -C native tidy >>"$OUT" 2>&1 || FAILED=1
+#    image ships gcc only, CI images with clang get the full gate);
+#  - the protospec model checker (tools/protospec/run_check.py): every
+#    protocol spec explored exhaustively + the three historical-bug
+#    mutations re-found, counts committed as the MODEL artifact
+#    (ST_SUITE_MODEL_OUT, default MODEL_r15.json; ST_SUITE_MODEL=0
+#    skips).
+# Per-gate wall-clock is logged ("gate <name>: <sec>s rc=<rc>") — the
+# r13/r14 notes say gate time is starting to matter, so the transcript
+# now carries the numbers to watch.
+gate_run() {  # gate_run <name> <cmd...>: append timing + rc, set FAILED
+  local name="$1"; shift
+  local t0 t1 rc
+  t0=$(date +%s.%N)
+  "$@" >>"$OUT" 2>&1; rc=$?
+  t1=$(date +%s.%N)
+  echo "gate $name: $(echo "$t1 $t0" | awk '{printf "%.2f", $1-$2}')s rc=$rc" >>"$OUT"
+  [ "$rc" -ne 0 ] && FAILED=1
+  return $rc
+}
+if [ "${ST_SUITE_STATIC:-1}" = "1" ]; then
+  echo "--- static gate (lint / analyze / model checker) ---" >>"$OUT"
+  if [ "${ST_SUITE_LINT:-1}" = "1" ]; then
+    for l in lint_abi lint_wire lint_events lint_metrics lint_locks; do
+      gate_run "$l" python "tools/$l.py" --repo .
+    done
+    [ "$FAILED" -ne 0 ] && { echo "FAIL: lint gate red" >>"$OUT"; exit 1; }
+  fi
+  if [ "${ST_SUITE_ANALYZE:-1}" = "1" ]; then
+    if command -v "${CLANG:-clang}" >/dev/null 2>&1; then
+      gate_run analyze make -C native analyze
+      if command -v "${CLANG_TIDY:-clang-tidy}" >/dev/null 2>&1; then
+        gate_run tidy make -C native tidy
+      fi
+      [ "$FAILED" -ne 0 ] && { echo "FAIL: analyze gate red" >>"$OUT"; exit 1; }
+    else
+      # honesty over silence (r14): this is a SKIPPED verification, not a
+      # passed one — `make -C native analyze` has never executed on a
+      # clang-less image, so the thread-safety annotations are unchecked
+      # prose here. The first box with clang runs the real gate above.
+      echo "--- analyze gate: SKIPPED-no-clang (make -C native analyze DID" \
+           "NOT RUN — thread-safety annotations are unverified on this" \
+           "image; CI/dev boxes with clang run the real gate) ---" >>"$OUT"
     fi
-    [ "$FAILED" -ne 0 ] && { echo "FAIL: analyze gate red" >>"$OUT"; exit 1; }
-  else
-    # honesty over silence (r14): this is a SKIPPED verification, not a
-    # passed one — `make -C native analyze` has never executed on a
-    # clang-less image, so the thread-safety annotations are unchecked
-    # prose here. The first box with clang runs the real gate above.
-    echo "--- analyze gate: SKIPPED-no-clang (make -C native analyze DID" \
-         "NOT RUN — thread-safety annotations are unverified on this" \
-         "image; CI/dev boxes with clang run the real gate) ---" >>"$OUT"
+  fi
+  if [ "${ST_SUITE_MODEL:-1}" = "1" ]; then
+    MODEL_OUT="${ST_SUITE_MODEL_OUT:-MODEL_r15.json}"
+    gate_run model_check python tools/protospec/run_check.py --out "$MODEL_OUT"
+    [ "$FAILED" -ne 0 ] && { echo "FAIL: model-checker gate red" >>"$OUT"; exit 1; }
   fi
 fi
 
@@ -163,10 +190,17 @@ if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_LIFECYCLE:-1}" = "1" ]; then
   # link (pre-kill and after the restart's fresh negotiation) with the
   # digest exact at quiesce. ST_SUITE_SHM=0 drops the flag (pure-TCP
   # lifecycle arm, the r12 shape).
+  # r15: the arm is ALSO the live trace-conformance gate — it replays
+  # its own flight-recorder timeline through the protospec trace
+  # acceptors and fails on any forbidden ordering, closing the
+  # spec<->implementation loop the model checker opened above.
   SHM_FLAG="--shm"
   [ "${ST_SUITE_SHM:-1}" = "0" ] && SHM_FLAG=""
-  JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py "$LIFE_OUT" \
-    --kill-restore $SHM_FLAG >/dev/null 2>>"$OUT" || FAILED=1
+  # stdout (the full JSON doc — it is the committed artifact) stays out
+  # of the transcript; stderr's one-line verdict + timing go in
+  gate_run lifecycle_chaos_conformance sh -c \
+    "JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py '$LIFE_OUT' \
+     --kill-restore $SHM_FLAG >/dev/null"
 fi
 
 # Sanitizer arm (r11): striping + adaptive precision put new hot code in

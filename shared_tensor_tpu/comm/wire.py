@@ -127,6 +127,24 @@ CTL = 15  # parent -> child: routed operator command (JSON)
 #: imports peer which imports this module, so wire cannot import compat).
 #: The bit gates the SYNC/WELCOME shm tails this module encodes/decodes.
 SHM_FLAG = 0x08
+#: r14 in-stream SWITCH marker (unstriped shm lanes): the length-prefix
+#: value the sender writes as its LAST data-plane byte on TCP before
+#: moving to the rings — above the transport's 1 GiB payload sanity cap,
+#: so it can never collide with a real frame length. Python-tier peers
+#: never negotiate the lane and so never see it on the wire; the value
+#: is mirrored here as the single protocol-constant source the wire lint
+#: (tools/lint_wire.py) and the protocol specs (tools/protospec)
+#: cross-check against sttransport.cpp's kShmSwitchLen — a silent drift
+#: would make an upgraded receiver mis-parse the marker as a length and
+#: tear the link down on every lane switch.
+SHM_SWITCH_LEN = 0xFFFFFFFD
+#: r14 sendmmsg batch cap: most queued messages the native sender folds
+#: into ONE kernel crossing on the clean send path (sttransport.cpp
+#: kCoalesce). Protocol-adjacent rather than wire-visible — but it
+#: bounds how many messages can shear together on a mid-batch failure,
+#: which the retransmission window's sizing assumes — so it lives here
+#: under the same lint tie as the header sizes.
+SENDMMSG_BATCH = 16
 
 _SYNC_FMT = "<IQ16s"  # num_leaves, total_n, layout digest
 _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot

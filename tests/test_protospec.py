@@ -1,0 +1,292 @@
+"""Protocol model checker + runtime trace conformance (r15 tentpole).
+
+Three layers, mirroring tools/protospec's own structure:
+
+1. the EXPLORER: every true spec explores clean (zero violations,
+   quiescence reachable, graph exhausted — not truncated), twice with
+   identical counts (the committed MODEL artifact pins exact numbers,
+   so nondeterminism is a bug);
+2. the RED TEAM: each seeded mutation — the three historical r10/r11/
+   r12 protocol bugs plus the extra lane-switch ordering mutation — is
+   FOUND within the documented depth bound, and its counterexample
+   trace REPLAYS through the mutated spec to the violating state (a
+   counterexample that can't be replayed is a checker bug);
+3. CONFORMANCE: the monitor accepts the committed CHAOS_r12/CHAOS_r14
+   fixture timelines (pinned from real cluster_chaos.py runs — spec
+   edits can't silently diverge from shipped behavior) and rejects a
+   battery of synthetic forbidden orderings, one per acceptor rule.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+TOOLS = REPO / "tools"
+sys.path.insert(0, str(TOOLS))
+
+from protospec import all_specs, explore  # noqa: E402
+from protospec.conformance import check_timeline, load_timeline  # noqa: E402
+
+FIXTURES = REPO / "tests" / "fixtures"
+
+#: the three hand-found historical bugs the checker must re-find
+#: (ISSUE r15 acceptance bar) — the extra mutations are gravy
+HISTORICAL = {
+    "sub.fresh_no_seq",  # r10: FRESH falsely verifying over a lost tail
+    "lane_stripe.requeue_before_kill",  # r11: last-stripe requeue livelock
+    "snap.async_pause",  # r12: pre-pause pass leaking mass across the cut
+}
+
+
+def _mutation_keys():
+    return {
+        f"{name}.{mut}"
+        for name, cls in all_specs().items()
+        for mut in cls.mutations
+    }
+
+
+# ---- explorer: true specs -------------------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(all_specs()))
+def test_true_spec_explores_clean(name):
+    res = explore(all_specs()[name]())
+    assert res.violations == [], [v.as_dict() for v in res.violations]
+    assert res.quiescent_reachable
+    assert not res.truncated_by_depth, (
+        f"{name}: frontier not exhausted at depth bound "
+        f"{res.depth_bound} — the artifact would overclaim"
+    )
+    assert res.states >= 30, f"{name}: {res.states} states is a toy graph"
+
+
+def test_exploration_is_deterministic():
+    for name, cls in all_specs().items():
+        a, b = explore(cls()), explore(cls())
+        assert (a.states, a.transitions) == (b.states, b.transitions), name
+
+
+# ---- red team: the seeded historical bugs ---------------------------------
+
+
+def test_historical_bugs_are_encoded():
+    assert HISTORICAL <= _mutation_keys()
+
+
+@pytest.mark.parametrize(
+    "name,mut",
+    [
+        (k.split(".")[0], k.split(".")[1])
+        for k in sorted(_mutation_keys())
+    ],
+)
+def test_mutation_is_found_within_bound(name, mut):
+    cls = all_specs()[name]
+    res = explore(cls(mutation=mut))
+    assert res.violations, (
+        f"{name}.{mut} NOT found within depth {res.depth_bound} — the "
+        f"checker is blind to this bug class"
+    )
+
+
+def test_mutation_counterexamples_replay():
+    """A counterexample must be a real path: replaying its action trace
+    from the initial state step-by-step (each action enabled where it
+    fires) must land in the reported violation."""
+    for key in sorted(_mutation_keys()):
+        name, mut = key.split(".")
+        spec = all_specs()[name](mutation=mut)
+        res = explore(spec)
+        v = res.violations[0]
+        s = spec.initial()
+        for act in v.trace:
+            assert act in spec.enabled(s), (key, act, s)
+            s = spec.apply(s, act)
+        if v.kind == "invariant":
+            assert spec.invariants(s), (key, s)
+        elif v.kind == "wedged":
+            assert not spec.enabled(s) and not spec.quiescent(s), (key, s)
+
+
+# ---- the committed MODEL artifact -----------------------------------------
+
+
+def test_model_artifact_matches_checker():
+    """MODEL_r15.json pins the explored state/transition counts; a spec
+    edit that changes the graph must re-commit the artifact, not drift
+    silently."""
+    path = REPO / "MODEL_r15.json"
+    doc = json.loads(path.read_text())
+    assert doc["pass"] is True
+    for name, cls in all_specs().items():
+        res = explore(cls())
+        pinned = doc["specs"][name]
+        assert (pinned["states"], pinned["transitions"]) == (
+            res.states,
+            res.transitions,
+        ), f"{name}: MODEL_r15.json is stale — re-run run_check.py"
+        assert pinned["violations"] == []
+        assert pinned["quiescent_reachable"] is True
+    for key in _mutation_keys():
+        assert doc["mutations"][key]["found"] is True, key
+
+
+def test_run_check_cli(tmp_path):
+    out = tmp_path / "MODEL.json"
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "protospec" / "run_check.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(out.read_text())
+    assert doc["pass"] is True
+    assert HISTORICAL <= set(doc["mutations"])
+
+
+# ---- conformance: the pinned chaos fixtures -------------------------------
+
+
+@pytest.mark.parametrize(
+    "fixture", ["CHAOS_r12_timeline.json", "CHAOS_r14_timeline.json"]
+)
+def test_conformance_accepts_committed_chaos_timelines(fixture):
+    """The regression pin: these timelines came from real (passing)
+    cluster_chaos kill-restore runs — r12 shape and r14 --shm shape. A
+    spec edit that rejects them has diverged from shipped behavior."""
+    tl = load_timeline(str(FIXTURES / fixture))
+    report = check_timeline(tl)
+    assert report["violations"] == [], report["violations"][:10]
+    assert report["events"] >= 100, "fixture lost its events"
+    assert report["scopes"] >= 5, "fixture no longer routes to acceptors"
+
+
+def test_conformance_cli_accepts_fixture_and_rejects_corruption(tmp_path):
+    fixture = FIXTURES / "CHAOS_r12_timeline.json"
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "protospec" / "run_conformance.py"),
+         str(fixture)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    # corrupt the fixture: strip every lifecycle_resume so some node is
+    # left paused — the monitor must go red, proving the fixture test
+    # can actually fail
+    doc = json.loads(fixture.read_text())
+    doc["timeline"] = [
+        e for e in doc["timeline"] if e["name"] != "lifecycle_resume"
+    ]
+    bad = tmp_path / "corrupt.json"
+    bad.write_text(json.dumps(doc))
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "protospec" / "run_conformance.py"),
+         str(bad)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "paused" in r.stdout
+
+
+# ---- conformance: synthetic forbidden orderings ---------------------------
+
+
+def _ev(name, node=1, link=1, arg=0, detail=""):
+    return {
+        "t_ns": 0, "tier": "py", "name": name, "node": node, "link": link,
+        "arg": arg, "detail": detail,
+    }
+
+
+def _violates(events, needle):
+    report = check_timeline(events)
+    assert report["violations"], f"accepted a timeline violating: {needle}"
+    assert any(needle in v for v in report["violations"]), report["violations"]
+
+
+def test_conformance_rejects_double_pause():
+    _violates(
+        [_ev("lifecycle_pause"), _ev("lifecycle_pause")],
+        "double lifecycle_pause",
+    )
+
+
+def test_conformance_rejects_bare_resume():
+    _violates([_ev("lifecycle_resume")], "while not paused")
+
+
+def test_conformance_rejects_node_left_paused():
+    _violates([_ev("lifecycle_pause")], "left paused")
+
+
+def test_conformance_rejects_unpaused_capture():
+    _violates([_ev("snap_shard")], "unpaused")
+
+
+def test_conformance_rejects_window_traffic_after_teardown():
+    _violates(
+        [_ev("blackhole_teardown"), _ev("retransmit")],
+        "after the link was torn down",
+    )
+
+
+def test_conformance_rejects_double_teardown():
+    _violates(
+        [_ev("blackhole_teardown"), _ev("blackhole_teardown")],
+        "second blackhole_teardown",
+    )
+
+
+def test_conformance_rejects_resync_before_attach():
+    _violates([_ev("sub_resync")], "before sub_attach")
+
+
+def test_conformance_rejects_double_lane_up():
+    _violates(
+        [_ev("shm_lane_up"), _ev("shm_lane_up")],
+        "shm_lane_up fired twice",
+    )
+
+
+def test_conformance_rejects_lane_up_after_fallback():
+    _violates(
+        [_ev("shm_fallback"), _ev("shm_lane_up")],
+        "shm_lane_up after shm_fallback",
+    )
+
+
+def test_conformance_rejects_dead_stripe_reattach():
+    _violates(
+        [_ev("stripe_down", arg=2), _ev("stripe_down", arg=2)],
+        "died twice",
+    )
+
+
+def test_conformance_rejects_drain_with_no_seal():
+    _violates([_ev("drain_begin")], "no seal")
+
+
+def test_conformance_accepts_legal_orderings():
+    ok = [
+        _ev("lifecycle_pause"),
+        _ev("snap_shard"),
+        _ev("lifecycle_resume"),
+        _ev("sub_attach", link=2),
+        _ev("sub_resync", link=2),
+        _ev("retransmit", link=3),
+        _ev("dedup_discard", link=3),
+        _ev("blackhole_teardown", link=3),
+        _ev("link_down", link=3),
+        _ev("shm_lane_up", link=4),
+        _ev("stripe_down", link=4, arg=0),
+        _ev("stripe_down", link=4, arg=1),
+        _ev("drain_begin", node=2),
+        _ev("seal", node=2),
+    ]
+    report = check_timeline(ok)
+    assert report["violations"] == [], report["violations"]
+    assert report["scopes"] >= 6

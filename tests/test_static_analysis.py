@@ -26,6 +26,7 @@ sys.path.insert(0, str(TOOLS))
 
 import lint_abi  # noqa: E402
 import lint_events  # noqa: E402
+import lint_locks  # noqa: E402
 import lint_metrics  # noqa: E402
 import lint_wire  # noqa: E402
 
@@ -36,6 +37,7 @@ _LINT_INPUTS = [
     "shared_tensor_tpu/comm/wire.py",
     "shared_tensor_tpu/comm/engine.py",
     "shared_tensor_tpu/comm/transport.py",
+    "shared_tensor_tpu/compat.py",
     "shared_tensor_tpu/obs/events.py",
     "shared_tensor_tpu/obs/schema.py",
 ]
@@ -79,7 +81,7 @@ def _cli(tool: str, repo: pathlib.Path) -> subprocess.CompletedProcess:
 
 
 @pytest.mark.parametrize(
-    "mod", [lint_abi, lint_wire, lint_events, lint_metrics]
+    "mod", [lint_abi, lint_wire, lint_events, lint_metrics, lint_locks]
 )
 def test_lint_passes_on_tree(mod):
     findings = mod.run(REPO)
@@ -88,7 +90,7 @@ def test_lint_passes_on_tree(mod):
 
 def test_lint_cli_green_exit_codes():
     for tool in ("lint_abi.py", "lint_wire.py", "lint_events.py",
-                 "lint_metrics.py"):
+                 "lint_metrics.py", "lint_locks.py"):
         r = _cli(tool, REPO)
         assert r.returncode == 0, (tool, r.stdout, r.stderr)
         assert "OK" in r.stdout
@@ -116,6 +118,49 @@ def test_wire_lint_flags_fault_injector_kind_set(tmp_path):
           "(kind0 == 0 || kind0 == 7)")
     findings = lint_wire.run(root)
     assert any("is_data" in f for f in findings), findings
+
+
+def test_wire_lint_flags_v3_header_drift(tmp_path):
+    # r14: the aligned v3 header is ONE size on both tiers; a drifted
+    # kHdrV3 makes every exact-length framing test reject v3 messages
+    root = _seed_tree(tmp_path)
+    _edit(root, "native/stengine.cpp",
+          "constexpr size_t kHdrV3 = 24;", "constexpr size_t kHdrV3 = 32;")
+    findings = lint_wire.run(root)
+    assert any("kHdrV3" in f and "HDR_V3" in f for f in findings), findings
+
+
+def test_wire_lint_flags_switch_marker_drift(tmp_path):
+    # r14: the in-stream SWITCH marker length — a drift means an
+    # upgraded receiver parses the marker as a (huge) frame length
+    root = _seed_tree(tmp_path)
+    _edit(root, "native/sttransport.cpp",
+          "constexpr uint32_t kShmSwitchLen = 0xFFFFFFFDu;",
+          "constexpr uint32_t kShmSwitchLen = 0xFFFFFFFEu;")
+    findings = lint_wire.run(root)
+    assert any("kShmSwitchLen" in f for f in findings), findings
+
+
+def test_wire_lint_flags_sendmmsg_batch_drift(tmp_path):
+    root = _seed_tree(tmp_path)
+    _edit(root, "native/sttransport.cpp",
+          "constexpr int kCoalesce = 16;", "constexpr int kCoalesce = 64;")
+    findings = lint_wire.run(root)
+    assert any("kCoalesce" in f and "SENDMMSG_BATCH" in f
+               for f in findings), findings
+
+
+def test_wire_lint_flags_shm_hello_flag_drift(tmp_path):
+    # the wire/compat twin declaration: the runtime assert catches this
+    # on import, but the lint must catch it statically (a seeded tree is
+    # never imported — and neither is a broken branch in CI until the
+    # suite runs)
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/compat.py",
+          "SYNC_FLAG_SHM = 0x08", "SYNC_FLAG_SHM = 0x10")
+    findings = lint_wire.run(root)
+    assert any("SYNC_FLAG_SHM" in f and "SHM_FLAG" in f
+               for f in findings), findings
 
 
 def test_event_lint_flags_unknown_and_drifted_code(tmp_path):
@@ -226,6 +271,75 @@ def test_metrics_lint_flags_legacy_alias_reintroduction(tmp_path):
     assert any("frames_out" in f and "legacy" in f for f in findings), (
         findings
     )
+
+
+def test_metrics_lint_flags_dynamic_fstring_name(tmp_path):
+    # r15: a dynamically-built st_* name never appears verbatim in any
+    # source line, so the literal grep is blind to it — the emitted
+    # metric ships undocumented. The f-string form is the one the
+    # labeled-gauge code would most naturally grow into.
+    root = _seed_tree(tmp_path, full_package=True)
+    _edit(root, "shared_tensor_tpu/comm/peer.py",
+          "def metrics(",
+          'DYN = f"st_dyn_gauge_{0}"\n    def metrics(')
+    findings = lint_metrics.run(root)
+    assert any(
+        "st_dyn_gauge_" in f and "dynamically-built" in f for f in findings
+    ), findings
+
+
+def test_metrics_lint_flags_dynamic_concat_name(tmp_path):
+    root = _seed_tree(tmp_path, full_package=True)
+    _edit(root, "shared_tensor_tpu/comm/peer.py",
+          "def metrics(",
+          'DYN = "st_dyn_" + "suffix"\n    def metrics(')
+    findings = lint_metrics.run(root)
+    assert any(
+        "st_dyn_" in f and "dynamically-built" in f for f in findings
+    ), findings
+
+
+def test_locks_lint_flags_blocking_send_under_ledger_lock(tmp_path):
+    # the deadlock shape r13's native annotations forbid, at the python
+    # tier: a blocking wire send under _ack_mu — the recv thread pops
+    # ACKs under the same lock, so a full send buffer can never drain
+    root = _seed_tree(tmp_path, full_package=True)
+    _edit(root, "shared_tensor_tpu/comm/peer.py",
+          "with self._ack_mu:\n            mo = sum(self._acked.values())",
+          "with self._ack_mu:\n"
+          "            self._send_blocking(1, b'x')\n"
+          "            mo = sum(self._acked.values())")
+    findings = lint_locks.run(root)
+    assert any(
+        "_send_blocking" in f and "_ack_mu" in f for f in findings
+    ), findings
+
+
+def test_locks_lint_flags_engine_abi_call_under_lock(tmp_path):
+    root = _seed_tree(tmp_path, full_package=True)
+    _edit(root, "shared_tensor_tpu/comm/peer.py",
+          "with self._ack_mu:\n            mo = sum(self._acked.values())",
+          "with self._ack_mu:\n"
+          "            self._engine.pause(True)\n"
+          "            mo = sum(self._acked.values())")
+    findings = lint_locks.run(root)
+    assert any(
+        "engine-ABI" in f and "_ack_mu" in f for f in findings
+    ), findings
+
+
+def test_locks_lint_skips_closures_under_lock(tmp_path):
+    # a closure DEFINED under a lock runs later — flagging it would
+    # make the lint unadoptable (callbacks are registered under locks
+    # all over the obs tier)
+    root = _seed_tree(tmp_path, full_package=True)
+    _edit(root, "shared_tensor_tpu/comm/peer.py",
+          "with self._ack_mu:\n            mo = sum(self._acked.values())",
+          "with self._ack_mu:\n"
+          "            cb = lambda: self._send_blocking(1, b'x')\n"
+          "            mo = sum(self._acked.values())")
+    findings = lint_locks.run(root)
+    assert findings == [], findings
 
 
 # ---- clang analyze / clang-tidy smoke (skipped without clang) -------------

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Python-tier lock-discipline lint — the r13 native hierarchy's twin.
+
+The native tier's lock order is machine-checked by clang thread-safety
+annotations (st_annotations.h: Engine::mu -> add_mu/TxPool::mu ->
+transport queues; leaves hold no further locks and NEVER block). The
+Python tier has the same discipline by convention only — this lint
+makes it a gate:
+
+    While holding a peer/obs/core lock, code must not
+      (a) perform a blocking wire operation (socket send/recv — the
+          recv thread ACKs under the same locks, so a full send buffer
+          held under the ledger lock deadlocks the ACK path that would
+          drain it), or
+      (b) call into the engine ABI (st_engine_* via the EngineTensor
+          wrapper — the native side takes Engine::mu, and python-lock ->
+          engine-mutex nests AGAINST the established order: the engine's
+          codec threads call back up into python-side collectors that
+          take these same locks).
+
+Checked locks (attribute names of ``with self.<lock>:`` /
+``with <obj>._mu:`` blocks): the peer ledger lock ``_ack_mu``, the core
+state lock ``_lock``, and the obs/pool ``_mu`` family. ``_lc_api_mu``
+is exempt by design — it serializes lifecycle API CALLERS across a
+result wait and is documented to be held across waits (comm/peer.py).
+
+Blocking set: ``_send_blocking`` / ``sendall`` / ``recv`` /
+``recv_into`` / ``connect`` / ``accept`` (wire I/O), plus any call on
+an ``_engine`` attribute (the ABI wrapper) except the documented
+non-blocking reads in ENGINE_SAFE.
+
+Nested function/lambda bodies inside a with-block are skipped: a
+closure defined under a lock usually runs after it (and a closure that
+doesn't is invisible to any static scope analysis — the TSan arm owns
+that residue).
+
+Like every lint here (tools/_lintlib.py): parses source text/AST only,
+never imports, ``run(repo) -> list[str]``, CLI exits 1 with findings.
+Red-tested on seeded violations in tests/test_static_analysis.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+if __package__ in (None, ""):
+    import _lintlib as L
+else:
+    from . import _lintlib as L
+
+#: lock attribute names whose critical sections must stay non-blocking
+LOCK_ATTRS = frozenset({"_ack_mu", "_lock", "_mu", "_state_lock"})
+
+#: blocking wire-operation method names (attribute position of a call)
+BLOCKING = frozenset(
+    {"_send_blocking", "sendall", "recv", "recv_into", "connect", "accept"}
+)
+
+#: engine-ABI wrapper methods that are documented NON-blocking reads
+#: (plain field loads / out-param counter copies, no Engine::mu wait
+#: that can nest against a python lock in practice): everything else on
+#: an ``_engine`` attribute is treated as an ABI entry.
+ENGINE_SAFE = frozenset({"is_destroyed"})
+
+#: (file, line) sites exempted with a written reason. Kept honest: a
+#: stale entry (site moved/removed) fails the lint.
+ALLOWED_SITES: dict[tuple[str, int], str] = {}
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    chain = []
+    while isinstance(node, ast.Attribute):
+        chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        chain.append(node.id)
+    return list(reversed(chain))
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel: str, findings: list[str]):
+        self.rel = rel
+        self.findings = findings
+        self.held: list[str] = []
+
+    # a closure body under a lock runs later (see module docstring)
+    def _skip(self, node):
+        held, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = held
+
+    def visit_FunctionDef(self, node):
+        self._skip(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._skip(node)
+
+    def visit_Lambda(self, node):
+        self._skip(node)
+
+    def visit_With(self, node):
+        locks = []
+        for item in node.items:
+            e = item.context_expr
+            if isinstance(e, ast.Attribute) and e.attr in LOCK_ATTRS:
+                locks.append(e.attr)
+            elif isinstance(e, ast.Name) and e.id in LOCK_ATTRS:
+                locks.append(e.id)
+        self.held.extend(locks)
+        self.generic_visit(node)
+        if locks:
+            del self.held[-len(locks):]
+
+    def visit_Call(self, node):
+        if self.held and isinstance(node.func, ast.Attribute):
+            site = (self.rel, node.lineno)
+            chain = _attr_chain(node.func)
+            method = chain[-1]
+            via_engine = "_engine" in chain[:-1]
+            bad = None
+            if method in BLOCKING:
+                bad = f"blocking wire call {'.'.join(chain)}"
+            elif via_engine and method not in ENGINE_SAFE:
+                bad = f"engine-ABI call {'.'.join(chain)}"
+            if bad and site not in ALLOWED_SITES:
+                self.findings.append(
+                    f"{self.rel}:{node.lineno}: {bad} while holding "
+                    f"{'+'.join(self.held)} — blocking I/O and engine "
+                    f"ABI entries must run unlocked (lint_locks.py "
+                    f"module docstring; add an ALLOWED_SITES entry with "
+                    f"a reason only if the nesting is provably safe)"
+                )
+        self.generic_visit(node)
+
+
+def run(repo: pathlib.Path) -> list[str]:
+    findings: list[str] = []
+    sources = sorted((repo / "shared_tensor_tpu").rglob("*.py"))
+    if not sources:
+        return ["scan found no sources (wrong --repo?)"]
+    seen_sites: set[tuple[str, int]] = set()
+    for path in sources:
+        rel = str(path.relative_to(repo))
+        try:
+            tree = ast.parse(path.read_text(errors="replace"))
+        except SyntaxError as e:
+            findings.append(f"{rel}: unparseable ({e})")
+            continue
+        v = _Visitor(rel, findings)
+        v.visit(tree)
+        for (f, ln) in ALLOWED_SITES:
+            if f == rel:
+                seen_sites.add((f, ln))
+    for site in sorted(set(ALLOWED_SITES) - seen_sites):
+        findings.append(
+            f"ALLOWED_SITES entry {site} names a file outside the scan — "
+            f"remove it"
+        )
+    return findings
+
+
+if __name__ == "__main__":
+    L.main(run)

@@ -2,7 +2,7 @@
 """Metric-name lint: every emitted st_* name is documented; legacy alias
 keys stay dead.
 
-Two contracts, both red gates:
+Three contracts, all red gates:
 
 1. (the r09 schema-lint, promoted from test-only to a suite gate) every
    ``st_*`` string literal in the Python package AND the native sources
@@ -14,6 +14,15 @@ Two contracts, both red gates:
    and the legacy metric keys from reappearing as dict keys in the
    delivery-metrics modules. Resurrecting a parallel non-schema namespace
    should fail CI by name, not slip in as "compat".
+3. (r15) DYNAMICALLY-BUILT ``st_*`` names — f-strings with a placeholder
+   inside the name, ``%``/``.format`` on an st_ literal, or string
+   concatenation extending an st_ prefix — evade contract 1's literal
+   grep entirely: the emitted name never appears in any source line, so
+   an undocumented metric ships invisibly. Base names must be complete
+   literals (labels are appended via schema.link_key, which this lint
+   does not flag — the base literal is intact); any construction site
+   that builds the NAME itself is a finding unless allowlisted with a
+   reason.
 """
 
 from __future__ import annotations
@@ -31,6 +40,34 @@ else:
 ALLOWED_NON_METRICS: dict[str, str] = {
     "st_trace": "Chrome trace_event category tag (trace_export.py)",
 }
+
+#: Dynamic-construction sites that are NOT metric names, keyed by the
+#: st_ prefix of the literal involved, each with a reason. Kept honest
+#: the same way: a stale entry fails the lint.
+ALLOWED_DYNAMIC: dict[str, str] = {
+    "st_postmortem_": "postmortem FILENAME prefix (obs/recorder.py), "
+                      "not a metric name",
+}
+
+#: Construction patterns that build an st_* NAME at runtime — each
+#: evades the literal grep above (the f-string/format/concat result
+#: never appears verbatim in source). The captured group is the st_
+#: prefix used for the allowlist lookup.
+DYNAMIC_PATTERNS = (
+    # f"st_foo_{x}" / f'st_foo_{x}...' — placeholder inside the name
+    (re.compile(r'''[fF]["'](st_[a-zA-Z0-9_]*)\{'''),
+     "f-string with a placeholder inside the st_ name"),
+    # "st_foo_%s" % ... / "st_foo_{}".format(...)
+    (re.compile(r'''["'](st_[a-zA-Z0-9_]*)%[sd]'''),
+     "%-formatting inside the st_ name"),
+    (re.compile(r'''["'](st_[a-zA-Z0-9_]*)\{?\}?["']\s*\.\s*format\('''),
+     ".format() on an st_ literal"),
+    # "st_foo_" + x — an st_ literal extended on its right (the
+    # x + "st_foo" direction produces a name whose st_ part IS the
+    # literal, which the schema scan above already sees whole)
+    (re.compile(r'''["'](st_[a-zA-Z0-9_]*)["']\s*\+'''),
+     "concatenation extending an st_ literal"),
+)
 
 #: The removed r08 legacy alias keys (and the machinery that served
 #: them). Any of these reappearing as a metrics dict key in the modules
@@ -83,6 +120,34 @@ def run(repo: pathlib.Path) -> list[str]:
     for stale in sorted(set(ALLOWED_NON_METRICS) - set(emitted)):
         findings.append(f"allowlist entry {stale!r} is no longer emitted "
                         f"anywhere — remove it")
+
+    # contract 3: dynamically-built st_* names (python sources only —
+    # the native tier has no runtime string building on metric names)
+    dynamic_hits: set[str] = set()
+    for path in sources:
+        if path.suffix != ".py":
+            continue
+        rel = str(path.relative_to(repo))
+        text = L.strip_py_comments(path.read_text(errors="replace"))
+        for pat, what in DYNAMIC_PATTERNS:
+            for m in pat.finditer(text):
+                prefix = m.group(1)
+                dynamic_hits.add(prefix)
+                if prefix in ALLOWED_DYNAMIC:
+                    continue
+                findings.append(
+                    f"{rel}: dynamically-built metric name "
+                    f"{prefix + '...'!r} ({what}) — the literal grep "
+                    f"cannot see the emitted name, so it ships "
+                    f"undocumented; build the full name as a literal "
+                    f"(labels go through schema.link_key) or add an "
+                    f"ALLOWED_DYNAMIC entry with a reason"
+                )
+    for stale in sorted(set(ALLOWED_DYNAMIC) - dynamic_hits):
+        findings.append(
+            f"ALLOWED_DYNAMIC entry {stale!r} no longer matches any "
+            f"construction site — remove it"
+        )
 
     # legacy alias surface must stay dead
     for rel in ("shared_tensor_tpu/obs/schema.py",) + LEGACY_KEY_SCOPE:

@@ -10,7 +10,15 @@ different message class) — exactly the mismatch class this lint makes a
 red gate instead of a debugging session.
 
 Checked, by name:
-  - every mapped k* constant in stengine.cpp equals its wire.py twin;
+  - every mapped k* constant in stengine.cpp equals its wire.py twin —
+    r14 included: the aligned v3 header size (kHdrV3 / HDR_V3);
+  - every mapped k* constant in sttransport.cpp equals its wire.py twin
+    (r14: the SWITCH marker length kShmSwitchLen / SHM_SWITCH_LEN and
+    the sendmmsg batch cap kCoalesce / SENDMMSG_BATCH);
+  - the SHM hello flag bit is identical in wire.py (SHM_FLAG) and
+    compat.py (SYNC_FLAG_SHM) — the import-time assert enforces this at
+    runtime, but a seeded-violation tree never imports, so the lint
+    re-checks it statically;
   - sttransport.cpp's ``is_data`` kind-literal set == {DATA, BURST, RDATA};
   - stengine.cpp's RDATA header-size ternary == (RDATA_HDR_T, RDATA_HDR).
 """
@@ -37,6 +45,18 @@ NATIVE_TO_WIRE = {
     "kDataHdrV1": "DATA_HDR",
     "kBurstHdrV1": "BURST_HDR",
     "kTraceBytes": "TRACE_BYTES",
+    # r14: ONE aligned header for v3 DATA/BURST — a size drift means
+    # every exact-length framing test on the other tier rejects the
+    # message as undecodable (the burst_wire_bytes failure class)
+    "kHdrV3": "HDR_V3",
+}
+
+#: sttransport.cpp constants with wire.py twins (r14 satellite): the
+#: unstriped lane's in-stream SWITCH marker length and the sendmmsg
+#: batch cap. Same parse, different file.
+TRANSPORT_TO_WIRE = {
+    "kShmSwitchLen": "SHM_SWITCH_LEN",
+    "kCoalesce": "SENDMMSG_BATCH",
 }
 
 
@@ -90,27 +110,50 @@ def run(repo: pathlib.Path) -> list[str]:
     wire = L.strip_py_comments(
         L.read(repo, "shared_tensor_tpu/comm/wire.py")
     )
+    compat = L.strip_py_comments(L.read(repo, "shared_tensor_tpu/compat.py"))
     nat = _native_constants(engine)
+    tnat = _native_constants(transport)
     py = _py_constants(wire)
+    pycompat = _py_constants(compat)
 
     if len(nat) < 5:
         findings.append(
             f"parse floor: only {len(nat)} k* constants found in "
             f"stengine.cpp (pattern rot?)"
         )
-    for cname, pyname in NATIVE_TO_WIRE.items():
-        if cname not in nat:
-            findings.append(f"stengine.cpp no longer defines {cname} "
-                            f"(update NATIVE_TO_WIRE if renamed)")
-            continue
-        if pyname not in py:
-            findings.append(f"comm/wire.py no longer defines {pyname}")
-            continue
-        if nat[cname] != py[pyname]:
-            findings.append(
-                f"kind/size mismatch: stengine.cpp {cname}={nat[cname]} "
-                f"vs wire.py {pyname}={py[pyname]}"
-            )
+    if len(tnat) < 2:
+        findings.append(
+            f"parse floor: only {len(tnat)} k* constants found in "
+            f"sttransport.cpp (pattern rot?)"
+        )
+    for src, table, consts in (
+        ("stengine.cpp", NATIVE_TO_WIRE, nat),
+        ("sttransport.cpp", TRANSPORT_TO_WIRE, tnat),
+    ):
+        for cname, pyname in table.items():
+            if cname not in consts:
+                findings.append(f"{src} no longer defines {cname} "
+                                f"(update the mapping if renamed)")
+                continue
+            if pyname not in py:
+                findings.append(f"comm/wire.py no longer defines {pyname}")
+                continue
+            if consts[cname] != py[pyname]:
+                findings.append(
+                    f"kind/size mismatch: {src} {cname}={consts[cname]} "
+                    f"vs wire.py {pyname}={py[pyname]}"
+                )
+
+    # the r14 shm hello flag bit is declared twice by necessity (compat
+    # cannot be imported from wire — the import cycle note at both
+    # sites); the runtime assert only fires on import, which a seeded
+    # lint tree never does, so the tie is re-checked statically here
+    if py.get("SHM_FLAG") != pycompat.get("SYNC_FLAG_SHM"):
+        findings.append(
+            f"SHM hello flag drift: wire.py SHM_FLAG={py.get('SHM_FLAG')} "
+            f"vs compat.py SYNC_FLAG_SHM={pycompat.get('SYNC_FLAG_SHM')} — "
+            f"every shm negotiation would silently fall back to TCP"
+        )
 
     # the transport fault injector's data-kind set (link_sender_loop
     # ``is_data``): the literals it matches must be exactly the data kinds

@@ -1,0 +1,58 @@
+"""protospec — executable state-machine specs of the wire protocols,
+an exhaustive adversarial explorer, and a runtime trace-conformance
+monitor (r15 tentpole).
+
+Every correctness bug the r10–r12 review rounds hand-found was a
+protocol-INTERLEAVING bug, not a data race: the pre-pause in-flight
+sender pass that leaked mass across the SNAP cut (r12), the last-stripe
+requeue livelock (r11), the FRESH mark that falsely verified freshness
+over a swallowed stream tail (r10). r13 made the data-race class
+machine-checked (TSan + annotations); this package does the same for the
+protocol class, three ways:
+
+1. **Specs** (``spec_*.py``): small declarative models of the
+   load-bearing protocols — the SYNC/WELCOME capability hello, the
+   per-link go-back-N window (ledgered and unledgered/FRESH modes), the
+   SNAP/SNAP_ACK/RESUME consistent-cut barrier, drain-node
+   seal→drain→close, and the r14 lane switch (SWITCH marker, stripe
+   promotion, ring backpressure). Each spec is states + enabled actions
+   + safety invariants + a quiescence predicate, written against the
+   PROTOCOL documentation in comm/wire.py / comm/peer.py /
+   sttransport.cpp — never importing the implementation.
+
+2. **Explorer** (``core.py``): exhaustive BFS of a spec's state graph
+   under an adversarial network (drop / duplicate / reorder / delay /
+   crash wherever the spec's channel model allows them), with state
+   hashing + per-spec symmetry canonicalization and a stated depth
+   bound. Checks every invariant in every reached state, flags wedged
+   states (pending work, no enabled action), and proves quiescence
+   reachable. Each spec also carries MUTATIONS encoding the three
+   historical bugs; ``run_check.py`` asserts the explorer finds every
+   mutation within the bound and none on the true specs, and commits
+   the state/transition counts as MODEL_r15.json.
+
+3. **Conformance** (``conformance.py``): the same specs replayed as
+   trace ACCEPTORS over real flight-recorder timelines (obs/recorder),
+   wired into benchmarks/cluster_chaos.py and suite_load.sh — the
+   explorer checks the model exhaustively, the live system checks the
+   model still describes it.
+
+Import with the repo's ``tools/`` directory on sys.path
+(``import protospec``), the same convention as the lint scripts.
+"""
+
+from .core import ExploreResult, Spec, Violation, explore  # noqa: F401
+
+__all__ = ["Spec", "Violation", "ExploreResult", "explore", "all_specs"]
+
+
+def all_specs():
+    """name -> spec CLASS for every true spec (mutations via
+    ``cls(mutation=...)``; ``cls.mutations`` names what each seeds)."""
+    from . import spec_drain, spec_gbn, spec_hello, spec_lane, spec_snap
+
+    out = {}
+    for mod in (spec_hello, spec_gbn, spec_snap, spec_drain, spec_lane):
+        for cls in mod.SPECS:
+            out[cls.name] = cls
+    return out

@@ -1,0 +1,137 @@
+"""Runtime trace conformance: replay a recorded flight-recorder
+timeline (obs/recorder.FlightRecorder) through the protocol specs'
+trace acceptors.
+
+The explorer (core.explore) checks the MODEL exhaustively; this module
+checks the LIVE SYSTEM still behaves like the model: every event
+sequence a real run records, projected onto a protocol scope (one
+node's lifecycle, one endpoint's link window, one link's lane), must be
+an ordering the spec allows. A conformance failure means either the
+implementation drifted from the spec or the spec no longer describes
+the shipped protocol — both are findings; neither is ignorable.
+
+Scopes and their acceptors (each defined next to its spec):
+
+- per node:         spec_snap.LifecycleAcceptor (pause/resume/capture)
+- per node:         spec_drain.DrainAcceptor   (drain_begin -> seal)
+- per (node, link): spec_gbn.LinkAcceptor      (go-back-N teardown)
+- per (node, link): spec_gbn.SubAcceptor       (attach-before-resync)
+- per (node, link): spec_lane.LaneAcceptor     (lane/stripe lifecycle)
+- per (node, link): spec_hello.HelloAcceptor   (one negotiation verdict)
+
+Events the specs don't model pass through untouched — a timeline is a
+lossy projection (the native ring drops under overflow and the
+recorder window is bounded), so acceptors are permissive about absence
+and strict about forbidden orderings. ``check_timeline`` is the
+library entry; ``run_conformance.py`` is the CLI; cluster_chaos.py
+gates its chaos arms on it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+from .core import iter_events
+from .spec_drain import DrainAcceptor
+from .spec_gbn import LinkAcceptor, SubAcceptor
+from .spec_hello import HelloAcceptor
+from .spec_lane import LaneAcceptor
+from .spec_snap import LifecycleAcceptor
+
+#: event name -> (acceptor class, scope kind). "node" scopes key on the
+#: node id; "link" scopes on (node, link). One event may drive several
+#: acceptors (link_down closes both the window and the lane).
+_ROUTES: list = [
+    (
+        frozenset(
+            {
+                "lifecycle_pause",
+                "lifecycle_resume",
+                "snap_begin",
+                "snap_shard",
+                "snap_done",
+            }
+        ),
+        LifecycleAcceptor,
+        "node",
+    ),
+    (frozenset({"drain_begin", "seal"}), DrainAcceptor, "node"),
+    (
+        frozenset(
+            {
+                "retransmit",
+                "dedup_discard",
+                "send_window_stall",
+                "blackhole_teardown",
+                "link_down",
+            }
+        ),
+        LinkAcceptor,
+        "link",
+    ),
+    (frozenset({"sub_attach", "sub_resync"}), SubAcceptor, "link"),
+    (
+        frozenset(
+            {"shm_lane_up", "shm_fallback", "stripe_down", "link_down"}
+        ),
+        LaneAcceptor,
+        "link",
+    ),
+    (frozenset({"shm_lane_up", "shm_fallback"}), HelloAcceptor, "link"),
+]
+
+
+def check_timeline(timeline: Iterable[Any]) -> dict:
+    """Replay ``timeline`` (Event objects or their as_dict shapes)
+    through every spec acceptor. Returns a report dict; the gate
+    condition is ``report["violations"] == []``."""
+    acceptors: dict[tuple, Any] = {}
+    events = 0
+    routed = 0
+    for e in iter_events(timeline):
+        events += 1
+        name = e["name"]
+        hit = False
+        for names, cls, kind in _ROUTES:
+            if name not in names:
+                continue
+            key = (
+                (cls.__name__, e["node"])
+                if kind == "node"
+                else (cls.__name__, e["node"], e["link"])
+            )
+            acc = acceptors.get(key)
+            if acc is None:
+                scope = (
+                    f"{cls.__name__} node={e['node']}"
+                    if kind == "node"
+                    else f"{cls.__name__} node={e['node']} link={e['link']}"
+                )
+                acc = acceptors[key] = cls(scope)
+            acc.step(e)
+            hit = True
+        routed += hit
+    violations: list[str] = []
+    for acc in acceptors.values():
+        violations.extend(acc.finish())
+    return {
+        "events": events,
+        "routed_events": routed,
+        "scopes": len(acceptors),
+        "violations": violations,
+        "pass": not violations,
+    }
+
+
+def load_timeline(path: str) -> list[dict]:
+    """Read a timeline file: either a bare JSON list of event dicts or
+    an object with a ``timeline`` key (the postmortem / fixture
+    shape)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        doc = doc.get("timeline", [])
+    if not isinstance(doc, list):
+        raise ValueError(f"{path}: not a timeline (list or {{'timeline': …}})")
+    return doc

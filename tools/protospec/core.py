@@ -1,0 +1,294 @@
+"""Spec interface + exhaustive explorer (the protospec engine).
+
+A spec is a *closed* transition system: a hashable initial state, an
+``enabled(state)`` enumeration of every action any participant — node,
+timer, or adversarial network — may take, and a pure
+``apply(state, action)``. The adversary is not a separate layer: a spec
+that allows the network to drop a message simply enumerates the drop as
+an enabled action, so exhaustive BFS over actions IS exhaustive
+adversarial exploration (delay falls out of interleaving: "not
+delivered yet" is always a reachable ordering).
+
+The explorer is deliberately plain: breadth-first over canonicalized
+states (``canon`` is the per-spec symmetry reduction — e.g. sorting
+interchangeable peers), a seen-set of state hashes, invariants checked
+at every state, and three verdict classes:
+
+- **invariant**: a reached state violates a named safety property;
+- **wedged**: a reached non-quiescent state has NO enabled action —
+  the model-level shape of a livelock/deadlock (a real spin-forever is
+  modeled as "the blocked action is not enabled", so the wedge is a
+  missing successor, not an infinite path);
+- **no-quiescence**: the whole bounded graph contains no quiescent
+  state (the protocol cannot finish even with a cooperative adversary).
+
+Counterexamples are reconstructed from a predecessor map and reported
+as the action path from the initial state.
+
+States are value objects (tuples of primitives / frozensets); specs
+never mutate them. Determinism matters: the committed MODEL artifact
+pins exact state/transition counts, so ``enabled`` must return a
+deterministically ordered list.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Hashable, Iterable, Optional
+
+
+class Spec:
+    """Base class for protocol specs. Subclasses define the five hooks
+    and two documented bounds:
+
+    - ``name``: artifact/report key;
+    - ``depth_bound``: BFS depth the checker explores to (committed in
+      MODEL_r15.json — "verified to depth D" is the honest claim);
+    - ``mutations``: mutation name -> the historical bug it seeds
+      (constructed via ``Spec(mutation=name)``).
+    """
+
+    name: str = "base"
+    depth_bound: int = 32
+    mutations: dict[str, str] = {}
+
+    def __init__(self, mutation: Optional[str] = None):
+        if mutation is not None and mutation not in self.mutations:
+            raise ValueError(
+                f"{self.name}: unknown mutation {mutation!r} "
+                f"(have {sorted(self.mutations)})"
+            )
+        self.mutation = mutation
+
+    # -- transition system ---------------------------------------------------
+
+    def initial(self) -> Hashable:
+        raise NotImplementedError
+
+    def enabled(self, state) -> list:
+        """Deterministically ordered list of hashable actions."""
+        raise NotImplementedError
+
+    def apply(self, state, action) -> Hashable:
+        raise NotImplementedError
+
+    # -- verdicts ------------------------------------------------------------
+
+    def invariants(self, state) -> list[str]:
+        """Names of every safety property this state violates."""
+        return []
+
+    def quiescent(self, state) -> bool:
+        """The protocol has finished cleanly in this state."""
+        raise NotImplementedError
+
+    def canon(self, state) -> Hashable:
+        """Symmetry reduction: map a state to its equivalence-class
+        representative (default: identity)."""
+        return state
+
+
+@dataclasses.dataclass
+class Violation:
+    kind: str  # "invariant" | "wedged" | "no-quiescence"
+    detail: str
+    depth: int
+    trace: tuple  # action path from the initial state
+
+    def as_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "detail": self.detail,
+            "depth": self.depth,
+            "trace": [repr(a) for a in self.trace],
+        }
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    spec: str
+    mutation: Optional[str]
+    states: int
+    transitions: int
+    depth_bound: int
+    max_depth_reached: int
+    truncated_by_depth: bool
+    quiescent_reachable: bool
+    violations: list[Violation]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and self.quiescent_reachable
+
+    def as_dict(self) -> dict:
+        return {
+            "spec": self.spec,
+            "mutation": self.mutation,
+            "states": self.states,
+            "transitions": self.transitions,
+            "depth_bound": self.depth_bound,
+            "max_depth_reached": self.max_depth_reached,
+            "truncated_by_depth": self.truncated_by_depth,
+            "quiescent_reachable": self.quiescent_reachable,
+            "violations": [v.as_dict() for v in self.violations],
+        }
+
+
+def _trace(parent: dict, key) -> tuple:
+    path = []
+    while True:
+        pkey, act = parent[key]
+        if act is None:
+            break
+        path.append(act)
+        key = pkey
+    return tuple(reversed(path))
+
+
+def explore(
+    spec: Spec,
+    depth_bound: Optional[int] = None,
+    max_states: int = 2_000_000,
+    max_violations: int = 4,
+) -> ExploreResult:
+    """Exhaustive BFS of ``spec`` to its depth bound.
+
+    Stops collecting counterexamples after ``max_violations`` (the first
+    few traces are what a human debugs; the count in the artifact stays
+    honest via ``violations != []``). ``max_states`` is a hard memory
+    backstop — hitting it raises, because a truncated-by-memory run
+    must never masquerade as an exhaustive one.
+    """
+    bound = spec.depth_bound if depth_bound is None else depth_bound
+    init = spec.initial()
+    ckey = spec.canon(init)
+    seen: set = {ckey}
+    parent: dict = {ckey: (None, None)}
+    frontier: list = [(init, ckey)]
+    violations: list[Violation] = []
+    quiescent = spec.quiescent(init)
+    states, transitions, depth = 1, 0, 0
+    truncated = False
+
+    bad = spec.invariants(init)
+    for b in bad[: max(0, max_violations - len(violations))]:
+        violations.append(Violation("invariant", b, 0, ()))
+
+    while frontier:
+        if depth >= bound:
+            truncated = True
+            break
+        nxt: list = []
+        for state, key in frontier:
+            acts = spec.enabled(state)
+            if not acts:
+                if not spec.quiescent(state) and len(violations) < max_violations:
+                    violations.append(
+                        Violation(
+                            "wedged",
+                            f"non-quiescent state has no enabled action: "
+                            f"{state!r}",
+                            depth,
+                            _trace(parent, key),
+                        )
+                    )
+                continue
+            for act in acts:
+                t = spec.apply(state, act)
+                transitions += 1
+                tkey = spec.canon(t)
+                if tkey in seen:
+                    continue
+                seen.add(tkey)
+                parent[tkey] = (key, act)
+                states += 1
+                if states > max_states:
+                    raise RuntimeError(
+                        f"{spec.name}: exceeded {max_states} states — the "
+                        f"model must shrink (an exhausted-memory run is "
+                        f"not an exhaustive one)"
+                    )
+                for b in spec.invariants(t):
+                    if len(violations) < max_violations:
+                        violations.append(
+                            Violation(
+                                "invariant", b, depth + 1,
+                                _trace(parent, tkey),
+                            )
+                        )
+                if spec.quiescent(t):
+                    quiescent = True
+                nxt.append((t, tkey))
+        frontier = nxt
+        if frontier:
+            depth += 1
+
+    if not quiescent and len(violations) < max_violations:
+        violations.append(
+            Violation(
+                "no-quiescence",
+                f"no quiescent state reachable within depth {bound}",
+                depth,
+                (),
+            )
+        )
+    return ExploreResult(
+        spec=spec.name,
+        mutation=spec.mutation,
+        states=states,
+        transitions=transitions,
+        depth_bound=bound,
+        max_depth_reached=depth,
+        truncated_by_depth=truncated,
+        quiescent_reachable=quiescent,
+        violations=violations,
+    )
+
+
+# -- trace-acceptor base -----------------------------------------------------
+
+
+class TraceAcceptor:
+    """Runtime-conformance counterpart of a spec: a monitor that replays
+    one SCOPE of a recorded timeline (one node's lifecycle, one link's
+    window, ...) through the spec's legal orderings. ``step`` consumes
+    one event dict (obs/events.Event.as_dict shape) and records any
+    violation; ``finish`` closes end-of-run obligations ("no node left
+    paused" is only checkable at the end).
+
+    Acceptors must be PERMISSIVE about events they don't model (a
+    timeline is a lossy projection of the run — the ring can drop
+    records under overflow) and STRICT about orderings the spec forbids:
+    an accepted violating trace is worse than a rejected honest one.
+    """
+
+    scope: str = ""
+
+    def __init__(self, scope: str = ""):
+        self.scope = scope
+        self.violations: list[str] = []
+
+    def _flag(self, msg: str) -> None:
+        self.violations.append(f"[{self.scope}] {msg}")
+
+    def step(self, event: dict) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> list[str]:
+        return self.violations
+
+
+def iter_events(timeline: Iterable[Any]) -> Iterable[dict]:
+    """Normalize a timeline (Event objects or dicts) to dicts with the
+    Event.as_dict keys present (detail/extra defaulted)."""
+    for e in timeline:
+        if isinstance(e, dict):
+            d = dict(e)
+        else:  # obs/events.Event
+            d = e.as_dict()
+        d.setdefault("detail", "")
+        d.setdefault("extra", 0)
+        d.setdefault("node", 0)
+        d.setdefault("link", 0)
+        d.setdefault("arg", 0)
+        yield d

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Model-checker gate: exhaustively explore every protocol spec to its
+documented depth bound, then red-team the checker itself by asserting
+the three seeded historical-bug mutations (r10 fresh_no_seq, r11
+requeue_before_kill, r12 async_pause — plus the extra lane-switch
+ordering mutation) are each FOUND within the same bound.
+
+Exit 0 iff every TRUE spec explores clean (zero violations, quiescence
+reachable, not truncated by the state backstop) AND every mutation is
+caught. Writes the state/transition counts as the round's MODEL
+artifact (default MODEL_r15.json) — the committed artifact pins the
+exact counts, so a spec edit that silently changes the explored space
+shows up as a diff, not a mystery.
+
+Usage: python tools/protospec/run_check.py [--out MODEL_r15.json]
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+if __package__ in (None, ""):
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from protospec import all_specs, explore
+else:
+    from . import all_specs, explore
+
+
+def run(out_path: str | None) -> int:
+    doc: dict = {"artifact": "protospec model check", "specs": {},
+                 "mutations": {}}
+    ok = True
+    t0 = time.monotonic()
+    for name, cls in sorted(all_specs().items()):
+        res = explore(cls())
+        doc["specs"][name] = res.as_dict()
+        status = "OK" if res.ok and not res.truncated_by_depth else "FAIL"
+        if status == "FAIL":
+            ok = False
+        print(
+            f"spec {name}: {res.states} states / {res.transitions} "
+            f"transitions to depth {res.max_depth_reached} "
+            f"(bound {res.depth_bound}) — "
+            f"{len(res.violations)} violation(s), quiescent="
+            f"{res.quiescent_reachable} [{status}]"
+        )
+        for v in res.violations:
+            print(f"  {v.kind}: {v.detail}")
+            if v.trace:
+                print(f"    trace: {' -> '.join(repr(a) for a in v.trace)}")
+        for mut in sorted(cls.mutations):
+            mres = explore(cls(mutation=mut))
+            found = bool(mres.violations)
+            if not found:
+                ok = False
+            first = mres.violations[0] if found else None
+            doc["mutations"][f"{name}.{mut}"] = {
+                "seeds": cls.mutations[mut],
+                "found": found,
+                "states": mres.states,
+                "transitions": mres.transitions,
+                "first_violation": first.as_dict() if first else None,
+            }
+            print(
+                f"  mutation {name}.{mut}: "
+                + (
+                    f"FOUND at depth {first.depth} ({first.kind}: "
+                    f"{first.detail})"
+                    if found
+                    else "NOT FOUND — the checker cannot see this bug class"
+                )
+            )
+    doc["duration_sec"] = round(time.monotonic() - t0, 3)
+    doc["pass"] = ok
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"wrote {out_path}")
+    print(f"model check: {'PASS' if ok else 'FAIL'} ({doc['duration_sec']}s)")
+    return 0 if ok else 1
+
+
+def main() -> int:
+    out = None
+    args = sys.argv[1:]
+    if args and args[0] == "--out":
+        out = args[1]
+    elif args:
+        out = args[0]
+    return run(out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

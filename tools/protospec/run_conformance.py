@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Trace-conformance CLI: replay one or more recorded timelines (flight
+recorder JSON — a bare event list, a ``{"timeline": [...]}`` fixture,
+or an obs postmortem file) through the protocol specs' trace acceptors.
+
+Exit 0 iff every timeline is accepted. A rejection names the scope and
+the forbidden ordering — either the implementation drifted from the
+spec or the spec no longer describes shipped behavior.
+
+Usage: python tools/protospec/run_conformance.py TIMELINE.json [...]
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+if __package__ in (None, ""):
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from protospec.conformance import check_timeline, load_timeline
+else:
+    from .conformance import check_timeline, load_timeline
+
+
+def main() -> int:
+    paths = sys.argv[1:]
+    if not paths:
+        print(__doc__)
+        return 2
+    ok = True
+    for path in paths:
+        report = check_timeline(load_timeline(path))
+        print(
+            f"{path}: {report['events']} events, "
+            f"{report['routed_events']} routed, {report['scopes']} scopes "
+            f"— {'PASS' if report['pass'] else 'FAIL'}"
+        )
+        for v in report["violations"]:
+            print(f"  {v}")
+        ok = ok and report["pass"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

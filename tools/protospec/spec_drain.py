@@ -1,0 +1,256 @@
+"""Drain-node spec: seal -> drain -> close with child re-graft
+(comm/peer.py leave()/drain_node, the r06 carry/re-graft discipline).
+
+A 3-node chain G <- T <- C (G the surviving parent, T the drain target,
+C its child). Mass units carry identities so conservation and
+exactly-once are set algebra, not counters:
+
+- C and T produce units; up-flow rides ledgered links (a unit stays in
+  its sender's ledger until the receiver ACKs it — the ACK implies the
+  receiver APPLIED it);
+- the routed drain command SEALS T: sealed ingress is discarded
+  WITHOUT acking, so every in-flight unit stays in C's ledger and rolls
+  back into C's carry when the link dies — the sender's mass is never
+  half-applied at a dying node;
+- T closes only after draining everything it OWES: its own residual
+  and its unacked uplink ledger must be empty (the close guard — the
+  "drain everything it owes" rule);
+- C re-grafts to G; the join's diff semantics deliver exactly the
+  units G lacks (carry minus G's state), so redelivery cannot
+  double-apply.
+
+Invariants: ``exactly-once`` (no unit applied twice at G),
+``conservation`` (every produced unit is applied at G or retained in a
+ledger / residual / carry / channel — never silently dropped),
+``closed-owing-nothing`` (a closed T with undrained mass). Quiescence:
+T closed, C re-grafted, G holding every produced unit, all channels
+and ledgers empty.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from .core import Spec, TraceAcceptor
+
+C_CAP = 2  # units produced at C (ids 1..2)
+T_ID = 3  # the one unit produced at T
+
+
+class DrainState(NamedTuple):
+    mode: int  # T: 0 normal / 1 sealed / 2 closed
+    regrafted: bool
+    prod_c: int
+    prod_t: int
+    applied_t: frozenset
+    applied_g: frozenset
+    res_t: frozenset  # applied at T, not yet forwarded to G
+    led_c: frozenset  # C->T unacked
+    led_t: frozenset  # T->G unacked
+    led_cg: frozenset  # C->G unacked (post-regraft)
+    carry_c: frozenset
+    chan_ct: tuple
+    chan_tg: tuple
+    chan_cg: tuple
+    ack_tc: tuple
+    ack_gt: tuple
+    ack_gc: tuple
+    double_apply: int
+
+
+class DrainSpec(Spec):
+    name = "drain"
+    depth_bound = 30
+    mutations: dict[str, str] = {}
+
+    def initial(self):
+        e = frozenset()
+        return DrainState(
+            0, False, 0, 0, e, e, e, e, e, e, e, (), (), (), (), (), (), 0
+        )
+
+    def enabled(self, s: DrainState):
+        acts = []
+        if s.prod_c < C_CAP:
+            acts.append(("produce_c",))
+        if s.mode == 0 and s.prod_t < 1:
+            acts.append(("produce_t",))
+        if s.mode < 2 and s.res_t:
+            acts.append(("fwd_t",))
+        if s.mode == 0:
+            acts.append(("drain_cmd",))
+        if s.mode == 1 and not s.res_t and not s.led_t:
+            acts.append(("close_t",))
+        if s.mode == 2 and not s.regrafted:
+            acts.append(("regraft",))
+        for ch in ("chan_ct", "chan_tg", "chan_cg", "ack_tc", "ack_gt",
+                   "ack_gc"):
+            if getattr(s, ch):
+                acts.append(("deliver", ch))
+        return acts
+
+    def apply(self, s: DrainState, a):
+        kind = a[0]
+        if kind == "produce_c":
+            uid = s.prod_c + 1
+            s = s._replace(prod_c=uid)
+            if s.regrafted:
+                return s._replace(
+                    led_cg=s.led_cg | {uid}, chan_cg=s.chan_cg + (uid,)
+                )
+            if s.mode < 2:
+                return s._replace(
+                    led_c=s.led_c | {uid}, chan_ct=s.chan_ct + (uid,)
+                )
+            # orphaned (uplink dead, not yet re-grafted): the unit lands
+            # in the live carry slot and rides the re-graft
+            return s._replace(carry_c=s.carry_c | {uid})
+        if kind == "produce_t":
+            return s._replace(
+                prod_t=1,
+                applied_t=s.applied_t | {T_ID},
+                res_t=s.res_t | {T_ID},
+            )
+        if kind == "fwd_t":
+            uid = min(s.res_t)
+            return s._replace(
+                res_t=s.res_t - {uid},
+                led_t=s.led_t | {uid},
+                chan_tg=s.chan_tg + (uid,),
+            )
+        if kind == "drain_cmd":
+            return s._replace(mode=1)
+        if kind == "close_t":
+            # the CT and TG links die with T: C rolls its unacked ledger
+            # into the carry (LINK_DOWN -> rollback), sockets clear
+            return s._replace(
+                mode=2,
+                carry_c=s.carry_c | s.led_c,
+                led_c=frozenset(),
+                chan_ct=(),
+                chan_tg=(),
+                ack_tc=(),
+                ack_gt=(),
+            )
+        if kind == "regraft":
+            # diff join: the handshake's parent-minus-child seeding means
+            # exactly the units G lacks stream over the new link
+            to_send = s.carry_c - s.applied_g
+            return s._replace(
+                regrafted=True,
+                carry_c=frozenset(),
+                led_cg=s.led_cg | to_send,
+                chan_cg=s.chan_cg + tuple(sorted(to_send)),
+            )
+        if kind == "deliver":
+            ch = a[1]
+            q = getattr(s, ch)
+            uid, rest = q[0], q[1:]
+            s = s._replace(**{ch: rest})
+            if ch == "chan_ct":
+                if s.mode == 0:
+                    return s._replace(
+                        applied_t=s.applied_t | {uid},
+                        res_t=s.res_t | {uid},
+                        ack_tc=s.ack_tc + (uid,),
+                    )
+                return s  # sealed: discard WITHOUT acking — the unit
+                # stays in C's ledger and survives into the carry
+            if ch == "chan_tg":
+                if uid in s.applied_g:
+                    return s._replace(
+                        double_apply=s.double_apply + 1,
+                        ack_gt=s.ack_gt + (uid,),
+                    )
+                return s._replace(
+                    applied_g=s.applied_g | {uid}, ack_gt=s.ack_gt + (uid,)
+                )
+            if ch == "chan_cg":
+                if uid in s.applied_g:
+                    return s._replace(
+                        double_apply=s.double_apply + 1,
+                        ack_gc=s.ack_gc + (uid,),
+                    )
+                return s._replace(
+                    applied_g=s.applied_g | {uid}, ack_gc=s.ack_gc + (uid,)
+                )
+            if ch == "ack_tc":
+                return s._replace(led_c=s.led_c - {uid})
+            if ch == "ack_gt":
+                return s._replace(led_t=s.led_t - {uid})
+            if ch == "ack_gc":
+                return s._replace(led_cg=s.led_cg - {uid})
+        raise AssertionError(a)
+
+    def invariants(self, s: DrainState):
+        bad = []
+        if s.double_apply:
+            bad.append("exactly-once: a unit was applied twice at G")
+        produced = set(range(1, s.prod_c + 1)) | (
+            {T_ID} if s.prod_t else set()
+        )
+        held = (
+            s.applied_g
+            | s.led_c
+            | s.led_t
+            | s.led_cg
+            | s.res_t
+            | s.carry_c
+            | set(s.chan_ct)
+            | set(s.chan_tg)
+            | set(s.chan_cg)
+        )
+        if produced - held:
+            bad.append(
+                "conservation: a produced unit is neither applied at G "
+                "nor retained anywhere"
+            )
+        if s.mode == 2 and (s.res_t or s.led_t):
+            bad.append("closed-owing-nothing: T closed with undrained mass")
+        return bad
+
+    def quiescent(self, s: DrainState):
+        # T's own unit is optional: a drain command landing before T
+        # ever produced simply drains a unit-less node (the app stops
+        # adding at seal time — leave() semantics)
+        produced = set(range(1, s.prod_c + 1)) | (
+            {T_ID} if s.prod_t else set()
+        )
+        return (
+            s.mode == 2
+            and s.regrafted
+            and s.prod_c == C_CAP
+            and s.applied_g == produced
+            and not (s.led_c or s.led_t or s.led_cg or s.res_t or s.carry_c)
+            and not (s.chan_ct or s.chan_tg or s.chan_cg)
+            and not (s.ack_tc or s.ack_gt or s.ack_gc)
+        )
+
+
+class DrainAcceptor(TraceAcceptor):
+    """One node's drain scope: a routed drain is accepted once
+    (drain_begin), and the seal it promises must actually fire before
+    the run ends — a drain_begin with no seal is a target that
+    acknowledged the command and never left."""
+
+    def __init__(self, scope: str = ""):
+        super().__init__(scope)
+        self._drains = 0
+        self._seals = 0
+
+    def step(self, event: dict) -> None:
+        name = event["name"]
+        if name == "drain_begin":
+            self._drains += 1
+            if self._drains > 1:
+                self._flag("drain_begin accepted twice on one node")
+        elif name == "seal":
+            self._seals += 1
+
+    def finish(self) -> list[str]:
+        if self._drains and not self._seals:
+            self._flag("drain_begin with no seal before end of run")
+        return self.violations
+
+
+SPECS = [DrainSpec]

@@ -1,0 +1,333 @@
+"""Per-link window specs: the ledgered go-back-N protocol and the
+unledgered subscriber stream with verifiable FRESH marks.
+
+Modeled against the protocol documentation in comm/wire.py (module
+docstring: the tx_seq/ACK/go-back-N rules) and the serve-tier FRESH
+format note (wire.py: FRESH carries ``last_seq`` so the mark is
+verifiable on an unledgered link).
+
+**GbnSpec** — one sender, one receiver, both channel directions fully
+adversarial (drop / duplicate / reorder at any step; delay is
+interleaving). Sender keeps every unacked seq in its ledger bounded by
+a window, retransmits the head on (non-deterministic) timeout, and
+tears the link down into the carry after ``retry_limit`` fruitless
+rounds. Receiver applies only ``seq == rx+1``, discards duplicates
+without re-applying, discards past a gap without acking. Invariants:
+
+- ``exactly-once``: no seq is ever applied twice;
+- ``in-order``: the applied set is exactly ``{1..rx}``;
+- ``conservation``: every produced seq is applied, retained in the
+  ledger, or rolled back into the carry — mass is never silently lost
+  (the debited-residual conservation rule at link scope).
+
+**SubSpec** — the r10 unledgered subscriber link: loss is repaired by
+resync (control-plane re-seed), not retransmission, and freshness is
+only believable when VERIFIED. The FRESH mark carries the link's last
+data tx_seq; the subscriber accepts it only when it has applied exactly
+that many messages — otherwise the stream tail was swallowed and the
+mark must trigger a resync instead (the one gap no later data message
+can expose on an idle tree). Invariant ``verified-fresh-is-true``: a
+subscriber in the verified-fresh state is byte-current with its parent.
+
+Mutation ``fresh_no_seq`` (the historical r10 bug, found by hand in
+review round 10): the mark's seq check is dropped — a FRESH after a
+swallowed tail then falsely verifies freshness over diverged state.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from .core import Spec, TraceAcceptor
+
+# model bounds: 3 messages, window 2, 2 retransmission rounds before
+# teardown, at most 3 messages in flight per direction (dup cap — the
+# cap is what keeps the graph finite: a full pipe drops the extra copy,
+# which the protocol must already survive)
+P, W, RETRY, CHAN_CAP = 3, 2, 2, 3
+
+
+class GbnState(NamedTuple):
+    produced: int  # seqs 1..produced exist
+    ledger: tuple  # unacked seqs (ordered)
+    carry: frozenset  # seqs rolled back at teardown
+    applied: tuple  # (seq, times_applied) sorted — times>1 is the bug
+    rx: int  # receiver's last in-order accepted seq
+    acked: int  # sender's view of the cumulative ack
+    retx_rounds: int
+    chan_data: tuple  # seqs in flight sender->receiver
+    chan_ack: tuple  # cumulative-ack values in flight receiver->sender
+    alive: bool
+
+
+def _applied_inc(applied: tuple, seq: int) -> tuple:
+    d = dict(applied)
+    d[seq] = d.get(seq, 0) + 1
+    return tuple(sorted(d.items()))
+
+
+class GbnSpec(Spec):
+    name = "gbn"
+    depth_bound = 64  # exhausts the capped graph (run_check demands the
+    # frontier empties — bounded-to-depth is a weaker claim than the
+    # artifact commits to)
+    mutations: dict[str, str] = {}
+
+    def initial(self):
+        return GbnState(0, (), frozenset(), (), 0, 0, 0, (), (), True)
+
+    def enabled(self, s: GbnState):
+        acts = []
+        if s.alive:
+            if s.produced < P and len(s.ledger) < W:
+                acts.append(("send",))
+            if s.ledger:
+                acts.append(("timeout",))
+        for i in range(len(s.chan_data)):
+            acts.append(("deliver_data", i))
+            acts.append(("drop_data", i))
+            if len(s.chan_data) < CHAN_CAP:
+                acts.append(("dup_data", i))
+        for i in range(len(s.chan_ack)):
+            acts.append(("deliver_ack", i))
+            acts.append(("drop_ack", i))
+        return acts
+
+    def apply(self, s: GbnState, a):
+        kind = a[0]
+        if kind == "send":
+            seq = s.produced + 1
+            return s._replace(
+                produced=seq,
+                ledger=s.ledger + (seq,),
+                chan_data=s.chan_data + (seq,)
+                if len(s.chan_data) < CHAN_CAP
+                else s.chan_data,  # full pipe: the message is "in the
+                # socket buffer", still ledgered — timeout re-offers it
+            )
+        if kind == "timeout":
+            if s.retx_rounds < RETRY:
+                chan = s.chan_data
+                if len(chan) < CHAN_CAP:
+                    chan = chan + (s.ledger[0],)  # byte-identical head retx
+                return s._replace(chan_data=chan, retx_rounds=s.retx_rounds + 1)
+            # black-hole teardown: roll the whole unacked tail into carry
+            return s._replace(
+                ledger=(),
+                carry=s.carry | set(s.ledger),
+                chan_data=(),
+                chan_ack=(),
+                alive=False,
+            )
+        if kind == "deliver_data":
+            i = a[1]
+            seq = s.chan_data[i]
+            chan = s.chan_data[:i] + s.chan_data[i + 1 :]
+            if not s.alive:
+                return s._replace(chan_data=chan)
+            if seq == s.rx + 1:  # in order: apply + cumulative ack
+                ack = s.chan_ack
+                if len(ack) < CHAN_CAP:
+                    ack = ack + (seq,)
+                return s._replace(
+                    chan_data=chan,
+                    applied=_applied_inc(s.applied, seq),
+                    rx=seq,
+                    chan_ack=ack,
+                )
+            # duplicate (<= rx) or gap (> rx+1): discard unapplied; a dup
+            # re-acks the current cumulative count so a lost ACK heals
+            if seq <= s.rx and len(s.chan_ack) < CHAN_CAP:
+                return s._replace(chan_data=chan, chan_ack=s.chan_ack + (s.rx,))
+            return s._replace(chan_data=chan)
+        if kind == "drop_data":
+            i = a[1]
+            return s._replace(chan_data=s.chan_data[:i] + s.chan_data[i + 1 :])
+        if kind == "dup_data":
+            return s._replace(chan_data=s.chan_data + (s.chan_data[a[1]],))
+        if kind == "deliver_ack":
+            i = a[1]
+            v = s.chan_ack[i]
+            chan = s.chan_ack[:i] + s.chan_ack[i + 1 :]
+            if not s.alive or v <= s.acked:
+                return s._replace(chan_ack=chan)
+            return s._replace(
+                chan_ack=chan,
+                acked=v,
+                ledger=tuple(q for q in s.ledger if q > v),
+                retx_rounds=0,  # forward progress resets the round count
+            )
+        if kind == "drop_ack":
+            i = a[1]
+            return s._replace(chan_ack=s.chan_ack[:i] + s.chan_ack[i + 1 :])
+        raise AssertionError(a)
+
+    def invariants(self, s: GbnState):
+        bad = []
+        if any(n > 1 for _, n in s.applied):
+            bad.append("exactly-once: a seq was applied twice")
+        if {q for q, _ in s.applied} != set(range(1, s.rx + 1)):
+            bad.append("in-order: applied set is not the prefix {1..rx}")
+        kept = {q for q, _ in s.applied} | set(s.ledger) | s.carry
+        if set(range(1, s.produced + 1)) - kept:
+            bad.append(
+                "conservation: a produced seq is neither applied nor "
+                "ledgered nor carried"
+            )
+        return bad
+
+    def quiescent(self, s: GbnState):
+        return (
+            not s.chan_data
+            and not s.chan_ack
+            and not s.ledger
+            and (s.produced == P or not s.alive)
+        )
+
+
+# -- unledgered subscriber stream + FRESH marks ------------------------------
+
+
+class SubState(NamedTuple):
+    sent: int  # parent's data tx_seq (1..sent emitted)
+    applied: int  # subscriber applied exactly seqs 1..applied
+    chan: tuple  # in flight: ("d", seq) | ("f", last_seq)
+    fresh_at: int  # 0, or the mark seq the subscriber VERIFIED fresh at
+    sent_at_mark: int  # ghost: parent's sent when that mark was emitted
+    resyncs: int
+
+
+class SubSpec(Spec):
+    name = "sub"
+    depth_bound = 24
+    mutations = {
+        "fresh_no_seq": (
+            "r10: FRESH marks verified without the last_seq check — a "
+            "mark after a swallowed stream tail falsely verifies "
+            "freshness over diverged state"
+        ),
+    }
+
+    def initial(self):
+        return SubState(0, 0, (), 0, 0, 0)
+
+    def enabled(self, s: SubState):
+        acts = []
+        if s.sent < P and len(s.chan) < CHAN_CAP:
+            acts.append(("send",))
+        if len(s.chan) < CHAN_CAP:
+            acts.append(("fresh",))  # idle-link drain mark, any time
+        for i in range(len(s.chan)):
+            acts.append(("deliver", i))
+            acts.append(("drop", i))  # unledgered: loss is a seq gap
+        if s.resyncs < 2 and s.applied < s.sent:
+            acts.append(("resync",))
+        return acts
+
+    def apply(self, s: SubState, a):
+        kind = a[0]
+        if kind == "send":
+            seq = s.sent + 1
+            return s._replace(sent=seq, chan=s.chan + (("d", seq),))
+        if kind == "fresh":
+            # the mark carries the link's last data tx_seq (wire.py FRESH
+            # format note); the ghost field remembers the parent's true
+            # state so the invariant can judge a verification
+            return s._replace(chan=s.chan + (("f", s.sent),))
+        if kind == "drop":
+            i = a[1]
+            return s._replace(chan=s.chan[:i] + s.chan[i + 1 :])
+        if kind == "resync":
+            # control-plane re-seed (SYNC/CHUNK/DONE ride TCP, chaos
+            # never touches them — r06 rule): the subscriber becomes
+            # current and the stream restarts from the parent's seq
+            return s._replace(
+                applied=s.sent,
+                chan=tuple(m for m in s.chan if m[0] != "d"),
+                fresh_at=0,
+                resyncs=s.resyncs + 1,
+            )
+        if kind == "deliver":
+            i = a[1]
+            m = s.chan[i]
+            chan = s.chan[:i] + s.chan[i + 1 :]
+            if m[0] == "d":
+                if m[1] == s.applied + 1:
+                    return s._replace(chan=chan, applied=m[1])
+                return s._replace(chan=chan)  # gap/dup: discard (resync
+                # is the repair path, enumerated separately)
+            # FRESH mark: verifiable acceptance — the TRUE spec accepts
+            # it only when applied == the mark's last_seq
+            last_seq = m[1]
+            if self.mutation == "fresh_no_seq" or s.applied == last_seq:
+                return s._replace(
+                    chan=chan, fresh_at=last_seq, sent_at_mark=last_seq
+                )
+            return s._replace(chan=chan)  # mismatch: resync, never verify
+        raise AssertionError(a)
+
+    def invariants(self, s: SubState):
+        bad = []
+        if s.fresh_at and s.applied < s.sent_at_mark:
+            bad.append(
+                "verified-fresh-is-true: subscriber verified fresh at a "
+                "mark whose stream tail it never applied"
+            )
+        if s.applied > s.sent:
+            bad.append("applied beyond the parent's stream")
+        return bad
+
+    def quiescent(self, s: SubState):
+        return s.sent == P and not s.chan and s.applied == s.sent
+
+
+# -- trace acceptors ---------------------------------------------------------
+
+
+class LinkAcceptor(TraceAcceptor):
+    """One (node, link) scope of a recorded timeline, checked against
+    the go-back-N teardown rules: at most one black-hole verdict per
+    link id (transport link ids are never reused within a process), and
+    a torn-down link stays silent — retransmit / dedup / window-stall
+    events after its teardown mean the implementation kept driving a
+    window the protocol declared dead."""
+
+    _WINDOW_EVENTS = frozenset(
+        {"retransmit", "dedup_discard", "send_window_stall"}
+    )
+
+    def __init__(self, scope: str = ""):
+        super().__init__(scope)
+        self._teardowns = 0
+        self._down = False
+
+    def step(self, event: dict) -> None:
+        name = event["name"]
+        if name == "blackhole_teardown":
+            self._teardowns += 1
+            if self._teardowns > 1:
+                self._flag("second blackhole_teardown on one link id")
+            self._down = True
+        elif name == "link_down":
+            self._down = True
+        elif name in self._WINDOW_EVENTS and self._down:
+            self._flag(f"{name} after the link was torn down")
+
+
+class SubAcceptor(TraceAcceptor):
+    """One (node, link) subscriber scope: a resync re-runs the handshake
+    on an ATTACHED link, so sub_resync before any sub_attach is an
+    ordering the serve tier cannot produce."""
+
+    def __init__(self, scope: str = ""):
+        super().__init__(scope)
+        self._attached = False
+
+    def step(self, event: dict) -> None:
+        if event["name"] == "sub_attach":
+            self._attached = True
+        elif event["name"] == "sub_resync" and not self._attached:
+            self._flag("sub_resync before sub_attach")
+
+
+SPECS = [GbnSpec, SubSpec]

@@ -1,0 +1,258 @@
+"""SYNC/WELCOME capability-hello spec (comm/wire.py encode_sync /
+encode_welcome, comm/peer.py handshake handlers, compat.py flag rules).
+
+The handshake is a tolerant-extension protocol: every generation since
+r09 appends trailing bytes older peers ignore, and EVERY capability
+mismatch must silently resolve to the least-capable common behavior —
+v1/v2/v3 framing by exact length, 1-bit vs sign2 codec by advertised
+decode capability, TCP vs the same-host shm lane by flag + boot-id +
+segment validation. The failure mode this spec exists to rule out is a
+HALF-negotiated link: one side emitting framing the other rejects, a
+parent upshifting to sign2 toward a 1-bit child, or one end moving its
+data plane to the rings while the other keeps reading TCP.
+
+The explorer enumerates the full generation/capability product — joiner
+and parent each drawn from r09/r10/r11/r14 with every legal flag
+combination, same-host or cross-host, segment validation succeeding or
+failing (the adversary's branch) — and checks the resolved link
+agreement in every outcome:
+
+- ``decodable-emission``: each side's DATA/BURST emission version is in
+  the peer's decode set (v3 only toward a peer that advertised the r14
+  capability flag);
+- ``sign2-decodable``: sign2 emission only toward a peer that
+  advertised SYNC_FLAG_SIGN2;
+- ``lane-agreement``: both ends resolve the same lane, and shm implies
+  both-r14 + both-enabled + boot-id match + validated join;
+- ``ranged-implies-ro``: a range subscription only on a read-only link;
+- ``ledger-agreement``: the link is unledgered iff the joiner
+  advertised READ_ONLY.
+
+Generations model the shipped decoders: every peer decodes v1+v2
+(pre-r09 peers are out of support — compat.py documents ST_WIRE_TRACE=0
+as the manual escape hatch for those); only r14 peers decode v3.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from .core import Spec, TraceAcceptor
+
+R09, R10, R11, R14 = 9, 10, 11, 14
+
+
+def _joiner_cfgs():
+    out = []
+    for gen in (R09, R10, R11, R14):
+        for v in (1, 2):
+            for ro in (0, 1) if gen >= R10 else (0,):
+                for rng in (0, 1) if ro else (0,):
+                    for sign2 in (0, 1) if gen >= R11 else (0,):
+                        for shm in (0, 1) if gen >= R14 else (0,):
+                            for host in (0, 1) if shm else (0,):
+                                out.append(
+                                    (gen, v, ro, rng, sign2, shm, host)
+                                )
+    return tuple(out)
+
+
+def _parent_cfgs():
+    out = []
+    for gen in (R09, R10, R11, R14):
+        for pin in (0, 1):
+            for s2cap in (0, 1) if gen >= R11 else (0,):
+                for shmcap in (0, 1) if gen >= R14 else (0,):
+                    for host in (0, 1) if shmcap else (0,):
+                        out.append((gen, pin, s2cap, shmcap, host))
+    return tuple(out)
+
+
+J_CFGS = _joiner_cfgs()
+P_CFGS = _parent_cfgs()
+
+
+class HelloState(NamedTuple):
+    j: tuple  # (gen, v_emit, ro, rng, sign2, shm, host) or ()
+    p: tuple  # (gen, pin_v1, sign2cap, shmcap, host) or ()
+    phase: int  # 0 pick / 1 sync sent / 2 welcome sent / 3 join pending
+    #             4 resolved
+    p_seen_flags: tuple  # parent's view: (ro, rng, sign2flag, shmflag)
+    w_flags: tuple  # joiner's view of WELCOME: (sign2flag, shmflag)
+    offer: int  # 0 none / 1 offer shipped in the WELCOME tail
+    j_lane: str  # "", "tcp", "shm"
+    p_lane: str
+
+
+class HelloSpec(Spec):
+    name = "hello"
+    depth_bound = 8
+    mutations: dict[str, str] = {}
+
+    def initial(self):
+        return HelloState((), (), 0, (), (), 0, "", "")
+
+    def enabled(self, s: HelloState):
+        if s.phase == 0:
+            return [("pick", j, p) for j in J_CFGS for p in P_CFGS]
+        if s.phase == 1:
+            return [("welcome",)]
+        if s.phase == 2:
+            if s.offer:
+                # segment validation is the adversary's branch: a failed
+                # open/map/token check MUST degrade to TCP (shm_fallback)
+                return [("join_ok",), ("join_fail",)]
+            return [("no_offer",)]
+        if s.phase == 3:
+            return [("serve_deadline",)]
+        return []
+
+    def apply(self, s: HelloState, a):
+        kind = a[0]
+        if kind == "pick":
+            return s._replace(j=a[1], p=a[2], phase=1)
+        if kind == "welcome":
+            jgen, _, ro, rng, sign2, shm, jhost = s.j
+            pgen, _, s2cap, shmcap, phost = s.p
+            # the parent reads the SYNC flags its generation knows about
+            mask_ro = pgen >= R10
+            mask_s2 = pgen >= R11
+            mask_shm = pgen >= R14
+            seen = (
+                ro if mask_ro else 0,
+                rng if mask_ro else 0,
+                sign2 if mask_s2 else 0,
+                # _peer_r14: own shm enabled AND the joiner's flag
+                (shm if shmcap else 0) if mask_shm else 0,
+            )
+            # WELCOME flags: parent's own capabilities (peer.py: SIGN2
+            # iff sign2 armed; SHM iff _shm_ok — host match NOT required
+            # for the flag, it marks the parent a v3 decoder)
+            wf = (
+                1 if (pgen >= R11 and s2cap) else 0,
+                1 if (pgen >= R14 and shmcap) else 0,
+            )
+            # segment offer iff host identity matched (peer.py _peer_shm)
+            offer = int(
+                bool(seen[3]) and shm and jhost == phost and shmcap
+            )
+            return s._replace(
+                phase=2, p_seen_flags=seen, w_flags=wf, offer=offer
+            )
+        jgen, _, ro, rng, sign2, shm, jhost = s.j
+        # the joiner reads the WELCOME flags its generation knows about
+        j_sees_shm = bool(
+            s.w_flags and s.w_flags[1] and jgen >= R14 and shm
+        )
+        if kind == "join_ok":
+            if j_sees_shm:
+                # joiner validated the segment: both planes move to rings
+                return s._replace(phase=4, j_lane="shm", p_lane="shm")
+            # offer present but the joiner cannot read it (pre-r14 or
+            # ST_SHM=0): the tail is ignored, parent's serve deadline
+            # closes the unjoined lane
+            return s._replace(phase=3, j_lane="tcp")
+        if kind == "join_fail":
+            # map/token validation failed -> shm_fallback, keep TCP; the
+            # parent's lane never activates (joined flag never set)
+            return s._replace(phase=3, j_lane="tcp")
+        if kind == "no_offer":
+            return s._replace(phase=4, j_lane="tcp", p_lane="tcp")
+        if kind == "serve_deadline":
+            return s._replace(phase=4, p_lane="tcp")
+        raise AssertionError(a)
+
+    # -- resolved-link properties -------------------------------------------
+
+    @staticmethod
+    def _decodes(gen: int) -> frozenset:
+        return frozenset((1, 2, 3)) if gen >= R14 else frozenset((1, 2))
+
+    def _resolved(self, s: HelloState) -> dict:
+        jgen, jv, ro, rng, sign2, shm, _ = s.j
+        pgen, pin, s2cap, _, _ = s.p
+        p_emit = (
+            3
+            if s.p_seen_flags[3]
+            else (1 if pin else 2)
+            if pgen >= R09
+            else 1
+        )
+        j_saw_shm_flag = bool(s.w_flags[1] and jgen >= R14 and shm)
+        j_emit = 3 if j_saw_shm_flag else jv
+        p_sign2_emit = bool(
+            pgen >= R11 and s2cap and s.p_seen_flags[2] and not ro
+        )
+        return {
+            "p_emit": p_emit,
+            "j_emit": j_emit,
+            "p_sign2_emit": p_sign2_emit,
+            "unledgered": bool(s.p_seen_flags[0]),
+            "ranged": bool(s.p_seen_flags[1]),
+        }
+
+    def invariants(self, s: HelloState):
+        if s.phase != 4:
+            return []
+        bad = []
+        jgen, _, ro, rng, sign2, shm, jhost = s.j
+        pgen, _, _, shmcap, phost = s.p
+        r = self._resolved(s)
+        if r["p_emit"] not in self._decodes(jgen):
+            bad.append(
+                f"decodable-emission: parent emits v{r['p_emit']} toward "
+                f"a gen-{jgen} joiner"
+            )
+        if r["j_emit"] not in self._decodes(pgen):
+            bad.append(
+                f"decodable-emission: joiner emits v{r['j_emit']} toward "
+                f"a gen-{pgen} parent"
+            )
+        if r["p_sign2_emit"] and not (jgen >= R11 and sign2):
+            bad.append(
+                "sign2-decodable: 2-bit emission toward a 1-bit joiner"
+            )
+        if s.j_lane != s.p_lane:
+            bad.append(
+                f"lane-agreement: joiner={s.j_lane!r} parent={s.p_lane!r}"
+            )
+        if s.j_lane == "shm" and not (
+            jgen >= R14 and pgen >= R14 and shm and shmcap and jhost == phost
+        ):
+            bad.append(
+                "lane-agreement: shm lane without both-r14 + both-enabled "
+                "+ host match"
+            )
+        if r["ranged"] and not r["unledgered"]:
+            bad.append("ranged-implies-ro: RANGE accepted on a writer link")
+        if r["unledgered"] != bool(ro and pgen >= R10):
+            bad.append(
+                "ledger-agreement: parent's ledger mode disagrees with "
+                "the joiner's advertised READ_ONLY"
+            )
+        return bad
+
+    def quiescent(self, s: HelloState):
+        return s.phase == 4
+
+
+class HelloAcceptor(TraceAcceptor):
+    """One (node, link) handshake scope over the recorded lane events:
+    negotiation happens once per link, so at most one lane verdict
+    (shm_lane_up XOR shm_fallback) may fire — spec_lane.LaneAcceptor
+    already enforces the lane rules; this acceptor adds the subscriber
+    pairing (sub_attach precedes any data-plane verdict on a sub
+    link)."""
+
+    def __init__(self, scope: str = ""):
+        super().__init__(scope)
+        self._verdicts = 0
+
+    def step(self, event: dict) -> None:
+        if event["name"] in ("shm_lane_up", "shm_fallback"):
+            self._verdicts += 1
+            if self._verdicts > 1:
+                self._flag("more than one shm negotiation verdict per link")
+
+
+SPECS = [HelloSpec]

@@ -1,0 +1,297 @@
+"""r14 same-host lane specs: the SWITCH-marker ordering on unstriped
+links, and the striped lane's writer promotion / ring backpressure /
+stripe-death requeue discipline (native/sttransport.cpp, "Ordering
+across the lane switch" + the r11 stripe-death notes).
+
+**LaneSwitchSpec** — an unstriped link moving its data plane from TCP
+to the shared-memory ring. The sender writes an in-stream SWITCH
+marker as its LAST data-plane byte on TCP, then emits on the ring; the
+receiver enables ring delivery only once the marker arrives in-stream
+(``rx_go``), so the TCP-before / ring-after order is exact. Invariant
+``switch-order``: the delivered sequence is exactly the send order —
+no data crosses the SWITCH marker out of order. Mutation
+``early_ring_delivery`` red-teams the invariant: a receiver that polls
+the ring before the marker delivers post-switch data ahead of the TCP
+tail.
+
+**LaneStripeSpec** — the striped lane: one SPSC ring, its single
+writer the lowest-index LIVE stripe's sender (promoting across stripe
+deaths), bounded link sendq, bounded ring (full ring = backpressure,
+never drop). A write failure kills the failing stripe FIRST; only then
+is the in-hand message re-routed (survivors) or dropped into the
+teardown carry (no survivors — the link's death, go-back-N's business).
+
+Mutation ``requeue_before_kill`` (the historical r11 bug, found by hand
+in review round 11): the failing writer requeues BEFORE killing its
+stripe — with the sendq full and no surviving sender to drain it, the
+requeue spins forever while the stripe still counts as alive: the
+last-stripe livelock, which the explorer reports as a wedged state
+(pending work, no enabled action).
+
+Conservation here is identity-based: every produced message is
+delivered exactly once or carried into teardown — never both, never
+neither.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from .core import Spec, TraceAcceptor
+
+M = 3  # messages produced
+Q = 2  # link sendq capacity
+R = 2  # ring capacity (records)
+FAILS = 2  # adversary write-failure budget
+S = 2  # stripes
+
+
+# -- unstriped SWITCH ordering ----------------------------------------------
+
+
+class SwitchState(NamedTuple):
+    sent: int  # 1..sent emitted
+    phase: int  # 0 = tcp, 1 = ring (sender side)
+    tcp: tuple  # FIFO: ("d", seq) | ("switch",)
+    ring: tuple  # FIFO: seqs
+    rx_go: bool
+    delivered: tuple  # seqs in delivery order
+
+
+class LaneSwitchSpec(Spec):
+    name = "lane_switch"
+    depth_bound = 20
+    mutations = {
+        "early_ring_delivery": (
+            "receiver polls the ring before the in-stream SWITCH marker "
+            "arrives — post-switch data overtakes the TCP tail"
+        ),
+    }
+
+    def initial(self):
+        return SwitchState(0, 0, (), (), False, ())
+
+    def enabled(self, s: SwitchState):
+        acts = []
+        if s.sent < M:
+            if s.phase == 0 and len(s.tcp) < 4:
+                acts.append(("send_tcp",))
+            if s.phase == 1 and len(s.ring) < R:
+                acts.append(("send_ring",))
+        if s.phase == 0 and len(s.tcp) < 4:
+            acts.append(("switch",))
+        if s.tcp:
+            acts.append(("deliver_tcp",))
+        if s.ring and (s.rx_go or self.mutation == "early_ring_delivery"):
+            acts.append(("poll_ring",))
+        return acts
+
+    def apply(self, s: SwitchState, a):
+        kind = a[0]
+        if kind == "send_tcp":
+            seq = s.sent + 1
+            return s._replace(sent=seq, tcp=s.tcp + (("d", seq),))
+        if kind == "switch":
+            return s._replace(phase=1, tcp=s.tcp + (("switch",),))
+        if kind == "send_ring":
+            seq = s.sent + 1
+            return s._replace(sent=seq, ring=s.ring + (seq,))
+        if kind == "deliver_tcp":
+            msg = s.tcp[0]
+            if msg[0] == "switch":
+                return s._replace(tcp=s.tcp[1:], rx_go=True)
+            return s._replace(
+                tcp=s.tcp[1:], delivered=s.delivered + (msg[1],)
+            )
+        if kind == "poll_ring":
+            return s._replace(
+                ring=s.ring[1:], delivered=s.delivered + (s.ring[0],)
+            )
+        raise AssertionError(a)
+
+    def invariants(self, s: SwitchState):
+        if s.delivered != tuple(range(1, len(s.delivered) + 1)):
+            return [
+                "switch-order: data crossed the SWITCH marker out of order"
+            ]
+        return []
+
+    def quiescent(self, s: SwitchState):
+        return (
+            s.sent == M
+            and s.phase == 1
+            and not s.tcp
+            and not s.ring
+            and len(s.delivered) == M
+        )
+
+
+# -- striped lane: promotion, backpressure, the requeue discipline -----------
+
+
+class StripeState(NamedTuple):
+    produced: int
+    sendq: tuple  # seqs queued on the link
+    hand: int  # seq popped by the current lane writer (0 = none)
+    ring: tuple  # seqs published, FIFO
+    stripes: tuple  # alive flags
+    fails: int  # adversary budget spent
+    delivered: tuple
+    carried: frozenset  # rolled into teardown at link death
+    alive: bool  # link alive
+    stuck: bool  # mutation only: writer spinning in requeue
+
+
+class LaneStripeSpec(Spec):
+    name = "lane_stripe"
+    depth_bound = 26
+    mutations = {
+        "requeue_before_kill": (
+            "r11: a failed lane write requeues BEFORE killing its "
+            "stripe — on a full sendq with no surviving sender the "
+            "requeue spins forever (the last-stripe livelock)"
+        ),
+    }
+
+    def initial(self):
+        return StripeState(
+            0, (), 0, (), (True,) * S, 0, (), frozenset(), True, False
+        )
+
+    def enabled(self, s: StripeState):
+        if s.stuck:
+            # the writer thread is spinning in requeue; only the reader
+            # still runs — and draining the ring cannot free the sendq
+            return [("drain",)] if s.ring else []
+        acts = []
+        live = any(s.stripes)
+        if s.alive and s.produced < M and len(s.sendq) < Q:
+            acts.append(("enqueue",))
+        if s.alive and live and s.hand == 0 and s.sendq:
+            acts.append(("pop",))
+        if s.alive and live and s.hand != 0:
+            if len(s.ring) < R:
+                acts.append(("write_ok",))
+            if s.fails < FAILS:
+                acts.append(("write_fail",))
+        if s.ring:
+            acts.append(("drain",))
+        return acts
+
+    def apply(self, s: StripeState, a):
+        kind = a[0]
+        if kind == "enqueue":
+            seq = s.produced + 1
+            return s._replace(produced=seq, sendq=s.sendq + (seq,))
+        if kind == "pop":
+            return s._replace(hand=s.sendq[0], sendq=s.sendq[1:])
+        if kind == "write_ok":
+            return s._replace(hand=0, ring=s.ring + (s.hand,))
+        if kind == "drain":
+            return s._replace(
+                ring=s.ring[1:], delivered=s.delivered + (s.ring[0],)
+            )
+        if kind == "write_fail":
+            writer = s.stripes.index(True)
+            if self.mutation == "requeue_before_kill":
+                if len(s.sendq) >= Q:
+                    # the historical wedge: requeue blocks on the full
+                    # sendq while the stripe still counts as alive
+                    return s._replace(fails=s.fails + 1, stuck=True)
+                s = s._replace(
+                    sendq=(s.hand,) + s.sendq, hand=0, fails=s.fails + 1
+                )
+                stripes = s.stripes[:writer] + (False,) + s.stripes[writer + 1 :]
+                if any(stripes):
+                    return s._replace(stripes=stripes)
+                return s._replace(
+                    stripes=stripes,
+                    alive=False,
+                    carried=s.carried | set(s.sendq),
+                    sendq=(),
+                )
+            # TRUE spec: kill the stripe FIRST, then route what's in hand
+            stripes = s.stripes[:writer] + (False,) + s.stripes[writer + 1 :]
+            s = s._replace(stripes=stripes, fails=s.fails + 1)
+            if any(stripes):
+                return s  # writer role promotes; the in-hand message
+                # re-routes through the new writer (hand retained)
+            return s._replace(
+                alive=False,
+                carried=s.carried | set(s.sendq) | {s.hand},
+                hand=0,
+                sendq=(),
+            )
+        raise AssertionError(a)
+
+    def invariants(self, s: StripeState):
+        bad = []
+        if len(set(s.delivered)) != len(s.delivered):
+            bad.append("stripe-seq: a message was delivered twice")
+        if s.delivered != tuple(sorted(s.delivered)):
+            bad.append("stripe-seq: lane delivery out of stripe-seq order")
+        if set(s.delivered) & s.carried:
+            bad.append("conservation: a delivered message was also carried")
+        outstanding = set(s.sendq) | set(s.ring) | ({s.hand} - {0})
+        everywhere = set(s.delivered) | s.carried | outstanding
+        if set(range(1, s.produced + 1)) - everywhere:
+            bad.append("conservation: a produced message vanished")
+        return bad
+
+    def quiescent(self, s: StripeState):
+        # a dead link ends production (the peer re-grafts — the carry's
+        # business, modeled in spec_drain): quiescence then only needs
+        # every produced message delivered or carried
+        done = set(s.delivered) | s.carried == set(range(1, s.produced + 1))
+        return (
+            (s.produced == M or not s.alive)
+            and not s.sendq
+            and s.hand == 0
+            and not s.ring
+            and done
+        )
+
+
+# -- trace acceptor ----------------------------------------------------------
+
+
+class LaneAcceptor(TraceAcceptor):
+    """One (node, link) lane scope: the negotiation runs once per link,
+    so shm_lane_up fires at most once and never alongside shm_fallback;
+    stripe deaths are permanent and per-index (a repeated index means a
+    dead stripe was re-attached — the r11 third-review-round class);
+    nothing lane- or stripe-scoped fires after the link went down."""
+
+    def __init__(self, scope: str = ""):
+        super().__init__(scope)
+        self._lane_up = 0
+        self._fallback = 0
+        self._dead_stripes: set[int] = set()
+        self._down = False
+
+    def step(self, event: dict) -> None:
+        name = event["name"]
+        if name == "link_down":
+            self._down = True
+            return
+        if name in ("shm_lane_up", "shm_fallback", "stripe_down") and self._down:
+            self._flag(f"{name} after link_down")
+            return
+        if name == "shm_lane_up":
+            self._lane_up += 1
+            if self._lane_up > 1:
+                self._flag("shm_lane_up fired twice on one link")
+            if self._fallback:
+                self._flag("shm_lane_up after shm_fallback on one link")
+        elif name == "shm_fallback":
+            self._fallback += 1
+            if self._lane_up:
+                self._flag("shm_fallback after shm_lane_up on one link")
+        elif name == "stripe_down":
+            idx = event["arg"]
+            if idx in self._dead_stripes:
+                self._flag(f"stripe {idx} died twice (dead-index re-attach)")
+            self._dead_stripes.add(idx)
+
+
+SPECS = [LaneSwitchSpec, LaneStripeSpec]

@@ -1,0 +1,343 @@
+"""SNAP/SNAP_ACK/RESUME consistent-cut barrier spec (comm/peer.py r12).
+
+A 3-node chain (root 0 -> 1 -> 2): the root pauses its own production
+and floods a SNAP marker down; every node on SNAP pauses, forwards the
+marker only after its own pre-cut pipeline is EMPTY, waits for its
+child's SNAP_ACK plus local quiesce, captures, and acks up; the root
+RESUMEs top-down. Channels are FIFO (TCP) — that FIFO ordering is what
+makes the marker a consistent-cut marker, and the spec's job is to
+check the sender-side discipline that keeps the marker LAST among
+pre-cut data.
+
+Production is modeled as the engine's two-phase sender: ``begin_pass``
+debits a link residual into an in-flight pass (the codec/encode pass
+holding mass in its frame buffer), ``complete_pass`` enqueues it on the
+wire. The TRUE spec's pause is synchronous across the pass boundary
+(peer.py ``_set_paused``: the C ``sender_pass`` counter handshake /
+python ``_send_pass`` twin), so a SNAP marker can only be flooded once
+no pass is in flight.
+
+Mutation ``async_pause`` (the historical r12 bug, found by hand in
+review round 12): the marker flood skips the pass-boundary wait — a
+pass already in flight when the pause flag lands completes AFTER the
+marker, its mass debited from the captured residual but applied past
+the receiver's capture: in neither shard, lost on restore. The ghost
+counter ``lost`` detects exactly that delivery.
+
+Failure never wedges: the root times out and RESUMEs anyway; a node
+whose RESUME is lost (root crash is an enabled adversary action)
+auto-resumes after its pause deadline. Invariant ``paused-implies-
+barrier`` plus quiescence reachability are the never-leave-paused rule.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from .core import Spec, TraceAcceptor
+
+CHAN_CAP = 4
+PRODUCE_CAP = 1  # units per producer (nodes 0 and 1)
+
+
+class SnapState(NamedTuple):
+    # per node 0..2
+    paused: tuple  # bool x3
+    bar: tuple  # 0 idle / 1 in barrier / 2 captured x3
+    res: tuple  # down-link residual mass (node 2 has no down link)
+    pas: tuple  # mass held by an in-flight sender pass
+    marked: tuple  # SNAP flooded to the child (nodes 0,1)
+    waiting: tuple  # child SNAP_ACK outstanding (nodes 0,1)
+    applied: tuple  # mass applied locally (nodes 1,2 receive)
+    prod: tuple  # units produced so far (nodes 0,1)
+    chan_down: tuple  # FIFO per link: 0->1, 1->2
+    chan_up: tuple  # FIFO per link: 1->0, 2->1 (SNAP_ACKs)
+    started: bool
+    alive0: bool  # root alive (crash is an adversary action)
+    lost: int  # ghost: pre-cut debited mass applied past a capture
+
+
+def _t(t, i, v):
+    return t[:i] + (v,) + t[i + 1 :]
+
+
+class SnapSpec(Spec):
+    name = "snap"
+    depth_bound = 36
+    mutations = {
+        "async_pause": (
+            "r12: the SNAP marker flood skips the synchronous pass-"
+            "boundary handshake — an in-flight pre-pause sender pass "
+            "enqueues its debited mass BEHIND the marker and the "
+            "receiver applies it after its capture (mass in neither "
+            "shard)"
+        ),
+    }
+
+    def initial(self):
+        return SnapState(
+            paused=(False,) * 3,
+            bar=(0,) * 3,
+            res=(0, 0, 0),
+            pas=(0, 0, 0),
+            marked=(False, False, False),
+            waiting=(False, False, False),
+            applied=(0, 0, 0),
+            prod=(0, 0, 0),
+            chan_down=((), ()),
+            chan_up=((), ()),
+            started=False,
+            alive0=True,
+            lost=0,
+        )
+
+    # -- enabled -------------------------------------------------------------
+
+    def enabled(self, s: SnapState):
+        acts = []
+        for i in (0, 1):
+            up = s.alive0 if i == 0 else True
+            if up and not s.paused[i] and s.prod[i] < PRODUCE_CAP:
+                acts.append(("produce", i))
+            if up and s.res[i] > 0 and s.pas[i] == 0 and not s.paused[i]:
+                acts.append(("begin_pass", i))
+            if up and s.pas[i] > 0 and len(s.chan_down[i]) < CHAN_CAP:
+                acts.append(("complete_pass", i))
+            # marker flood: paused, in barrier, child not yet marked, own
+            # pre-cut pipeline delivered (no data in the down channel =
+            # the unacked ledger drained). The TRUE spec additionally
+            # demands the pass boundary (pas == 0); the async_pause
+            # mutation is exactly that missing wait.
+            if (
+                up
+                and s.bar[i] == 1
+                and s.paused[i]
+                and not s.marked[i]
+                and not any(m[0] == "d" for m in s.chan_down[i])
+                and (self.mutation == "async_pause" or s.pas[i] == 0)
+                and len(s.chan_down[i]) < CHAN_CAP
+            ):
+                acts.append(("mark", i))
+        if s.alive0 and not s.started and s.bar == (0, 0, 0):
+            acts.append(("snap_start",))
+        # capture: in barrier, subtree acked, locally quiesced (no pass
+        # in flight, down channel drained — peer.py _lc_quiesced)
+        for i in (0, 1, 2):
+            up = s.alive0 if i == 0 else True
+            has_child = i < 2
+            if (
+                up
+                and s.bar[i] == 1
+                and (not has_child or (s.marked[i] and not s.waiting[i]))
+                and s.pas[i] == 0
+                and (not has_child or not s.chan_down[i])
+                and (i == 0 or len(s.chan_up[i - 1]) < CHAN_CAP)
+                and (i != 0 or len(s.chan_down[0]) < CHAN_CAP)
+            ):
+                acts.append(("capture", i))
+        if s.alive0 and s.bar[0] == 1 and len(s.chan_down[0]) < CHAN_CAP:
+            acts.append(("root_timeout",))
+        for i in (1, 2):
+            if s.paused[i]:
+                acts.append(("pause_timeout", i))
+        if s.alive0 and s.bar[0] != 0:
+            acts.append(("crash_root",))
+        for li in (0, 1):
+            # a dead root's sockets died with it — crash_root already
+            # cleared its channels, so plain non-emptiness is the guard
+            if s.chan_down[li]:
+                acts.append(("deliver_down", li))
+        for li in (0, 1):
+            if s.chan_up[li]:
+                acts.append(("deliver_up", li))
+        return acts
+
+    # -- apply ---------------------------------------------------------------
+
+    def apply(self, s: SnapState, a):
+        kind = a[0]
+        if kind == "produce":
+            i = a[1]
+            return s._replace(
+                res=_t(s.res, i, s.res[i] + 1), prod=_t(s.prod, i, s.prod[i] + 1)
+            )
+        if kind == "begin_pass":
+            i = a[1]
+            return s._replace(
+                res=_t(s.res, i, 0), pas=_t(s.pas, i, s.res[i])
+            )
+        if kind == "complete_pass":
+            i = a[1]
+            behind = s.paused[i] and s.marked[i]
+            msg = ("d", s.pas[i], behind)
+            return s._replace(
+                pas=_t(s.pas, i, 0),
+                chan_down=_t(s.chan_down, i, s.chan_down[i] + (msg,)),
+            )
+        if kind == "snap_start":
+            return s._replace(
+                started=True, bar=_t(s.bar, 0, 1), paused=_t(s.paused, 0, True)
+            )
+        if kind == "mark":
+            i = a[1]
+            return s._replace(
+                marked=_t(s.marked, i, True),
+                waiting=_t(s.waiting, i, True),
+                chan_down=_t(s.chan_down, i, s.chan_down[i] + (("snap",),)),
+            )
+        if kind == "capture":
+            i = a[1]
+            if i == 0:
+                # root capture completes the barrier: RESUME floods down
+                return s._replace(
+                    bar=_t(s.bar, 0, 0),
+                    paused=_t(s.paused, 0, False),
+                    chan_down=_t(
+                        s.chan_down, 0, s.chan_down[0] + (("resume",),)
+                    ),
+                )
+            return s._replace(
+                bar=_t(s.bar, i, 2),
+                chan_up=_t(s.chan_up, i - 1, s.chan_up[i - 1] + (("ack",),)),
+            )
+        if kind == "root_timeout":
+            return s._replace(
+                bar=_t(s.bar, 0, 0),
+                paused=_t(s.paused, 0, False),
+                waiting=_t(s.waiting, 0, False),
+                chan_down=_t(s.chan_down, 0, s.chan_down[0] + (("resume",),)),
+            )
+        if kind == "pause_timeout":
+            i = a[1]
+            return s._replace(
+                paused=_t(s.paused, i, False), bar=_t(s.bar, i, 0)
+            )
+        if kind == "crash_root":
+            # the root dies: its sockets — and every message on them —
+            # die with it (TCP, not a lossy channel), and its local
+            # barrier state dies too (a dead node is not "paused")
+            return s._replace(
+                alive0=False,
+                paused=_t(s.paused, 0, False),
+                bar=_t(s.bar, 0, 0),
+                pas=_t(s.pas, 0, 0),
+                chan_down=((), s.chan_down[1]),
+                chan_up=((), s.chan_up[1]),
+            )
+        if kind == "deliver_down":
+            li = a[1]
+            j = li + 1  # receiver node
+            msg = s.chan_down[li][0]
+            chan = _t(s.chan_down, li, s.chan_down[li][1:])
+            if msg[0] == "d":
+                lost = s.lost
+                if s.bar[j] == 2 and s.paused[j] and msg[2]:
+                    lost += msg[1]  # the cut already captured j: this
+                    # pre-cut debit lands in neither shard
+                return s._replace(
+                    chan_down=chan,
+                    applied=_t(s.applied, j, s.applied[j] + msg[1]),
+                    lost=lost,
+                )
+            if msg[0] == "snap":
+                if s.bar[j] != 0:
+                    return s._replace(chan_down=chan)  # duplicate marker
+                return s._replace(
+                    chan_down=chan,
+                    bar=_t(s.bar, j, 1),
+                    paused=_t(s.paused, j, True),
+                )
+            # resume: release, forward down, clear barrier state
+            out = s._replace(
+                chan_down=chan,
+                bar=_t(s.bar, j, 0),
+                paused=_t(s.paused, j, False),
+            )
+            if j == 1 and out.marked[1] and len(out.chan_down[1]) < CHAN_CAP:
+                out = out._replace(
+                    chan_down=_t(
+                        out.chan_down, 1, out.chan_down[1] + (("resume",),)
+                    )
+                )
+            return out
+        if kind == "deliver_up":
+            li = a[1]
+            parent = li  # chan_up[0]: 1->0, chan_up[1]: 2->1
+            chan = _t(s.chan_up, li, s.chan_up[li][1:])
+            if parent == 0 and not s.alive0:
+                return s._replace(chan_up=chan)
+            return s._replace(
+                chan_up=chan, waiting=_t(s.waiting, parent, False)
+            )
+        raise AssertionError(a)
+
+    # -- verdicts ------------------------------------------------------------
+
+    def invariants(self, s: SnapState):
+        bad = []
+        if s.lost:
+            bad.append(
+                "snap-conservation: pre-cut debited mass was applied "
+                "after the receiver's capture (in neither shard)"
+            )
+        for i in (0, 1, 2):
+            if s.paused[i] and s.bar[i] == 0:
+                bad.append(
+                    f"paused-implies-barrier: node {i} paused with no "
+                    f"active barrier"
+                )
+        return bad
+
+    def quiescent(self, s: SnapState):
+        return (
+            s.started
+            and s.bar == (0, 0, 0)
+            and not any(s.paused)
+            and s.chan_down == ((), ())
+            and s.chan_up == ((), ())
+            and s.pas == (0, 0, 0)
+        )
+
+
+# -- trace acceptor ----------------------------------------------------------
+
+
+class LifecycleAcceptor(TraceAcceptor):
+    """One node's lifecycle scope replayed against the barrier's legal
+    orderings (comm/peer.py emits lifecycle_pause / lifecycle_resume on
+    every _set_paused edge, snap_begin on barrier entry, snap_shard at
+    capture, snap_done at the root's finish):
+
+    - pause/resume strictly alternate (a double pause without a resume
+      is a torn barrier; a bare resume is a state machine the spec
+      cannot produce);
+    - snap_shard (the capture) only while paused — a capture on an
+      unpaused node is not a consistent cut;
+    - end of run: the node must not be left paused (the r12
+      never-leave-paused rule, checkable only at finish).
+    """
+
+    def __init__(self, scope: str = ""):
+        super().__init__(scope)
+        self._paused = False
+
+    def step(self, event: dict) -> None:
+        name = event["name"]
+        if name == "lifecycle_pause":
+            if self._paused:
+                self._flag("double lifecycle_pause without a resume")
+            self._paused = True
+        elif name == "lifecycle_resume":
+            if not self._paused:
+                self._flag("lifecycle_resume while not paused")
+            self._paused = False
+        elif name == "snap_shard" and not self._paused:
+            self._flag("snap_shard captured on an unpaused node")
+
+    def finish(self) -> list[str]:
+        if self._paused:
+            self._flag("node left paused at end of run")
+        return self.violations
+
+
+SPECS = [SnapSpec]
