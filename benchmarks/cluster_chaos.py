@@ -118,6 +118,23 @@ if "--shm" in sys.argv:
     sys.argv.remove("--shm")
 if SHM_ARM:
     KILL_RESTORE = True
+# r16 ``--sharded`` arm: the 7-node tree runs the CLUSTER-SHARDED tensor
+# (shared_tensor_tpu/shard — one shard per node, owner-routed FWD frames
+# instead of the flood) under the same 25% drop schedule, kill-restore
+# included via the sharded checkpoint path. The acceptance bar it gates:
+# a model >= ST_SHARD_FACTOR x bigger than any single node's allowance
+# converges EXACTLY under the chaos (the per-node alloc bound is
+# enforced at every sample throughout the soak), and per-node
+# steady-state resident memory is ~1/N of the full-replica arm's
+# (structurally: a full replica is the whole table per node).
+SHARDED_ARM = os.environ.get("ST_CLUSTER_SHARDED", "0") == "1"
+if "--sharded" in sys.argv:
+    SHARDED_ARM = True
+    sys.argv.remove("--sharded")
+#: Sharded-arm table size (elements) and the memory factor: the model is
+#: FACTOR x bigger than the per-node alloc allowance (the ISSUE's N >= 2).
+SHARD_N = int(os.environ.get("ST_SHARD_N", "16384"))
+SHARD_FACTOR = int(os.environ.get("ST_SHARD_FACTOR", "2"))
 #: Wall-clock budget for the full-cluster restore: first restarted create
 #: to every node re-converged on the pre-kill mass.
 RESTORE_BUDGET_S = float(os.environ.get("ST_RESTORE_BUDGET_S", "45"))
@@ -439,8 +456,274 @@ def run_kill_restore(art_path: str) -> int:
     return 0 if out["pass"] else 1
 
 
+def run_sharded(art_path: str) -> int:
+    """The r16 cluster-sharded acceptance arm (module docstring): 7-node
+    sharded tree, 25% drop chaos on the deep node's uplink (the native
+    injector's is_data set covers wire.FWD), whole-tree kill-restore
+    through the sharded checkpoint path, a per-node alloc bound enforced
+    at every soak sample, and the steady-state memory ratio against the
+    full-replica baseline recorded."""
+    import tempfile
+
+    import numpy as np
+
+    from shared_tensor_tpu.comm import faults
+    from shared_tensor_tpu.config import (
+        Config, FaultConfig, LifecycleConfig, ShardConfig, TransportConfig,
+    )
+    from shared_tensor_tpu.ops.table import make_spec
+    from shared_tensor_tpu.shard import ShardGather, create_or_fetch_sharded
+    from shared_tensor_tpu.utils import checkpoint as ckpt
+
+    tmpl = {"t": np.zeros(SHARD_N, np.float32)}
+    spec = make_spec(tmpl)
+    full_bytes = spec.total * 4  # any full-replica node's model floor
+    bound = full_bytes // SHARD_FACTOR  # per-node allowance (model is
+    # SHARD_FACTOR x bigger than one node — the harness enforces this at
+    # EVERY sample below, chaos included)
+    chaos_idx = NODES - 1
+    env = faults.to_env(
+        FaultConfig(enabled=True, seed=SEED, drop_pct=0.25, only_link=1)
+    )
+
+    def cfg(i: int, restore: str = "") -> Config:
+        return Config(
+            shard=ShardConfig(
+                n_shards=NODES, shard_index=i, restore_dir=restore
+            ),
+            lifecycle=LifecycleConfig(node_name=f"s{i}"),
+            transport=TransportConfig(
+                peer_timeout_sec=20.0, ack_timeout_sec=0.4
+            ),
+        )
+
+    def build(port, restore_dir=""):
+        handles = []
+        for i in range(NODES):
+            if i == chaos_idx:
+                os.environ["ST_FAULT_PLAN"] = env["ST_FAULT_PLAN"]
+            try:
+                handles.append(
+                    create_or_fetch_sharded(
+                        "127.0.0.1", port, tmpl, cfg(i, restore_dir),
+                        timeout=60.0,
+                    )
+                )
+            finally:
+                os.environ.pop("ST_FAULT_PLAN", None)
+        return handles
+
+    # SPARSE adds (embedding-style windows spanning ~one shard): the
+    # whole point of the sharded tensor is that no single writer needs
+    # the full table resident — a dense delta would itself be O(full)
+    rng = np.random.default_rng(SEED)
+    win = max(64, SHARD_N // NODES)
+
+    def mk_deltas(count):
+        out = []
+        for _ in range(count):
+            lo = int(rng.integers(0, SHARD_N - win))
+            d = np.zeros(SHARD_N, np.float32)
+            d[lo : lo + win] = rng.uniform(-0.5, 0.5, win).astype(np.float32)
+            out.append(d)
+        return out
+
+    p1 = mk_deltas(ADDS)
+    p2 = mk_deltas(max(4, ADDS // 2))
+    total1 = np.sum(p1, axis=0, dtype=np.float64)
+    total_all = total1 + np.sum(p2, axis=0, dtype=np.float64)
+
+    alloc = {"violations": 0, "peak": 0, "samples": 0, "stalls": 0}
+    # one shard slice's resident bytes — the admission unit below
+    slice_bytes = (spec.total // NODES + 32) * 4
+
+    def soak(handles, deltas):
+        for i, d in enumerate(deltas):
+            h = handles[0 if i % 2 else chaos_idx]
+            # flow control: a writer ADMITS a new update only once its
+            # resident state has room for another outbox slice — the
+            # backpressure a training step's sync point provides. Without
+            # it a producer outrunning the chaotic link's drain would
+            # accumulate one outbox per remote shard and the "model
+            # bigger than the node" bound would be unachievable by ANY
+            # implementation that keeps error feedback per target shard.
+            deadline = time.time() + 30.0
+            while (
+                # room for TWO slices: a window can straddle a shard
+                # boundary and allocate two outboxes in one add
+                h.node.alloc_bytes() > bound - 2 * slice_bytes
+                and time.time() < deadline
+            ):
+                alloc["stalls"] += 1
+                time.sleep(0.005)
+            h.add({"t": d})
+            for hh in handles:
+                b = hh.node.alloc_bytes()
+                alloc["samples"] += 1
+                alloc["peak"] = max(alloc["peak"], b)
+                if b > bound:
+                    alloc["violations"] += 1
+            time.sleep(0.015)
+
+    def gathered(handles, total, budget, atol=1e-3):
+        deadline = time.time() + budget
+        while time.time() < deadline:
+            if all(h.node.drained() for h in handles):
+                with ShardGather(handles[0].node, tmpl) as g:
+                    got = np.asarray(g.read_tree(max_staleness=60.0)["t"])
+                if np.allclose(got, total, atol=atol):
+                    return True, float(np.max(np.abs(got - total)))
+            time.sleep(0.25)
+        with ShardGather(handles[0].node, tmpl) as g:
+            got = np.asarray(g.read_tree(max_staleness=60.0)["t"])
+        return False, float(np.max(np.abs(got - total)))
+
+    out = {
+        "bench": "cluster_chaos_sharded",
+        "nodes": NODES,
+        "n_shards": NODES,
+        "n": SHARD_N,
+        "adds": {"phase1": len(p1), "phase2": len(p2)},
+        "seed": SEED,
+        "chaos": {"drop_pct": 0.25, "only_link": 1, "node_index": chaos_idx},
+        "memory_model": {
+            # the harness-enforced contract: the model is FACTOR x bigger
+            # than any node's allowance, checked at every soak sample
+            "full_replica_bytes_per_node": full_bytes,
+            "per_node_alloc_bound": bound,
+            "model_over_node_factor": SHARD_FACTOR,
+        },
+    }
+    from shared_tensor_tpu import obs
+
+    hub = obs.hub()
+    hub.poll_native()
+    hub.recorder.clear()
+    hub.recorder.set_capacity(500_000)
+
+    snapdir = tempfile.mkdtemp(prefix="st_snap_r16_")
+    handles = build(_free_port())
+    try:
+        assert all(h.sharded for h in handles), "a join fell back"
+        soak(handles, p1)
+        ok1, dev1 = gathered(handles, total1, 120.0)
+        out["pre_kill"] = {"converged": ok1, "max_dev": dev1}
+        # steady state: outboxes drained AND FREED — resident is the
+        # owned slice (+ empty maps); the 1/N memory claim is measured
+        # here, not mid-soak. The gather's subscriber legs tear down
+        # ASYNCHRONOUSLY (each owner drops the sub residual when its loop
+        # processes the LINK_DOWN), so wait for the teardown to settle —
+        # sampling immediately can catch owned slice + one lingering sub
+        # residual and trip the 2/N gate with no real regression
+        settle = time.time() + 5.0
+        while time.time() < settle:
+            steady = max(h.node.alloc_bytes() for h in handles)
+            if steady <= full_bytes * 2.0 / NODES:
+                break
+            time.sleep(0.05)
+        out["memory_model"]["steady_state_max_bytes"] = steady
+        out["memory_model"]["steady_over_full_ratio"] = steady / full_bytes
+        owned = sorted(
+            (i, h.node.owned_shards()) for i, h in enumerate(handles)
+        )
+        out["ownership_pre_kill"] = {str(i): s for i, s in owned}
+        entries = [
+            e
+            for e in (h.node.save_shards(snapdir) for h in handles)
+            if e is not None
+        ]
+        ckpt.write_manifest(snapdir, "chaos-r16", entries)
+        coverage = ckpt.verify_shard_coverage(snapdir, NODES)
+        out["snapshot"] = {
+            "nodes": len(entries), "coverage_problems": coverage,
+        }
+    finally:
+        for h in handles:
+            h.close()  # the whole-cluster kill
+    t0 = time.monotonic()
+    handles = build(_free_port(), restore_dir=snapdir)
+    try:
+        ok_r, dev_r = gathered(handles, total1, RESTORE_BUDGET_S)
+        out["restore"] = {
+            "reconverged_pre_kill_mass": ok_r,
+            "max_dev": dev_r,
+            "duration_sec": time.monotonic() - t0,
+        }
+        soak(handles, p2)
+        ok2, dev2 = gathered(handles, total_all, 120.0)
+        out["restored_arm"] = {"converged": ok2, "max_dev": dev2}
+        owned = sorted(
+            (i, h.node.owned_shards()) for i, h in enumerate(handles)
+        )
+        out["ownership_restored"] = {str(i): s for i, s in owned}
+        snaps = [h.node.metrics() for h in handles]
+        out["fwd"] = {
+            k: int(sum(s.get(k, 0) for s in snaps))
+            for k in (
+                "st_shard_fwd_msgs_out_total",
+                "st_shard_fwd_msgs_in_total",
+                "st_shard_fwd_relayed_total",
+                "st_shard_fwd_dedup_total",
+                "st_shard_park_drops_total",
+            )
+        }
+        hub.poll_native()
+        counts = hub.recorder.counts
+        out["injected"] = {"fault_drop": counts.get("fault_drop", 0)}
+        out["alloc"] = dict(alloc)
+    finally:
+        for h in handles:
+            h.close()
+    conf = _conformance(hub)
+    out["conformance"] = conf
+    out["pass"] = bool(
+        conf["pass"]
+        and out["pre_kill"]["converged"]
+        and out["snapshot"]["coverage_problems"] == []
+        and out["snapshot"]["nodes"] == NODES
+        and out["restore"]["reconverged_pre_kill_mass"]
+        and out["restore"]["duration_sec"] <= RESTORE_BUDGET_S
+        and out["restored_arm"]["converged"]
+        # every node re-owned its pre-kill shards after the restore
+        and out["ownership_restored"] == out["ownership_pre_kill"]
+        # chaos actually fired (the injector's is_data set covers FWD)
+        and out["injected"]["fault_drop"] >= 1
+        and out["fwd"]["st_shard_fwd_msgs_out_total"] >= 1
+        and out["fwd"]["st_shard_park_drops_total"] == 0  # no silent loss
+        # the memory contract: bound held at EVERY sample (model
+        # FACTOR x bigger than one node), steady state ~1/N of the
+        # full-replica arm (2x slack for padding + dict overheads)
+        and alloc["violations"] == 0
+        and out["memory_model"]["steady_over_full_ratio"] <= 2.0 / NODES
+    )
+    doc = json.dumps(out, indent=2)
+    print(doc)
+    if not os.path.isabs(art_path):
+        art_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            art_path,
+        )
+    with open(art_path, "w") as f:
+        f.write(doc + "\n")
+    print(
+        f"cluster_chaos --sharded: steady/full "
+        f"{out['memory_model']['steady_over_full_ratio']:.3f} "
+        f"(bound {2.0 / NODES:.3f}), alloc violations "
+        f"{alloc['violations']}/{alloc['samples']}, drops "
+        f"{out['injected']['fault_drop']}, fwd dedup "
+        f"{out['fwd']['st_shard_fwd_dedup_total']} -> "
+        f"{'PASS' if out['pass'] else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return 0 if out["pass"] else 1
+
+
 def main() -> int:
     art_path = sys.argv[1] if len(sys.argv) > 1 else "CHAOS_r09.json"
+    if SHARDED_ARM:
+        return run_sharded(
+            sys.argv[1] if len(sys.argv) > 1 else "CHAOS_r16.json"
+        )
     if KILL_RESTORE:
         return run_kill_restore(
             sys.argv[1] if len(sys.argv) > 1 else "CHAOS_r12.json"

@@ -28,7 +28,7 @@ FAILED=0
 #  - the protospec model checker (tools/protospec/run_check.py): every
 #    protocol spec explored exhaustively + the three historical-bug
 #    mutations re-found, counts committed as the MODEL artifact
-#    (ST_SUITE_MODEL_OUT, default MODEL_r15.json; ST_SUITE_MODEL=0
+#    (ST_SUITE_MODEL_OUT, default MODEL_r16.json; ST_SUITE_MODEL=0
 #    skips).
 # Per-gate wall-clock is logged ("gate <name>: <sec>s rc=<rc>") — the
 # r13/r14 notes say gate time is starting to matter, so the transcript
@@ -69,7 +69,7 @@ if [ "${ST_SUITE_STATIC:-1}" = "1" ]; then
     fi
   fi
   if [ "${ST_SUITE_MODEL:-1}" = "1" ]; then
-    MODEL_OUT="${ST_SUITE_MODEL_OUT:-MODEL_r15.json}"
+    MODEL_OUT="${ST_SUITE_MODEL_OUT:-MODEL_r16.json}"
     gate_run model_check python tools/protospec/run_check.py --out "$MODEL_OUT"
     [ "$FAILED" -ne 0 ] && { echo "FAIL: model-checker gate red" >>"$OUT"; exit 1; }
   fi
@@ -201,6 +201,22 @@ if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_LIFECYCLE:-1}" = "1" ]; then
   gate_run lifecycle_chaos_conformance sh -c \
     "JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py '$LIFE_OUT' \
      --kill-restore $SHM_FLAG >/dev/null"
+fi
+
+# Sharded gate (r16): the cluster-sharded chaos arm — 7-node sharded
+# tree (one shard per node, owner-routed FWD data plane) under the 25%
+# drop schedule with kill-restore through the sharded checkpoint path.
+# Gates the r16 acceptance bar alongside the lifecycle gate: a model
+# ST_SHARD_FACTOR x bigger than any node's enforced alloc bound
+# converges EXACTLY (bound checked at every soak sample), every node
+# re-owns its shards after the restore, the manifest's
+# exactly-one-owner coverage audit is clean, and steady-state per-node
+# memory lands at ~1/N of a full replica. ST_SUITE_SHARD=0 skips.
+if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_SHARD:-1}" = "1" ]; then
+  SHARD_OUT="${ST_SUITE_SHARD_OUT:-CHAOS_r16.json}"
+  gate_run sharded_chaos sh -c \
+    "JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py '$SHARD_OUT' \
+     --sharded >/dev/null"
 fi
 
 # Sanitizer arm (r11): striping + adaptive precision put new hot code in

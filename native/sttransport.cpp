@@ -1656,9 +1656,15 @@ void link_sender_loop(Node* node, std::shared_ptr<Link> link, int sidx) {
     if (fp.enabled) {
       const uint8_t* d = msg.data();
       uint8_t kind0 = msg.size() > 0 ? (uint8_t)(d[0] & 0x7F) : 0xFF;
+      // data kinds the chaos classes cover: DATA, BURST, RDATA, and the
+      // r16 owner-routed FWD (17) — the sharded tree's whole data plane
+      // rides FWD frames, so leaving it out would silently exempt every
+      // sharded cluster from wire chaos (tools/lint_wire.py pins this
+      // literal set against wire.py's data kinds)
       bool is_data = node->cfg.wire_compat ||
                      (msg.size() > 0 &&
-                      (kind0 == 0 || kind0 == 7 || kind0 == 11));
+                      (kind0 == 0 || kind0 == 7 || kind0 == 11 ||
+                       kind0 == 17));
       if (is_data && (fp.only_link <= 0 || link->id == fp.only_link) &&
           (fp.only_stripe < 0 || sidx == fp.only_stripe)) {
         StUniqueLock flk(link->fault_mu);

@@ -121,6 +121,20 @@ SNAP = 12  # parent -> child: lifecycle barrier marker (JSON body)
 SNAP_ACK = 13  # child -> parent: barrier ack + subtree shard entries (JSON)
 RESUME = 14  # parent -> child: release the lifecycle barrier (JSON)
 CTL = 15  # parent -> child: routed operator command (JSON)
+# r16 cluster-sharded tensor (shared_tensor_tpu/shard). SHARD is the
+# control plane of the shard map — claims/grants, owner route announces,
+# drain-handoff state transfer — a bounded JSON body like the lifecycle
+# kinds (encode_shard below). FWD is the owner-routed data plane: one
+# codec frame sliced to a shard's word range, relayed hop-by-hop toward
+# the shard's owner WITHOUT re-quantization (the r16 routing discipline:
+# per-hop loss is repaired by the same go-back-N ledger as DATA/BURST;
+# end-to-end duplication — a rollback-resend racing a delivered-but-
+# unACKed original across a re-route — is deduplicated at the owner by
+# the (origin, fwd_seq) identity the header carries). Pre-r16 peers that
+# receive either kind log "unknown message kind" and drop it without
+# touching their data plane (the r12 tolerant-extension discipline).
+SHARD = 16  # shard-map control: claim/grant/own/map/handoff (JSON)
+FWD = 17  # owner-routed forwarded delta frame (binary, ledgered)
 
 #: r14 shm/r14-capability flag bit — MUST equal compat.SYNC_FLAG_SHM
 #: (compat asserts the tie at import; defined here too because compat
@@ -145,6 +159,12 @@ SHM_SWITCH_LEN = 0xFFFFFFFD
 #: which the retransmission window's sizing assumes — so it lives here
 #: under the same lint tie as the header sizes.
 SENDMMSG_BATCH = 16
+#: r16 shard-capability flag bit — MUST equal compat.SYNC_FLAG_SHARD
+#: (compat asserts the tie at import, like SHM_FLAG above; the lint
+#: re-checks it statically on seeded trees that never import). The bit
+#: gates the 2-byte shard-claim tail this module appends to SYNC and the
+#: shard-map hello a sharded parent sends after WELCOME.
+SHARD_FLAG = 0x10
 
 _SYNC_FMT = "<IQ16s"  # num_leaves, total_n, layout digest
 _CHUNK_HDR = "<Q"  # byte offset into the flat f32 snapshot
@@ -658,6 +678,7 @@ def encode_sync(
     wire_version: int = 1,
     flags: int = 0,
     shm_host: bytes = b"",
+    shard: int = -1,
 ) -> bytes:
     """Join request header. Since r09 a trailing version byte advertises
     the joiner's DATA/BURST framing (compat.WIRE_VERSION); pre-r09 parents
@@ -676,7 +697,15 @@ def encode_sync(
     the same-host shared-memory lane negotiation. A parent on the same
     host answers with a segment offer in its WELCOME tail
     (:func:`encode_welcome`); any other parent — pre-r14 included — just
-    ignores the bytes and the link stays on TCP."""
+    ignores the bytes and the link stays on TCP.
+
+    ``shard`` (r16, 2 trailing bytes present iff flags carries
+    compat.SYNC_FLAG_SHARD, AFTER the shm tail): the joiner's shard-index
+    claim for the cluster-sharded tensor (0xFFFF = a member that owns no
+    shard — a pure writer/relay). A pre-r16 parent ignores the tail
+    entirely and attaches the joiner as a plain writer child; the joiner
+    detects the legacy parent by the absent WELCOME shard flag and falls
+    back to today's full-replica protocol (shard/node.py)."""
     return (
         bytes([SYNC])
         + struct.pack(
@@ -684,6 +713,11 @@ def encode_sync(
         )
         + bytes([wire_version & 0xFF, flags & 0xFF])
         + (shm_host[:16] if flags & SHM_FLAG else b"")
+        + (
+            struct.pack("<H", shard & 0xFFFF)
+            if flags & SHARD_FLAG
+            else b""
+        )
     )
 
 
@@ -715,6 +749,21 @@ def sync_shm_host(payload: bytes) -> Optional[bytes]:
     base = 3 + struct.calcsize(_SYNC_FMT)
     return bytes(payload[base : base + 16]) if len(payload) >= base + 16 \
         else None
+
+
+def sync_shard(payload: bytes) -> Optional[int]:
+    """The joiner's shard-index claim (r16), or None when the SYNC carries
+    no compat.SYNC_FLAG_SHARD / the tail is truncated. 0xFFFF decodes to
+    -1 (a member that owns no shard). The tail sits AFTER the optional
+    16-byte shm host identity."""
+    flags = sync_flags(payload)
+    if not flags & SHARD_FLAG:
+        return None
+    base = 3 + struct.calcsize(_SYNC_FMT) + (16 if flags & SHM_FLAG else 0)
+    if len(payload) < base + 2:
+        return None
+    (idx,) = struct.unpack_from("<H", payload, base)
+    return -1 if idx == 0xFFFF else idx
 
 
 def encode_welcome(flags: int = 0, shm_offer=None) -> bytes:
@@ -882,6 +931,189 @@ def decode_rdata(
     return scales, words, word_lo, word_cnt, trace
 
 
+
+
+# -- r16 cluster-sharded tensor messages -------------------------------------
+#
+# FWD: [kind][u32 link_seq][u32 word_lo][u32 word_cnt][u32 origin]
+# [u32 fwd_seq][k x (scales L*4 || words word_cnt*4)] — k codec frames of
+# a writer's OUT-OF-SHARD delta, sliced to the target shard's word range
+# and routed hop-by-hop toward the shard's owner (shard/node.py routes by
+# word_lo through the shard map). Each frame is the RDATA representation
+# (full-L per-leaf scales + the word slice); successive frames are
+# successive HALVINGS of the sender's outbox residual (the r07 burst /
+# r11 cascade insight carried over: the ladder's length is fixed by the
+# codec arithmetic regardless of pacing — see the FWD_BURST_FRAMES note,
+# it is THOUSANDS of steps — so shipping up to fwd_frames_cap halvings
+# per message divides the message count, and with it the go-back-N round
+# trips a lossy hop must win, by k). k is
+# derived from the message length (the header carries word_cnt, so the
+# per-frame size is fixed); one message is ONE ledger entry / ONE
+# end-to-end identity however many frames it carries. The extra
+# origin/fwd_seq pair is that identity:
+#
+# - link_seq is the per-link go-back-N seq, shared with every other
+#   ledgered kind on the link (in-order accept + cumulative wire.ACK +
+#   byte-identical retransmission, exactly the DATA/BURST discipline);
+#   a relay RE-STAMPS it per outgoing link (struct.pack_into at offset 1)
+#   while the rest of the message is forwarded verbatim — owner-routed
+#   forwarding never re-quantizes;
+# - (origin, fwd_seq) never changes in flight. The owner deduplicates on
+#   it: when a link dies, every unacked FWD re-routes and is re-sent
+#   byte-identical (same identity), so a message that was actually
+#   delivered before the death — the classic at-least-once window — is
+#   discarded by the owner's seen-set instead of double-applied. Rolling
+#   the quantized mass back into the outbox instead would re-mint it
+#   under a NEW identity and double-apply through the same window.
+#
+# Wire size: the sender caps k with fwd_frames_cap(spec, word_cnt), which
+# keeps FWD_HDR + k frames inside frame_wire_bytes(spec) — the receive
+# bound every sharded peer passes to its transport — so no sizing change
+# for any receiver; decode_fwd re-derives k from the message length and
+# rejects anything past the FWD_BURST_FRAMES ceiling.
+#
+# SHARD: [kind][JSON] — the shard-map control plane (claims/grants, owner
+# route announces, map updates, drain-handoff state transfer), bounded by
+# DIGEST_MAX_BYTES like every JSON control kind since r09.
+
+_FWD_FMT = "<IIIII"  # link_seq, word_lo, word_cnt, origin, fwd_seq
+FWD_HDR = 21  # kind + the five u32 fields above
+#: Hard ceiling on halving frames per FWD message, shared with the BURST
+#: plane; the ACTUAL cap for a shard geometry comes from fwd_frames_cap
+#: below (the same budget-vs-receive-bound derivation as
+#: burst_frames_cap). The drain ladder of the rms-scaled sign codec is
+#: LONG — heavy-tailed residuals step down linearly at the rms scale, so
+#: a fresh outbox takes a few THOUSAND halvings, not ~log2(mass/dust) —
+#: and each message is one ledgered go-back-N entry, so the frames-per-
+#: message cap directly divides the round trips a lossy hop must win
+#: (the r07 burst insight; a 16-frame cap measured ~500 messages per
+#: outbox drain where 255 takes ~11).
+FWD_BURST_FRAMES = BURST_MAX_FRAMES
+
+
+def fwd_frames_cap(spec: TableSpec, word_cnt: int) -> int:
+    """Most halving frames one FWD message may carry for a shard of
+    ``word_cnt`` words (>= 1): sized so FWD_HDR + k frames stays inside
+    frame_wire_bytes(spec) — the bound every sharded peer passes to its
+    transport — like burst_frames_cap sizes BURST against its budget."""
+    per = 4 * spec.num_leaves + 4 * word_cnt
+    return max(
+        1,
+        min(FWD_BURST_FRAMES, (frame_wire_bytes(spec) - FWD_HDR) // per),
+    )
+
+
+def encode_fwd(
+    frames: list,
+    word_lo: int,
+    seq: int,
+    origin: int,
+    fwd_seq: int,
+) -> bytes:
+    """``frames`` is 1..FWD_BURST_FRAMES (scales f32[L], words
+    u32[word_cnt]) pairs — successive halvings of one outbox residual,
+    already sliced to the target shard's range by the outbox codec."""
+    if not 1 <= len(frames) <= FWD_BURST_FRAMES:
+        raise ValueError(
+            f"FWD burst of {len(frames)} frames (allowed 1.."
+            f"{FWD_BURST_FRAMES})"
+        )
+    word_cnt = len(frames[0][1])
+    parts = [
+        bytes([FWD])
+        + struct.pack(
+            _FWD_FMT,
+            seq & 0xFFFFFFFF,
+            word_lo & 0xFFFFFFFF,
+            word_cnt & 0xFFFFFFFF,
+            origin & 0xFFFFFFFF,
+            fwd_seq & 0xFFFFFFFF,
+        )
+    ]
+    for scales, words in frames:
+        if len(words) != word_cnt:
+            raise ValueError("FWD burst frames must share one word range")
+        parts.append(np.asarray(scales, dtype="<f4").tobytes())
+        parts.append(np.asarray(words, dtype="<u4").tobytes())
+    return b"".join(parts)
+
+
+def fwd_restamp(payload: bytearray, seq: int) -> None:
+    """Re-stamp a FWD's per-link seq for the next hop IN PLACE (relay /
+    re-route path) — everything after byte 5 is forwarded verbatim."""
+    struct.pack_into("<I", payload, 1, seq & 0xFFFFFFFF)
+
+
+def decode_fwd(
+    payload: bytes, spec: TableSpec
+) -> tuple[list, int, int, int, int]:
+    """([(scales f32[L], words u32[word_cnt]), ...], word_lo, link_seq,
+    origin, fwd_seq) — frame count derived from the message length; the
+    same non-finite-scale corruption guard as decode_frame/decode_rdata
+    applies per frame (a poisoned scale zeroes its leaf instead of
+    NaN-ing the owner's shard)."""
+    L = spec.num_leaves
+    seq, word_lo, word_cnt, origin, fwd_seq = struct.unpack_from(
+        _FWD_FMT, payload, 1
+    )
+    if word_cnt <= 0 or word_lo + word_cnt > spec.total // 32:
+        raise ValueError(
+            f"FWD range [{word_lo}, {word_lo + word_cnt}) outside the "
+            f"{spec.total // 32}-word table"
+        )
+    per = 4 * L + 4 * word_cnt
+    body = len(payload) - FWD_HDR
+    nf, rem = divmod(body, per)
+    if rem or not 1 <= nf <= FWD_BURST_FRAMES:
+        raise ValueError(
+            f"FWD is {len(payload)} bytes: not 1..{FWD_BURST_FRAMES} "
+            f"whole {per}-byte frames past the {FWD_HDR}-byte header"
+        )
+    frames = []
+    for i in range(nf):
+        off = FWD_HDR + i * per
+        scales = np.frombuffer(payload, "<f4", count=L, offset=off).copy()
+        words = np.frombuffer(
+            payload, "<u4", count=word_cnt, offset=off + 4 * L
+        ).copy()
+        bad = ~np.isfinite(scales)
+        if bad.any():
+            nbad = int(np.count_nonzero(bad))
+            log.warning(
+                "zeroing %d non-finite scale(s) in received FWD "
+                "(corrupt link?)", nbad,
+            )
+            _count_corrupt_scales(nbad)
+            scales[bad] = np.float32(0.0)
+        frames.append((scales, words))
+    return frames, word_lo, seq, origin, fwd_seq
+
+
+def encode_shard(doc: dict) -> bytes:
+    """One shard-map control message ({"t": "claim"|"grant"|"deny"|"map"|
+    "own"|"ho_meta"|"ho_state"|"ho_ack", ...} — shard/node.py owns the
+    document shapes). JSON for the same reason as DIGEST/lifecycle: this
+    is off-hot-path control traffic whose debuggability matters more than
+    bytes; the DIGEST_MAX_BYTES cap keeps every peer's receive bound
+    valid (handoff state transfer chunks itself under it)."""
+    import json
+
+    body = json.dumps(doc, separators=(",", ":")).encode()
+    if len(body) > DIGEST_MAX_BYTES:
+        raise ValueError(
+            f"shard control message is {len(body)} bytes, cap "
+            f"{DIGEST_MAX_BYTES} — chunk handoff state / bound the map"
+        )
+    return bytes([SHARD]) + body
+
+
+def decode_shard(payload: bytes) -> dict:
+    import json
+
+    doc = json.loads(payload[1:].decode("utf-8"))
+    if not isinstance(doc, dict):
+        raise ValueError("shard control message body is not a JSON object")
+    return doc
 
 
 def encode_snapshot_chunks(flat: np.ndarray) -> Iterator[bytes]:

@@ -97,13 +97,39 @@ SYNC_FLAG_SIGN2 = 0x04
 # timeline event. ST_SHM=0 force-disables the lane end to end (the A/B
 # escape hatch, like ST_SIGN2/ST_WIRE_TRACE).
 SYNC_FLAG_SHM = 0x08
-# the wire module hardcodes the same bit (it cannot import this module —
+# r16: the cluster-sharded tensor (shared_tensor_tpu/shard). A sharded
+# joiner sets this flag and appends its 2-byte shard-index claim to the
+# SYNC tail (after the shm bytes); a sharded parent answers with the same
+# bit in its WELCOME flags and the shard map as a wire.SHARD control
+# message right behind it. The negotiation is tolerant in BOTH
+# orientations, r14 discipline:
+#
+# - sharded joiner -> pre-r16 (or unsharded) parent: the parent ignores
+#   the tail and attaches a plain writer child; the joiner detects the
+#   absent WELCOME shard flag and FALLS BACK to today's full-replica
+#   protocol (shard.create_or_fetch_sharded returns a classic peer) —
+#   any non-sharded tree keeps the full-replica flood untouched;
+# - pre-r16 WRITER joiner -> sharded parent: REJECTed with an explicit
+#   reason (the r10 detectably-broken-not-silently-wrong rule: no node
+#   in a sharded cluster holds the full replica, so a full-replica child
+#   cannot be served; start the cluster with ShardConfig.n_shards=0 /
+#   ST_SHARD=0 to keep the classic protocol);
+# - read-only SUBSCRIBERS (SYNC_FLAG_READ_ONLY) interop either way: a
+#   sharded owner serves ranged subscriptions within its own shard.
+#
+# ST_SHARD=0 force-disables sharding end to end (the A/B escape hatch,
+# like ST_SHM/ST_SIGN2/ST_WIRE_TRACE).
+SYNC_FLAG_SHARD = 0x10
+# the wire module hardcodes the same bits (it cannot import this module —
 # compat -> peer -> wire would be a cycle); a silent drift between the two
-# would degrade every negotiation to permanent TCP fallback, so tie them
+# would degrade every negotiation to permanent fallback, so tie them
 # at import time
 from .comm import wire as _wire
 
 assert SYNC_FLAG_SHM == _wire.SHM_FLAG, "SYNC_FLAG_SHM drifted from wire.SHM_FLAG"
+assert SYNC_FLAG_SHARD == _wire.SHARD_FLAG, (
+    "SYNC_FLAG_SHARD drifted from wire.SHARD_FLAG"
+)
 del _wire
 
 # ---- r12 cluster-lifecycle control kinds ----------------------------------

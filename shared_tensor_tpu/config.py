@@ -411,6 +411,63 @@ class LifecycleConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ShardConfig:
+    """r16 cluster-sharded tensor (``shared_tensor_tpu/shard``): the table
+    is partitioned into contiguous word ranges, each owned by exactly one
+    cluster node. Per-node memory is the owned slice plus transient
+    outboxes — O(total / n_shards) at steady state — instead of a full
+    replica; a writer's out-of-shard delta rides owner-routed wire.FWD
+    frames toward the shard's owner (no per-hop re-quantization), and
+    readers assemble views by subscribing to owner shards (shard.gather).
+    """
+
+    #: Number of contiguous shards the master partitions the word space
+    #: into at creation. 0 = sharding off (the classic full-replica
+    #: protocol; ``create_or_fetch_sharded`` then returns a classic peer).
+    n_shards: int = 0
+    #: The shard index this node claims at join (the master claims its own
+    #: index locally). -1 = a member that owns no shard: it still joins
+    #: the tree, routes FWD traffic and may write/read, but holds no
+    #: slice. Claims are arbitrated by the master; a taken index is
+    #: DENIED and creation fails.
+    shard_index: int = -1
+    #: The address OTHER nodes (gather legs, takeover peers) should dial
+    #: to reach THIS node's listener — recorded in the node's OwnerEntry
+    #: at claim/handoff time. "" = advertise the rendezvous host argument,
+    #: which is correct exactly when every node shares one host (the
+    #: loopback cluster); multi-host deployments must set each node's
+    #: reachable address here or every gather toward a non-master owner
+    #: dials the wrong machine.
+    advertise_host: str = ""
+    #: Restart path: directory holding a sharded-snapshot MANIFEST.json
+    #: (utils/checkpoint.write_manifest ``shards`` entries). The node
+    #: loads its shard's slice/outboxes/dedup state BEFORE joining and
+    #: claims with takeover semantics (the master re-grants the index at
+    #: a higher epoch). "" = fresh start.
+    restore_dir: str = ""
+    #: Tree fan-out for sharded nodes (SEPARATE from
+    #: TransportConfig.max_children): owner nodes also serve read-only
+    #: subscriber leaves on the same listener, so they need slots beyond
+    #: the tree's writer fan-out. This matters more than for classic
+    #: trees: the transport redirects joiners DOWN the tree when slots
+    #: fill, which is harmless for a full-replica subscription (any node
+    #: serves the whole table) but breaks a gather leg that must land on
+    #: one specific owner — so the sharded default sits near the
+    #: transport's cap (16) and shard.gather documents the residual
+    #: limit.
+    max_children: int = 12
+    #: Budget for the join-time claim round trip (SYNC -> map -> claim ->
+    #: grant flood). Past it, creation fails instead of waiting forever.
+    claim_timeout_sec: float = 20.0
+    #: Bound on FWD messages parked while a shard's route is unknown
+    #: (owner not yet granted, route purged by a LINK_DOWN, owner being
+    #: restored). Overflow drops the OLDEST parked message and counts it
+    #: (st_shard_park_drops_total) — loud bounded loss, never unbounded
+    #: memory.
+    park_cap: int = 4096
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Pod-tier (intra-slice) configuration: how the shared array is laid out
     across the local device mesh and which collective strategy syncs it."""
@@ -446,6 +503,10 @@ class Config:
     lifecycle: LifecycleConfig = dataclasses.field(
         default_factory=LifecycleConfig
     )
+    #: Cluster-sharded tensor (r16): shard count, this node's claim,
+    #: restart-restore, routing bounds. n_shards=0 keeps the classic
+    #: full-replica protocol.
+    shard: ShardConfig = dataclasses.field(default_factory=ShardConfig)
     #: Background sync frame pacing: target seconds between frames per link;
     #: 0 = free-running (reference behavior: fill all bandwidth, README.md:31).
     sync_interval_sec: float = 0.0

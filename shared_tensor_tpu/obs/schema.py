@@ -133,6 +133,27 @@ SCHEMA: dict[str, tuple[str, str]] = {
     "st_drain_in_progress": ("gauge", "1 while this node is executing a routed drain (seal+drain+close)"),
     "st_drain_total": ("counter", "routed drain commands this node accepted"),
     "st_lifecycle_errors_total": ("counter", "lifecycle barrier/ctl failures (overlap, timeout, lost RESUME, shard I/O)"),
+    # r16 cluster-sharded tensor (shared_tensor_tpu/shard). The write
+    # plane: fwd_out counts frames a node ORIGINATED (its outbox drains),
+    # fwd_in frames applied to an owned shard, relayed frames forwarded
+    # verbatim toward their owner, dedup the end-to-end (origin, fwd_seq)
+    # discards that close the re-route at-least-once window. park_drops is
+    # the bounded-park overflow (loud bounded loss — ShardConfig.park_cap).
+    # The read plane: the gather histogram records each assembled view's
+    # WORST per-shard verified staleness. owned_words/alloc_bytes ride the
+    # per-node digest breakdown (obs.top's shard column, and the chaos
+    # harness's per-node memory bound).
+    "st_shard_owned_words": ("gauge", "words of the table this node currently owns (0 = pure writer/relay)"),
+    "st_shard_alloc_bytes": ("gauge", "resident shard-state bytes: owned slices + subscriber residuals + live outboxes"),
+    "st_shard_routes": ("gauge", "shards with a learned next-hop route at this node"),
+    "st_shard_parked_msgs": ("gauge", "FWD frames parked awaiting a route (bounded by ShardConfig.park_cap)"),
+    "st_shard_fwd_msgs_out_total": ("counter", "FWD frames this node originated (outbox drains)"),
+    "st_shard_fwd_msgs_in_total": ("counter", "FWD frames applied to an owned shard"),
+    "st_shard_fwd_relayed_total": ("counter", "FWD frames relayed verbatim toward their owner (no re-quantization)"),
+    "st_shard_fwd_dedup_total": ("counter", "FWD frames discarded by the owner's (origin, fwd_seq) dedup window"),
+    "st_shard_park_drops_total": ("counter", "parked FWD frames dropped at the park-buffer cap (bounded loud loss)"),
+    "st_shard_handoffs_total": ("counter", "shard ownership handoffs completed (counted at both endpoints)"),
+    "st_shard_gather_staleness_seconds": ("histogram", "worst per-shard verified staleness per assembled gather view"),
     # per-link series (rendered via link_key)
     "st_link_bytes_out_total": ("counter", "wire bytes sent on the link (incl. framing/keepalives)"),
     "st_link_bytes_in_total": ("counter", "wire bytes received on the link"),

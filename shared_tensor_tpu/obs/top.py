@@ -106,16 +106,25 @@ def render(doc: dict, prev: dict | None, dt: float) -> str:
             f"(rolling upgrade in progress?)"
         )
     lines.append("")
+    # r16: the shard column renders only when any node reports shard
+    # telemetry — a classic full-replica tree keeps the r12 layout
+    sharded = any(
+        _node_val(nodes[nid].get("m", {}), "st_shard_owned_words") > 0
+        or _node_val(nodes[nid].get("m", {}), "st_shard_routes") > 0
+        for nid in nodes
+    )
     hdr = (
         f"{'node':>6} {'stale_s':>10} {'resid_L2':>10} {'hops':>5} "
         f"{'frames_out':>11} {'frames_in':>10} {'updates':>8} "
         f"{'retx':>6} {'inflight':>9}"
     )
+    if sharded:
+        hdr += f" {'owned_w':>9} {'fwd_in':>8} {'fwd_out':>8}"
     lines.append(hdr)
     lines.append("-" * len(hdr))
     for nid in sorted(nodes, key=int):
         m = nodes[nid].get("m", {})
-        lines.append(
+        row = (
             f"{nid:>6} "
             f"{_fmt(_node_val(m, 'st_staleness_seconds'))} "
             f"{_fmt(_node_val(m, 'st_residual_norm'))} "
@@ -126,6 +135,13 @@ def render(doc: dict, prev: dict | None, dt: float) -> str:
             f"{_fmt(m.get('st_retransmit_msgs_total', 0), 6)} "
             f"{_fmt(_node_val(m, 'st_inflight_msgs'), 9)}"
         )
+        if sharded:
+            row += (
+                f" {int(_node_val(m, 'st_shard_owned_words')):>9}"
+                f" {int(m.get('st_shard_fwd_msgs_in_total', 0)):>8}"
+                f" {int(m.get('st_shard_fwd_msgs_out_total', 0)):>8}"
+            )
+        lines.append(row)
     return "\n".join(lines)
 
 

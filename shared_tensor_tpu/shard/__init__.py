@@ -1,0 +1,215 @@
+"""Cluster-sharded tensor (r16 tentpole): shard ownership, owner-routed
+updates, and a cluster-wide ``createOrFetch``.
+
+The classic protocol converges EVERY node on the WHOLE table, so cluster
+memory and per-link bytes scale with model size. This package changes the
+core invariant: the table's word space is partitioned into
+``ShardConfig.n_shards`` contiguous ranges, every word has exactly one
+owner node, and the cluster converges on the union of the owned slices —
+per-node memory is O(total / n_shards) (the update-exchange decomposition
+of "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training": shard-local apply + owner-routed forwarding, i.e.
+reduce-scatter / all-gather decomposed over the async tree).
+
+Layers:
+
+- :mod:`.map` — the partition + epoch-merged owner directory;
+- :mod:`.state` — shard-local arrays (owned slices, per-subscriber
+  residuals, per-target-shard outboxes) + the word-range slice codec;
+- :mod:`.node` — the cluster member: capability hello, claim/grant,
+  the ledgered FWD plane with end-to-end dedup, relay routing,
+  subscriber serving, drain-handoff, restart-restore;
+- :mod:`.gather` — the reader's async all-gather over r10 subscriptions.
+
+Entry point: :func:`create_or_fetch_sharded` — the sharded twin of
+``create_or_fetch``, with the r14-discipline fallback: joining an
+unsharded (or pre-r16) tree returns a CLASSIC full-replica peer, so a
+sharded binary interoperates with any existing deployment. ``ST_SHARD=0``
+pins the classic protocol end to end.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..config import Config
+from .gather import ShardGather
+from .map import OwnerEntry, ShardMap
+from .node import ShardFallback, ShardNode, ShardRejected, shard_enabled
+from .state import ShardState, SliceCodec
+
+__all__ = [
+    "OwnerEntry",
+    "ShardMap",
+    "ShardState",
+    "SliceCodec",
+    "ShardNode",
+    "ShardGather",
+    "ShardFallback",
+    "ShardRejected",
+    "ShardHandle",
+    "create_or_fetch_sharded",
+    "shard_enabled",
+]
+
+
+class ShardHandle:
+    """The user-facing handle ``create_or_fetch_sharded`` returns.
+
+    ``sharded`` is True when the node joined (or created) a sharded
+    cluster; False when the tolerant fallback attached a classic
+    full-replica peer instead (unsharded/pre-r16 tree, n_shards=0, or
+    ST_SHARD=0) — same API either way, so callers don't branch."""
+
+    def __init__(self, node=None, peer=None, template=None, config=None):
+        if (node is None) == (peer is None):
+            raise ValueError("exactly one of node/peer")
+        self._node: Optional[ShardNode] = node
+        self._peer = peer
+        self._template = template
+        self._config = config or Config()
+
+    @property
+    def sharded(self) -> bool:
+        return self._node is not None
+
+    @property
+    def node(self) -> ShardNode:
+        if self._node is None:
+            raise RuntimeError("classic-fallback handle has no ShardNode")
+        return self._node
+
+    @property
+    def peer(self):
+        if self._peer is None:
+            raise RuntimeError("sharded handle has no classic peer")
+        return self._peer
+
+    def add(self, delta: Any) -> None:
+        (self._node or self._peer).add(delta)
+
+    def drain(self, timeout: float = 60.0, tol: float = 0.0) -> bool:
+        return (self._node or self._peer).drain(timeout=timeout, tol=tol)
+
+    def gather(
+        self,
+        elements: Optional[tuple[int, int]] = None,
+        timeout: float = 30.0,
+    ) -> ShardGather:
+        """An async all-gather view over the cluster (sharded handles
+        only — a classic peer already holds the full replica; read it)."""
+        return ShardGather(
+            self.node, self._template, self._config,
+            elements=elements, timeout=timeout,
+        )
+
+    def read(self, max_staleness: Optional[float] = None) -> Any:
+        """The full table as the caller's pytree. Classic fallback: the
+        local replica snapshot (exactly ``peer.read()``). Sharded: a
+        verified gather across the owners (staleness bound per shard).
+
+        Each call builds and tears down one subscription per owner — a
+        loop that reads repeatedly should hold ONE :meth:`gather` open
+        (``with h.gather() as g: ... g.read_tree(...)``) and pay the
+        N-leg join once."""
+        if self._peer is not None:
+            return self._peer.read()
+        with self.gather() as g:
+            return g.read_tree(max_staleness)
+
+    def jax_view(
+        self,
+        max_staleness: Optional[float] = None,
+        axis_name: str = "cluster",
+    ):
+        """The table as ONE jax array whose :class:`jax.sharding.
+        NamedSharding` mirrors the CLUSTER partition: a 1-D device mesh
+        named ``axis_name``, the flat table partitioned along it — the
+        "createOrFetch returns an array sharded across the cluster"
+        surface (ROADMAP item 1). In a single process this is a local
+        projection of the cluster partition (each local device holds the
+        shards mapped onto it); under ``jax.distributed`` the same spec
+        places each host's addressable slice. Values come from a
+        verified gather (sharded) or the local replica (fallback)."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        from ..ops.codec_np import flatten_np
+        from ..ops.table import make_spec
+
+        spec = make_spec(self._template)
+        if self._peer is not None:
+            flat = np.asarray(
+                flatten_np(self._peer.read(), spec), np.float32
+            )
+        else:
+            with self.gather() as g:
+                flat, _worst = g.read(max_staleness)
+        devs = jax.local_devices()
+        n = len(devs)
+        while n > 1 and spec.total % n:
+            n -= 1  # largest local fan-out that divides the padded table
+        mesh = Mesh(np.array(devs[:n]), (axis_name,))
+        return jax.device_put(
+            flat, NamedSharding(mesh, PartitionSpec(axis_name))
+        )
+
+    def close(self) -> None:
+        (self._node or self._peer).close()
+
+    def leave(self, timeout: float = 60.0) -> bool:
+        if self._node is not None:
+            return self._node.leave(timeout=timeout)
+        return self._peer.leave(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def create_or_fetch_sharded(
+    host: str,
+    port: int,
+    template: Any,
+    config: Config | None = None,
+    timeout: float = 30.0,
+) -> ShardHandle:
+    """The sharded ``createOrFetch``: create the cluster-sharded tensor at
+    ``host:port`` (becoming master and minting the shard map) or join it
+    (claiming ``ShardConfig.shard_index``). Falls back to the CLASSIC
+    full-replica protocol — returning a working handle either way — when
+    sharding is off (``n_shards=0`` / ``ST_SHARD=0``) or the existing
+    tree is not sharded (pre-r16 / unsharded parent: the tolerant-hello
+    fallback, r14 discipline)."""
+    cfg = config or Config()
+    if cfg.shard.n_shards <= 0 or not shard_enabled():
+        from ..comm.peer import create_or_fetch
+
+        return ShardHandle(
+            peer=create_or_fetch(host, port, template, cfg, timeout),
+            template=template, config=cfg,
+        )
+    deadline = time.monotonic() + timeout
+    node = ShardNode(host, port, template, cfg)
+    try:
+        node.wait_ready(timeout)
+    except ShardFallback:
+        node.close()
+        from ..comm.peer import create_or_fetch
+
+        return ShardHandle(
+            peer=create_or_fetch(
+                host, port, template, cfg,
+                max(1.0, deadline - time.monotonic()),
+            ),
+            template=template, config=cfg,
+        )
+    except BaseException:
+        node.close()
+        raise
+    return ShardHandle(node=node, template=template, config=cfg)

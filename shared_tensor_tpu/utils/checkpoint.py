@@ -433,6 +433,136 @@ def verify_manifest(dirpath: str) -> list[str]:
     return problems
 
 
+# ---- r16 cluster-sharded tensor: per-owner shard state --------------------
+#
+# A sharded cluster's checkpoint is one file per NODE (same shard_<name>
+# naming + sha256-manifest discipline as the r12 lifecycle shards), but
+# the payload is the r16 memory model, not a full replica: the node's
+# OWNED slices (word ranges of the global table), its per-target-shard
+# OUTBOX residuals (out-of-shard mass quantized-but-undelivered — owed to
+# other owners, so dropping it at restart would silently lose cluster
+# mass), its per-origin END-TO-END dedup windows (without them a restart
+# re-applies any frame that was delivered-but-unacked at the kill), and
+# its fwd_seq high-water mark (forward-compat only: origin obs ids are
+# pid-seeded, so a reborn node's identities are fresh either way — the
+# windows are what protect against OTHER, still-alive origins' resends).
+# MANIFEST.json gains per-shard entries via the normal ``nodes`` rows —
+# each row's ``shards`` list records which word ranges that node owned at
+# the capture, so ``ctl verify``/restore tooling can audit coverage
+# (every shard owned exactly once) before trusting a snapshot.
+
+
+def save_shard_state(
+    dirpath: str,
+    node_name: str,
+    layout_digest: bytes,
+    owned: dict,
+    outboxes: dict,
+    dedup: dict,
+    fwd_seq: int,
+) -> dict:
+    """Write one sharded node's checkpoint. ``owned`` maps shard index ->
+    (word_lo, word_cnt, values f32); ``outboxes`` maps shard index ->
+    (word_lo, residual f32); ``dedup`` maps origin (str) -> sorted seq
+    list. Returns the MANIFEST.json entry (``{"node", "file", "sha256",
+    "bytes", "shards"}``)."""
+    os.makedirs(dirpath, exist_ok=True)
+    fname = shard_filename(node_name)
+    path = os.path.join(dirpath, fname)
+    arrays = {
+        "layout": np.frombuffer(layout_digest, dtype=np.uint8),
+    }
+    shard_meta = []
+    for k, (wlo, wcnt, vals) in sorted(owned.items()):
+        arrays[f"owned_{int(k)}"] = np.ascontiguousarray(vals, np.float32)
+        shard_meta.append(
+            {"shard": int(k), "word_lo": int(wlo), "word_cnt": int(wcnt)}
+        )
+    outbox_meta = []
+    for k, (wlo, resid) in sorted(outboxes.items()):
+        arrays[f"outbox_{int(k)}"] = np.ascontiguousarray(resid, np.float32)
+        outbox_meta.append({"shard": int(k), "word_lo": int(wlo)})
+    arrays["meta"] = np.frombuffer(
+        json.dumps(
+            {
+                "format": _FORMAT,
+                "kind": "shard_state",
+                "node": str(node_name),
+                "time": time.time(),
+                "shards": shard_meta,
+                "outboxes": outbox_meta,
+                "dedup": {str(o): list(map(int, s)) for o, s in dedup.items()},
+                "fwd_seq": int(fwd_seq),
+            }
+        ).encode(),
+        dtype=np.uint8,
+    )
+    _atomic_savez(path, **arrays)
+    return {
+        "node": str(node_name),
+        "file": fname,
+        "sha256": file_sha256(path),
+        "bytes": os.path.getsize(path),
+        "shards": shard_meta,
+    }
+
+
+def load_shard_state(path: str) -> dict:
+    """Read a :func:`save_shard_state` file back: ``{"layout", "owned":
+    {shard: (word_lo, word_cnt, values)}, "outboxes": {shard: (word_lo,
+    residual)}, "dedup": {origin: [seqs]}, "fwd_seq"}``."""
+    with np.load(path) as z:
+        meta = json.loads(z["meta"].tobytes().decode())
+        if meta.get("kind") != "shard_state":
+            raise ValueError(f"{path} is not an r16 shard-state checkpoint")
+        layout = z["layout"].tobytes()
+        owned = {
+            int(e["shard"]): (
+                int(e["word_lo"]),
+                int(e["word_cnt"]),
+                np.asarray(z[f"owned_{int(e['shard'])}"], np.float32),
+            )
+            for e in meta.get("shards", [])
+        }
+        outboxes = {
+            int(e["shard"]): (
+                int(e["word_lo"]),
+                np.asarray(z[f"outbox_{int(e['shard'])}"], np.float32),
+            )
+            for e in meta.get("outboxes", [])
+        }
+    return {
+        "layout": layout,
+        "owned": owned,
+        "outboxes": outboxes,
+        "dedup": meta.get("dedup", {}),
+        "fwd_seq": int(meta.get("fwd_seq", 0)),
+    }
+
+
+def verify_shard_coverage(dirpath: str, n_shards: int) -> list[str]:
+    """Sharded-manifest audit on top of :func:`verify_manifest`: every
+    shard index in [0, n_shards) owned by EXACTLY one node at the
+    capture. Returns problems ([] = clean)."""
+    problems = verify_manifest(dirpath)
+    try:
+        doc = load_manifest(dirpath)
+    except (OSError, ValueError):
+        return problems  # verify_manifest already reported it
+    owners: dict[int, list[str]] = {}
+    for entry in doc.get("nodes", []):
+        for s in entry.get("shards", []):
+            owners.setdefault(int(s["shard"]), []).append(entry["node"])
+    for k in range(n_shards):
+        who = owners.get(k, [])
+        if len(who) != 1:
+            problems.append(
+                f"shard {k}: owned by {who or 'nobody'} at the capture "
+                f"(exactly-one-owner audit)"
+            )
+    return problems
+
+
 # ---- sharded (per-device) pod checkpoint ----------------------------------
 #
 # save_pod/load_pod round-trip the whole table through ONE host's memory

@@ -439,3 +439,36 @@ def test_chaos_soak_native_arm_under_asan_ubsan():
     stdout = proc.stdout
     out = json.loads(stdout[stdout.index("{"):])
     assert out["arms"]["native"]["pass"], out
+
+
+@pytest.mark.slow
+def test_shard_suite_under_asan_ubsan():
+    """r16 satellite: the cluster-sharded tensor pushes a NEW data kind
+    (wire.FWD, 21-byte header + k variable-size frames) through the
+    native transport — recv-bound sizing, the fault injector's widened
+    is_data set, and relay paths that re-stamp a buffer in place before
+    re-sending it. Run the whole shard test file (map negotiation, mixed
+    interop, drain-handoff, snapshot/restore) against the sanitizer
+    builds so ASan/UBSan watch every FWD framing offset and relay copy."""
+    asan = _runtime("libasan.so")
+    ubsan = _runtime("libubsan.so")
+    if asan is None or ubsan is None:
+        pytest.skip("gcc sanitizer runtimes unavailable")
+    build = subprocess.run(
+        ["make", "-C", str(NATIVE), "sanitize"],
+        capture_output=True, text=True, timeout=300,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"sanitize build failed: {build.stderr[-500:]}")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "tests/test_shard.py", "-q",
+            "-p", "no:cacheprovider",
+        ],
+        env=_san_env(asan, ubsan), capture_output=True, text=True,
+        timeout=540, cwd=str(REPO),
+    )
+    err_tail = proc.stderr[-4000:]
+    assert "AddressSanitizer" not in proc.stderr, err_tail
+    assert "runtime error:" not in proc.stderr, err_tail  # UBSan findings
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-2000:], err_tail)

@@ -1,0 +1,473 @@
+"""r16 cluster-sharded tensor (shared_tensor_tpu/shard).
+
+What these tests pin down, in the order the subsystem composes:
+
+- the shard map's geometry/epoch-merge discipline and the word-range
+  slice codec's bit-compatibility with the main codec's apply rule
+  (value += scale[leaf] * (1 - 2*bit) on live lanes, ±SAT saturation);
+- the FWD wire frame: burst encode/decode round trip, the verbatim-
+  relay restamp discipline, the spec-derived frame cap, and the
+  corrupt-scale zeroing guard every other data kind already has;
+- map negotiation over the tolerant SYNC/WELCOME hello: claims route up
+  the tree, grants flood down, and every node converges on the union of
+  the owned slices while holding ONLY its slice (the memory contract);
+- mixed-tree interop in BOTH orientations (r14 discipline): a sharded
+  joiner under a classic tree falls back to the full-replica protocol
+  and still converges; a classic WRITER under a sharded tree is
+  rejected LOUDLY (no node can seed a full replica — detectably broken,
+  not silently wrong), while read-only subscribers interop fine;
+- owner drain -> handoff: a leaving owner transfers slice + epoch +
+  end-to-end dedup state to its parent, the cluster's routes flip, and
+  no mass is lost or double-applied across the transfer;
+- the sharded snapshot/restore round trip: per-node shard files, the
+  MANIFEST.json exactly-one-owner coverage audit, and a killed owner
+  restored from disk under takeover semantics with its values intact.
+"""
+
+import dataclasses
+import os
+import time
+
+import numpy as np
+import pytest
+
+from shared_tensor_tpu.comm import wire
+from shared_tensor_tpu.config import (
+    Config,
+    LifecycleConfig,
+    ScalePolicy,
+    ShardConfig,
+    TransportConfig,
+)
+from shared_tensor_tpu.ops.table import make_spec
+from shared_tensor_tpu.shard import (
+    ShardGather,
+    ShardMap,
+    create_or_fetch_sharded,
+)
+from shared_tensor_tpu.shard.map import OwnerEntry
+from shared_tensor_tpu.shard.state import SliceCodec
+from shared_tensor_tpu.utils import checkpoint as ckpt
+from tests._ports import free_port
+
+TMPL = {
+    "w": np.zeros(4096, np.float32),
+    "b": np.zeros(512, np.float32),
+}
+SPEC = make_spec(TMPL)
+TOTAL = SPEC.total  # padded element count
+WORDS = TOTAL // 32
+
+
+def _cfg(idx: int, n: int = 3, name: str = "", restore: str = "") -> Config:
+    return Config(
+        shard=ShardConfig(n_shards=n, shard_index=idx, restore_dir=restore),
+        lifecycle=LifecycleConfig(node_name=name),
+        transport=TransportConfig(peer_timeout_sec=20.0),
+    )
+
+
+def _flat_ref(tree: dict) -> np.ndarray:
+    from shared_tensor_tpu.ops.codec_np import flatten_np
+
+    return np.asarray(flatten_np(tree, SPEC), np.float32)
+
+
+def _add_rounds(handles, rng, ref, rounds=3):
+    for i in range(rounds):
+        for h in handles:
+            d = {
+                "w": rng.standard_normal(4096).astype(np.float32),
+                "b": rng.standard_normal(512).astype(np.float32),
+            }
+            ref["w"] += d["w"]
+            ref["b"] += d["b"]
+            h.add(d)
+
+
+def _drain_all(handles, timeout=90.0):
+    for h in handles:
+        assert h.drain(timeout=timeout), "drain timed out"
+
+
+def _gather_matches(source, ref, atol=2e-3):
+    with ShardGather(source, TMPL) as g:
+        tree = g.read_tree(max_staleness=60.0)
+    np.testing.assert_allclose(tree["w"], ref["w"], atol=atol)
+    np.testing.assert_allclose(tree["b"], ref["b"], atol=atol)
+
+
+# ---- units: map / codec / wire --------------------------------------------
+
+
+def test_shard_map_geometry_and_epoch_merge():
+    m = ShardMap(WORDS, 3)
+    assert m.validate() == []
+    # contiguous exact cover, word->shard agrees with the ranges
+    lo = 0
+    for k, (wlo, wcnt) in enumerate(m.ranges):
+        assert wlo == lo
+        assert m.shard_of_word(wlo) == k
+        assert m.shard_of_word(wlo + wcnt - 1) == k
+        lo = wlo + wcnt
+    assert lo == WORDS
+    # epoch merge: higher epoch wins, lower/equal is ignored
+    assert m.merge_entry(1, OwnerEntry(2, 7, "h", 1))
+    assert not m.merge_entry(1, OwnerEntry(2, 9, "x", 2))
+    assert not m.merge_entry(1, OwnerEntry(1, 9, "x", 2))
+    assert m.owners[1].owner == 7
+    # doc round trip preserves owners; geometry mismatch is loud
+    m2 = ShardMap.from_doc(m.as_doc())
+    assert m2.owners[1].epoch == 2
+    with pytest.raises(ValueError, match="geometry"):
+        m2.merge_doc(ShardMap(WORDS, 4).as_doc())
+
+
+def test_slice_codec_quantize_apply_bit_compat():
+    """One quantize step's wire frame applies back EXACTLY like the main
+    codec rule, and error feedback makes the ladder lossless: target
+    converges to the original residual mass."""
+    rng = np.random.default_rng(7)
+    c = SliceCodec(SPEC, WORDS // 3, WORDS // 3)
+    resid = (rng.standard_normal(c.n_el) * c.live).astype(np.float32)
+    want = resid.copy()
+    target = np.zeros(c.n_el, np.float32)
+    for _ in range(6000):
+        scales, words, resid = c.quantize(resid, ScalePolicy.POW2_RMS)
+        if not scales.any():
+            break
+        # the explicit apply rule, element by element
+        bits = np.unpackbits(
+            words.view(np.uint8), bitorder="little"
+        ).astype(np.float32)
+        manual = target + scales[c.leaf_of] * c.live * (1.0 - 2.0 * bits)
+        c.apply(target, scales, words)
+        np.testing.assert_array_equal(target, manual.astype(np.float32))
+    # the documented drain caveat (state.py): the ladder goes idle when
+    # each segment's RMS pow2-floors to 0 (rms < 2^-126); single elements
+    # can sit up to ~sqrt(n_live) above that — still denormal dust
+    assert float(np.max(np.abs(resid))) < 2.0**-126 * np.sqrt(c.n_el)
+    np.testing.assert_allclose(target, want, atol=5e-5)
+
+
+def test_fwd_wire_roundtrip_restamp_and_caps():
+    rng = np.random.default_rng(3)
+    wcnt = WORDS // 3
+    L = SPEC.num_leaves
+    frames = [
+        (
+            rng.standard_normal(L).astype(np.float32) ** 2,
+            rng.integers(0, 2**32, wcnt, dtype=np.uint32),
+        )
+        for _ in range(5)
+    ]
+    payload = wire.encode_fwd(frames, 4, seq=9, origin=42, fwd_seq=1234)
+    assert payload[0] == wire.FWD
+    got, word_lo, seq, origin, fwd_seq = wire.decode_fwd(payload, SPEC)
+    assert (word_lo, seq, origin, fwd_seq) == (4, 9, 42, 1234)
+    assert len(got) == 5
+    for (s0, w0), (s1, w1) in zip(frames, got):
+        np.testing.assert_array_equal(s0, s1)
+        np.testing.assert_array_equal(w0, w1)
+    # relay restamp touches ONLY the per-link seq; the end-to-end
+    # identity and every frame byte stay verbatim
+    buf = bytearray(payload)
+    wire.fwd_restamp(buf, 77)
+    got2, _wlo, seq2, origin2, fwd2 = wire.decode_fwd(bytes(buf), SPEC)
+    assert (seq2, origin2, fwd2) == (77, 42, 1234)
+    np.testing.assert_array_equal(got2[0][0], got[0][0])
+    # a non-finite scale zeroes its leaf instead of NaN-ing the owner
+    bad = bytearray(payload)
+    np.frombuffer(bad, "<f4", count=L, offset=wire.FWD_HDR)  # layout check
+    bad[wire.FWD_HDR : wire.FWD_HDR + 4] = np.float32("nan").tobytes()
+    gotb, *_ = wire.decode_fwd(bytes(bad), SPEC)
+    assert gotb[0][0][0] == 0.0
+    # spec-derived cap: always >= 1, never exceeds the receive bound
+    cap = wire.fwd_frames_cap(SPEC, wcnt)
+    assert 1 <= cap <= wire.FWD_BURST_FRAMES
+    per = 4 * L + 4 * wcnt
+    assert wire.FWD_HDR + cap * per <= wire.frame_wire_bytes(SPEC)
+    # truncated / ragged bodies are rejected, not misparsed
+    with pytest.raises(ValueError):
+        wire.decode_fwd(payload[:-3], SPEC)
+
+
+# ---- cluster: negotiation, convergence, memory contract -------------------
+
+
+def test_map_negotiation_and_owner_routed_convergence():
+    """3 nodes claim 3 shards through the SYNC/WELCOME hello; every
+    node's out-of-shard writes ride owner-routed FWD frames (relayed,
+    never re-quantized) and the cluster converges on the union — while
+    NO node ever holds the full table (the memory contract)."""
+    port = free_port()
+    handles = [
+        create_or_fetch_sharded("127.0.0.1", port, TMPL, _cfg(i))
+        for i in range(3)
+    ]
+    try:
+        assert all(h.sharded for h in handles)
+        m = handles[0].node.map_doc()
+        assert ShardMap.from_doc(m).fully_owned()
+        rng = np.random.default_rng(0)
+        ref = {"w": np.zeros(4096, np.float32),
+               "b": np.zeros(512, np.float32)}
+        _add_rounds(handles, rng, ref, rounds=3)
+        _drain_all(handles)
+        # per-node resident state is the owned slice (plus drained
+        # outboxes = freed): strictly below half the full table
+        full = TOTAL * 4
+        for h in handles:
+            assert h.node.alloc_bytes() < full // 2
+            assert h.node.state.owned_words() < WORDS
+        assert sum(h.node.state.owned_words() for h in handles) == WORDS
+        _gather_matches(handles[0].node, ref)
+        # owner routing actually relayed (leaf->leaf crosses the master)
+        relayed = sum(
+            int(h.node.metrics().get("st_shard_fwd_relayed_total", 0))
+            for h in handles
+        )
+        assert relayed > 0
+    finally:
+        for h in reversed(handles):
+            h.close()
+
+
+def test_partial_gather_reads_covering_shards_only():
+    port = free_port()
+    handles = [
+        create_or_fetch_sharded("127.0.0.1", port, TMPL, _cfg(i, n=2))
+        for i in range(2)
+    ]
+    try:
+        ref = {"w": np.zeros(4096, np.float32),
+               "b": np.zeros(512, np.float32)}
+        rng = np.random.default_rng(5)
+        _add_rounds(handles, rng, ref, rounds=2)
+        _drain_all(handles)
+        flat_ref = _flat_ref(ref)
+        lo, hi = 100, 1500  # inside shard 0 only
+        with ShardGather(handles[0].node, TMPL, elements=(lo, hi)) as g:
+            assert len(g.legs) == 1
+            flat, worst = g.read(max_staleness=60.0)
+        assert np.isfinite(worst)
+        np.testing.assert_allclose(flat, flat_ref[lo:hi], atol=2e-3)
+    finally:
+        for h in reversed(handles):
+            h.close()
+
+
+# ---- mixed-tree interop, both orientations --------------------------------
+
+
+def test_sharded_joiner_falls_back_under_classic_tree():
+    from shared_tensor_tpu.comm.peer import create_or_fetch
+
+    port = free_port()
+    classic = create_or_fetch("127.0.0.1", port, TMPL, Config())
+    try:
+        h = create_or_fetch_sharded("127.0.0.1", port, TMPL, _cfg(1))
+        try:
+            assert not h.sharded  # tolerant fallback, not an error
+            d = np.ones(4096, np.float32)
+            h.add({"w": d, "b": np.zeros(512, np.float32)})
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if np.allclose(
+                    np.asarray(classic.read()["w"]), d, atol=1e-3
+                ):
+                    break
+                time.sleep(0.05)
+            np.testing.assert_allclose(
+                np.asarray(classic.read()["w"]), d, atol=1e-3
+            )
+        finally:
+            h.close()
+    finally:
+        classic.close()
+
+
+def test_classic_writer_rejected_by_sharded_tree_loudly():
+    from shared_tensor_tpu.comm.peer import SpecMismatch, create_or_fetch
+
+    port = free_port()
+    h0 = create_or_fetch_sharded("127.0.0.1", port, TMPL, _cfg(0, n=2))
+    try:
+        assert h0.sharded
+        with pytest.raises(ConnectionError, match="sharded") as ei:
+            p = create_or_fetch(
+                "127.0.0.1", port, TMPL, Config(), timeout=15
+            )
+            p.close()
+        assert isinstance(ei.value, SpecMismatch)
+    finally:
+        h0.close()
+
+
+def test_read_only_subscriber_interops_with_sharded_owner():
+    from shared_tensor_tpu.serve.subscriber import Subscriber
+
+    port = free_port()
+    handles = [
+        create_or_fetch_sharded("127.0.0.1", port, TMPL, _cfg(i, n=2))
+        for i in range(2)
+    ]
+    try:
+        ref = {"w": np.zeros(4096, np.float32),
+               "b": np.zeros(512, np.float32)}
+        rng = np.random.default_rng(11)
+        _add_rounds(handles, rng, ref, rounds=2)
+        _drain_all(handles)
+        s_lo, s_hi = handles[0].node.map.element_range(0)
+        lo, hi = s_lo + 32, min(s_hi, s_lo + 1056)
+        cfg = Config()
+        cfg = dataclasses.replace(
+            cfg, serve=dataclasses.replace(cfg.serve, range=(lo, hi))
+        )
+        # the rendezvous port is the master — owner of shard 0
+        with Subscriber("127.0.0.1", port, TMPL, cfg) as sub:
+            sub.wait_ready(30.0)
+            deadline = time.time() + 30
+            flat_ref = _flat_ref(ref)
+            while time.time() < deadline:
+                flat, _st, _ver = sub.read_flat(60.0)
+                p_lo, _p_hi = sub.range_elements
+                got = flat[lo - p_lo : hi - p_lo]
+                if np.allclose(got, flat_ref[lo:hi], atol=2e-3):
+                    break
+                time.sleep(0.05)
+            np.testing.assert_allclose(got, flat_ref[lo:hi], atol=2e-3)
+    finally:
+        for h in reversed(handles):
+            h.close()
+
+
+# ---- drain-handoff --------------------------------------------------------
+
+
+def test_owner_drain_handoff_preserves_mass_and_routes():
+    """A leaving owner hands its slice to the parent; the successor owns
+    it at a HIGHER epoch, the full view is preserved, and post-handoff
+    writes toward the moved shard land at the successor."""
+    port = free_port()
+    handles = [
+        create_or_fetch_sharded("127.0.0.1", port, TMPL, _cfg(i))
+        for i in range(3)
+    ]
+    try:
+        rng = np.random.default_rng(2)
+        ref = {"w": np.zeros(4096, np.float32),
+               "b": np.zeros(512, np.float32)}
+        _add_rounds(handles, rng, ref, rounds=2)
+        _drain_all(handles)
+        leaver = handles[2]
+        moved = leaver.node.owned_shards()
+        assert moved
+        epoch_before = leaver.node.map.owners[moved[0]].epoch
+        assert leaver.leave(timeout=60.0)
+        live = handles[:2]
+        # the successor (the leaver's parent) owns the moved shard now
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            owners = {
+                s for h in live for s in h.node.owned_shards()
+            }
+            if set(moved) <= owners:
+                break
+            time.sleep(0.05)
+        all_owned = sorted(
+            s for h in live for s in h.node.owned_shards()
+        )
+        assert all_owned == list(range(3)), all_owned
+        succ = next(
+            h for h in live if set(moved) <= set(h.node.owned_shards())
+        )
+        assert succ.node.map.owners[moved[0]].epoch > epoch_before
+        assert (
+            int(succ.node.metrics().get("st_shard_handoffs_total", 0)) > 0
+        )
+        _gather_matches(succ.node, ref)
+        # post-handoff writes toward the moved shard land and converge
+        _add_rounds(live, rng, ref, rounds=1)
+        _drain_all(live)
+        _gather_matches(live[0].node, ref)
+    finally:
+        for h in reversed(handles):
+            try:
+                h.close()
+            except Exception:
+                pass
+
+
+# ---- snapshot / restore ---------------------------------------------------
+
+
+def test_sharded_snapshot_restore_roundtrip(tmp_path):
+    """Quiesced capture -> MANIFEST.json with per-shard rows -> coverage
+    audit clean -> kill an owner -> restore from disk under takeover
+    semantics: the reborn node re-claims its shard at a higher epoch
+    with its values intact, and the cluster converges again."""
+    snap = str(tmp_path / "snap")
+    port = free_port()
+    h0 = create_or_fetch_sharded(
+        "127.0.0.1", port, TMPL, _cfg(0, n=2, name="m")
+    )
+    h1 = create_or_fetch_sharded(
+        "127.0.0.1", port, TMPL, _cfg(1, n=2, name="n1")
+    )
+    try:
+        rng = np.random.default_rng(4)
+        ref = {"w": np.zeros(4096, np.float32),
+               "b": np.zeros(512, np.float32)}
+        _add_rounds([h0, h1], rng, ref, rounds=2)
+        _drain_all([h0, h1])
+        entries = [
+            e
+            for e in (h.node.save_shards(snap) for h in (h0, h1))
+            if e is not None
+        ]
+        assert len(entries) == 2
+        assert all(e["shards"] for e in entries)
+        ckpt.write_manifest(snap, "r16-test", entries)
+        assert ckpt.verify_shard_coverage(snap, 2) == []
+        # an N-shard audit against a SHORT manifest is loud
+        assert ckpt.verify_shard_coverage(snap, 3) != []
+
+        before = h1.node.owned_shards()
+        h1.close()  # hard kill: no handoff, no drain
+        h1 = None
+        h1 = create_or_fetch_sharded(
+            "127.0.0.1",
+            port,
+            TMPL,
+            _cfg(1, n=2, name="n1", restore=snap),
+        )
+        assert h1.sharded
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if h1.node.owned_shards() == before:
+                break
+            time.sleep(0.05)
+        assert h1.node.owned_shards() == before
+        _gather_matches(h0.node, ref)
+        # the restored node keeps serving writes
+        _add_rounds([h0, h1], rng, ref, rounds=1)
+        _drain_all([h0, h1])
+        _gather_matches(h1.node, ref)
+    finally:
+        for h in (h1, h0):
+            if h is not None:
+                h.close()
+
+
+def test_st_shard_0_pins_classic_protocol(monkeypatch):
+    from shared_tensor_tpu.comm.peer import SharedTensorPeer
+
+    monkeypatch.setenv("ST_SHARD", "0")
+    port = free_port()
+    h = create_or_fetch_sharded("127.0.0.1", port, TMPL, _cfg(0))
+    try:
+        assert not h.sharded
+        assert isinstance(h.peer, SharedTensorPeer)
+    finally:
+        h.close()

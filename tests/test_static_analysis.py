@@ -40,6 +40,7 @@ _LINT_INPUTS = [
     "shared_tensor_tpu/compat.py",
     "shared_tensor_tpu/obs/events.py",
     "shared_tensor_tpu/obs/schema.py",
+    "shared_tensor_tpu/shard/node.py",
 ]
 
 
@@ -114,10 +115,54 @@ def test_wire_lint_flags_fault_injector_kind_set(tmp_path):
     # stops covering it at the native wire boundary
     root = _seed_tree(tmp_path)
     _edit(root, "native/sttransport.cpp",
-          "(kind0 == 0 || kind0 == 7 || kind0 == 11)",
-          "(kind0 == 0 || kind0 == 7)")
+          "kind0 == 11", "kind0 == 7")
     findings = lint_wire.run(root)
     assert any("is_data" in f for f in findings), findings
+
+
+def test_wire_lint_flags_fwd_missing_from_injector(tmp_path):
+    # r16: the sharded tree's WHOLE data plane rides FWD frames — an
+    # is_data set that loses kind 17 silently exempts every sharded
+    # cluster from wire chaos
+    root = _seed_tree(tmp_path)
+    _edit(root, "native/sttransport.cpp",
+          "kind0 == 17", "kind0 == 11")
+    findings = lint_wire.run(root)
+    assert any("is_data" in f for f in findings), findings
+
+
+def test_wire_lint_flags_shard_hello_flag_drift(tmp_path):
+    # r16: the shard capability bit's wire/compat twin declaration — a
+    # drift silently degrades every sharded join to the full-replica
+    # fallback (same class as the shm flag below)
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/compat.py",
+          "SYNC_FLAG_SHARD = 0x10", "SYNC_FLAG_SHARD = 0x20")
+    findings = lint_wire.run(root)
+    assert any("SYNC_FLAG_SHARD" in f and "SHARD_FLAG" in f
+               for f in findings), findings
+
+
+def test_wire_lint_flags_fwd_header_drift(tmp_path):
+    # r16: FWD's fixed header (kind + five u32) — a drifted constant
+    # desyncs decode_fwd's length check and fwd_restamp's offset
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/comm/wire.py",
+          "FWD_HDR = 21", "FWD_HDR = 25")
+    findings = lint_wire.run(root)
+    assert any("FWD_HDR" in f for f in findings), findings
+
+
+def test_abi_lint_flags_shard_queue_depth_drift(tmp_path):
+    # r16: the transport send-queue depth is declared three times (native
+    # config default, TransportNode default, shard/node.py QUEUE_DEPTH);
+    # the shard pump's control-traffic headroom math reads the last one,
+    # and a drift re-opens the ACK-starvation wedge
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/shard/node.py",
+          "QUEUE_DEPTH = 8", "QUEUE_DEPTH = 4")
+    findings = lint_abi.run(root)
+    assert any("queue-depth drift" in f for f in findings), findings
 
 
 def test_wire_lint_flags_v3_header_drift(tmp_path):

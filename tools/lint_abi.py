@@ -402,6 +402,43 @@ def run(repo: pathlib.Path) -> list[str]:
             "(pattern rot, or the r14 lane ABI was removed?)"
         )
 
+    # ---- r16 shard-tier queue-depth twin declaration ---------------------
+    # ShardNode's FWD pump keeps control-traffic headroom in the per-link
+    # transport send queue (_queue_room: pumps stop at depth - keep so
+    # cumulative ACKs and shard control messages always have slots — a
+    # pump that races them for the last slot starves the very ACKs that
+    # drain its own ledger). The depth is declared THREE times: the
+    # native config default (sttransport.cpp), TransportNode's python
+    # default, and shard/node.py's QUEUE_DEPTH. A silent drift either
+    # starves the pump (python > native) or re-opens the ACK-starvation
+    # wedge (python < native).
+    shard_text = L.strip_py_comments(
+        L.read(repo, "shared_tensor_tpu/shard/node.py")
+    )
+    depths = {}
+    m = re.search(r"int32_t\s+queue_depth\s*=\s*(\d+)\s*;", nat_text)
+    if m:
+        depths["sttransport.cpp queue_depth"] = int(m.group(1))
+    m = re.search(
+        r"queue_depth:\s*int\s*=\s*(\d+)", py_sources["comm/transport.py"]
+    )
+    if m:
+        depths["transport.py queue_depth default"] = int(m.group(1))
+    m = re.search(r"^QUEUE_DEPTH\s*=\s*(\d+)", shard_text, re.M)
+    if m:
+        depths["shard/node.py QUEUE_DEPTH"] = int(m.group(1))
+    if len(depths) != 3:
+        findings.append(
+            f"queue-depth twin declaration: only {sorted(depths)} parsed "
+            f"(pattern rot?)"
+        )
+    elif len(set(depths.values())) != 1:
+        findings.append(
+            f"queue-depth drift across the shard ABI: {depths} — the FWD "
+            f"pump's control-traffic headroom math desyncs from the "
+            f"native send queue"
+        )
+
     # ---- ctypes.Structure mirrors ----------------------------------------
     t_nat = L.strip_c_comments(L.read(repo, "native/sttransport.cpp"))
     t_py = py_sources["comm/transport.py"]

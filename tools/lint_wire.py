@@ -155,17 +155,42 @@ def run(repo: pathlib.Path) -> list[str]:
             f"every shm negotiation would silently fall back to TCP"
         )
 
+    # r16: the shard hello flag bit has the same twin-declaration shape
+    # (wire.SHARD_FLAG gates the SYNC claim tail; compat.SYNC_FLAG_SHARD
+    # is the documented capability bit) — a drift would silently degrade
+    # every sharded join to the classic full-replica fallback
+    if py.get("SHARD_FLAG") != pycompat.get("SYNC_FLAG_SHARD"):
+        findings.append(
+            f"shard hello flag drift: wire.py SHARD_FLAG="
+            f"{py.get('SHARD_FLAG')} vs compat.py SYNC_FLAG_SHARD="
+            f"{pycompat.get('SYNC_FLAG_SHARD')} — every sharded join "
+            f"would silently fall back to the full-replica protocol"
+        )
+
+    # r16: the FWD header size must equal its fixed layout (kind byte +
+    # five u32 fields — wire.py _FWD_FMT); a drifted constant desyncs
+    # every decode_fwd length check and the fwd_restamp offset discipline
+    if py.get("FWD_HDR") != 21:
+        findings.append(
+            f"wire.py FWD_HDR={py.get('FWD_HDR')} != 21 (kind + 5 u32 "
+            f"fields) — decode_fwd/fwd_restamp offsets desync"
+        )
+
     # the transport fault injector's data-kind set (link_sender_loop
     # ``is_data``): the literals it matches must be exactly the data kinds
     # wire.py defines — a new data kind that is not added there silently
-    # escapes chaos coverage at the native wire boundary.
+    # escapes chaos coverage at the native wire boundary. r16 adds FWD:
+    # a sharded cluster's whole data plane rides FWD frames, so the set
+    # now has four members.
     m = re.search(r"bool\s+is_data\s*=(.*?);", transport, flags=re.S)
     if not m:
         findings.append("sttransport.cpp: is_data expression not found "
                         "(pattern rot?)")
     else:
         lits = {int(v) for v in re.findall(r"kind0\s*==\s*(\d+)", m.group(1))}
-        want = {py.get("DATA"), py.get("BURST"), py.get("RDATA")}
+        want = {
+            py.get("DATA"), py.get("BURST"), py.get("RDATA"), py.get("FWD"),
+        }
         if lits != want:
             findings.append(
                 f"sttransport.cpp is_data kind set {sorted(lits)} != "

@@ -29,7 +29,7 @@ protocol class, three ways:
    reachable. Each spec also carries MUTATIONS encoding the three
    historical bugs; ``run_check.py`` asserts the explorer finds every
    mutation within the bound and none on the true specs, and commits
-   the state/transition counts as MODEL_r15.json.
+   the state/transition counts as MODEL_r16.json.
 
 3. **Conformance** (``conformance.py``): the same specs replayed as
    trace ACCEPTORS over real flight-recorder timelines (obs/recorder),
@@ -49,10 +49,19 @@ __all__ = ["Spec", "Violation", "ExploreResult", "explore", "all_specs"]
 def all_specs():
     """name -> spec CLASS for every true spec (mutations via
     ``cls(mutation=...)``; ``cls.mutations`` names what each seeds)."""
-    from . import spec_drain, spec_gbn, spec_hello, spec_lane, spec_snap
+    from . import (
+        spec_drain,
+        spec_gbn,
+        spec_hello,
+        spec_lane,
+        spec_shard,
+        spec_snap,
+    )
 
     out = {}
-    for mod in (spec_hello, spec_gbn, spec_snap, spec_drain, spec_lane):
+    for mod in (
+        spec_hello, spec_gbn, spec_snap, spec_drain, spec_lane, spec_shard,
+    ):
         for cls in mod.SPECS:
             out[cls.name] = cls
     return out

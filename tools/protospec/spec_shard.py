@@ -1,0 +1,252 @@
+"""Cluster-shard spec: owner-routed forwarding + shard-handoff-on-drain
+(shared_tensor_tpu/shard/node.py, the r16 tentpole).
+
+A 3-node chain A -> B -> C over one shard s: A the writer (origin of
+out-of-shard mass), B the relay (and handoff successor — C's parent),
+C the shard's owner. Mass units carry identities so exactly-once and
+conservation are set algebra:
+
+- A produces units; each rides a wire.FWD message toward the owner.
+  The A->B hop is the per-link go-back-N discipline collapsed to
+  exactly-once delivery (in-order accept + cumulative ACK filters
+  per-link duplicates — spec_gbn already model-checks that layer);
+  what this spec keeps adversarial is the LAST hop's at-least-once
+  window: a unit in B's ledger may be re-delivered (re-route /
+  retransmission racing the ACK), and the TRUE owner discards the
+  duplicate via its end-to-end (origin, fwd_seq) dedup set;
+- handoff-on-drain: C snapshots its slice INTO the ho message (state
+  chunks + the dedup window ride along, per-link FIFO), B adopts at
+  ho_done and mints the next epoch, C releases. The handoff window is
+  where both bugs live:
+
+  * ``no_dedup_transfer`` seeds the double-apply: the successor adopts
+    WITHOUT the dedup window, so a re-routed duplicate of a unit the
+    old owner applied-but-never-acked re-applies at the successor
+    (exactly the mutation node.py's ho_dedup transfer exists to kill);
+  * ``apply_during_handoff`` seeds the conservation bug: the old owner
+    keeps applying frames AFTER its slice snapshot shipped — the
+    applied mass is not in the transferred bytes and dies with the
+    released slice, while the sender's ledger was already ACK-debited
+    (node.py's _ho_sent routing-onward discipline exists to kill it).
+
+Invariants: ``exactly-once`` (no unit applied twice at any owner
+authority), ``conservation`` (every produced unit is applied at the
+CURRENT owner or retained in a channel / ledger / parked buffer /
+in-flight handoff — never silently destroyed), ``exactly-one-owner``
+(the epoch mint: never two simultaneous authorities for s). Quiescence:
+every produced unit applied exactly once at the current owner, all
+channels and ledgers empty, no handoff in flight.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from .core import Spec
+
+P = 2  # units A produces (ids 1..P)
+
+
+class ShardState(NamedTuple):
+    prod: int  # units produced so far at A
+    chan_ab: frozenset  # A->B in flight (per-link layer: exactly-once)
+    led_bc: frozenset  # B->C ledgered, unacked (the at-least-once hop)
+    chan_bc: frozenset  # B->C in flight
+    applied_c: frozenset  # C's slice content (while C is the authority)
+    dedup_c: frozenset  # C's end-to-end seen set
+    parked_c: frozenset  # frames C holds mid-handoff (route pending)
+    chan_cb: frozenset  # C->B relays (post-snapshot forwarding)
+    applied_b: frozenset  # B's slice content (post-adopt)
+    dedup_b: frozenset  # B's end-to-end seen set (post-adopt)
+    owner: int  # 0 = C is the authority, 1 = B (post-adopt)
+    ho: int  # 0 none / 1 ho message in flight / 2 complete
+    ho_mass: frozenset  # slice snapshot riding the ho message
+    ho_dedup: frozenset  # dedup window riding the ho message
+    double: int  # ghost: double-applies observed
+    lost: frozenset  # ghost: units destroyed
+
+
+class ShardSpec(Spec):
+    name = "shard"
+    depth_bound = 26
+    mutations = {
+        "no_dedup_transfer": (
+            "r16: handoff ships the slice WITHOUT the end-to-end dedup "
+            "window — a re-routed duplicate of a unit the old owner "
+            "applied-but-never-acked double-applies at the successor"
+        ),
+        "apply_during_handoff": (
+            "r16: the old owner keeps applying FWDs after its slice "
+            "snapshot shipped — the mass is absent from the transferred "
+            "bytes and dies with the released slice while the sender's "
+            "ledger was ACK-debited (silent cluster-mass loss)"
+        ),
+    }
+
+    def initial(self):
+        e = frozenset()
+        return ShardState(0, e, e, e, e, e, e, e, e, e, 0, 0, e, e, 0, e)
+
+    def enabled(self, s: ShardState):
+        acts = []
+        if s.prod < P:
+            acts.append(("produce",))
+        for u in sorted(s.chan_ab):
+            acts.append(("deliver_ab", u))
+        for u in sorted(s.chan_bc):
+            acts.append(("deliver_bc", u))
+        for u in sorted(s.led_bc - s.chan_bc):
+            # retransmission / re-route: a ledgered unit already
+            # delivered once goes back in flight byte-identical — the
+            # at-least-once window the owner's dedup must close
+            acts.append(("redeliver_bc", u))
+        for u in sorted(s.led_bc):
+            if u in s.dedup_c or u in s.parked_c or u in s.dedup_b:
+                acts.append(("ack_bc", u))
+        for u in sorted(s.chan_cb):
+            acts.append(("deliver_cb", u))
+        if s.owner == 0 and s.ho == 0:
+            acts.append(("ho_start",))
+        if s.ho == 1:
+            acts.append(("ho_complete",))
+        return acts
+
+    def apply(self, s: ShardState, a):
+        kind = a[0]
+        if kind == "produce":
+            u = s.prod + 1
+            return s._replace(prod=u, chan_ab=s.chan_ab | {u})
+        if kind == "deliver_ab":
+            u = a[1]
+            # B relays toward the owner (or applies, once B IS the
+            # owner): the relay ledgers the unit for the lossy hop
+            s = s._replace(chan_ab=s.chan_ab - {u})
+            if s.owner == 1:
+                return self._apply_at_b(s, u)
+            return s._replace(
+                led_bc=s.led_bc | {u}, chan_bc=s.chan_bc | {u}
+            )
+        if kind in ("deliver_bc", "redeliver_bc"):
+            u = a[1]
+            s = s._replace(chan_bc=s.chan_bc - {u})
+            if s.owner == 1:
+                # C released: the frame relays back toward the new
+                # owner under its unchanged identity
+                return s._replace(chan_cb=s.chan_cb | {u})
+            if s.ho == 1 and self.mutation != "apply_during_handoff":
+                # TRUE spec: the snapshot already shipped — hold the
+                # frame for onward routing, never the dying slice
+                return s._replace(parked_c=s.parked_c | {u})
+            if u in s.dedup_c:
+                return s  # end-to-end duplicate: discarded
+            dbl = s.double + (1 if u in s.applied_c else 0)
+            return s._replace(
+                applied_c=s.applied_c | {u},
+                dedup_c=s.dedup_c | {u},
+                double=dbl,
+            )
+        if kind == "ack_bc":
+            u = a[1]
+            return s._replace(led_bc=s.led_bc - {u})
+        if kind == "deliver_cb":
+            u = a[1]
+            s = s._replace(chan_cb=s.chan_cb - {u})
+            return self._apply_at_b(s, u)
+        if kind == "ho_start":
+            dedup = (
+                frozenset()
+                if self.mutation == "no_dedup_transfer"
+                else s.dedup_c
+            )
+            return s._replace(ho=1, ho_mass=s.applied_c, ho_dedup=dedup)
+        if kind == "ho_complete":
+            # B adopts the shipped snapshot + dedup window and mints the
+            # next epoch; C releases. Anything C applied AFTER the
+            # snapshot left is not in ho_mass — it dies with the slice
+            # (reachable only under apply_during_handoff); parked frames
+            # route onward now that the successor announced
+            lost = s.applied_c - s.ho_mass
+            return s._replace(
+                ho=2,
+                owner=1,
+                applied_b=s.ho_mass,
+                dedup_b=s.ho_dedup,
+                applied_c=frozenset(),
+                dedup_c=frozenset(),
+                chan_cb=s.chan_cb | s.parked_c,
+                parked_c=frozenset(),
+                lost=s.lost | lost,
+            )
+        raise AssertionError(a)
+
+    def _apply_at_b(self, s: ShardState, u):
+        if u in s.dedup_b:
+            return s
+        dbl = s.double + (1 if u in s.applied_b else 0)
+        return s._replace(
+            applied_b=s.applied_b | {u},
+            dedup_b=s.dedup_b | {u},
+            double=dbl,
+        )
+
+    def invariants(self, s: ShardState):
+        bad = []
+        if s.double:
+            bad.append(
+                "exactly-once: a unit was applied twice at an owner "
+                "authority (end-to-end dedup window breached)"
+            )
+        if s.lost:
+            bad.append(
+                "conservation: debited mass destroyed across the "
+                f"handoff (units {sorted(s.lost)} applied at the old "
+                f"owner after its snapshot shipped)"
+            )
+        # every produced unit must be SOMEWHERE: applied at the current
+        # authority, or retained in a channel/ledger/parked buffer/the
+        # in-flight handoff snapshot
+        applied = s.applied_b if s.owner == 1 else s.applied_c
+        held = (
+            applied
+            | s.chan_ab
+            | s.led_bc
+            | s.chan_bc
+            | s.chan_cb
+            | s.parked_c
+            | (s.ho_mass if s.ho == 1 else frozenset())
+            | (s.applied_c if s.owner == 1 else frozenset())
+            | s.lost  # already reported above; keep the report single
+        )
+        missing = frozenset(range(1, s.prod + 1)) - held
+        if missing:
+            bad.append(
+                f"conservation: units {sorted(missing)} vanished with "
+                f"no channel, ledger, slice, or handoff holding them"
+            )
+        # exactly-one-owner: the authority moves ATOMICALLY at adopt
+        # (ho_complete) — a state where C still applies while B holds
+        # the minted slice would show up as double-apply or loss above;
+        # structurally the single `owner` field cannot split, so what
+        # is checked is that post-adopt C's slice is empty
+        if s.owner == 1 and s.applied_c:
+            bad.append(
+                "exactly-one-owner: the released owner still holds "
+                "slice content after the successor adopted"
+            )
+        return bad
+
+    def quiescent(self, s: ShardState):
+        applied = s.applied_b if s.owner == 1 else s.applied_c
+        return (
+            s.prod == P
+            and applied == frozenset(range(1, P + 1))
+            and not s.chan_ab
+            and not s.led_bc
+            and not s.chan_bc
+            and not s.chan_cb
+            and not s.parked_c
+            and s.ho != 1
+        )
+
+
+SPECS = [ShardSpec]
