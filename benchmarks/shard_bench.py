@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Shard-plane FWD throughput: python-tier vs engine-tier, per link.
+
+The r17 tentpole's acceptance number. A 2-node sharded pair on loopback:
+node 0 (master, owns shard 0) is the WRITER — every add() lands entirely
+in shard 1's range, so all mass drains as owner-routed FWD frames over
+the one link — and node 1 is the OWNER applying them. Per-link FWD
+throughput is reported the way every bench here reports the data plane:
+
+    GB/s equiv = applied FWD frames x slice f32 bytes / wall
+
+(each 1-bit frame conveys a full-slice update against per-leaf scales —
+the same fp32-equivalent convention as bench.py's headline.)
+
+Arms (fresh pair per repeat, ShardConfig.engine_lane pins the plane):
+  - python: the r16 correctness-first plane (the semantic reference);
+  - engine: the r17 native plane (outbox quantize into tx slots,
+    verbatim relay, owner-side dedup+apply in C).
+
+Gate (suite_load.sh "shard-perf"): engine lower-90 (mean - 1.645*SEM
+across repeats — the obs/serve-gate discipline; this box's loopback
+noise is 5-10%) must clear the ratcheted floor from the newest committed
+SHARD_BENCH_r*.json (floor_locked = max(prior floor, 0.9 x prior
+headline), monotone non-decreasing), AND the engine/python mean ratio
+must hold the r17 acceptance bar (>= 5x).
+
+Usage: python benchmarks/shard_bench.py [SHARD_BENCH_r17.json]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from shared_tensor_tpu.config import (  # noqa: E402
+    Config, ShardConfig, TransportConfig,
+)
+from shared_tensor_tpu.ops.table import make_spec  # noqa: E402
+from shared_tensor_tpu.shard import create_or_fetch_sharded  # noqa: E402
+from tests._ports import free_port  # noqa: E402
+
+N = int(os.environ.get("ST_SHARD_BENCH_N", 1 << 19))  # elements (f32)
+REPEATS = int(os.environ.get("ST_SHARD_BENCH_REPEATS", 3))
+WARM_S = float(os.environ.get("ST_SHARD_BENCH_WARM_S", 1.0))
+MEASURE_S = float(os.environ.get("ST_SHARD_BENCH_MEASURE_S", 4.0))
+RATIO_BAR = 5.0  # the r17 acceptance criterion
+
+TMPL = {"t": np.zeros(N, np.float32)}
+SPEC = make_spec(TMPL)
+
+
+def _cfg(idx: int, engine: bool) -> Config:
+    return Config(
+        shard=ShardConfig(n_shards=2, shard_index=idx, engine_lane=engine),
+        transport=TransportConfig(
+            peer_timeout_sec=20.0, ack_timeout_sec=0.4
+        ),
+    )
+
+
+def run_arm(engine: bool) -> float:
+    """One fresh writer->owner pair; returns GB/s equiv on the link."""
+    port = free_port()
+    h0 = create_or_fetch_sharded(
+        "127.0.0.1", port, TMPL, _cfg(0, engine), timeout=30.0
+    )
+    h1 = create_or_fetch_sharded(
+        "127.0.0.1", port, TMPL, _cfg(1, engine), timeout=30.0
+    )
+    try:
+        lane = h0.node._lane is not None
+        assert lane == engine, (
+            f"arm wanted engine={engine} but lane={lane} — is the native "
+            f"lib missing?"
+        )
+        m = h0.node.map
+        elo, ehi = m.element_range(1)  # shard 1's slice: all out-of-shard
+        slice_el = ehi - elo
+        rng = np.random.default_rng(42)
+        stop = threading.Event()
+
+        def writer():
+            # fresh mass into the remote shard's range every pass: the
+            # outbox never goes idle, the pump stays saturated (a single
+            # "t" leaf of a 32-multiple N has no padding, so the padded
+            # element range IS the template index range). Deltas are
+            # PRE-GENERATED — rng.uniform over the slice costs ~ms and
+            # would meter the producer, not the plane under test.
+            width = min(ehi, N) - elo
+            deltas = []
+            for _ in range(8):
+                full = np.zeros(N, np.float32)
+                full[elo:elo + width] = rng.uniform(
+                    -0.1, 0.1, width
+                ).astype(np.float32)
+                deltas.append(full)
+            i = 0
+            while not stop.is_set():
+                h0.add({"t": deltas[i % len(deltas)]})
+                i += 1
+                time.sleep(0.001)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        time.sleep(WARM_S)
+        f0 = h1.node.metrics().get("st_shard_fwd_frames_in_total", 0)
+        t0 = time.monotonic()
+        time.sleep(MEASURE_S)
+        f1 = h1.node.metrics().get("st_shard_fwd_frames_in_total", 0)
+        wall = time.monotonic() - t0
+        stop.set()
+        t.join(timeout=5.0)
+        frames = int(f1) - int(f0)
+        gbps = frames * slice_el * 4 / wall / 1e9
+        return gbps
+    finally:
+        h1.close()
+        h0.close()
+
+
+def lower90(xs: list[float]) -> float:
+    if len(xs) < 2:
+        return xs[0] if xs else 0.0
+    m = float(np.mean(xs))
+    sem = float(np.std(xs, ddof=1)) / (len(xs) ** 0.5)
+    return m - 1.645 * sem
+
+
+def prior_floor(out_path: str) -> tuple[float, str]:
+    """Newest committed SHARD_BENCH artifact by ROUND NUMBER (numeric —
+    lexicographic sort misorders r99/r100), never the run's own output
+    (the bench_gate discipline: ratcheting against a same-round artifact
+    would demand 0.9x of our own lower-90 again inside the box's 5-10%
+    noise)."""
+    import re
+
+    own = os.path.basename(out_path)
+    best: tuple[int, str] | None = None
+    for p in glob.glob(os.path.join(REPO, "SHARD_BENCH_r*.json")):
+        name = os.path.basename(p)
+        if name == own:
+            continue
+        m = re.match(r"SHARD_BENCH_r(\d+)\.json$", name)
+        if not m:
+            continue
+        r = int(m.group(1))
+        if best is None or r > best[0]:
+            best = (r, p)
+    if best is None:
+        return 0.0, ""
+    try:
+        with open(best[1]) as f:
+            doc = json.load(f)
+        return float(doc.get("floor_locked", 0.0)), os.path.basename(best[1])
+    except Exception:
+        return 0.0, ""
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "SHARD_BENCH_r17.json"
+    if not os.path.isabs(out_path):
+        out_path = os.path.join(REPO, out_path)
+    res: dict[str, list[float]] = {"python": [], "engine": []}
+    for r in range(REPEATS):
+        for arm in ("python", "engine"):
+            gbps = run_arm(arm == "engine")
+            res[arm].append(gbps)
+            print(
+                f"repeat {r + 1}/{REPEATS} {arm}: {gbps:.3f} GB/s equiv",
+                file=sys.stderr,
+            )
+    py_mean = float(np.mean(res["python"]))
+    en_mean = float(np.mean(res["engine"]))
+    en_l90 = lower90(res["engine"])
+    ratio = en_mean / py_mean if py_mean > 0 else float("inf")
+    floor, floor_src = prior_floor(out_path)
+    new_floor = max(floor, 0.9 * en_l90)  # monotone ratchet
+    ok = en_l90 >= floor and ratio >= RATIO_BAR
+    doc = {
+        "bench": "shard_bench",
+        "n": N,
+        "slice_elements": None,  # filled below for the record
+        "repeats": REPEATS,
+        "warm_s": WARM_S,
+        "measure_s": MEASURE_S,
+        "python_gbps": res["python"],
+        "engine_gbps": res["engine"],
+        "python_mean": py_mean,
+        "engine_mean": en_mean,
+        "engine_lower90": en_l90,
+        "ratio": ratio,
+        "ratio_bar": RATIO_BAR,
+        "prior_floor": floor,
+        "prior_floor_source": floor_src,
+        "floor_locked": new_floor,
+        "pass": bool(ok),
+        "note": (
+            "GB/s equiv = applied FWD frames x slice f32 bytes / wall; "
+            "box loopback noise is 5-10%, lower-90 discipline per the "
+            "obs/serve gates"
+        ),
+    }
+    from shared_tensor_tpu.shard.map import ShardMap
+
+    elo, ehi = ShardMap(SPEC.total // 32, 2).element_range(1)
+    doc["slice_elements"] = ehi - elo
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(
+        f"shard_bench: python {py_mean:.3f} / engine {en_mean:.3f} GB/s "
+        f"equiv (lower90 {en_l90:.3f}, floor {floor:.3f}) ratio "
+        f"{ratio:.1f}x (bar {RATIO_BAR}x) -> "
+        f"{'PASS' if ok else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,7 +28,7 @@ FAILED=0
 #  - the protospec model checker (tools/protospec/run_check.py): every
 #    protocol spec explored exhaustively + the three historical-bug
 #    mutations re-found, counts committed as the MODEL artifact
-#    (ST_SUITE_MODEL_OUT, default MODEL_r16.json; ST_SUITE_MODEL=0
+#    (ST_SUITE_MODEL_OUT, default MODEL_r17.json; ST_SUITE_MODEL=0
 #    skips).
 # Per-gate wall-clock is logged ("gate <name>: <sec>s rc=<rc>") — the
 # r13/r14 notes say gate time is starting to matter, so the transcript
@@ -69,7 +69,7 @@ if [ "${ST_SUITE_STATIC:-1}" = "1" ]; then
     fi
   fi
   if [ "${ST_SUITE_MODEL:-1}" = "1" ]; then
-    MODEL_OUT="${ST_SUITE_MODEL_OUT:-MODEL_r16.json}"
+    MODEL_OUT="${ST_SUITE_MODEL_OUT:-MODEL_r17.json}"
     gate_run model_check python tools/protospec/run_check.py --out "$MODEL_OUT"
     [ "$FAILED" -ne 0 ] && { echo "FAIL: model-checker gate red" >>"$OUT"; exit 1; }
   fi
@@ -213,10 +213,25 @@ fi
 # exactly-one-owner coverage audit is clean, and steady-state per-node
 # memory lands at ~1/N of a full replica. ST_SUITE_SHARD=0 skips.
 if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_SHARD:-1}" = "1" ]; then
-  SHARD_OUT="${ST_SUITE_SHARD_OUT:-CHAOS_r16.json}"
+  # r17: the arm runs on the ENGINE lane by default now (the shard FWD
+  # plane's production path); ST_SHARD_ENGINE=0 pins the python-tier arm
+  SHARD_OUT="${ST_SUITE_SHARD_OUT:-CHAOS_r17.json}"
   gate_run sharded_chaos sh -c \
     "JAX_PLATFORMS=cpu python benchmarks/cluster_chaos.py '$SHARD_OUT' \
      --sharded >/dev/null"
+fi
+
+# Shard-perf gate (r17): the engine-tier FWD plane must hold its
+# ratcheted per-link throughput floor (lower-90 across repeats, the
+# obs/serve-gate discipline per this box's 5-10% loopback noise) AND the
+# r17 acceptance ratio — engine-tier >= 5x the python-tier plane — on
+# the committed SHARD_BENCH artifact (benchmarks/shard_bench.py).
+# ST_SUITE_SHARDBENCH=0 skips.
+if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_SHARDBENCH:-1}" = "1" ]; then
+  SHARDBENCH_OUT="${ST_SUITE_SHARDBENCH_OUT:-SHARD_BENCH_r17.json}"
+  gate_run shard_perf sh -c \
+    "JAX_PLATFORMS=cpu python benchmarks/shard_bench.py '$SHARDBENCH_OUT' \
+     >/dev/null"
 fi
 
 # Sanitizer arm (r11): striping + adaptive precision put new hot code in
@@ -231,10 +246,11 @@ fi
 # (e.g. a box without the gcc sanitizer runtimes — the tests themselves
 # also skip cleanly there).
 if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_SAN:-1}" = "1" ]; then
-  echo "--- sanitizer arm (striped+adaptive + lifecycle) ---" >>"$OUT"
+  echo "--- sanitizer arm (striped+adaptive + lifecycle + shard engine) ---" >>"$OUT"
   JAX_PLATFORMS=cpu python -m pytest \
     tests/test_sanitizers.py::test_striped_adaptive_suite_under_asan_ubsan \
     tests/test_sanitizers.py::test_lifecycle_suite_under_asan_ubsan \
+    tests/test_sanitizers.py::test_shard_engine_suite_under_asan_ubsan \
     -m slow -q -p no:cacheprovider >>"$OUT" 2>&1 || FAILED=1
 fi
 exit "$FAILED"

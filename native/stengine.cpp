@@ -52,7 +52,9 @@
 #include <condition_variable>
 #include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <utility>
@@ -127,6 +129,12 @@ int32_t st_node_recv(void*, int32_t, uint8_t*, int32_t, double);
 // lane (TCP, striped, shm).
 int32_t st_node_recv_zc(void*, int32_t, const uint8_t**, double);
 void st_node_recv_done(void*, int32_t);
+// r17 shard plane: ownership-transfer receive (the transport half of the
+// zero-copy verbatim relay) + sendq headroom probe (the _queue_room
+// discipline). See sttransport.cpp for semantics.
+int32_t st_node_recv_take(void*, int32_t, const uint8_t**, void**);
+void st_node_take_free(void*, int32_t, void*);
+int32_t st_node_sendq_room(void*, int32_t);
 int32_t st_node_drop_link(void*, int32_t);
 uint64_t st_node_data_seq(void*);
 uint64_t st_node_wait_data(void*, uint64_t, double);
@@ -1980,6 +1988,1111 @@ void receiver_loop(Engine* e) {
   }
 }
 
+// ---- r17 engine-tier shard data plane -------------------------------------
+//
+// The r16 shard FWD plane (shared_tensor_tpu/shard/node.py) ran entirely in
+// Python — correctness-first, ~3 orders of interpreter cost per message
+// above the classic plane's native engine. This section ports the HOT LOOP
+// into the same machinery: outbox residuals quantize DIRECTLY into
+// refcounted TxSlots as burst-packed FWD frames (error feedback per target
+// shard, the successive-halving drain ladder per message), relays forward a
+// FWD whose owner is downstream VERBATIM — the received buffer's ownership
+// transfers via st_node_recv_take, only the per-link seq is re-stamped in
+// place, and the same bytes enqueue zero-copy through st_node_send_zc
+// (sendmmsg/shm-lane eligible) while serving as the go-back-N ledger entry
+// — and the owner's (origin, fwd_seq) dedup + slice apply commit under ONE
+// plane mutex, byte-compatible with the Python tier's dedup windows so
+// checkpoints and mixed trees interop.
+//
+// The CONTROL plane stays in Python (claim/grant/handoff/arbitration/
+// announces): every non-FWD/ACK message on a member link defers to the
+// ctrl queue (st_shard_poll_ctrl), exactly the engine/peer.py split.
+// Ownership/routing mutations arrive over the ABI (adopt/release/
+// set_route/set_handoff), all under the same mutex as the data path.
+//
+// Parity discipline: slice_quantize/slice_apply mirror state.SliceCodec
+// step for step (same f32 elementwise arithmetic, double accumulation for
+// the scale reductions — state.py accumulates in f64 too, so POW2_RMS
+// scales agree bit-for-bit in practice and scales always ride the wire).
+// tests/test_shard_engine.py pins byte-equal frames/residuals/applies on
+// shared random state via the exported st_slice_quantize/st_slice_apply.
+
+constexpr uint8_t kFwd = 17;      // comm/wire.py FWD
+constexpr size_t kFwdHdr = 21;    // [kind][seq u32][wlo u32][wcnt u32]
+                                  // [origin u32][fwd_seq u32]
+constexpr size_t kShardDedupWindow = 1024;  // shard/node.py DEDUP_WINDOW
+constexpr int kOutboxMsgsPerPass = 4;  // shard/node.py OUTBOX_MSGS_PER_PASS
+constexpr int32_t kCtrlHeadroom = 3;   // shard/node.py _queue_room keep
+constexpr uint32_t kEvShardParkDrop = 36;  // obs/events.py CODE_NAMES
+constexpr uint32_t kEvShardDedup = 37;
+
+struct ShardSeg {
+  int64_t g;       // global leaf index
+  int64_t i0, i1;  // slice-element bounds of the segment
+  int64_t n_live;  // non-padding elements in it
+};
+
+// Per-shard slice geometry, precomputed once at create (the shard ranges
+// are fixed at creation — the r16 contract the python ShardMap carries).
+struct ShardGeom {
+  int64_t wlo = 0, wcnt = 0, elo = 0, n_el = 0;
+  std::vector<ShardSeg> segs;
+  std::vector<int32_t> leaf_of;  // slice element -> global leaf
+  std::vector<float> live;       // 1.0 live / 0.0 padding
+  int32_t kcap = 1;              // FWD frames per message (recv bound)
+  // SYNTHETIC LAYOUT (r17): each segment presented as a leaf of a
+  // slice-local table — live elements are a contiguous prefix of every
+  // segment and segment bounds are 32-multiples, so the slice is a
+  // legal stcodec layout and the hot loops ride the SAME AVX-512
+  // cascade/apply kernels as the classic plane (stc_quantize_ef_cascade
+  // / stc_apply_frames) instead of scalar twins.
+  std::vector<int64_t> syn_off, syn_ns, syn_padded;
+  std::vector<int32_t> syn_g;  // synthetic leaf -> global leaf
+};
+
+// One received FWD buffer whose ownership transferred from the transport
+// (st_node_recv_take): refcounted like a TxSlot — the go-back-N ledger
+// holds one reference, each in-flight (re)send another. The LAST unref
+// returns the buffer to the transport's rx pool. `plane_live` lets
+// st_shard_destroy wait for stragglers exactly like the TxPool drain.
+struct ShardPlane;
+struct TakenBuf {
+  ShardPlane* plane = nullptr;
+  void* tok = nullptr;
+  uint8_t* data = nullptr;
+  uint32_t len = 0;
+  int32_t from_link = 0;
+  std::atomic<int32_t> refs{0};
+};
+
+struct ShardSent {
+  uint64_t seq = 0;
+  TxSlot* slot = nullptr;    // originated / re-packed copy
+  TakenBuf* taken = nullptr; // zero-copy relay
+};
+
+struct SMember {
+  std::deque<ShardSent> unacked;
+  uint64_t tx_seq = 0, rx_count = 0, ack_sent = 0;
+  bool ack_due = false;
+  EClock::time_point ack_progress{};
+  int32_t retx_rounds = 0;
+  bool window_blocked = false;
+  bool dead = false;
+  // per-link send-order mutex: the outbox pump (sender thread) and the
+  // verbatim relay (receiver thread) both produce ledgered FWDs on the
+  // same link — holding this across [seq alloc + ledger push + transport
+  // enqueue] keeps wire order = seq order, which the python tier gets
+  // for free from its single loop thread. Lock order: order_mu -> mu.
+  std::shared_ptr<StMutex> order_mu = std::make_shared<StMutex>();
+};
+
+struct ParkedFwd {
+  int32_t shard = 0;
+  std::vector<uint8_t> bytes;
+};
+
+struct ShardPlane {
+  void* node = nullptr;
+  uint32_t obs_id = 0, origin = 0;
+  int64_t L = 0, total = 0, total_n = 0, W = 0;
+  std::vector<int64_t> off, ns, padded;
+  int policy = kPow2Rms;
+  int32_t recv_cap = 0;
+  double ack_timeout = 0.0;
+  int32_t ack_retry_limit = 8;
+  int32_t park_cap = 4096;
+  std::vector<ShardGeom> geom;  // n_shards entries, fixed at create
+
+  TxPool txpool;
+
+  StMutex mu;
+  std::map<int32_t, std::vector<float>> owned ST_GUARDED_BY(mu);
+  std::map<int32_t, std::vector<float>> outbox ST_GUARDED_BY(mu);
+  std::set<int32_t> ho_sent ST_GUARDED_BY(mu);
+  std::map<int32_t, SMember> members ST_GUARDED_BY(mu);
+  std::map<int32_t, int32_t> route ST_GUARDED_BY(mu);
+  int32_t uplink ST_GUARDED_BY(mu) = -1;
+  uint32_t fwd_seq ST_GUARDED_BY(mu) = 0;
+  // origin -> (seen set, insertion fifo): the end-to-end dedup window,
+  // byte-compatible with shard/node.py's (DEDUP_WINDOW trim included)
+  std::map<uint32_t, std::pair<std::set<uint32_t>, std::deque<uint32_t>>>
+      dedup ST_GUARDED_BY(mu);
+  std::deque<ParkedFwd> parked ST_GUARDED_BY(mu);
+
+  // control messages (non FWD/ACK on member links) surfaced to Python
+  StMutex cmu;
+  std::deque<std::pair<int32_t, std::vector<uint8_t>>> ctrl
+      ST_GUARDED_BY(cmu);
+
+  // sender wake (missed-wakeup-safe sequence counter)
+  StMutex wmu;
+  std::condition_variable wcv;
+  uint64_t wseq ST_GUARDED_BY(wmu) = 0;
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> fwd_msgs_out{0}, fwd_msgs_in{0}, relayed{0};
+  std::atomic<uint64_t> dedup_discards{0}, park_drops{0}, retx_msgs{0};
+  std::atomic<uint64_t> updates{0}, fwd_frames_out{0}, fwd_frames_in{0};
+  std::atomic<uint64_t> fwd_undecodable{0};
+  std::atomic<int64_t> taken_live{0};
+  std::thread send_thread, recv_thread;
+  bool started = false;
+
+  void wake() ST_EXCLUDES(wmu) {
+    {
+      StLockGuard lk(wmu);
+      wseq++;
+    }
+    wcv.notify_all();
+  }
+};
+
+void shard_geom_init(ShardPlane* p, const int64_t* wlo, const int64_t* wcnt,
+                     int32_t n_shards) {
+  // leaf boundaries: element index where each leaf's padded span ends
+  std::vector<int64_t> bounds((size_t)p->L);
+  int64_t acc = 0;
+  for (int64_t i = 0; i < p->L; i++) {
+    acc += p->padded[(size_t)i];
+    bounds[(size_t)i] = acc;
+  }
+  p->geom.resize((size_t)n_shards);
+  for (int32_t s = 0; s < n_shards; s++) {
+    ShardGeom& g = p->geom[(size_t)s];
+    g.wlo = wlo[s];
+    g.wcnt = wcnt[s];
+    g.elo = g.wlo * 32;
+    g.n_el = g.wcnt * 32;
+    g.leaf_of.resize((size_t)g.n_el);
+    g.live.resize((size_t)g.n_el);
+    int64_t leaf = 0;
+    while (leaf < p->L && bounds[(size_t)leaf] <= g.elo) leaf++;
+    for (int64_t j = 0; j < g.n_el; j++) {
+      int64_t el = g.elo + j;
+      while (leaf < p->L && bounds[(size_t)leaf] <= el) leaf++;
+      int64_t lf = leaf < p->L ? leaf : p->L - 1;
+      g.leaf_of[(size_t)j] = (int32_t)lf;
+      g.live[(size_t)j] =
+          (el - p->off[(size_t)lf]) < p->ns[(size_t)lf] ? 1.0f : 0.0f;
+    }
+    // contiguous runs of one leaf -> segments with live counts
+    int64_t i0 = 0;
+    while (i0 < g.n_el) {
+      int64_t i1 = i0;
+      int32_t lf = g.leaf_of[(size_t)i0];
+      int64_t nl = 0;
+      while (i1 < g.n_el && g.leaf_of[(size_t)i1] == lf) {
+        if (g.live[(size_t)i1] != 0.0f) nl++;
+        i1++;
+      }
+      g.segs.push_back(ShardSeg{lf, i0, i1, nl});
+      g.syn_off.push_back(i0);
+      g.syn_ns.push_back(nl);
+      g.syn_padded.push_back(i1 - i0);
+      g.syn_g.push_back(lf);
+      i0 = i1;
+    }
+    size_t per = (size_t)p->L * 4 + (size_t)g.wcnt * 4;
+    int64_t cap = ((int64_t)p->recv_cap - (int64_t)kFwdHdr) / (int64_t)per;
+    if (cap < 1) cap = 1;
+    if (cap > 255) cap = 255;
+    g.kcap = (int32_t)cap;
+  }
+}
+
+// The slice-codec hot loops below carry the plane's whole per-byte cost
+// (quantize on the writer, apply at the owner): O3 + vectorization for
+// just these bodies — exact float semantics, NO fast-math (the parity
+// contract). Guarded off clang: the analyze gate runs -Werror and clang
+// warns on gcc optimize pragmas it cannot honor.
+#ifndef __clang__
+#pragma GCC push_options
+#pragma GCC optimize("O3,tree-vectorize")
+#endif
+
+// Per-segment scale measurement (state.SliceCodec.measure): scales per
+// GLOBAL leaf (zero outside the range) + per-leaf amax. Reductions
+// accumulate EXACT f64 products (f32->f64 squares are exact, so only the
+// accumulation order is inexact) with 8 interleaved accumulators — a
+// FIXED deterministic order; state.py's f64 numpy sum (pairwise) agrees
+// with it to the last bit after the f32 cast in practice, which the
+// parity test pins on shared random state.
+//
+// Layout note the speed leans on: within one leaf, LIVE elements are a
+// contiguous prefix (padding sits at the leaf tail), so every segment
+// splits into [live prefix | padding tail] and the per-element
+// scale/live lookups collapse to constants per span.
+void slice_measure(const ShardPlane* p, const ShardGeom& g,
+                   const float* resid, float* scales, float* amaxes) {
+  std::memset(scales, 0, (size_t)p->L * 4);
+  std::memset(amaxes, 0, (size_t)p->L * 4);
+  for (const ShardSeg& sg : g.segs) {
+    if (sg.n_live <= 0) continue;
+    int64_t live_end = sg.i0 + sg.n_live;
+    // amax over the segment's elements (padding is 0 and cannot win; a
+    // NaN element falls out of the comparisons here, and then poisons
+    // the sum below into scales[g] = 0 — the same skipped segment
+    // numpy's NaN-propagating max produces)
+    float m0 = 0, m1 = 0, m2 = 0, m3 = 0;
+    int64_t j = sg.i0;
+    for (; j + 4 <= sg.i1; j += 4) {
+      float b0 = std::fabs(resid[j]), b1 = std::fabs(resid[j + 1]);
+      float b2 = std::fabs(resid[j + 2]), b3 = std::fabs(resid[j + 3]);
+      if (b0 > m0) m0 = b0;
+      if (b1 > m1) m1 = b1;
+      if (b2 > m2) m2 = b2;
+      if (b3 > m3) m3 = b3;
+    }
+    for (; j < sg.i1; j++) {
+      float a = std::fabs(resid[j]);
+      if (a > m0) m0 = a;
+    }
+    float am = m0;
+    if (m1 > am) am = m1;
+    if (m2 > am) am = m2;
+    if (m3 > am) am = m3;
+    if (!(am > 0.0f) || !std::isfinite(am)) continue;
+    amaxes[sg.g] = am;
+    double a0 = 0, a1 = 0, a2 = 0, a3 = 0, a4 = 0, a5 = 0, a6 = 0, a7 = 0;
+    float s;
+    if (p->policy == kAbsMean) {
+      j = sg.i0;
+      for (; j + 8 <= live_end; j += 8) {
+        a0 += std::fabs((double)resid[j]);
+        a1 += std::fabs((double)resid[j + 1]);
+        a2 += std::fabs((double)resid[j + 2]);
+        a3 += std::fabs((double)resid[j + 3]);
+        a4 += std::fabs((double)resid[j + 4]);
+        a5 += std::fabs((double)resid[j + 5]);
+        a6 += std::fabs((double)resid[j + 6]);
+        a7 += std::fabs((double)resid[j + 7]);
+      }
+      for (; j < live_end; j++) a0 += std::fabs((double)resid[j]);
+      double acc = ((a0 + a1) + (a2 + a3)) + ((a4 + a5) + (a6 + a7));
+      s = (float)(acc / (double)(float)sg.n_live);
+    } else {
+      j = sg.i0;
+      for (; j + 8 <= live_end; j += 8) {
+        double d0 = resid[j], d1 = resid[j + 1];
+        double d2 = resid[j + 2], d3 = resid[j + 3];
+        double d4 = resid[j + 4], d5 = resid[j + 5];
+        double d6 = resid[j + 6], d7 = resid[j + 7];
+        a0 += d0 * d0;
+        a1 += d1 * d1;
+        a2 += d2 * d2;
+        a3 += d3 * d3;
+        a4 += d4 * d4;
+        a5 += d5 * d5;
+        a6 += d6 * d6;
+        a7 += d7 * d7;
+      }
+      for (; j < live_end; j++) {
+        double d = resid[j];
+        a0 += d * d;
+      }
+      double acc = ((a0 + a1) + (a2 + a3)) + ((a4 + a5) + (a6 + a7));
+      s = (float)std::sqrt(acc / (double)(float)sg.n_live);
+      if (p->policy == kPow2Rms) {
+        union {
+          float f;
+          uint32_t u;
+        } b;
+        b.f = s;
+        b.u &= 0x7F800000u;  // 2^floor(log2 s); subnormals -> 0
+        s = b.f;
+      }
+    }
+    scales[sg.g] = std::isfinite(s) ? s : 0.0f;
+  }
+}
+
+// Pack + error-feedback one frame at a GIVEN scale row (the cascade
+// rung) — state.SliceCodec.quantize_at. EF per segment with a constant
+// scale over the live prefix (on the pre-subtraction sign), padding
+// tail pinned to exactly 0 (the `new_r *= live` twin). The cold-path
+// scalar twin of the stc cascade kernels the pump rides.
+void slice_quantize_at(const ShardPlane* p, const ShardGeom& g,
+                       float* resid, const float* row, uint32_t* words) {
+  (void)p;
+  // sign plane: bit j = (resid[j] <= 0) on LIVE lanes, 0 on padding —
+  // the stcodec cascade-kernel convention (receivers mask by live)
+  for (int64_t w = 0; w < g.wcnt; w++) {
+    uint32_t bits = 0;
+    const float* r = resid + w * 32;
+    const float* lv = g.live.data() + w * 32;
+    for (int b = 0; b < 32; b++)
+      bits |= (uint32_t)(r[b] <= 0.0f && lv[b] != 0.0f) << b;
+    words[w] = bits;
+  }
+  for (const ShardSeg& sg : g.segs) {
+    float se = sg.n_live > 0 ? row[sg.g] : 0.0f;
+    int64_t live_end = sg.i0 + sg.n_live;
+    if (se > 0.0f) {
+      for (int64_t k = sg.i0; k < live_end; k++) {
+        float r0 = resid[k];
+        resid[k] = r0 <= 0.0f ? r0 + se : r0 - se;
+      }
+    }
+    for (int64_t k = live_end; k < sg.i1; k++) resid[k] = 0.0f;
+  }
+}
+
+inline uint32_t f32_exp(float x) {
+  uint32_t u;
+  std::memcpy(&u, &x, 4);
+  return (u >> 23) & 0xFFu;
+}
+
+bool slice_row_any(const ShardPlane* p, const float* row) {
+  for (int64_t i = 0; i < p->L; i++)
+    if (row[i] != 0.0f) return true;
+  return false;
+}
+
+// Message-build scratch (one per sender thread / test call).
+struct ShardScratch {
+  std::vector<float> mscales, row, sched;
+  std::vector<double> dpart;
+};
+
+// Build one FWD message's frames into `body` at wire strides (frame f's
+// GLOBAL scale row at f*per, its word plane at f*per + 4L): ONE
+// measurement (stc_scale_partials over the synthetic slice layout), the
+// cascade-halving schedule (amax-anchored frame 0, +1 binade per rung to
+// the measured scale, +8 refinement rungs — state.SliceCodec.cascade_rows
+// bit-for-bit: the exponent math is integer), then every word plane in
+// ONE memory pass via the classic plane's AVX-512 cascade kernel
+// (stc_quantize_ef_cascade). Returns the frame count (0 = idle; the
+// residual is then untouched). Error feedback lands in `resid` in place.
+int slice_cascade_message(const ShardPlane* p, const ShardGeom& g,
+                          float* resid, int kmax, uint8_t* body, size_t per,
+                          ShardScratch& scr) {
+  size_t nsyn = g.syn_g.size();
+  if (scr.mscales.size() < nsyn) {
+    scr.mscales.resize(nsyn);
+    scr.row.resize(nsyn);
+  }
+  if (scr.dpart.size() < nsyn * 3) scr.dpart.resize(nsyn * 3);
+  double* pa = scr.dpart.data();
+  double* ps = pa + nsyn;
+  double* pb = ps + nsyn;
+  stc_scale_partials(resid, g.syn_off.data(), g.syn_ns.data(),
+                     (int64_t)nsyn, pa, ps, pb);
+  int d = 0;
+  bool anyscale = false;
+  for (size_t i = 0; i < nsyn; i++) {
+    double n_live = (double)(float)g.syn_ns[i];
+    float s = 0.0f;
+    if (pa[i] > 0 && std::isfinite(pa[i]) && n_live > 0) {
+      if (p->policy == kAbsMean) {
+        s = (float)(pb[i] / n_live);
+      } else {
+        s = (float)std::sqrt(ps[i] / n_live);
+        if (p->policy == kPow2Rms) {
+          union {
+            float f;
+            uint32_t u;
+          } b;
+          b.f = s;
+          b.u &= 0x7F800000u;
+          s = b.f;
+        }
+      }
+      if (!std::isfinite(s)) s = 0.0f;
+    }
+    scr.mscales[i] = s;
+    if (s > 0.0f) {
+      anyscale = true;
+      union {
+        float f;
+        uint32_t u;
+      } b;
+      b.f = (float)pa[i];
+      b.u &= 0x7F800000u;
+      float top = b.f;
+      int di = (int)f32_exp(top) - (int)f32_exp(s);
+      if (di > d) d = di;
+      scr.row[i] = top > s ? top : s;
+    } else {
+      scr.row[i] = 0.0f;
+    }
+  }
+  if (!anyscale) return 0;
+  int kc = d + 1 + (d > 0 ? 8 : 0);
+  if (kc > kmax) kc = kmax;
+  if (kc > 64) kc = 64;  // the cascade kernel's schedule cap
+  if (kc < 1) kc = 1;
+  if (scr.sched.size() < (size_t)kc * nsyn)
+    scr.sched.resize((size_t)kc * nsyn);
+  int nf = 0;
+  for (int f = 0; f < kc; f++) {
+    bool anyrow = false;
+    for (size_t i = 0; i < nsyn; i++) {
+      float v =
+          f == 0 ? scr.row[i] : scr.sched[(size_t)(f - 1) * nsyn + i] * 0.5f;
+      scr.sched[(size_t)f * nsyn + i] = v;
+      if (v != 0.0f) anyrow = true;
+    }
+    if (f > 0 && !anyrow) break;  // halved into the subnormal floor
+    nf++;
+  }
+  uint32_t* wbase = (uint32_t*)(body + (size_t)p->L * 4);
+  stc_quantize_ef_cascade(resid, resid, g.syn_off.data(), g.syn_ns.data(),
+                          g.syn_padded.data(), (int64_t)nsyn, nf,
+                          scr.sched.data(), wbase, (int64_t)(per / 4), pa,
+                          ps, pb);
+  // scatter each rung's synthetic scales into the wire's GLOBAL per-leaf
+  // rows (zero outside the slice's leaves)
+  for (int f = 0; f < nf; f++) {
+    float* sc = (float*)(body + (size_t)f * per);
+    std::memset(sc, 0, (size_t)p->L * 4);
+    for (size_t i = 0; i < nsyn; i++)
+      sc[g.syn_g[i]] = scr.sched[(size_t)f * nsyn + i];
+  }
+  return nf;
+}
+
+// One measured single-frame step (state.SliceCodec.quantize — the
+// serve-tier shape and the st_slice_quantize parity surface).
+bool slice_quantize(const ShardPlane* p, const ShardGeom& g, float* resid,
+                    float* scales, uint32_t* words) {
+  std::vector<float> amaxes((size_t)p->L);
+  slice_measure(p, g, resid, scales, amaxes.data());
+  if (!slice_row_any(p, scales)) return false;
+  slice_quantize_at(p, g, resid, scales, words);
+  return true;
+}
+
+// Receiver step (state.SliceCodec.apply): target += scale[leaf]*(1-2*bit)
+// on live lanes, saturated at +/-kSat. False for an all-zero-scale no-op.
+// Same segment structure as the quantize: constant scale per live
+// prefix; the padding tail only pays the clip (a no-op for the 0-valued
+// padding an owned slice maintains — byte-identical to numpy's
+// whole-slice np.clip).
+bool slice_apply(const ShardPlane* p, const ShardGeom& g, float* target,
+                 const float* scales, const uint32_t* words) {
+  bool any = false;
+  for (int64_t i = 0; i < p->L; i++)
+    if (scales[i] != 0.0f) any = true;
+  if (!any) return false;
+  for (const ShardSeg& sg : g.segs) {
+    float se = sg.n_live > 0 ? scales[sg.g] : 0.0f;
+    int64_t live_end = sg.i0 + sg.n_live;
+    for (int64_t j = sg.i0; j < live_end; j++) {
+      float bf = (float)((words[j >> 5] >> (j & 31)) & 1u);
+      float t = target[j] + se * (1.0f - 2.0f * bf);
+      if (t > kSat) t = kSat;
+      if (t < -kSat) t = -kSat;
+      target[j] = t;
+    }
+    for (int64_t j = live_end; j < sg.i1; j++) {
+      float t = target[j];
+      if (t > kSat) t = kSat;
+      if (t < -kSat) t = -kSat;
+      target[j] = t;
+    }
+  }
+  return true;
+}
+
+#ifndef __clang__
+#pragma GCC pop_options
+#endif
+
+int32_t shard_of_word(const ShardPlane* p, uint32_t word_lo) {
+  for (size_t s = 0; s < p->geom.size(); s++)
+    if ((int64_t)word_lo >= p->geom[s].wlo &&
+        (int64_t)word_lo < p->geom[s].wlo + p->geom[s].wcnt)
+      return (int32_t)s;
+  return -1;
+}
+
+void taken_unref(TakenBuf* t) {
+  if (t->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    ShardPlane* p = t->plane;
+    st_node_take_free(p->node, t->from_link, t->tok);
+    delete t;
+    p->taken_live.fetch_sub(1, std::memory_order_acq_rel);
+  }
+}
+
+void taken_release(void* ctx) { taken_unref((TakenBuf*)ctx); }
+
+void shard_entry_unref(ShardPlane* p, ShardSent& e) {
+  if (e.slot) p->txpool.unref(e.slot);
+  if (e.taken) taken_unref(e.taken);
+  e.slot = nullptr;
+  e.taken = nullptr;
+}
+
+// shard -> next-hop link (shard/node.py _next_hop): the learned route,
+// else the uplink; never the arrival link, never a dead member. Caller
+// holds p->mu.
+int32_t shard_next_hop(ShardPlane* p, int32_t shard, int32_t arrival)
+    ST_REQUIRES(p->mu) {
+  auto rit = p->route.find(shard);
+  if (rit != p->route.end() && rit->second != arrival) {
+    auto mit = p->members.find(rit->second);
+    if (mit != p->members.end() && !mit->second.dead) return rit->second;
+  }
+  if (p->uplink >= 0 && p->uplink != arrival) {
+    auto mit = p->members.find(p->uplink);
+    if (mit != p->members.end() && !mit->second.dead) return p->uplink;
+  }
+  return -1;
+}
+
+void shard_park(ShardPlane* p, int32_t shard, const uint8_t* data,
+                uint32_t len) ST_REQUIRES(p->mu) {
+  p->parked.push_back(ParkedFwd{shard, std::vector<uint8_t>(data, data + len)});
+  while ((int32_t)p->parked.size() > p->park_cap) {
+    p->parked.pop_front();
+    // loud bounded loss, never unbounded memory (ShardConfig.park_cap)
+    p->park_drops++;
+    st_obs_emit(p->obs_id, kEvShardParkDrop, 0, 0);
+  }
+}
+
+// Ledger + send one FWD on a member link, preserving per-link wire order
+// across the two producing threads (see SMember::order_mu). The entry's
+// bytes are re-stamped in place with the link's next seq. Consumes ONE
+// owned reference of slot/taken on success (the ledger keeps it); takes
+// its own in-flight reference for the transport enqueue. False = member
+// gone/dead or go-back-N window full — ownership NOT consumed.
+bool shard_ledger_send(ShardPlane* p, int32_t link, TxSlot* slot,
+                       TakenBuf* taken, uint8_t* data, uint32_t len)
+    ST_EXCLUDES(p->mu) {
+  std::shared_ptr<StMutex> omu;
+  {
+    StLockGuard lk(p->mu);
+    auto it = p->members.find(link);
+    if (it == p->members.end() || it->second.dead) return false;
+    omu = it->second.order_mu;
+  }
+  StLockGuard ol(*omu);
+  {
+    StLockGuard lk(p->mu);
+    auto it = p->members.find(link);
+    if (it == p->members.end() || it->second.dead) return false;
+    SMember& m = it->second;
+    if (m.unacked.size() >= kSendWindow) {
+      if (!m.window_blocked) {
+        m.window_blocked = true;
+        st_obs_emit(p->obs_id, kEvWindowStall, link,
+                    (uint64_t)m.unacked.size());
+      }
+      return false;
+    }
+    m.window_blocked = false;
+    uint64_t seq = ++m.tx_seq;
+    uint32_t s32 = (uint32_t)seq;
+    std::memcpy(data + 1, &s32, 4);  // re-stamp ONLY the per-link seq
+    if (m.unacked.empty()) m.ack_progress = EClock::now();
+    ShardSent ent;
+    ent.seq = seq;
+    ent.slot = slot;
+    ent.taken = taken;
+    m.unacked.push_back(ent);
+    // in-flight reference for the send below, taken under p->mu (the
+    // TxPool r07 rationale: a racing ACK/detach may drop the ledger
+    // reference the moment the lock releases)
+    if (slot) slot->refs.fetch_add(1, std::memory_order_relaxed);
+    if (taken) taken->refs.fetch_add(1, std::memory_order_relaxed);
+  }
+  int32_t r = st_node_send_zc(p->node, link, data, (int32_t)len, 0.05,
+                              slot ? tx_slot_release : taken_release,
+                              slot ? (void*)slot : (void*)taken);
+  if (r != 1) {
+    // bounced/dead: the transport took no ownership — drop the in-flight
+    // reference; the entry stays ledgered and go-back-N re-sends it
+    if (slot) p->txpool.unref(slot);
+    if (taken) taken_unref(taken);
+  }
+  return true;
+}
+
+// Owner-side apply with end-to-end dedup: the (origin, fwd_seq) window
+// check/insert and the slice apply commit together under p->mu — the
+// same one-mutex discipline node.py's _apply_fwd/_dedup_mu carries, so a
+// checkpoint capture under the same mutex can never persist a window seq
+// whose mass missed the slice. Caller holds p->mu and has verified
+// ownership. Returns true (the message is consumed either way).
+bool shard_apply_fwd(ShardPlane* p, int32_t shard, uint8_t* data,
+                     uint32_t len, std::vector<float>& sscratch,
+                     std::vector<uint32_t>& wscratch) ST_REQUIRES(p->mu) {
+  const ShardGeom& g = p->geom[(size_t)shard];
+  uint32_t wlo, wcnt, origin, fseq;
+  std::memcpy(&wlo, data + 5, 4);
+  std::memcpy(&wcnt, data + 9, 4);
+  std::memcpy(&origin, data + 13, 4);
+  std::memcpy(&fseq, data + 17, 4);
+  size_t per = (size_t)p->L * 4 + (size_t)g.wcnt * 4;
+  int64_t body = (int64_t)len - (int64_t)kFwdHdr;
+  int64_t nf = per > 0 ? body / (int64_t)per : 0;
+  if ((int64_t)wlo != g.wlo || (int64_t)wcnt != g.wcnt || body <= 0 ||
+      body % (int64_t)per != 0 || nf < 1 || nf > 255) {
+    // relays forward verbatim without decoding, so a frame a fault
+    // corrupted upstream is first DECODED here at the owner — drop it
+    // loudly instead of poisoning the slice (node.py's decode guard)
+    p->fwd_undecodable++;
+    return true;
+  }
+  auto& win = p->dedup[origin];
+  if (win.first.count(fseq)) {
+    p->dedup_discards++;
+    st_obs_emit(p->obs_id, kEvShardDedup, 0, (uint64_t)fseq);
+    return true;
+  }
+  win.first.insert(fseq);
+  win.second.push_back(fseq);
+  while (win.second.size() > kShardDedupWindow) {
+    win.first.erase(win.second.front());
+    win.second.pop_front();
+  }
+  auto oit = p->owned.find(shard);
+  float* vals = oit->second.data();
+  // the 21-byte header leaves the frame body 1 (mod 4): gather every
+  // frame's scales (global leaf rows -> synthetic slice rows) and words
+  // into aligned scratch — the relay path, which never decodes, is what
+  // stays zero-copy — sanitizing non-finite scales at the trust
+  // boundary (wire.decode_fwd's twin), then apply the WHOLE burst in
+  // one fused pass over the synthetic layout (stc_apply_frames, the
+  // classic receive kernel).
+  size_t nsyn = g.syn_g.size();
+  if (sscratch.size() < (size_t)nf * nsyn)
+    sscratch.resize((size_t)nf * nsyn);
+  if (wscratch.size() < (size_t)(nf * g.wcnt))
+    wscratch.resize((size_t)(nf * g.wcnt));
+  uint64_t frames = 0;
+  float sv;
+  for (int64_t f = 0; f < nf; f++) {
+    const uint8_t* fp = data + kFwdHdr + (size_t)f * per;
+    bool anyf = false;
+    for (size_t i = 0; i < nsyn; i++) {
+      std::memcpy(&sv, fp + (size_t)g.syn_g[i] * 4, 4);
+      if (!std::isfinite(sv)) sv = 0.0f;
+      sscratch[(size_t)f * nsyn + i] = sv;
+      if (sv != 0.0f) anyf = true;
+    }
+    if (anyf) frames++;
+    std::memcpy(wscratch.data() + (size_t)f * g.wcnt,
+                fp + (size_t)p->L * 4, (size_t)g.wcnt * 4);
+  }
+  if (frames > 0) {
+    stc_apply_frames(vals, vals, g.syn_off.data(), g.syn_ns.data(),
+                     g.syn_padded.data(), (int64_t)nsyn, g.wcnt,
+                     (int32_t)nf, sscratch.data(), wscratch.data(), nullptr,
+                     nullptr, nullptr);
+    p->fwd_msgs_in++;
+    p->fwd_frames_in += frames;
+  }
+  return true;
+}
+
+// Apply locally (owner), relay toward the owner, or return false (the
+// caller parks). `slot`/`taken`/`data` carry the message exactly like
+// shard_ledger_send; on a true return the passed reference is consumed.
+// arrival = -1 for re-dispatch (link death / unpark) — which, per the
+// r16 discipline, re-routes under the UNCHANGED end-to-end identity so a
+// delivered-but-unacked copy dies in the owner's dedup window.
+bool shard_dispatch(ShardPlane* p, int32_t shard, TxSlot* slot,
+                    TakenBuf* taken, uint8_t* data, uint32_t len,
+                    int32_t arrival, std::vector<float>& sscratch,
+                    std::vector<uint32_t>& wscratch) ST_EXCLUDES(p->mu) {
+  int32_t hop = -1;
+  {
+    StLockGuard lk(p->mu);
+    if (p->owned.count(shard) && !p->ho_sent.count(shard)) {
+      shard_apply_fwd(p, shard, data, len, sscratch, wscratch);
+      if (slot) p->txpool.unref(slot);
+      if (taken) taken_unref(taken);
+      return true;
+    }
+    hop = shard_next_hop(p, shard, arrival);
+  }
+  if (hop < 0) return false;
+  if (!shard_ledger_send(p, hop, slot, taken, data, len)) return false;
+  if (arrival >= 0) p->relayed++;
+  return true;
+}
+
+// Re-dispatch a parked/rolled-back FWD held as plain bytes: re-pack into
+// a fresh tx slot (the original buffer is gone) and dispatch. False =
+// still routeless (caller re-parks the bytes).
+bool shard_dispatch_bytes(ShardPlane* p, int32_t shard,
+                          const std::vector<uint8_t>& bytes,
+                          std::vector<float>& sscratch,
+                          std::vector<uint32_t>& wscratch)
+    ST_EXCLUDES(p->mu) {
+  {
+    // owner fast path: no slot needed
+    StLockGuard lk(p->mu);
+    if (p->owned.count(shard) && !p->ho_sent.count(shard)) {
+      shard_apply_fwd(p, shard, const_cast<uint8_t*>(bytes.data()),
+                      (uint32_t)bytes.size(), sscratch, wscratch);
+      return true;
+    }
+    if (shard_next_hop(p, shard, -1) < 0) return false;
+  }
+  TxSlot* slot = p->txpool.acquire();
+  uint32_t off = (uint32_t)(kBodyOff - kFwdHdr);
+  std::memcpy(slot->buf.data() + off, bytes.data(), bytes.size());
+  slot->wire_off = off;
+  slot->wire_len = (uint32_t)bytes.size();
+  if (!shard_dispatch(p, shard, slot, nullptr, slot->buf.data() + off,
+                      (uint32_t)bytes.size(), -1, sscratch, wscratch)) {
+    p->txpool.unref(slot);
+    return false;
+  }
+  return true;
+}
+
+// Go-back-N retransmission pass (the engine retransmit_pass twin, minus
+// rollback: FWD ledger entries re-dispatch at detach instead of rolling
+// back into a residual). Black-hole links tear down via st_node_drop_link
+// — Python's LINK_DOWN handler detaches and re-routes the ledger.
+void shard_retransmit(ShardPlane* p) ST_EXCLUDES(p->mu) {
+  if (p->ack_timeout <= 0) return;
+  auto now = EClock::now();
+  std::vector<int32_t> ids;
+  {
+    StLockGuard lk(p->mu);
+    for (auto& kv : p->members)
+      if (!kv.second.dead) ids.push_back(kv.first);
+  }
+  for (int32_t id : ids) {
+    std::vector<std::pair<const uint8_t*, uint32_t>> tail;
+    std::vector<ShardSent> held;
+    bool teardown = false;
+    {
+      StLockGuard lk(p->mu);
+      auto it = p->members.find(id);
+      if (it == p->members.end() || it->second.dead) continue;
+      SMember& m = it->second;
+      if (m.unacked.empty()) continue;
+      double waited =
+          std::chrono::duration<double>(now - m.ack_progress).count();
+      int32_t shift = m.retx_rounds < 3 ? m.retx_rounds : 3;
+      if (waited < p->ack_timeout * (double)(1 << shift)) continue;
+      m.retx_rounds++;
+      m.ack_progress = now;
+      if (m.retx_rounds > p->ack_retry_limit) {
+        m.dead = true;
+        teardown = true;
+      } else {
+        size_t k = m.unacked.size() < kRetxPrefix ? m.unacked.size()
+                                                  : kRetxPrefix;
+        for (size_t i = 0; i < k; i++) {
+          ShardSent& e = m.unacked[i];
+          const uint8_t* d;
+          uint32_t n;
+          if (e.slot) {
+            e.slot->refs.fetch_add(1, std::memory_order_relaxed);
+            d = e.slot->buf.data() + e.slot->wire_off;
+            n = e.slot->wire_len;
+          } else {
+            e.taken->refs.fetch_add(1, std::memory_order_relaxed);
+            d = e.taken->data;
+            n = e.taken->len;
+          }
+          tail.emplace_back(d, n);
+          held.push_back(e);
+        }
+      }
+    }
+    if (teardown) {
+      st_obs_emit(p->obs_id, kEvBlackhole, id, (uint64_t)p->ack_retry_limit);
+      st_node_drop_link(p->node, id);
+      continue;
+    }
+    if (!tail.empty()) {
+      p->retx_msgs += (uint64_t)tail.size();
+      st_obs_emit(p->obs_id, kEvRetransmit, id, (uint64_t)tail.size());
+    }
+    for (size_t i = 0; i < tail.size(); i++) {
+      ShardSent& e = held[i];
+      int32_t r = st_node_send_zc(
+          p->node, id, tail[i].first, (int32_t)tail[i].second, 0.1,
+          e.slot ? tx_slot_release : taken_release,
+          e.slot ? (void*)e.slot : (void*)e.taken);
+      if (r != 1) {
+        for (size_t j = i; j < held.size(); j++)
+          shard_entry_unref(p, held[j]);
+        break;
+      }
+    }
+  }
+}
+
+void shard_flush_acks(ShardPlane* p, int32_t id, SMember& m)
+    ST_REQUIRES(p->mu) {
+  // cumulative + retried + RE-ANNOUNCED on duplicates (node.py: a dup
+  // usually means our ACK was lost — a sender whose retransmissions are
+  // silently discarded without a fresh ACK is wedged forever)
+  if (!m.ack_due || m.dead) return;
+  uint8_t ack[9];
+  ack[0] = kAck;
+  uint64_t c = m.rx_count;
+  std::memcpy(ack + 1, &c, 8);
+  int32_t r = st_node_send(p->node, id, ack, 9, 0.0);
+  if (r == 1 || r < 0) {
+    m.ack_due = false;
+    m.ack_sent = m.rx_count;
+  }
+}
+
+void shard_unpark(ShardPlane* p, std::vector<float>& sscratch,
+                  std::vector<uint32_t>& wscratch) ST_EXCLUDES(p->mu) {
+  std::deque<ParkedFwd> work;
+  {
+    StLockGuard lk(p->mu);
+    if (p->parked.empty()) return;
+    work.swap(p->parked);
+  }
+  for (auto& pf : work) {
+    if (!shard_dispatch_bytes(p, pf.shard, pf.bytes, sscratch, wscratch)) {
+      StLockGuard lk(p->mu);
+      shard_park(p, pf.shard, pf.bytes.data(), (uint32_t)pf.bytes.size());
+    }
+  }
+}
+
+// ---- shard sender: the outbox pump ----------------------------------------
+
+void shard_sender_loop(ShardPlane* p) {
+  std::vector<float> sscratch;
+  std::vector<uint32_t> wscratch;
+  ShardScratch scr;
+  while (!p->stop.load()) {
+    uint64_t seq_before;
+    {
+      StLockGuard lk(p->wmu);
+      seq_before = p->wseq;
+    }
+    bool sent_any = false;
+    bool blocked = false;  // work exists but the queue/window gated it
+    std::vector<int32_t> shards;
+    {
+      StLockGuard lk(p->mu);
+      for (auto& kv : p->outbox)
+        if (!p->owned.count(kv.first)) shards.push_back(kv.first);
+    }
+    for (int32_t shard : shards) {
+      if (p->stop.load()) return;
+      int32_t hop;
+      {
+        StLockGuard lk(p->mu);
+        hop = shard_next_hop(p, shard, -1);
+      }
+      if (hop < 0) continue;  // mass stays until a route heals
+      // control-traffic headroom (node.py _queue_room): the pump must
+      // never race the ACKs/shard control for the last sendq slots
+      if (st_node_sendq_room(p->node, hop) < kCtrlHeadroom) {
+        blocked = true;
+        continue;
+      }
+      for (int msg = 0; msg < kOutboxMsgsPerPass; msg++) {
+        const ShardGeom& g = p->geom[(size_t)shard];
+        size_t per = (size_t)p->L * 4 + (size_t)g.wcnt * 4;
+        {
+          // window pre-check BEFORE paying for a quantize (node.py
+          // _pump_outboxes): a full ledger leaves the mass in the
+          // residual, where error feedback keeps it exact
+          StLockGuard lk(p->mu);
+          auto mit = p->members.find(hop);
+          if (mit == p->members.end() || mit->second.dead ||
+              mit->second.unacked.size() >= kSendWindow)
+            break;
+        }
+        TxSlot* slot = p->txpool.acquire();
+        uint8_t* body = slot->buf.data() + kBodyOff;
+        int32_t nf = 0;
+        uint32_t fseq = 0;
+        {
+          StLockGuard lk(p->mu);
+          auto it = p->outbox.find(shard);
+          if (it == p->outbox.end() || p->owned.count(shard)) {
+            p->txpool.unref(slot);
+            break;
+          }
+          // ONE measurement per message, the cascade-halving schedule,
+          // every word plane in one AVX-512 memory pass — the classic
+          // plane's machinery (slice_cascade_message; the per-frame
+          // scalar path measured ~60 msgs/s where this shape does
+          // thousands)
+          nf = slice_cascade_message(p, g, it->second.data(), g.kcap,
+                                     body, per, scr);
+          if (nf == 0) {
+            // drained to dust: FREE the outbox (the transient-memory
+            // contract — state.drain_outbox_frames' twin)
+            p->outbox.erase(it);
+            p->txpool.unref(slot);
+            break;
+          }
+          fseq = ++p->fwd_seq;
+        }
+        uint32_t off = (uint32_t)(kBodyOff - kFwdHdr);
+        uint8_t* H = slot->buf.data() + off;
+        H[0] = kFwd;
+        uint32_t z = 0, wlo32 = (uint32_t)g.wlo, wc32 = (uint32_t)g.wcnt;
+        std::memcpy(H + 1, &z, 4);  // per-link seq stamped by ledger_send
+        std::memcpy(H + 5, &wlo32, 4);
+        std::memcpy(H + 9, &wc32, 4);
+        std::memcpy(H + 13, &p->origin, 4);
+        std::memcpy(H + 17, &fseq, 4);
+        slot->wire_off = off;
+        slot->wire_len = (uint32_t)(kFwdHdr + (size_t)nf * per);
+        if (!shard_dispatch(p, shard, slot, nullptr, H, slot->wire_len, -1,
+                            sscratch, wscratch)) {
+          // window filled / hop died mid-pump: park the encoded frames
+          // under their identity (the residual was already debited —
+          // error feedback lives in the frames now)
+          StLockGuard lk(p->mu);
+          shard_park(p, shard, H, slot->wire_len);
+          p->txpool.unref(slot);
+          break;
+        }
+        p->fwd_msgs_out++;
+        p->fwd_frames_out += (uint64_t)nf;
+        sent_any = true;
+      }
+    }
+    shard_unpark(p, sscratch, wscratch);
+    shard_retransmit(p);
+    if (!sent_any && !p->stop.load()) {
+      // blocked = mass waiting on sendq/window drain: come back on the
+      // transport's timescale (a 20 ms nap here paced the whole plane
+      // at ~250 msgs/s — the first bench run's wall); idle = wait for a
+      // wake (add / ACK / route) with the retransmit-timer backstop
+      StUniqueLock lk(p->wmu);
+      auto nap_deadline = st_cv_deadline(blocked ? 0.0005 : 0.02);
+      while (p->wseq <= seq_before && !p->stop.load()) {
+        if (p->wcv.wait_until(lk.native(), nap_deadline) ==
+            std::cv_status::timeout)
+          break;
+      }
+    }
+  }
+}
+
+// ---- shard receiver -------------------------------------------------------
+
+void shard_recv_loop(ShardPlane* p) {
+  std::vector<float> sscratch;
+  std::vector<uint32_t> wscratch;
+  while (!p->stop.load()) {
+    uint64_t seq0 = st_node_data_seq(p->node);
+    bool busy = false;
+    std::vector<int32_t> ids;
+    {
+      StLockGuard lk(p->mu);
+      for (auto& kv : p->members)
+        if (!kv.second.dead) ids.push_back(kv.first);
+    }
+    for (int32_t id : ids) {
+      for (int iter = 0; iter < 256; iter++) {
+        const uint8_t* buf = nullptr;
+        void* tok = nullptr;
+        int32_t n = st_node_recv_take(p->node, id, &buf, &tok);
+        if (n == 0) break;
+        if (n < 0) {
+          StLockGuard lk(p->mu);
+          auto it = p->members.find(id);
+          if (it != p->members.end()) it->second.dead = true;
+          break;
+        }
+        busy = true;
+        uint8_t kind = buf[0];
+        if (kind == kFwd && (size_t)n >= kFwdHdr) {
+          uint32_t seq;
+          std::memcpy(&seq, buf + 1, 4);
+          int32_t shard = -1;
+          bool accept = false;
+          {
+            StLockGuard lk(p->mu);
+            auto it = p->members.find(id);
+            if (it != p->members.end()) {
+              SMember& m = it->second;
+              if (seq != (uint32_t)(m.rx_count + 1)) {
+                // dup or gap: discard unapplied, RE-ANNOUNCE the ACK
+                // (node.py: the dup usually means our ACK was lost)
+                m.ack_due = true;
+              } else {
+                m.rx_count++;
+                m.ack_due = true;
+                uint32_t wlo;
+                std::memcpy(&wlo, buf + 5, 4);
+                shard = shard_of_word(p, wlo);
+                accept = shard >= 0;
+                if (shard < 0) p->fwd_undecodable++;
+              }
+            }
+          }
+          if (accept) {
+            auto* tb = new TakenBuf();
+            tb->plane = p;
+            tb->tok = tok;
+            tb->data = const_cast<uint8_t*>(buf);
+            tb->len = (uint32_t)n;
+            tb->from_link = id;
+            tb->refs.store(1, std::memory_order_relaxed);
+            p->taken_live.fetch_add(1, std::memory_order_acq_rel);
+            if (!shard_dispatch(p, shard, nullptr, tb, tb->data, tb->len,
+                                id, sscratch, wscratch)) {
+              StLockGuard lk(p->mu);
+              shard_park(p, shard, tb->data, tb->len);
+              taken_unref(tb);
+            }
+          } else {
+            st_node_take_free(p->node, id, tok);
+          }
+        } else if (kind == kAck && n == 9) {
+          uint64_t count;
+          std::memcpy(&count, buf + 1, 8);
+          st_node_take_free(p->node, id, tok);
+          bool opened = false;
+          {
+            StLockGuard lk(p->mu);
+            auto it = p->members.find(id);
+            if (it != p->members.end()) {
+              SMember& m = it->second;
+              bool progressed = false;
+              while (!m.unacked.empty() && m.unacked.front().seq <= count) {
+                shard_entry_unref(p, m.unacked.front());
+                m.unacked.pop_front();
+                progressed = true;
+              }
+              if (progressed) {
+                m.ack_progress = EClock::now();
+                m.retx_rounds = 0;
+                opened = true;
+              }
+            }
+          }
+          if (opened) p->wake();  // window opened: outboxes/park may drain
+        } else {
+          // control plane (SHARD JSON, DIGEST, handshake strays): hand to
+          // Python in arrival order
+          {
+            StLockGuard lk(p->cmu);
+            p->ctrl.emplace_back(id, std::vector<uint8_t>(buf, buf + n));
+          }
+          st_node_take_free(p->node, id, tok);
+        }
+      }
+      {
+        StLockGuard lk(p->mu);
+        auto it = p->members.find(id);
+        if (it != p->members.end()) shard_flush_acks(p, id, it->second);
+      }
+    }
+    if (!busy && !p->stop.load()) {
+      st_node_wait_data(p->node, seq0, 0.05);
+    }
+  }
+}
+
 }  // namespace
 
 // ---- C ABI ---------------------------------------------------------------
@@ -2715,6 +3828,596 @@ __attribute__((visibility("default"))) int32_t st_engine_snapshot_all(
     int32_t max_links) {
   return st_engine_snapshot_ex(h, values_out, ids_out, resid_out, nullptr,
                                max_links);
+}
+
+// ---- r17 engine-tier shard data plane ABI ---------------------------------
+
+// Standalone slice-codec kernels (the parity surface): one quantize /
+// apply step over a word range of the global layout, exactly
+// state.SliceCodec's semantics. tests/test_shard_engine.py pins byte
+// equality against the numpy twin on shared random state; the python
+// tier itself stays numpy (the reference), so these exist for the plane
+// and the tests, not as a codec fast path for state.py.
+__attribute__((visibility("default"))) int32_t st_slice_quantize(
+    const int64_t* off, const int64_t* ns, const int64_t* padded,
+    int64_t n_leaves, int64_t word_lo, int64_t word_cnt, int32_t policy,
+    float* resid, float* scales, uint32_t* words) {
+  ShardPlane p;
+  p.L = n_leaves;
+  p.off.assign(off, off + n_leaves);
+  p.ns.assign(ns, ns + n_leaves);
+  p.padded.assign(padded, padded + n_leaves);
+  p.policy = policy;
+  p.recv_cap = 1 << 20;
+  int64_t wl = word_lo, wc = word_cnt;
+  shard_geom_init(&p, &wl, &wc, 1);
+  return slice_quantize(&p, p.geom[0], resid, scales, words) ? 1 : 0;
+}
+
+__attribute__((visibility("default"))) int32_t st_slice_apply(
+    const int64_t* off, const int64_t* ns, const int64_t* padded,
+    int64_t n_leaves, int64_t word_lo, int64_t word_cnt, float* target,
+    const float* scales, const uint32_t* words) {
+  ShardPlane p;
+  p.L = n_leaves;
+  p.off.assign(off, off + n_leaves);
+  p.ns.assign(ns, ns + n_leaves);
+  p.padded.assign(padded, padded + n_leaves);
+  p.recv_cap = 1 << 20;
+  int64_t wl = word_lo, wc = word_cnt;
+  shard_geom_init(&p, &wl, &wc, 1);
+  return slice_apply(&p, p.geom[0], target, scales, words) ? 1 : 0;
+}
+
+// The pump's whole message build as a standalone kernel (the cascade
+// parity surface): up to k frames written at wire strides into `frames`
+// (frame f's global scale row at f*per, word plane at f*per + 4L; per =
+// 4*n_leaves + 4*word_cnt). Returns the frame count; error feedback
+// lands in `resid` in place. tests/test_shard_engine.py pins byte
+// equality against state.py's measure + cascade_rows + quantize_at on
+// shared random state.
+__attribute__((visibility("default"))) int32_t st_slice_cascade(
+    const int64_t* off, const int64_t* ns, const int64_t* padded,
+    int64_t n_leaves, int64_t word_lo, int64_t word_cnt, int32_t policy,
+    int32_t k, float* resid, uint8_t* frames) {
+  ShardPlane p;
+  p.L = n_leaves;
+  p.off.assign(off, off + n_leaves);
+  p.ns.assign(ns, ns + n_leaves);
+  p.padded.assign(padded, padded + n_leaves);
+  p.policy = policy;
+  p.recv_cap = 1 << 20;
+  int64_t wl = word_lo, wc = word_cnt;
+  shard_geom_init(&p, &wl, &wc, 1);
+  ShardScratch scr;
+  size_t per = (size_t)n_leaves * 4 + (size_t)word_cnt * 4;
+  return slice_cascade_message(&p, p.geom[0], resid, k, frames, per, scr);
+}
+
+// Create the plane. `wlo`/`wcnt` carry every shard's word range (the r16
+// fixed-at-creation partition — python's ShardMap mirrors the same
+// deterministic geometry). `recv_cap` is wire.frame_wire_bytes(spec):
+// the per-message FWD burst cap derives from it exactly like
+// wire.fwd_frames_cap. `origin` is the node's obs id — the end-to-end
+// (origin, fwd_seq) identity's first half.
+__attribute__((visibility("default"))) void* st_shard_create(
+    void* node, const int64_t* off, const int64_t* ns, const int64_t* padded,
+    int64_t n_leaves, int64_t total, int64_t total_n, int32_t n_shards,
+    const int64_t* wlo, const int64_t* wcnt, int32_t policy,
+    int32_t recv_cap, double ack_timeout_sec, int32_t ack_retry_limit,
+    int32_t park_cap, uint32_t origin) {
+  if (!node || n_shards <= 0) return nullptr;
+  auto* p = new ShardPlane();
+  p->node = node;
+  p->obs_id = st_node_obs_id(node);
+  p->origin = origin;
+  p->L = n_leaves;
+  p->total = total;
+  p->total_n = total_n;
+  p->W = total / 32;
+  p->off.assign(off, off + n_leaves);
+  p->ns.assign(ns, ns + n_leaves);
+  p->padded.assign(padded, padded + n_leaves);
+  p->policy = policy;
+  p->recv_cap = recv_cap;
+  p->ack_timeout = ack_timeout_sec > 0 ? ack_timeout_sec : 0.0;
+  p->ack_retry_limit = ack_retry_limit > 0 ? ack_retry_limit : 1;
+  p->park_cap = park_cap > 0 ? park_cap : 4096;
+  shard_geom_init(p, wlo, wcnt, n_shards);
+  size_t widest = 0;
+  for (auto& g : p->geom) {
+    size_t per = (size_t)p->L * 4 + (size_t)g.wcnt * 4;
+    size_t need = (size_t)g.kcap * per;
+    if (need > widest) widest = need;
+  }
+  p->txpool.slot_bytes = kBodyOff + widest;
+  return p;
+}
+
+__attribute__((visibility("default"))) void st_shard_start(void* h) {
+  if (!h) return;
+  auto* p = (ShardPlane*)h;
+  if (p->started) return;
+  p->started = true;
+  p->send_thread = std::thread(shard_sender_loop, p);
+  p->recv_thread = std::thread(shard_recv_loop, p);
+}
+
+__attribute__((visibility("default"))) void st_shard_stop(void* h) {
+  if (!h) return;
+  auto* p = (ShardPlane*)h;
+  p->stop.store(true);
+  p->wake();
+  if (p->send_thread.joinable()) p->send_thread.join();
+  if (p->recv_thread.joinable()) p->recv_thread.join();
+}
+
+__attribute__((visibility("default"))) void st_shard_destroy(void* h) {
+  auto* p = (ShardPlane*)h;
+  if (!p) return;
+  // drop ledger references (no rollback — FWD mass re-dispatches at
+  // detach; a dying plane has nothing left to repair)
+  {
+    StLockGuard lk(p->mu);
+    for (auto& kv : p->members) {
+      for (auto& e : kv.second.unacked) shard_entry_unref(p, e);
+      kv.second.unacked.clear();
+    }
+  }
+  // wait for in-flight transport release callbacks (TxSlots AND taken rx
+  // buffers) to drain — the st_engine_destroy rationale, verbatim
+  for (int i = 0;; i++) {
+    bool busy = p->taken_live.load(std::memory_order_acquire) != 0;
+    if (!busy) {
+      StLockGuard lk(p->txpool.mu);
+      for (auto& s : p->txpool.all_)
+        if (s->refs.load(std::memory_order_acquire) != 0) {
+          busy = true;
+          break;
+        }
+    }
+    if (!busy) break;
+    if (i >= 2000) return;  // ~2 s: leak rather than free under a live thread
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  delete p;
+}
+
+// Attach a member link (handshake complete — python's WELCOME exchange).
+// The plane's receiver owns the link's stream from here: FWD/ACK are
+// consumed natively, everything else defers to st_shard_poll_ctrl.
+__attribute__((visibility("default"))) int32_t st_shard_member_attach(
+    void* h, int32_t link, uint64_t tx_init, uint64_t rx_init) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  if (p->members.count(link)) return 0;
+  SMember m;
+  m.tx_seq = tx_init;
+  m.rx_count = rx_init;
+  m.ack_sent = rx_init;
+  m.ack_progress = EClock::now();
+  p->members.emplace(link, std::move(m));
+  return 1;
+}
+
+// Detach a member (LINK_DOWN): every unacked FWD re-dispatches under its
+// UNCHANGED end-to-end identity — a copy that was actually delivered dies
+// in the owner's dedup window instead of double-applying (node.py
+// _on_link_down's discipline). Routeless frames park.
+__attribute__((visibility("default"))) int32_t st_shard_member_detach(
+    void* h, int32_t link) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  std::deque<ShardSent> entries;
+  {
+    StLockGuard lk(p->mu);
+    auto it = p->members.find(link);
+    if (it == p->members.end()) return 0;
+    entries.swap(it->second.unacked);
+    p->members.erase(it);
+    if (p->uplink == link) p->uplink = -1;
+    for (auto rit = p->route.begin(); rit != p->route.end();) {
+      if (rit->second == link)
+        rit = p->route.erase(rit);
+      else
+        ++rit;
+    }
+  }
+  std::vector<float> ss;
+  std::vector<uint32_t> ws;
+  for (auto& e : entries) {
+    const uint8_t* d = e.slot ? e.slot->buf.data() + e.slot->wire_off
+                              : e.taken->data;
+    uint32_t n = e.slot ? e.slot->wire_len : e.taken->len;
+    uint32_t wlo;
+    std::memcpy(&wlo, d + 5, 4);
+    int32_t shard = shard_of_word(p, wlo);
+    if (shard < 0) {
+      shard_entry_unref(p, e);
+      continue;
+    }
+    if (!shard_dispatch(p, shard, e.slot, e.taken,
+                        const_cast<uint8_t*>(d), n, -1, ss, ws)) {
+      StLockGuard lk(p->mu);
+      shard_park(p, shard, d, n);
+      shard_entry_unref(p, e);
+    }
+  }
+  p->wake();
+  return 1;
+}
+
+__attribute__((visibility("default"))) void st_shard_set_uplink(
+    void* h, int32_t link) {
+  if (!h) return;
+  auto* p = (ShardPlane*)h;
+  {
+    StLockGuard lk(p->mu);
+    p->uplink = link;
+  }
+  p->wake();
+}
+
+// Routes are Python's call (the own-announce flood stays control-plane);
+// the plane mirrors them for the relay/pump hop choice. link < 0 clears.
+__attribute__((visibility("default"))) void st_shard_set_route(
+    void* h, int32_t shard, int32_t link) {
+  if (!h) return;
+  auto* p = (ShardPlane*)h;
+  {
+    StLockGuard lk(p->mu);
+    if (link < 0)
+      p->route.erase(shard);
+    else
+      p->route[shard] = link;
+  }
+  p->wake();  // parked frames may have a route now
+}
+
+// Mark a shard's outgoing handoff in flight (the _ho_sent discipline):
+// while set, FWDs for it relay toward the successor instead of applying
+// to the already-shipped slice (debited-mass conservation — the
+// spec_shard apply_during_handoff mutation).
+__attribute__((visibility("default"))) void st_shard_set_handoff(
+    void* h, int32_t shard, int32_t on) {
+  if (!h) return;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  if (on)
+    p->ho_sent.insert(shard);
+  else
+    p->ho_sent.erase(shard);
+}
+
+// Adopt a shard slice (grant / handoff / restore). `values` NULL seeds
+// zeros. Any outbox held toward the shard folds straight into the slice
+// (we ARE the owner now — exact local apply), under the same mutex.
+__attribute__((visibility("default"))) void st_shard_adopt(
+    void* h, int32_t shard, const float* values) {
+  if (!h) return;
+  auto* p = (ShardPlane*)h;
+  {
+    StLockGuard lk(p->mu);
+    if (shard < 0 || (size_t)shard >= p->geom.size()) return;
+    const ShardGeom& g = p->geom[(size_t)shard];
+    auto& vals = p->owned[shard];
+    vals.assign((size_t)g.n_el, 0.0f);
+    if (values) std::memcpy(vals.data(), values, (size_t)g.n_el * 4);
+    auto ob = p->outbox.find(shard);
+    if (ob != p->outbox.end()) {
+      for (int64_t j = 0; j < g.n_el; j++) {
+        float t = vals[(size_t)j] + ob->second[(size_t)j];
+        if (t > kSat) t = kSat;
+        if (t < -kSat) t = -kSat;
+        vals[(size_t)j] = t;
+      }
+      p->outbox.erase(ob);
+    }
+    p->route.erase(shard);
+    p->ho_sent.erase(shard);
+  }
+  p->wake();  // parked frames for this shard can apply now
+}
+
+// Release ownership (handoff tail / takeover re-grant). Returns 1 and
+// copies the slice into `out` (when non-NULL) if it was owned.
+__attribute__((visibility("default"))) int32_t st_shard_release(
+    void* h, int32_t shard, float* out) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  auto it = p->owned.find(shard);
+  if (it == p->owned.end()) return 0;
+  if (out) std::memcpy(out, it->second.data(), it->second.size() * 4);
+  p->owned.erase(it);
+  p->ho_sent.erase(shard);
+  return 1;
+}
+
+__attribute__((visibility("default"))) int32_t st_shard_owns(
+    void* h, int32_t shard) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  return p->owned.count(shard) ? 1 : 0;
+}
+
+// Copy one owned slice out (serve-tier reads, handoff state chunks).
+__attribute__((visibility("default"))) int32_t st_shard_read(
+    void* h, int32_t shard, float* out) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  auto it = p->owned.find(shard);
+  if (it == p->owned.end()) return 0;
+  std::memcpy(out, it->second.data(), it->second.size() * 4);
+  return 1;
+}
+
+// Merge an additive update (node.py add()'s hot half): the in-shard part
+// applies EXACTLY to the owned slices, every out-of-shard part
+// accumulates into its target shard's outbox residual — one mutex, like
+// state.add_delta, so a racing adopt can never strand a deposit.
+// `flat` is the full padded flat delta (spec.total floats).
+__attribute__((visibility("default"))) void st_shard_add(
+    void* h, const float* flat) {
+  if (!h) return;
+  auto* p = (ShardPlane*)h;
+  {
+    StLockGuard lk(p->mu);
+    for (size_t s = 0; s < p->geom.size(); s++) {
+      const ShardGeom& g = p->geom[s];
+      const float* seg = flat + g.elo;
+      bool nz = false;
+      for (int64_t j = 0; j < g.n_el; j++)
+        if (seg[j] != 0.0f) {
+          nz = true;
+          break;
+        }
+      if (!nz) continue;
+      auto oit = p->owned.find((int32_t)s);
+      if (oit != p->owned.end()) {
+        float* vals = oit->second.data();
+        for (int64_t j = 0; j < g.n_el; j++) {
+          float t = vals[j] + seg[j] * g.live[(size_t)j];
+          if (t > kSat) t = kSat;
+          if (t < -kSat) t = -kSat;
+          vals[j] = t;
+        }
+      } else {
+        auto& ob = p->outbox[(int32_t)s];
+        if (ob.empty()) ob.assign((size_t)g.n_el, 0.0f);
+        float* r = ob.data();
+        for (int64_t j = 0; j < g.n_el; j++)
+          r[j] += seg[j] * g.live[(size_t)j];
+      }
+    }
+  }
+  p->updates++;
+  p->wake();
+}
+
+// Re-seat a checkpointed outbox residual (restart path) — added to any
+// mass already accumulated, like state.restore_outbox.
+__attribute__((visibility("default"))) void st_shard_restore_outbox(
+    void* h, int32_t shard, const float* resid) {
+  if (!h) return;
+  auto* p = (ShardPlane*)h;
+  {
+    StLockGuard lk(p->mu);
+    if (shard < 0 || (size_t)shard >= p->geom.size()) return;
+    const ShardGeom& g = p->geom[(size_t)shard];
+    auto& ob = p->outbox[shard];
+    if (ob.empty()) ob.assign((size_t)g.n_el, 0.0f);
+    for (int64_t j = 0; j < g.n_el; j++) ob[(size_t)j] += resid[j];
+  }
+  p->wake();
+}
+
+// Merge (origin, seqs) into the end-to-end dedup window (handoff /
+// restore) — sorted-merge + window trim, byte-compatible with node.py's
+// _on_ho merge so mixed-tier handoffs interop.
+__attribute__((visibility("default"))) int32_t st_shard_dedup_merge(
+    void* h, uint32_t origin, const uint64_t* seqs, int64_t n) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  auto& win = p->dedup[origin];
+  for (int64_t i = 0; i < n; i++) win.first.insert((uint32_t)seqs[i]);
+  win.second.assign(win.first.begin(), win.first.end());  // sorted merge
+  while (win.second.size() > kShardDedupWindow) {
+    win.first.erase(win.second.front());
+    win.second.pop_front();
+  }
+  return 1;
+}
+
+// Atomic checkpoint capture — owned slices, outbox residuals and dedup
+// windows under ONE mutex acquisition (the r16 fourth-review invariant:
+// a window seq must never persist without its applied mass). Returns the
+// owned-slice count; ids/values land in ascending shard order, values
+// concatenated (the caller knows each shard's n_el from the map
+// geometry). `dd_n`/`n_ob` receive the dedup pair count and outbox count.
+__attribute__((visibility("default"))) int32_t st_shard_snapshot(
+    void* h, int32_t* owned_ids, float* owned_vals, int32_t* outbox_ids,
+    float* outbox_vals, uint32_t* dd_origins, uint64_t* dd_seqs,
+    int64_t dd_cap, int64_t* dd_n, int32_t* n_ob) {
+  *dd_n = 0;
+  *n_ob = 0;
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  int32_t no = 0;
+  size_t voff = 0;
+  for (auto& kv : p->owned) {
+    owned_ids[no++] = kv.first;
+    std::memcpy(owned_vals + voff, kv.second.data(), kv.second.size() * 4);
+    voff += kv.second.size();
+  }
+  int32_t nb = 0;
+  voff = 0;
+  for (auto& kv : p->outbox) {
+    outbox_ids[nb++] = kv.first;
+    std::memcpy(outbox_vals + voff, kv.second.data(), kv.second.size() * 4);
+    voff += kv.second.size();
+  }
+  *n_ob = nb;
+  int64_t dn = 0;
+  for (auto& kv : p->dedup)
+    for (uint32_t s : kv.second.second) {
+      if (dn >= dd_cap) break;
+      dd_origins[dn] = kv.first;
+      dd_seqs[dn] = s;
+      dn++;
+    }
+  *dd_n = dn;
+  return no;
+}
+
+// Total (origin, fwd_seq) pairs across every dedup window — sizes the
+// export/snapshot buffers so a many-origin cluster's windows never
+// silently truncate (each origin holds at most kShardDedupWindow).
+__attribute__((visibility("default"))) int64_t st_shard_dedup_size(void* h) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  int64_t n = 0;
+  for (auto& kv : p->dedup) n += (int64_t)kv.second.second.size();
+  return n;
+}
+
+// Export the dedup windows alone (the handoff ride-along: per-origin
+// state, no reason to copy every owned slice the way st_shard_snapshot
+// must). Returns the pair count written (<= cap).
+__attribute__((visibility("default"))) int64_t st_shard_dedup_export(
+    void* h, uint32_t* origins, uint64_t* seqs, int64_t cap) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  int64_t dn = 0;
+  for (auto& kv : p->dedup)
+    for (uint32_t s : kv.second.second) {
+      if (dn >= cap) return dn;
+      origins[dn] = kv.first;
+      seqs[dn] = s;
+      dn++;
+    }
+  return dn;
+}
+
+__attribute__((visibility("default"))) uint32_t st_shard_fwd_seq(void* h) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  return p->fwd_seq;
+}
+
+__attribute__((visibility("default"))) void st_shard_set_fwd_seq(
+    void* h, uint32_t seq) {
+  if (!h) return;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  p->fwd_seq = seq;
+}
+
+// Resident f32 state bytes (owned slices + live outboxes): the chaos
+// harness's per-node bound (subscriber residuals stay python-side and
+// are added there).
+__attribute__((visibility("default"))) int64_t st_shard_alloc_bytes(
+    void* h) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  int64_t total = 0;
+  for (auto& kv : p->owned) total += (int64_t)kv.second.size() * 4;
+  for (auto& kv : p->outbox) total += (int64_t)kv.second.size() * 4;
+  return total;
+}
+
+__attribute__((visibility("default"))) int64_t st_shard_outbox_bytes(
+    void* h) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  int64_t total = 0;
+  for (auto& kv : p->outbox) total += (int64_t)kv.second.size() * 4;
+  return total;
+}
+
+__attribute__((visibility("default"))) int64_t st_shard_owned_words(
+    void* h) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  int64_t total = 0;
+  for (auto& kv : p->owned)
+    total += p->geom[(size_t)kv.first].wcnt;
+  return total;
+}
+
+// True when every outbox residual is within tol of idle AND every ledger
+// is empty AND nothing is parked — node.py drained()'s engine half.
+__attribute__((visibility("default"))) int32_t st_shard_idle(void* h,
+                                                             double tol) {
+  if (!h) return 1;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->mu);
+  if (!p->parked.empty()) return 0;
+  for (auto& kv : p->members)
+    if (!kv.second.unacked.empty()) return 0;
+  for (auto& kv : p->outbox)
+    for (float v : kv.second)
+      if (std::fabs(v) > tol) return 0;
+  return 1;
+}
+
+// Counter snapshot:
+// [0 fwd_msgs_out, 1 fwd_msgs_in, 2 relayed, 3 dedup_discards,
+//  4 park_drops, 5 parked (gauge), 6 retx_msgs, 7 updates,
+//  8 fwd_frames_out, 9 fwd_frames_in, 10 tx_slot_acquires,
+//  11 tx_slot_alloc_events, 12 fwd_undecodable, 13 inflight (gauge)]
+__attribute__((visibility("default"))) void st_shard_counters(
+    void* h, uint64_t* out14) {
+  for (int i = 0; i < 14; i++) out14[i] = 0;
+  if (!h) return;
+  auto* p = (ShardPlane*)h;
+  out14[0] = p->fwd_msgs_out.load();
+  out14[1] = p->fwd_msgs_in.load();
+  out14[2] = p->relayed.load();
+  out14[3] = p->dedup_discards.load();
+  out14[4] = p->park_drops.load();
+  out14[6] = p->retx_msgs.load();
+  out14[7] = p->updates.load();
+  out14[8] = p->fwd_frames_out.load();
+  out14[9] = p->fwd_frames_in.load();
+  out14[10] = p->txpool.acquires.load();
+  out14[11] = p->txpool.alloc_events.load();
+  out14[12] = p->fwd_undecodable.load();
+  uint64_t parked_n = 0, inflight = 0;
+  {
+    StLockGuard lk(p->mu);
+    parked_n = (uint64_t)p->parked.size();
+    for (auto& kv : p->members) inflight += (uint64_t)kv.second.unacked.size();
+  }
+  out14[5] = parked_n;
+  out14[13] = inflight;
+}
+
+// Pop one control-plane message the receiver deferred to Python (same
+// contract as st_engine_poll_ctrl).
+__attribute__((visibility("default"))) int32_t st_shard_poll_ctrl(
+    void* h, int32_t* link_out, uint8_t* buf, int32_t cap) {
+  if (!h) return 0;
+  auto* p = (ShardPlane*)h;
+  StLockGuard lk(p->cmu);
+  if (p->ctrl.empty()) return 0;
+  auto& front = p->ctrl.front();
+  *link_out = front.first;
+  int32_t n = (int32_t)std::min<size_t>(front.second.size(), (size_t)cap);
+  std::memcpy(buf, front.second.data(), (size_t)n);
+  p->ctrl.pop_front();
+  return n;
 }
 
 }  // extern "C"

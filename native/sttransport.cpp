@@ -2807,6 +2807,78 @@ void st_node_recv_done(void* h, int32_t link_id) {
   if (link) link->rx_pool.put(std::move(prev));
 }
 
+// r17 engine-tier shard plane: ownership-transfer receive, the transport
+// half of the zero-copy verbatim relay. Like st_node_recv_zc, but the
+// popped rx buffer's OWNERSHIP moves to the caller: *out points at its
+// bytes, *tok receives an opaque owner token the caller releases with
+// st_node_take_free(h, link_id, tok) exactly once (recycling the buffer
+// into the link's rx pool when the link still exists, so the steady
+// state stays allocation-free). The shard plane's relay path is the
+// intended caller: a FWD frame whose owner is downstream is re-stamped
+// IN PLACE (per-link seq only — the bytes are never decoded) and
+// enqueued via st_node_send_zc straight from this same buffer, held
+// through go-back-N retention — which makes relays ordinary zero-copy
+// sends, eligible for sendmmsg batching and the r14 shm lane like any
+// slot-backed message. No loan bookkeeping: the token outlives any
+// number of recv calls on the link.
+int32_t st_node_recv_take(void* h, int32_t link_id, const uint8_t** out,
+                          void** tok) {
+  auto* node = (Node*)h;
+  *out = nullptr;
+  *tok = nullptr;
+  std::shared_ptr<Link> link;
+  {
+    StLockGuard lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it != node->links.end()) link = it->second;
+  }
+  if (!link) return -1;
+  std::vector<uint8_t> frame;
+  if (!link->recvq.pop(&frame, 0.0)) {
+    return link->alive ? 0 : -1;
+  }
+  auto* owner = new std::vector<uint8_t>(std::move(frame));
+  *out = owner->data();
+  *tok = owner;
+  return (int32_t)owner->size();
+}
+
+// Release a buffer taken with st_node_recv_take (exactly once). The link
+// id routes the recycle back into the owning link's rx pool; a link torn
+// down in the meantime just frees the buffer.
+void st_node_take_free(void* h, int32_t link_id, void* tok) {
+  auto* owner = (std::vector<uint8_t>*)tok;
+  if (!owner) return;
+  auto* node = (Node*)h;
+  std::shared_ptr<Link> link;
+  if (node) {
+    StLockGuard lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it != node->links.end()) link = it->second;
+  }
+  if (link) link->rx_pool.put(std::move(*owner));
+  delete owner;
+}
+
+// Free slots in the link's send queue (-1 unknown link). The shard
+// plane's outbox pump keeps control-traffic headroom with this — the
+// python tier's _queue_room discipline: a data pump that races the
+// cumulative ACKs and shard control messages for the last sendq slot
+// starves the very ACKs that drain its own ledger.
+int32_t st_node_sendq_room(void* h, int32_t link_id) {
+  auto* node = (Node*)h;
+  std::shared_ptr<Link> link;
+  {
+    StLockGuard lk(node->mu);
+    auto it = node->links.find(link_id);
+    if (it == node->links.end()) return -1;
+    link = it->second;
+  }
+  int32_t depth = node->cfg.queue_depth;
+  int32_t used = (int32_t)link->sendq.size();
+  return used >= depth ? 0 : depth - used;
+}
+
 // r07 pool/zero-copy observability:
 // out[0..1] tx buffer acquires / misses (fresh allocations),
 // out[2..3] rx buffer acquires / misses, out[4] zero-copy sends enqueued.

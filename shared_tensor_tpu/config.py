@@ -465,6 +465,29 @@ class ShardConfig:
     #: (st_shard_park_drops_total) — loud bounded loss, never unbounded
     #: memory.
     park_cap: int = 4096
+    #: r17 engine-tier shard plane: run the FWD hot loop (outbox pump,
+    #: verbatim relay, owner dedup+apply, go-back-N) in the native engine
+    #: (shard/engine_lane.py) when the lib is available. False pins the
+    #: r16 python-tier plane — the semantic reference, wire-identical;
+    #: the ST_SHARD_ENGINE=0 env escape hatch pins it process-wide.
+    engine_lane: bool = True
+    #: r17 library-side writer admission control (ROADMAP 1(d)): bound on
+    #: resident per-target-shard outbox bytes. An add() whose
+    #: out-of-shard deposits would exceed it waits for the FWD plane to
+    #: drain room (outbox_overflow="block") or raises ShardBackpressure
+    #: ("raise") — the backpressure that previously lived only in the
+    #: chaos harness's alloc-polling loop. 0 = unlimited (the r16
+    #: behavior: one outbox per remote shard can accumulate). The
+    #: projection is conservative at slice granularity: each target shard
+    #: of the delta counts one full outbox slice.
+    outbox_limit_bytes: int = 0
+    #: "block" (wait up to outbox_block_timeout_sec, then raise) or
+    #: "raise" (fail the add() immediately).
+    outbox_overflow: str = "block"
+    #: How long a blocking add() waits for outbox room before raising
+    #: ShardBackpressure (a stalled link should fail the writer loudly,
+    #: never wedge it forever).
+    outbox_block_timeout_sec: float = 30.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -67,6 +67,12 @@ NODE_COUNTERS = (
     "st_retransmit_msgs_total",
     "st_dedup_discards_total",
     "st_traced_msgs_in_total",
+    # r17: obs.top's shard columns read these off the per-node breakdown
+    # (they rendered 0 for every node before — the cluster SUM carried
+    # them but the breakdown didn't); engine-lane nodes serve them off
+    # the native counters ABI through the same collector names
+    "st_shard_fwd_msgs_in_total",
+    "st_shard_fwd_msgs_out_total",
 )
 
 

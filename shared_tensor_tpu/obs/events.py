@@ -74,6 +74,14 @@ CODE_NAMES: dict[int, str] = {
     # open failed, 2 map/size failed, 3 header/token mismatch).
     34: "shm_lane_up",
     35: "shm_fallback",
+    # 36/37: r17 engine-tier shard plane. shard_park_drop is the native
+    # twin of the python tier's event of the same name (a parked FWD
+    # dropped at the ShardConfig.park_cap bound — loud bounded loss);
+    # shard_dedup_discard records an end-to-end (origin, fwd_seq)
+    # duplicate discarded at an engine-lane owner (arg = the fwd_seq) —
+    # distinct from code 14's per-link dup/gap discards.
+    36: "shard_park_drop",
+    37: "shard_dedup_discard",
 }
 NAME_CODES = {v: k for k, v in CODE_NAMES.items()}
 

@@ -152,6 +152,15 @@ SCHEMA: dict[str, tuple[str, str]] = {
     "st_shard_fwd_relayed_total": ("counter", "FWD frames relayed verbatim toward their owner (no re-quantization)"),
     "st_shard_fwd_dedup_total": ("counter", "FWD frames discarded by the owner's (origin, fwd_seq) dedup window"),
     "st_shard_park_drops_total": ("counter", "parked FWD frames dropped at the park-buffer cap (bounded loud loss)"),
+    # r17 engine-tier shard plane twins: the same write-plane numbers,
+    # served off the native st_shard_counters ABI for engine-lane nodes
+    # (the python tier reports them from its own registry — obs.top and
+    # the chaos harness stay lane-blind). frames_in is the codec-frame
+    # subtotal behind fwd_msgs_in (one FWD message bursts many halving
+    # frames — the shard-perf bench's GB/s-equiv numerator); retx counts
+    # go-back-N re-sends on the FWD ledger.
+    "st_shard_fwd_frames_in_total": ("counter", "codec frames applied from FWD messages (burst subtotal of st_shard_fwd_msgs_in_total)"),
+    "st_shard_fwd_retx_total": ("counter", "FWD messages re-sent byte-identical by the shard plane's go-back-N"),
     "st_shard_handoffs_total": ("counter", "shard ownership handoffs completed (counted at both endpoints)"),
     "st_shard_gather_staleness_seconds": ("histogram", "worst per-shard verified staleness per assembled gather view"),
     # per-link series (rendered via link_key)

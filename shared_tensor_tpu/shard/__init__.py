@@ -38,7 +38,13 @@ import numpy as np
 from ..config import Config
 from .gather import ShardGather
 from .map import OwnerEntry, ShardMap
-from .node import ShardFallback, ShardNode, ShardRejected, shard_enabled
+from .node import (
+    ShardBackpressure,
+    ShardFallback,
+    ShardNode,
+    ShardRejected,
+    shard_enabled,
+)
 from .state import ShardState, SliceCodec
 
 __all__ = [
@@ -48,6 +54,7 @@ __all__ = [
     "SliceCodec",
     "ShardNode",
     "ShardGather",
+    "ShardBackpressure",
     "ShardFallback",
     "ShardRejected",
     "ShardHandle",

@@ -92,10 +92,29 @@ from ..compat import (
 from ..config import Config
 from ..ops.codec_np import flatten_np
 from ..ops.table import TableFrame, make_spec
+from .engine_lane import ShardLane, shard_engine_eligible
 from .map import OwnerEntry, ShardMap
 from .state import ShardState, SliceCodec
 
 log = logging.getLogger("shared_tensor_tpu.shard")
+
+
+class ShardBackpressure(RuntimeError):
+    """add() refused: the per-target-shard outbox allocation would exceed
+    ShardConfig.outbox_limit_bytes and the overflow policy is "raise"
+    (or the "block" wait timed out). The writer is outrunning the FWD
+    plane's drain — back off, or raise the limit."""
+
+
+class _NullCounter:
+    """Stands in for a Registry counter whose value the engine lane serves
+    from the C counters ABI instead (the collector would lose to a
+    registered instrument of the same name — obs/registry.py snapshot)."""
+
+    __slots__ = ()
+
+    def inc(self, n: int = 1) -> None:
+        pass
 
 #: Go-back-N bounds, mirroring comm/peer.py's ledgered discipline: most
 #: unacked FWD messages per link (backpressure: a full window leaves mass
@@ -209,6 +228,21 @@ class ShardNode:
             )
         self.scfg = scfg
         self.state = ShardState(self.spec)
+        #: r17 engine lane: when eligible, the FWD hot loop (outbox pump,
+        #: verbatim relay, owner dedup+apply, go-back-N) runs in the
+        #: native shard plane (shard/engine_lane.py); Python keeps the
+        #: control plane. Created once the shard map exists (the plane's
+        #: slice geometry is the map's fixed partition). ST_SHARD_ENGINE=0
+        #: / ShardConfig.engine_lane=False pin the r16 python-tier plane.
+        self._lane_want = shard_engine_eligible(self.config)
+        self._lane: Optional[ShardLane] = None
+        self._lane_links: set[int] = set()
+        #: lane-mode subscriber serving: link -> [SliceCodec of the
+        #: subscribed range, conveyed values copy, owning shard]. The
+        #: residual is (current slice - conveyed) computed on demand —
+        #: error-feedback-equivalent without per-apply feeding, since the
+        #: owned slice lives in C
+        self._lane_subs: dict[int, list] = {}
         self._host = host
         self._wire_version = wire_protocol_version(self.config)
         self._codecs: dict[int, SliceCodec] = {}
@@ -228,6 +262,7 @@ class ShardNode:
         #: window restores without a just-applied seq and double-applies.
         self._dedup: dict[int, tuple[set, deque]] = {}
         self._dedup_mu = threading.Lock()
+        self._retx_total = 0
         self._claim_nonce = f"{os.getpid()}-{time.monotonic_ns()}"
         self._claim_sent_t = 0.0
         self._claim_first_t = 0.0
@@ -282,32 +317,23 @@ class ShardNode:
         self._obs_on = _obs.obs_enabled() and self.config.obs.enabled
         self._hub = _obs.hub() if self._obs_on else None
         self._reg = _obs.Registry()
-        self._m_fwd_out = self._reg.counter(
-            "st_shard_fwd_msgs_out_total",
-            help="FWD frames this node originated onto the wire",
-        )
-        self._m_fwd_in = self._reg.counter(
-            "st_shard_fwd_msgs_in_total",
-            help="FWD frames applied to an owned shard",
-        )
-        self._m_relayed = self._reg.counter(
-            "st_shard_fwd_relayed_total",
-            help="FWD frames forwarded verbatim toward their owner",
-        )
-        self._m_dedup = self._reg.counter(
-            "st_shard_fwd_dedup_total",
-            help="FWD frames discarded by the owner's (origin, fwd_seq) dedup",
-        )
-        self._m_park_drops = self._reg.counter(
-            "st_shard_park_drops_total",
-            help="parked FWD frames dropped at the park-buffer cap",
-        )
+        if self._lane_want:
+            # engine lane: the FWD counters live in the C plane and reach
+            # the registry through _collect (a registered instrument would
+            # shadow the collector's value — obs/registry.py snapshot);
+            # _ensure_lane re-registers the real instruments if plane
+            # creation later fails and the python tier takes over
+            self._m_fwd_out = _NullCounter()
+            self._m_fwd_in = _NullCounter()
+            self._m_relayed = _NullCounter()
+            self._m_dedup = _NullCounter()
+            self._m_park_drops = _NullCounter()
+            self._m_updates = _NullCounter()
+        else:
+            self._register_py_counters()
         self._m_handoffs = self._reg.counter(
             "st_shard_handoffs_total",
             help="shard ownership handoffs completed (either side)",
-        )
-        self._m_updates = self._reg.counter(
-            "st_updates_total", help="local add() calls merged"
         )
         self._reg.register_collector(self._collect)
         self._label = f"shard-{self.obs_id}"
@@ -317,6 +343,7 @@ class ShardNode:
         if self.is_master:
             words = self.spec.total // 32
             self.map = ShardMap(words, scfg.n_shards)
+            self._ensure_lane()
             if scfg.shard_index >= 0:
                 entry = OwnerEntry(
                     1, self.obs_id, self._adv_host, self.node.listen_port
@@ -349,6 +376,15 @@ class ShardNode:
         if m is None:
             raise RuntimeError("node not ready (no shard map yet)")
         flat = flatten_np(delta, self.spec, copy=False)
+        self._admit_add(flat)
+        if self._lane is not None:
+            # engine lane: ONE native call splits in-shard (exact apply)
+            # from out-of-shard (outbox deposit) under the plane's mutex
+            self._lane.add_flat(
+                np.ascontiguousarray(flat, np.float32)
+            )
+            self._wake.set()
+            return
         for k in range(m.n_shards):
             elo, ehi = m.element_range(k)
             seg = flat[elo:ehi]
@@ -365,11 +401,31 @@ class ShardNode:
         """{shard: (word_lo, word_cnt, values copy)} of the owned slices —
         a node's whole resident view. Full/partial cluster views ride
         :mod:`shared_tensor_tpu.shard.gather`."""
+        if self._lane is not None:
+            out = {}
+            for s in self.owned_shards():
+                vals = self._lane.read_shard(s)
+                if vals is not None:
+                    wlo, wcnt = self.map.word_range(s)
+                    out[s] = (wlo, wcnt, vals)
+            return out
         return self.state.snapshot_owned()
 
     def owned_shards(self) -> list[int]:
+        if self._lane is not None:
+            return [
+                s
+                for s in range(self.map.n_shards if self.map else 0)
+                if self._lane.owns(s)
+            ]
         with self.state._lock:
             return sorted(self.state.owned)
+
+    def owned_words(self) -> int:
+        """Words of the table this node currently owns (lane-blind)."""
+        if self._lane is not None:
+            return self._lane.owned_words()
+        return self.state.owned_words()
 
     def map_doc(self) -> dict:
         """The node's current shard-map document (geometry + owners)."""
@@ -396,6 +452,8 @@ class ShardNode:
     def drained(self, tol: float = 0.0) -> bool:
         """True when every outbox residual is idle AND every ledger is
         empty AND nothing is parked — this node owes the cluster nothing."""
+        if self._lane is not None:
+            return self._lane.idle(tol)
         if not self.state.outboxes_idle(tol):
             return False
         if self._parked:
@@ -415,6 +473,13 @@ class ShardNode:
 
     def alloc_bytes(self) -> int:
         """Resident f32 state bytes (the chaos harness's per-node bound)."""
+        if self._lane is not None:
+            # C-resident slices/outboxes + the python-side conveyed
+            # copies backing lane-mode subscriber serving
+            extra = sum(
+                ent[1].nbytes for ent in list(self._lane_subs.values())
+            )
+            return self._lane.alloc_bytes() + extra
         return self.state.alloc_bytes()
 
     def metrics(self) -> dict:
@@ -460,6 +525,11 @@ class ShardNode:
         self._thread.join(timeout=5.0)
         if self._hub is not None:
             self._hub.unregister_registry(self._label)
+        if self._lane is not None:
+            # the plane's threads block inside the node's queues/condvars:
+            # stop+destroy strictly BEFORE TransportNode.close (the
+            # engine/peer.py teardown ordering)
+            self._lane.destroy()
         self.node.close()
 
     def __enter__(self):
@@ -477,16 +547,32 @@ class ShardNode:
         Quiesce first (``drain()``) for an exact capture."""
         from ..utils import checkpoint as ckpt
 
-        with self._dedup_mu:
-            # one mutex covers slices AND windows (_apply_fwd commits
-            # both under it), so even a live capture can't persist a
-            # window seq whose mass missed the slice
-            owned = self.state.snapshot_owned()
-            outboxes = self.state.snapshot_outboxes()
-            dedup = {
-                str(origin): sorted(seen)
-                for origin, (seen, _fifo) in self._dedup.items()
+        if self._lane is not None:
+            # the plane captures slices + outboxes + windows under its
+            # ONE mutex (st_shard_snapshot) — same no-torn-pair contract
+            lowned, loutboxes, ldedup = self._lane.snapshot()
+            owned = {}
+            for s, vals in lowned.items():
+                wlo, wcnt = self.map.word_range(s)
+                owned[s] = (wlo, wcnt, vals)
+            outboxes = {
+                s: (self.map.word_range(s)[0], r)
+                for s, r in loutboxes.items()
             }
+            dedup = {str(o): sorted(seqs) for o, seqs in ldedup.items()}
+            fwd_seq = self._lane.fwd_seq()
+        else:
+            with self._dedup_mu:
+                # one mutex covers slices AND windows (_apply_fwd commits
+                # both under it), so even a live capture can't persist a
+                # window seq whose mass missed the slice
+                owned = self.state.snapshot_owned()
+                outboxes = self.state.snapshot_outboxes()
+                dedup = {
+                    str(origin): sorted(seen)
+                    for origin, (seen, _fifo) in self._dedup.items()
+                }
+            fwd_seq = self._fwd_seq
         if not owned and not outboxes:
             return None
         return ckpt.save_shard_state(
@@ -496,7 +582,7 @@ class ShardNode:
             owned,
             outboxes,
             dedup,
-            self._fwd_seq,
+            fwd_seq,
         )
 
     @property
@@ -530,11 +616,33 @@ class ShardNode:
     # -- observability -------------------------------------------------------
 
     def _collect(self) -> dict:
+        if self._lane is not None:
+            c = self._lane.counters()
+            return {
+                "st_shard_owned_words": self._lane.owned_words(),
+                "st_shard_alloc_bytes": self.alloc_bytes(),
+                "st_shard_routes": len(self._route),
+                "st_shard_parked_msgs": int(c[5]),
+                # engine-tier counter twins, served off the C plane's
+                # counters ABI under the SAME canonical names the python
+                # tier registers — obs.top's shard column and the chaos
+                # harness's tallies stay lane-blind
+                "st_shard_fwd_msgs_out_total": int(c[0]),
+                "st_shard_fwd_msgs_in_total": int(c[1]),
+                "st_shard_fwd_relayed_total": int(c[2]),
+                "st_shard_fwd_dedup_total": int(c[3]),
+                "st_shard_park_drops_total": int(c[4]),
+                "st_shard_fwd_frames_in_total": int(c[9]),
+                "st_shard_fwd_retx_total": int(c[6]),
+                "st_updates_total": int(c[7]),
+            }
         return {
             "st_shard_owned_words": self.state.owned_words(),
             "st_shard_alloc_bytes": self.state.alloc_bytes(),
             "st_shard_routes": len(self._route),
             "st_shard_parked_msgs": len(self._parked),
+            "st_shard_fwd_frames_in_total": self.state.applies,
+            "st_shard_fwd_retx_total": self._retx_total,
         }
 
     def _event(self, name: str, link: int = 0, arg: int = 0) -> None:
@@ -550,12 +658,153 @@ class ShardNode:
             c = self._codecs[shard] = SliceCodec(self.spec, wlo, wcnt)
         return c
 
+    def _owns(self, shard: int) -> bool:
+        """Lane-blind ownership check (control-plane call sites)."""
+        if self._lane is not None:
+            return self._lane.owns(shard)
+        return self.state.owns(shard)
+
+    # -- r17 engine lane -----------------------------------------------------
+
+    def _register_py_counters(self) -> None:
+        """The python-tier FWD plane's registry instruments — created at
+        init when the lane is ineligible, or at _ensure_lane's failure
+        fallback (the _NullCounter placeholders would otherwise silence
+        park drops and every FWD tally for the python plane's lifetime)."""
+        self._m_fwd_out = self._reg.counter(
+            "st_shard_fwd_msgs_out_total",
+            help="FWD frames this node originated onto the wire",
+        )
+        self._m_fwd_in = self._reg.counter(
+            "st_shard_fwd_msgs_in_total",
+            help="FWD frames applied to an owned shard",
+        )
+        self._m_relayed = self._reg.counter(
+            "st_shard_fwd_relayed_total",
+            help="FWD frames forwarded verbatim toward their owner",
+        )
+        self._m_dedup = self._reg.counter(
+            "st_shard_fwd_dedup_total",
+            help="FWD frames discarded by the owner's (origin, fwd_seq) dedup",
+        )
+        self._m_park_drops = self._reg.counter(
+            "st_shard_park_drops_total",
+            help="parked FWD frames dropped at the park-buffer cap",
+        )
+        self._m_updates = self._reg.counter(
+            "st_updates_total", help="local add() calls merged"
+        )
+
+    def _ensure_lane(self, newmap: Optional[ShardMap] = None) -> None:
+        """Create the native shard plane once the map exists (its slice
+        geometry is the map's fixed partition), seed it with any restored
+        dedup windows / fwd_seq, and attach every member the handshake
+        already admitted. Joiners pass the JUST-DECODED map BEFORE
+        publishing self.map: add() gates on `map is not None` from the
+        caller's thread, so the lane must exist by the time the map is
+        visible or a racing add() would deposit into the python-tier
+        outboxes nothing ever pumps. Falls back to the python-tier plane
+        (loudly, with its registry instruments restored) if creation
+        fails — never silently loses the node."""
+        m = newmap if newmap is not None else self.map
+        if not self._lane_want or self._lane is not None or m is None:
+            return
+        from ..comm.engine import _POLICY_CODE
+
+        try:
+            lane = ShardLane(
+                self.node,
+                self.spec,
+                [m.word_range(s) for s in range(m.n_shards)],
+                _POLICY_CODE[self.config.codec.scale_policy],
+                wire.frame_wire_bytes(self.spec),
+                self.config.transport.ack_timeout_sec,
+                self.config.transport.ack_retry_limit,
+                self.scfg.park_cap,
+                self.obs_id,
+            )
+        except Exception as e:
+            log.warning(
+                "engine shard lane unavailable (%s): running the "
+                "python-tier FWD plane", e,
+            )
+            self._lane_want = False
+            self._register_py_counters()
+            return
+        self._lane = lane
+        with self._dedup_mu:
+            for origin, (seen, _fifo) in self._dedup.items():
+                lane.dedup_merge(origin, seen)
+        lane.set_fwd_seq(self._fwd_seq)
+        if self._uplink is not None:
+            lane.set_uplink(self._uplink)
+        for link, m in self._members.items():
+            if lane.member_attach(link, m.tx_seq, m.rx_count):
+                self._lane_links.add(link)
+
+    def _lane_attach(self, link: int) -> None:
+        m = self._members.get(link)
+        if self._lane is not None and m is not None:
+            if self._lane.member_attach(link, m.tx_seq, m.rx_count):
+                self._lane_links.add(link)
+
+    def _admit_add(self, flat: np.ndarray) -> None:
+        """Library-side writer admission control (ROADMAP 1(d)): with
+        ShardConfig.outbox_limit_bytes set, an add() whose out-of-shard
+        deposits would push resident outbox bytes past the limit BLOCKS
+        until the FWD plane drains room (or raises, per outbox_overflow).
+        The projection is conservative at slice granularity: each target
+        shard of this delta counts one full outbox slice, whether or not
+        one is already allocated."""
+        limit = self.scfg.outbox_limit_bytes
+        if limit <= 0:
+            return
+        m = self.map
+        need = 0
+        for k in range(m.n_shards):
+            elo, ehi = m.element_range(k)
+            if not np.any(flat[elo:ehi]):
+                continue
+            if self._lane is not None:
+                owned = self._lane.owns(k)
+            else:
+                owned = self.state.owns(k)
+            if not owned:
+                need += (ehi - elo) * 4
+        if need == 0:
+            return
+        outbox_bytes = (
+            self._lane.outbox_bytes
+            if self._lane is not None
+            else self.state.outbox_bytes
+        )
+        if outbox_bytes() + need <= limit:
+            return
+        if self.scfg.outbox_overflow == "raise":
+            raise ShardBackpressure(
+                f"outbox {outbox_bytes()} B + {need} B new > "
+                f"limit {limit} B"
+            )
+        deadline = time.monotonic() + self.scfg.outbox_block_timeout_sec
+        while time.monotonic() < deadline:
+            if outbox_bytes() + need <= limit:
+                return
+            self._wake.set()
+            time.sleep(0.002)
+        raise ShardBackpressure(
+            f"outbox stayed over {limit} B for "
+            f"{self.scfg.outbox_block_timeout_sec}s (link stalled?)"
+        )
+
     def _restore_pending_outboxes(self) -> None:
         """Re-seat checkpointed outbox residuals once the map exists
         (their geometry needs the shard ranges). Outboxes toward shards
         we end up owning fold at adopt time instead."""
         for s, (_wlo, resid) in list(self._restore_outboxes.items()):
-            if not self.state.owns(s):
+            if self._lane is not None:
+                if not self._lane.owns(s):
+                    self._lane.restore_outbox(s, resid)
+            elif not self.state.owns(s):
                 self.state.restore_outbox(s, self._codec(s), resid)
             self._restore_outboxes.pop(s, None)
 
@@ -563,7 +812,10 @@ class ShardNode:
         wlo, wcnt = self.map.word_range(shard)
         rest = self._restored.pop(shard, None)
         vals = rest[2] if rest is not None else None
-        self.state.adopt(shard, wlo, wcnt, vals)
+        if self._lane is not None:
+            self._lane.adopt(shard, vals)
+        else:
+            self.state.adopt(shard, wlo, wcnt, vals)
         self._route.pop(shard, None)
         self._event("shard_adopt", arg=shard)
 
@@ -574,7 +826,10 @@ class ShardNode:
         values — silently-stale verified reads, the exact failure the
         serving tier refuses. A dropped link makes the subscriber
         resync/redial against the new owner."""
-        released = self.state.release(shard)
+        if self._lane is not None:
+            released = self._lane.release(shard)
+        else:
+            released = self.state.release(shard)
         if released is None or self.map is None:
             return released
         wlo, wcnt = self.map.word_range(shard)
@@ -582,6 +837,11 @@ class ShardNode:
             if wlo <= sub.wlo < wlo + wcnt:
                 self._subs.pop(l, None)
                 self.state.drop_sub(l)
+                self.node.drop_link(l)
+        for l, ent in list(self._lane_subs.items()):
+            if ent[2] == shard:
+                self._lane_subs.pop(l, None)
+                self._subs.pop(l, None)
                 self.node.drop_link(l)
         return released
 
@@ -808,6 +1068,7 @@ class ShardNode:
                 self.node.drop_link(link)  # LINK_DOWN re-routes the ledger
                 continue
             m.progress_t = now
+            self._retx_total += min(len(m.unacked), RETX_PREFIX)
             for seq, buf, _t in m.unacked[:RETX_PREFIX]:
                 self._send_raw(link, buf)
 
@@ -833,7 +1094,10 @@ class ShardNode:
         words = self.spec.total // 32
         wlo, wcnt = rng if rng is not None else (0, words)
         try:
-            seed = self.state.attach_sub(link, wlo, wcnt)
+            if self._lane is not None:
+                seed = self._lane_attach_sub(link, wlo, wcnt)
+            else:
+                seed = self.state.attach_sub(link, wlo, wcnt)
         except ValueError as e:
             self._send_ctrl(link, wire.encode_reject(
                 f"{e} (a sharded owner serves subscriptions only within "
@@ -851,6 +1115,64 @@ class ShardNode:
         )
         self._event("sub_attach", link, wcnt)
 
+    def _lane_attach_sub(self, link: int, wlo: int, wcnt: int) -> np.ndarray:
+        """Lane-mode subscriber attach: the owned slice lives in C, so the
+        serve-tier residual is tracked as (current - conveyed) instead of
+        per-apply feeding — error-feedback-equivalent and self-correcting
+        (the quantize ladder drains the DIFFERENCE, whatever path the
+        slice took). Returns the seed snapshot; raises ValueError when no
+        owned shard covers the range (the REJECT path)."""
+        for s in self.owned_shards():
+            swlo, swcnt = self.map.word_range(s)
+            if swlo <= wlo and wlo + wcnt <= swlo + swcnt:
+                vals = self._lane.read_shard(s)
+                if vals is None:
+                    break
+                i0 = (wlo - swlo) * 32
+                seed = vals[i0:i0 + wcnt * 32].copy()
+                sc = SliceCodec(self.spec, wlo, wcnt)
+                self._lane_subs[link] = [sc, seed.copy(), s]
+                return seed
+        raise ValueError(
+            f"subscription [{wlo}, {wlo + wcnt}) not within any owned shard"
+        )
+
+    def _lane_sub_frame(self, link: int):
+        """One RDATA frame off a lane-mode subscriber's conveyed-diff
+        residual (None = idle/unknown), plus idle bookkeeping."""
+        ent = self._lane_subs.get(link)
+        if ent is None:
+            return None
+        sc, conveyed, shard = ent
+        vals = self._lane.read_shard(shard)
+        if vals is None:
+            return None
+        swlo, _sw = self.map.word_range(shard)
+        i0 = (sc.word_lo - swlo) * 32
+        cur = vals[i0:i0 + sc.n_el]
+        r = cur - conveyed
+        if not np.any(r):
+            return None
+        scales, words, new_r = sc.quantize(
+            r, self.config.codec.scale_policy
+        )
+        if not scales.any():
+            return None
+        ent[1] = cur - new_r  # conveyed advances by exactly what shipped
+        return scales, words, sc.word_lo, sc.word_cnt
+
+    def _lane_sub_idle(self, link: int) -> bool:
+        ent = self._lane_subs.get(link)
+        if ent is None:
+            return True
+        sc, conveyed, shard = ent
+        vals = self._lane.read_shard(shard)
+        if vals is None:
+            return True
+        swlo, _sw = self.map.word_range(shard)
+        i0 = (sc.word_lo - swlo) * 32
+        return bool(np.array_equal(vals[i0:i0 + sc.n_el], conveyed))
+
     def _pump_subs(self) -> None:
         fresh_iv = self.config.serve.fresh_interval_sec
         now = time.monotonic()
@@ -860,7 +1182,12 @@ class ShardNode:
                 # (the residual was already debited) — don't even
                 # quantize until there is room
                 continue
-            out = self.state.sub_frame(link, self.config.codec.scale_policy)
+            if self._lane is not None:
+                out = self._lane_sub_frame(link)
+            else:
+                out = self.state.sub_frame(
+                    link, self.config.codec.scale_policy
+                )
             if out is not None:
                 scales, words, wlo, wcnt = out
                 sub.tx_seq += 1
@@ -881,7 +1208,11 @@ class ShardNode:
                 except BrokenPipeError:
                     continue
             elif (
-                self.state.sub_idle(link)
+                (
+                    self._lane_sub_idle(link)
+                    if self._lane is not None
+                    else self.state.sub_idle(link)
+                )
                 and now - sub.last_fresh_t >= fresh_iv
             ):
                 sub.last_fresh_t = now
@@ -903,11 +1234,26 @@ class ShardNode:
         up = self._uplink
         send_dedup = True
         for shard in list(wanted):
-            ent = self.state.owned_entry(shard)
-            if ent is None:
-                wanted.remove(shard)
-                continue
-            c, vals = ent
+            if self._lane is not None:
+                # conservation across the capture/send window: the C
+                # receiver applies CONCURRENTLY with this thread (the
+                # python tier is safe by its single loop thread), so the
+                # relay-onward flag must be up BEFORE the slice is read —
+                # a frame applied after the read would die with the
+                # released slice (spec_shard's apply_during_handoff)
+                self._lane.set_handoff(shard, True)
+                vals = self._lane.read_shard(shard)
+                if vals is None:
+                    self._lane.set_handoff(shard, False)
+                    wanted.remove(shard)
+                    continue
+                c = self._codec(shard)
+            else:
+                ent = self.state.owned_entry(shard)
+                if ent is None:
+                    wanted.remove(shard)
+                    continue
+                c, vals = ent
             epoch = self.map.owners[shard].epoch + 1
             ok = self._send_ctrl(up, wire.encode_shard({
                 "t": "ho_meta", "shard": shard, "word_lo": c.word_lo,
@@ -933,11 +1279,19 @@ class ShardNode:
             # at that shard's ho_done, before any adopted slice can see
             # a replayed frame
             if ok and send_dedup:
-                with self._dedup_mu:
+                if self._lane is not None:
+                    # windows alone — the full snapshot would copy every
+                    # owned slice under the plane mutex just to discard it
                     windows = {
-                        int(origin): sorted(seen)
-                        for origin, (seen, _fifo) in self._dedup.items()
+                        int(o): sorted(seqs)
+                        for o, seqs in self._lane.dedup_windows().items()
                     }
+                else:
+                    with self._dedup_mu:
+                        windows = {
+                            int(origin): sorted(seen)
+                            for origin, (seen, _fifo) in self._dedup.items()
+                        }
                 for origin, seqs in windows.items():
                     for off in range(0, len(seqs), 4096):
                         if not ok:
@@ -962,6 +1316,10 @@ class ShardNode:
                     "shard %d handoff send bounced; retrying next pass",
                     shard,
                 )
+                if self._lane is not None:
+                    # resume local ownership: frames relayed upstream in
+                    # the window self-heal (routes still point here)
+                    self._lane.set_handoff(shard, False)
                 return
             send_dedup = False
             self._ho_sent.add(shard)
@@ -996,17 +1354,22 @@ class ShardNode:
             if st is None:
                 return
             vals = np.frombuffer(bytes(st["buf"]), "<f4").copy()
-            self.state.adopt(shard, st["word_lo"], st["word_cnt"], vals)
-            for origin, seqs in st["dedup"].items():
-                with self._dedup_mu:
-                    seen, fifo = self._dedup.setdefault(
-                        origin, (set(), deque())
-                    )
-                    merged = sorted(set(seqs) | seen)[-DEDUP_WINDOW:]
-                    seen.clear()
-                    seen.update(merged)
-                    fifo.clear()
-                    fifo.extend(merged)
+            if self._lane is not None:
+                self._lane.adopt(shard, vals)
+                for origin, seqs in st["dedup"].items():
+                    self._lane.dedup_merge(origin, seqs)
+            else:
+                self.state.adopt(shard, st["word_lo"], st["word_cnt"], vals)
+                for origin, seqs in st["dedup"].items():
+                    with self._dedup_mu:
+                        seen, fifo = self._dedup.setdefault(
+                            origin, (set(), deque())
+                        )
+                        merged = sorted(set(seqs) | seen)[-DEDUP_WINDOW:]
+                        seen.clear()
+                        seen.update(merged)
+                        fifo.clear()
+                        fifo.extend(merged)
             entry = OwnerEntry(
                 st["epoch"], self.obs_id, self._adv_host, self.node.listen_port
             )
@@ -1038,7 +1401,13 @@ class ShardNode:
         if t == "map":
             changed = False
             if self.map is None:
-                self.map = ShardMap.from_doc(doc["map"])
+                newmap = ShardMap.from_doc(doc["map"])
+                # lane BEFORE the map publishes: add() gates on the map
+                # from the caller's thread, and a delta deposited into
+                # the python-tier outboxes in the gap would be stranded
+                # (lane mode never pumps them)
+                self._ensure_lane(newmap)
+                self.map = newmap
                 self._restore_pending_outboxes()
                 changed = True
             else:
@@ -1073,12 +1442,12 @@ class ShardNode:
                 else entry
             )
             if cur is not None and cur.owner == self.obs_id:
-                if not self.state.owns(shard):
+                if not self._owns(shard):
                     self._adopt(shard)
                     self._announce_owned()
                 self._granted.set()
                 self._ready.set()
-            elif cur is not None and self.state.owns(shard):
+            elif cur is not None and self._owns(shard):
                 # a takeover re-granted our shard elsewhere (we were
                 # presumed dead): release — exactly-one-owner wins
                 self._release_owned(shard)
@@ -1097,7 +1466,7 @@ class ShardNode:
             owner = int(doc["owner"])
             if owner == self.obs_id:
                 return
-            if self.state.owns(shard):
+            if self._owns(shard):
                 my_e = self.map.owners[shard].epoch if self.map else 0
                 if epoch > my_e:
                     self._release_owned(shard)
@@ -1109,6 +1478,8 @@ class ShardNode:
                 return
             self._route[shard] = link
             self._route_epoch[shard] = epoch
+            if self._lane is not None:
+                self._lane.set_route(shard, link)
             # ALWAYS re-flood (tree: flood-except-arrival terminates; no
             # cycles, no storm): an epoch-gated forward would starve any
             # node whose route a link death purged — its neighbors, still
@@ -1137,7 +1508,7 @@ class ShardNode:
                 cur.epoch + 1, claimer, str(doc["host"]), int(doc["port"])
             )
             self.map.merge_entry(shard, entry)
-            if self.state.owns(shard) and claimer != self.obs_id:
+            if self._owns(shard) and claimer != self.obs_id:
                 self._release_owned(shard)
             self._flood_shard({
                 "t": "grant", "shard": shard, "e": entry.as_doc(),
@@ -1200,6 +1571,7 @@ class ShardNode:
         any data), then our route announces so its reverse paths exist."""
         self._send_ctrl(link, wire.encode_welcome(SYNC_FLAG_SHARD))
         self._members[link] = _Member()
+        self._lane_attach(link)
         self._send_ctrl(
             link,
             wire.encode_shard({
@@ -1210,7 +1582,7 @@ class ShardNode:
         # routes we LEARNED (owners elsewhere) propagate to the new child,
         # so its reverse paths exist before its first out-of-shard write
         for shard in sorted(self._route):
-            if not self.state.owns(shard):
+            if not self._owns(shard):
                 e = self.map.owners[shard]
                 if e.epoch > 0:
                     self._send_ctrl(link, wire.encode_shard({
@@ -1236,6 +1608,11 @@ class ShardNode:
     def _on_message(self, link: int, payload: bytes) -> None:
         kind = payload[0]
         if kind == wire.FWD:
+            if self._lane is not None:
+                # a stray consumed in the attach race window: drop
+                # unacked — the sender's go-back-N re-delivers into the
+                # plane's receiver (its rx_count never saw this seq)
+                return
             m = self._members.get(link)
             if m is None:
                 return  # not a member link (mid-handshake stray)
@@ -1309,6 +1686,7 @@ class ShardNode:
                 self._ready.set()
                 return
             self._members[link] = _Member()
+            self._lane_attach(link)  # lane exists on a re-grafted member
             # map + claim follow (the parent sends its map right behind);
             # a RE-GRAFTED member re-announces its shards so the new
             # subtree's routes point here again
@@ -1360,6 +1738,14 @@ class ShardNode:
 
     def _on_link_down(self, link: int, is_uplink: bool) -> None:
         m = self._members.pop(link, None)
+        if self._lane is not None and link in self._lane_links:
+            # the plane re-dispatches every unacked FWD under its
+            # unchanged end-to-end identity (apply/relay/park) — the
+            # python redispatch below is the non-lane twin
+            self._lane.member_detach(link)
+            self._lane_links.discard(link)
+            m = None
+        self._lane_subs.pop(link, None)
         self._subs.pop(link, None)
         self.state.drop_sub(link)
         self._pending.pop(link, None)
@@ -1378,6 +1764,10 @@ class ShardNode:
             del self._route[shard]
         if is_uplink:
             self._uplink = None
+            if self._lane is not None:
+                self._lane.set_uplink(None)
+                for s in list(self._ho_sent):
+                    self._lane.set_handoff(s, False)
             # un-acked outgoing handoffs: the successor may never have
             # adopted — we still hold the slice (release only happens on
             # ho_ack), so resume local applies; if the successor DID
@@ -1400,6 +1790,8 @@ class ShardNode:
             if ev.kind == EventKind.LINK_UP:
                 if ev.is_uplink:
                     self._uplink = ev.link_id
+                    if self._lane is not None:
+                        self._lane.set_uplink(ev.link_id)
                     self._start_join(ev.link_id)
                 # children speak first (SYNC); nothing to do yet
             elif ev.kind == EventKind.LINK_DOWN:
@@ -1410,6 +1802,8 @@ class ShardNode:
                 # the authority state; exactly-one-owner is preserved
                 # because only the CURRENT root arbitrates)
                 self._uplink = None
+                if self._lane is not None:
+                    self._lane.set_uplink(None)
                 self.is_master = True
             elif ev.kind == EventKind.REJOIN_FAILED:
                 self._error = ConnectionError("rejoin failed (tree gone)")
@@ -1458,6 +1852,8 @@ class ShardNode:
         while not self._stop.is_set():
             busy = self._handle_events()
             for link in list(self.node.links or ()):
+                if link in self._lane_links:
+                    continue  # the plane's receiver thread consumes these
                 for _ in range(256):
                     try:
                         payload = self.node.recv(link, timeout=0.0)
@@ -1470,11 +1866,29 @@ class ShardNode:
                         self._on_message(link, payload)
                     except Exception as e:
                         log.warning("dropping bad message: %s", e)
-            self._flush_acks()
-            self._unpark()  # frames parked on a full window retry here
-            self._pump_outboxes()
+                    if link in self._lane_links:
+                        # _ensure_lane attached this link mid-drain (the
+                        # map just landed): the plane's receiver owns the
+                        # stream from here
+                        break
+            if self._lane is not None:
+                # control-plane messages the plane deferred (it owns only
+                # FWD/ACK on member links — the engine/peer.py split)
+                while True:
+                    c = self._lane.poll_ctrl()
+                    if c is None:
+                        break
+                    busy = True
+                    try:
+                        self._on_message(c[0], c[1])
+                    except Exception as e:
+                        log.warning("dropping bad ctrl message: %s", e)
+            else:
+                self._flush_acks()
+                self._unpark()  # frames parked on a full window retry here
+                self._pump_outboxes()
+                self._check_retransmit()
             self._pump_subs()
-            self._check_retransmit()
             self._run_handoffs()
             self._maybe_claim()
             now = time.monotonic()

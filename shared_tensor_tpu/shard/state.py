@@ -76,39 +76,39 @@ class SliceCodec:
     def zeros(self) -> np.ndarray:
         return np.zeros(self.n_el, np.float32)
 
-    def quantize(
+    def measure(
         self,
         resid: np.ndarray,
         policy: ScalePolicy = ScalePolicy.POW2_RMS,
-    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """One sender step: (scales f32[L] — zero outside the range's
-        leaves, words u32[word_cnt], new_resid). All-zero scales = idle
-        (nothing the codec can express; residual returned unchanged).
-        Scale per leaf segment follows the main codec's policy (POW2_RMS
-        default) over the segment's LIVE elements; like the main codec,
-        subnormal rms pow2-floors to 0, so residual dust below ~1.2e-38
-        reads as idle — the documented drain caveat."""
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Per-segment scale measurement: (scales f32[L] — zero outside
+        the range's leaves, amax f32[L]). Reductions accumulate EXACT f64
+        products (f32->f64 squares are exact, so the only inexactness is
+        the accumulation order) — the engine-tier twin (stengine.cpp
+        slice_measure) sums the same doubles with interleaved
+        accumulators, and the f32-cast results agree to the last bit in
+        practice (the parity test pins it on shared random state). Like
+        the main codec, subnormal rms pow2-floors to 0, so residual dust
+        below ~1.2e-38 reads as idle — the documented drain caveat."""
         L = self.spec.num_leaves
         scales = np.zeros(L, np.float32)
+        amaxes = np.zeros(L, np.float32)
         for g, i0, i1, n_live in self.segments:
             if n_live <= 0:
                 continue
             seg = resid[i0:i1]
-            amax = float(np.max(np.abs(seg)))
+            amax = np.float32(np.max(np.abs(seg)))
             if not (amax > 0) or not np.isfinite(amax):
                 continue
-            norm = seg.astype(np.float32) / np.float32(amax)
+            amaxes[g] = amax
+            seg64 = seg.astype(np.float64)
             if policy == ScalePolicy.ABS_MEAN:
-                s = np.float32(amax) * np.float32(
-                    np.sum(np.abs(norm), dtype=np.float32)
-                    / np.float32(n_live)
+                s = np.float32(
+                    np.sum(np.abs(seg64)) / np.float32(n_live)
                 )
             else:
-                rms = np.float32(amax) * np.float32(
-                    np.sqrt(
-                        np.sum(norm * norm, dtype=np.float32)
-                        / np.float32(n_live)
-                    )
+                rms = np.float32(
+                    np.sqrt(np.sum(seg64 * seg64) / np.float32(n_live))
                 )
                 s = (
                     _pow2_floor_np(rms)[()]
@@ -116,16 +116,76 @@ class SliceCodec:
                     else rms
                 )
             scales[g] = s if np.isfinite(s) else 0.0
-        if not scales.any():
-            return scales, np.zeros(self.word_cnt, np.uint32), resid
+        return scales, amaxes
+
+    def quantize_at(
+        self, resid: np.ndarray, scales: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Pack + error-feedback one frame at a GIVEN scale row (the
+        cascade rung): (words u32[word_cnt], new_resid). The caller owns
+        the schedule; an all-zero row is the caller's stop condition.
+        Padding lanes pack as 0 bits (r17: the stcodec cascade-kernel
+        convention the engine lane rides — receivers mask by ``live``
+        either way, so only the parity bytes care)."""
         s_el = scales[self.leaf_of] * self.live
-        neg = resid <= 0
+        neg = (resid <= 0) & (self.live > 0)
         words = (
             np.packbits(neg, bitorder="little").view("<u4").astype(np.uint32)
         )
         sent = np.where(neg, -s_el, s_el)
         new_r = np.where(s_el > 0, resid - sent, resid).astype(np.float32)
         new_r *= self.live  # padding stays exactly 0
+        return words, new_r
+
+    def cascade_rows(
+        self, scales: np.ndarray, amaxes: np.ndarray, k: int
+    ) -> list[np.ndarray]:
+        """The r11 cascade schedule restricted to this slice: frame 0's
+        row anchors each segment at max(policy scale, pow2_floor(amax))
+        — the amax anchor is what drains OUTLIERS geometrically (the r11
+        engine note: an rms-anchored ladder starves the gaussian tail) —
+        and rows 1..k-1 halve, +8 refinement rungs below the measured
+        scale (finer lattice for the next message's measured frame to
+        terminate on), stopping at the subnormal floor. Exponent math is
+        integer (f32 bit fields), so the engine twin is bit-identical."""
+        if not scales.any():
+            return []
+        tops = np.where(scales > 0, _pow2_floor_np(amaxes), 0.0).astype(
+            np.float32
+        )
+        row0 = np.maximum(scales, tops).astype(np.float32)
+        # ladder depth: binades from the anchor down to the measured
+        # scale (+1), +8 refinement; collapses to 1 when anchor == scale
+        exp = lambda x: (  # noqa: E731 — biased f32 exponents, vectorized
+            ((np.asarray(x, np.float32).view(np.uint32) >> 23) & 0xFF)
+            .astype(np.int64)
+        )
+        nz = scales > 0
+        d = int(np.max(np.where(nz, exp(tops) - exp(scales), 0), initial=0))
+        maxd = d + 1 + (8 if d > 0 else 0)
+        rows = []
+        row = row0
+        for j in range(min(max(1, k), maxd)):
+            if j > 0:
+                row = (row * np.float32(0.5)).astype(np.float32)
+                if not row.any():
+                    break  # halved into the subnormal floor
+            rows.append(row)
+        return rows
+
+    def quantize(
+        self,
+        resid: np.ndarray,
+        policy: ScalePolicy = ScalePolicy.POW2_RMS,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One measured sender step (the serve-tier shape): (scales,
+        words, new_resid); all-zero scales = idle (residual returned
+        unchanged). The FWD outbox drain uses measure + cascade_rows +
+        quantize_at instead — one measurement per message."""
+        scales, _amax = self.measure(resid, policy)
+        if not scales.any():
+            return scales, np.zeros(self.word_cnt, np.uint32), resid
+        words, new_r = self.quantize_at(resid, scales)
         return scales, words, new_r
 
     def apply(
@@ -316,24 +376,27 @@ class ShardState:
     def drain_outbox_frames(
         self, shard: int, policy: ScalePolicy, k: int = 1
     ) -> Optional[tuple[list, int]]:
-        """Quantize up to ``k`` successive halving frames off a shard's
-        outbox (error feedback applied per frame — the r07 burst shape:
-        the sign codec's drain ladder needs ~log2(mass/dust) frames no
-        matter the pacing, so shipping k per message divides the message
-        count a lossy hop must carry). Returns ([(scales, words), ...],
-        word_lo) with 1..k frames, or None when idle — an idle outbox is
-        FREED (the transient-memory contract)."""
+        """Quantize up to ``k`` cascade frames off a shard's outbox: ONE
+        scale measurement per message, then the halving schedule
+        (SliceCodec.cascade_rows — frame 0 amax-anchored, +8 refinement
+        rungs), error feedback applied per frame. The r11 discipline the
+        engine lane rides at native speed — per-frame re-measurement was
+        the python plane's measured wall (a division per element per
+        frame), and the measured sequence converges to the halving
+        schedule anyway. Returns ([(scales, words), ...], word_lo) with
+        1..k frames, or None when idle — an idle outbox is FREED (the
+        transient-memory contract)."""
         with self._lock:
             ob = self.outbox.get(shard)
             if ob is None:
                 return None
             c, r = ob
+            scales, amaxes = c.measure(r, policy)
+            rows = c.cascade_rows(scales, amaxes, k)
             frames = []
-            for _ in range(max(1, k)):
-                scales, words, r = c.quantize(r, policy)
-                if not scales.any():
-                    break
-                frames.append((scales, words))
+            for row in rows:
+                words, r = c.quantize_at(r, row)
+                frames.append((row, words))
             if not frames:
                 self.outbox.pop(shard, None)  # drained to dust: free it
                 return None
@@ -458,6 +521,12 @@ class ShardState:
             return {
                 k: (c.word_lo, r.copy()) for k, (c, r) in self.outbox.items()
             }
+
+    def outbox_bytes(self) -> int:
+        """Resident outbox residual bytes (the r17 admission-control
+        gauge — ShardConfig.outbox_limit_bytes bounds it)."""
+        with self._lock:
+            return sum(r.nbytes for _, r in self.outbox.values())
 
     def outboxes_idle(self, tol: float = 0.0) -> bool:
         with self._lock:

@@ -117,10 +117,10 @@ def test_mutation_counterexamples_replay():
 
 
 def test_model_artifact_matches_checker():
-    """MODEL_r16.json pins the explored state/transition counts; a spec
+    """MODEL_r17.json pins the explored state/transition counts; a spec
     edit that changes the graph must re-commit the artifact, not drift
     silently."""
-    path = REPO / "MODEL_r16.json"
+    path = REPO / "MODEL_r17.json"
     doc = json.loads(path.read_text())
     assert doc["pass"] is True
     for name, cls in all_specs().items():
@@ -129,7 +129,7 @@ def test_model_artifact_matches_checker():
         assert (pinned["states"], pinned["transitions"]) == (
             res.states,
             res.transitions,
-        ), f"{name}: MODEL_r16.json is stale — re-run run_check.py"
+        ), f"{name}: MODEL_r17.json is stale — re-run run_check.py"
         assert pinned["violations"] == []
         assert pinned["quiescent_reachable"] is True
     for key in _mutation_keys():

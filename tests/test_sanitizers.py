@@ -442,6 +442,40 @@ def test_chaos_soak_native_arm_under_asan_ubsan():
 
 
 @pytest.mark.slow
+def test_shard_engine_suite_under_asan_ubsan():
+    """r17 satellite: the engine-tier shard plane is new concurrent
+    native code on the hot path — two plane threads sharing a TxSlot
+    ring with ownership-transferred rx buffers (st_node_recv_take),
+    in-place seq re-stamps on relayed frames, and fused cascade/apply
+    kernels over synthetic slice layouts. Run the engine-lane test file
+    (kernel parity, dedup/relay crafted members, mixed-lane interop,
+    admission control) under ASan+UBSan so a lifetime or aliasing bug in
+    the zero-copy relay path turns the suite red, not production."""
+    asan = _runtime("libasan.so")
+    ubsan = _runtime("libubsan.so")
+    if asan is None or ubsan is None:
+        pytest.skip("gcc sanitizer runtimes unavailable")
+    build = subprocess.run(
+        ["make", "-C", str(NATIVE), "sanitize"],
+        capture_output=True, text=True, timeout=300,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"sanitize build failed: {build.stderr[-500:]}")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "tests/test_shard_engine.py",
+            "-q", "-p", "no:cacheprovider",
+        ],
+        env=_san_env(asan, ubsan), capture_output=True, text=True,
+        timeout=540, cwd=str(REPO),
+    )
+    err_tail = proc.stderr[-4000:]
+    assert "AddressSanitizer" not in proc.stderr, err_tail
+    assert "runtime error:" not in proc.stderr, err_tail  # UBSan findings
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-2000:], err_tail)
+
+
+@pytest.mark.slow
 def test_shard_suite_under_asan_ubsan():
     """r16 satellite: the cluster-sharded tensor pushes a NEW data kind
     (wire.FWD, 21-byte header + k variable-size frames) through the

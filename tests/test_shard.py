@@ -219,8 +219,8 @@ def test_map_negotiation_and_owner_routed_convergence():
         full = TOTAL * 4
         for h in handles:
             assert h.node.alloc_bytes() < full // 2
-            assert h.node.state.owned_words() < WORDS
-        assert sum(h.node.state.owned_words() for h in handles) == WORDS
+            assert h.node.owned_words() < WORDS
+        assert sum(h.node.owned_words() for h in handles) == WORDS
         _gather_matches(handles[0].node, ref)
         # owner routing actually relayed (leaf->leaf crosses the master)
         relayed = sum(

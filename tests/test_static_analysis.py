@@ -41,6 +41,7 @@ _LINT_INPUTS = [
     "shared_tensor_tpu/obs/events.py",
     "shared_tensor_tpu/obs/schema.py",
     "shared_tensor_tpu/shard/node.py",
+    "shared_tensor_tpu/shard/engine_lane.py",
 ]
 
 
@@ -163,6 +164,56 @@ def test_abi_lint_flags_shard_queue_depth_drift(tmp_path):
           "QUEUE_DEPTH = 8", "QUEUE_DEPTH = 4")
     findings = lint_abi.run(root)
     assert any("queue-depth drift" in f for f in findings), findings
+
+
+def test_wire_lint_flags_shard_fwd_kind_drift(tmp_path):
+    # r17: the engine-tier shard plane re-declares wire.FWD as kFwd — a
+    # renumbered kind makes the native receiver treat every FWD as an
+    # unknown control message (whole data plane deferred to Python)
+    root = _seed_tree(tmp_path)
+    _edit(root, "native/stengine.cpp",
+          "constexpr uint8_t kFwd = 17;", "constexpr uint8_t kFwd = 18;")
+    findings = lint_wire.run(root)
+    assert any("kFwd" in f and "FWD" in f for f in findings), findings
+
+
+def test_wire_lint_flags_shard_fwd_header_drift(tmp_path):
+    # r17: kFwdHdr is the verbatim relay's restamp geometry — a size
+    # drift shifts the re-stamped seq into the word_lo field
+    root = _seed_tree(tmp_path)
+    _edit(root, "native/stengine.cpp",
+          "constexpr size_t kFwdHdr = 21;", "constexpr size_t kFwdHdr = 25;")
+    findings = lint_wire.run(root)
+    assert any("kFwdHdr" in f for f in findings), findings
+
+
+def test_abi_lint_flags_shard_counter_width_drift(tmp_path):
+    # r17: the st_shard_counters out-array widening class (the exact
+    # st_engine_counters 8->22 history, now on the shard plane's ABI):
+    # a python buffer narrower than the native out14 promise reads
+    # garbage past the allocation
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/shard/engine_lane.py",
+          "out = np.zeros(14, np.uint64)", "out = np.zeros(12, np.uint64)")
+    findings = lint_abi.run(root)
+    assert any("st_shard_counters" in f and "14" in f
+               for f in findings), findings
+
+
+def test_abi_lint_flags_shard_abi_signature_drift(tmp_path):
+    # r17: a dropped argtypes parameter on the shard ABI reads stack
+    # garbage (the silent-mismatch class the lint exists for)
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/shard/engine_lane.py",
+          "lib.st_shard_member_attach.argtypes = [\n"
+          "        ctypes.c_void_p, ctypes.c_int32, ctypes.c_uint64, ctypes.c_uint64,\n"
+          "    ]",
+          "lib.st_shard_member_attach.argtypes = [\n"
+          "        ctypes.c_void_p, ctypes.c_int32, ctypes.c_uint64,\n"
+          "    ]")
+    findings = lint_abi.run(root)
+    assert any("st_shard_member_attach" in f and "count" in f
+               for f in findings), findings
 
 
 def test_wire_lint_flags_v3_header_drift(tmp_path):

@@ -169,6 +169,7 @@ _PY_KIND = {
     "ctypes.c_double": "f64",
     "ctypes.c_int": "i32",
     "_f32p": "ptr:float",
+    "_u8p": "ptr:char",
     "_u32p": "ptr:uint32",
     "_u64p": "ptr:uint64",
     "_i32p": "ptr:int32",
@@ -287,6 +288,12 @@ def run(repo: pathlib.Path) -> list[str]:
         ),
         "comm/transport.py": L.strip_py_comments(
             L.read(repo, "shared_tensor_tpu/comm/transport.py")
+        ),
+        # r17: the shard plane's ctypes surface (st_shard_*/st_slice_*)
+        # — the st_shard_counters out14 widening class is checked by the
+        # same outN rule as st_engine_counters
+        "shard/engine_lane.py": L.strip_py_comments(
+            L.read(repo, "shared_tensor_tpu/shard/engine_lane.py")
         ),
     }
     py: dict[str, dict] = {}

@@ -49,6 +49,11 @@ NATIVE_TO_WIRE = {
     # every exact-length framing test on the other tier rejects the
     # message as undecodable (the burst_wire_bytes failure class)
     "kHdrV3": "HDR_V3",
+    # r17: the engine-tier shard plane speaks wire.FWD natively — a kind
+    # or header-size drift desyncs the verbatim-relay restamp offset and
+    # every decode_fwd length check between the two lanes
+    "kFwd": "FWD",
+    "kFwdHdr": "FWD_HDR",
 }
 
 #: sttransport.cpp constants with wire.py twins (r14 satellite): the

@@ -29,7 +29,7 @@ protocol class, three ways:
    reachable. Each spec also carries MUTATIONS encoding the three
    historical bugs; ``run_check.py`` asserts the explorer finds every
    mutation within the bound and none on the true specs, and commits
-   the state/transition counts as MODEL_r16.json.
+   the state/transition counts as MODEL_r17.json.
 
 3. **Conformance** (``conformance.py``): the same specs replayed as
    trace ACCEPTORS over real flight-recorder timelines (obs/recorder),

@@ -43,7 +43,7 @@ class Spec:
 
     - ``name``: artifact/report key;
     - ``depth_bound``: BFS depth the checker explores to (committed in
-      MODEL_r16.json — "verified to depth D" is the honest claim);
+      MODEL_r17.json — "verified to depth D" is the honest claim);
     - ``mutations``: mutation name -> the historical bug it seeds
       (constructed via ``Spec(mutation=name)``).
     """

@@ -8,11 +8,11 @@ ordering mutation) are each FOUND within the same bound.
 Exit 0 iff every TRUE spec explores clean (zero violations, quiescence
 reachable, not truncated by the state backstop) AND every mutation is
 caught. Writes the state/transition counts as the round's MODEL
-artifact (default MODEL_r16.json) — the committed artifact pins the
+artifact (default MODEL_r17.json) — the committed artifact pins the
 exact counts, so a spec edit that silently changes the explored space
 shows up as a diff, not a mystery.
 
-Usage: python tools/protospec/run_check.py [--out MODEL_r16.json]
+Usage: python tools/protospec/run_check.py [--out MODEL_r17.json]
 """
 
 from __future__ import annotations

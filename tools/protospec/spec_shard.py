@@ -249,4 +249,140 @@ class ShardSpec(Spec):
         )
 
 
-SPECS = [ShardSpec]
+class EngineShardState(NamedTuple):
+    """ShardState + the r17 engine-lane fields (appended so the base
+    spec's field accesses and _replace calls keep working)."""
+
+    prod: int
+    chan_ab: frozenset
+    led_bc: frozenset
+    chan_bc: frozenset
+    applied_c: frozenset
+    dedup_c: frozenset
+    parked_c: frozenset
+    chan_cb: frozenset
+    applied_b: frozenset
+    dedup_b: frozenset
+    owner: int
+    ho: int
+    ho_mass: frozenset
+    ho_dedup: frozenset
+    double: int
+    lost: frozenset
+    route_b: int  # 1 = B knows the next hop toward the owner
+    park_b: frozenset  # frames parked at the RELAY awaiting a route
+
+
+class EngineShardSpec(ShardSpec):
+    """r17 engine-lane extension of the shard spec: the native plane's
+    new interleavings layered on the same identity algebra.
+
+    - RELAY-SIDE PARKING: the engine plane parks a routeless frame at
+      ANY node (node.py's python loop parks too, but its route map and
+      message handling are one thread — the plane's receiver races the
+      control plane's set_route, so park/heal is a genuine interleaving
+      here). ``route_lose``/``route_heal`` model the control plane
+      purging and re-announcing the route; a healed park re-LEDGERS the
+      frame (shard_dispatch_bytes re-packs into a fresh tx slot under a
+      fresh per-link seq — the end-to-end identity unchanged).
+    - VERBATIM-RELAY RESTAMP: the relay may only re-stamp the per-link
+      seq. The ``relay_restamp_identity`` mutation models the buggy
+      relay that re-mints (origin, fwd_seq) while re-routing — the
+      duplicate then bypasses the owner's dedup window and
+      double-applies, exactly what the verbatim discipline (and the
+      byte-range the restamp is allowed to touch) exists to prevent.
+    """
+
+    name = "shard_engine"
+    depth_bound = 30
+    mutations = dict(
+        ShardSpec.mutations,
+        relay_restamp_identity=(
+            "r17: the relay re-stamps MORE than the per-link seq — a "
+            "re-routed duplicate arrives under a fresh (origin, "
+            "fwd_seq) identity, bypasses the owner's end-to-end dedup "
+            "window and double-applies"
+        ),
+    )
+
+    def initial(self):
+        e = frozenset()
+        return EngineShardState(
+            0, e, e, e, e, e, e, e, e, e, 0, 0, e, e, 0, e, 1, e
+        )
+
+    def enabled(self, s):
+        acts = list(super().enabled(s))
+        if s.route_b:
+            acts.append(("route_lose",))
+        else:
+            acts.append(("route_heal",))
+        return acts
+
+    def apply(self, s, a):
+        kind = a[0]
+        if kind == "route_lose":
+            return s._replace(route_b=0)
+        if kind == "route_heal":
+            # parked frames re-ledger toward the owner under their
+            # unchanged identity (shard_dispatch_bytes)
+            return s._replace(
+                route_b=1,
+                led_bc=s.led_bc | s.park_b,
+                chan_bc=s.chan_bc | s.park_b,
+                park_b=frozenset(),
+            )
+        if kind == "deliver_ab" and s.owner == 0 and not s.route_b:
+            # engine lane: the relay has no route — the frame parks at B
+            # (bounded, loud) until the control plane heals the route
+            u = a[1]
+            return s._replace(
+                chan_ab=s.chan_ab - {u}, park_b=s.park_b | {u}
+            )
+        if (
+            kind == "redeliver_bc"
+            and self.mutation == "relay_restamp_identity"
+            and s.owner == 0
+            and s.ho != 1
+        ):
+            # the buggy relay re-minted the end-to-end identity: the
+            # owner's dedup window cannot recognize the duplicate
+            u = a[1]
+            s = s._replace(chan_bc=s.chan_bc - {u})
+            dbl = s.double + (1 if u in s.applied_c else 0)
+            return s._replace(
+                applied_c=s.applied_c | {u},
+                dedup_c=s.dedup_c | {u},
+                double=dbl,
+            )
+        return super().apply(s, a)
+
+    def invariants(self, s):
+        bad = super().invariants(s)
+        # park_b retention: base conservation's `held` does not know the
+        # relay park — re-check the full union here
+        applied = s.applied_b if s.owner == 1 else s.applied_c
+        held = (
+            applied
+            | s.chan_ab
+            | s.led_bc
+            | s.chan_bc
+            | s.chan_cb
+            | s.parked_c
+            | s.park_b
+            | (s.ho_mass if s.ho == 1 else frozenset())
+            | (s.applied_c if s.owner == 1 else frozenset())
+            | s.lost
+        )
+        missing = frozenset(range(1, s.prod + 1)) - held
+        base_cons = [b for b in bad if "vanished" in b]
+        if base_cons and not missing:
+            # the unit is in the relay park — retained, not vanished
+            bad = [b for b in bad if "vanished" not in b]
+        return bad
+
+    def quiescent(self, s):
+        return super().quiescent(s) and not s.park_b
+
+
+SPECS = [ShardSpec, EngineShardSpec]
