@@ -1,0 +1,474 @@
+#!/usr/bin/env python
+"""Fleet-health acceptance bench (r18 tentpole evidence -> CHAOS_r18.json).
+
+Three phases, each a fresh loopback fleet, each producing a pass/fail
+verdict the suite gate reads:
+
+- **heat** — a 7-node SHARDED fleet (python tier, 4 shards: nodes 0-3
+  own one each, nodes 4-6 are shardless writers) under zipf-skewed
+  writes (~79% of the writes land in one shard). The root's health
+  analyzer must NAME that shard (``heat.hot_shard``) within 3 digest
+  beats of shard telemetry first reaching it.
+- **slo** — a 7-node full-replica peer tree, every node writing, with a
+  staleness SLO of 3 s (5% budget, page = 2x burn over 6 s/1 s
+  windows). The sender is paced (``sync_interval_sec=0.5``) so the
+  go-back-N window drains between frame cuts — an unpaced python-tier
+  sender keeps the window full and end-to-end generation latency
+  (which IS what the live-aged staleness gauge reports) sits at many
+  seconds even on a healthy fleet. Paced, the steady-state worst sits
+  near 1 s; all writers then stall: the page alert must FIRE
+  (alert == 2 in health.json + an ``slo_alert_fire`` timeline event)
+  while the stall holds, and CLEAR after the writers resume and the
+  fleet quiesces back under the objective.
+- **skew** — a 3-node python-tier tree whose children run the r18 clock
+  simulator at +50 ms / -50 ms (``ObsConfig.clock_skew_sim_sec``). The
+  control-plane offset estimator must agree with the simulated skew
+  within its own reported uncertainty, and the root's offset-corrected
+  staleness for the skewed writer's link must shift by that offset
+  (i.e. ``corrected - raw ~ +50 ms``) — cross-host honesty, proven on
+  an adversarial clock.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/fleet_health.py CHAOS_r18.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from shared_tensor_tpu import obs  # noqa: E402
+from shared_tensor_tpu.config import (  # noqa: E402
+    Config, ObsConfig, ShardConfig, TransportConfig,
+)
+from tests._ports import free_port  # noqa: E402
+
+HEAT_N = int(os.environ.get("ST_FLEET_HEAT_N", 1 << 14))
+HOT_SHARD = 2
+BEAT_S = 0.2
+
+
+def _tmpfile(tag: str) -> str:
+    return os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"st_fleet_{tag}_{os.getpid()}.json"
+    )
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _wait(pred, timeout: float, msg: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# phase 1: zipf heat on a 7-node sharded fleet
+# ---------------------------------------------------------------------------
+
+
+def phase_heat() -> dict:
+    from shared_tensor_tpu.shard import create_or_fetch_sharded
+
+    tmpl = {"t": np.zeros(HEAT_N, np.float32)}
+    health_path = _tmpfile("heat")
+    port = free_port()
+
+    def cfg(idx: int, root: bool = False) -> Config:
+        return Config(
+            shard=ShardConfig(
+                n_shards=4, shard_index=idx, engine_lane=False
+            ),
+            transport=TransportConfig(peer_timeout_sec=30.0),
+            obs=ObsConfig(
+                digest_interval_sec=BEAT_S,
+                health_json_path=health_path if root else "",
+            ),
+        )
+
+    handles = [
+        create_or_fetch_sharded(
+            "127.0.0.1", port, tmpl, cfg(0, root=True), timeout=60.0
+        )
+    ]
+    for i in range(1, 7):
+        handles.append(
+            create_or_fetch_sharded(
+                "127.0.0.1", port, tmpl,
+                cfg(i if i <= 3 else -1), timeout=60.0,
+            )
+        )
+    stop = threading.Event()
+    threads: list[threading.Thread] = []
+    try:
+        m = handles[0].node.map
+        ranges = [m.element_range(k) for k in range(4)]
+        # zipf-ish write mix: the hot shard takes the rank-1 weight, the
+        # rest split a steep tail (~79/14/5/2%). The exponent and the
+        # write pace below are chosen together: the python shard tier
+        # COALESCES same-shard writes in the outbox, so a write rate
+        # near the pump frequency flattens per-shard apply rates toward
+        # uniform no matter how skewed the draw — writes must stay
+        # sparse enough that (nearly) each one becomes its own FWD
+        order = [HOT_SHARD] + [k for k in range(4) if k != HOT_SHARD]
+        weights = np.array([1.0 / (r + 1) ** 2.5 for r in range(4)])
+        weights /= weights.sum()
+        shard_of_draw = {i: order[i] for i in range(4)}
+
+        deltas = {}
+        for k, (lo, hi) in enumerate(ranges):
+            width = min(hi, HEAT_N) - lo
+            d = np.zeros(HEAT_N, np.float32)
+            d[lo:lo + width] = 0.01
+            deltas[k] = d
+
+        def writer(seed: int):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                k = shard_of_draw[int(rng.choice(4, p=weights))]
+                handles[4 + seed % 3].add({"t": deltas[k]})
+                stop.wait(0.04)
+
+        # writers are started only once the analyzer is beating, so the
+        # detection clock below starts at first telemetry, not mid-warmup
+        _wait(
+            lambda: (_read_json(health_path) or {}).get("beats", 0) >= 2,
+            30.0, "first health beats",
+        )
+        for i in range(3):
+            t = threading.Thread(target=writer, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+
+        def beat_with(pred):
+            doc = _read_json(health_path)
+            if doc and pred(doc):
+                return doc
+            return None
+
+        # the naming clock starts when naming first becomes POSSIBLE: the
+        # analyzer needs >= 2 shards with live apply rates before the
+        # skew ratio is even computable — a single cold shard's counter
+        # arriving one beat early must not start the stopwatch
+        evidence = _wait(
+            lambda: beat_with(
+                lambda d: len(d["heat"]["shards"]) >= 2
+                and any(
+                    s["apply_rate"] > 0
+                    for s in d["heat"]["shards"].values()
+                )
+            ),
+            30.0, "computable shard telemetry reaching the root",
+        )
+        named = _wait(
+            lambda: beat_with(
+                lambda d: d["heat"]["hot_shard"] == HOT_SHARD
+            ),
+            30.0, f"hot shard {HOT_SHARD} named",
+        )
+        beats_to_name = named["beats"] - evidence["beats"]
+        return {
+            "hot_shard_expected": HOT_SHARD,
+            "hot_shard_named": named["heat"]["hot_shard"],
+            "skew_ratio": named["heat"]["skew_ratio"],
+            "evidence_beat": evidence["beats"],
+            "named_beat": named["beats"],
+            "beats_to_name": beats_to_name,
+            "shards": named["heat"]["shards"],
+            "pass": bool(
+                named["heat"]["hot_shard"] == HOT_SHARD
+                and beats_to_name <= 3
+            ),
+        }
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        for h in reversed(handles):
+            h.close()
+
+
+# ---------------------------------------------------------------------------
+# phase 2: SLO fire on stall, clear at quiesce (7-node peer tree)
+# ---------------------------------------------------------------------------
+
+
+def phase_slo() -> dict:
+    import jax.numpy as jnp
+
+    from shared_tensor_tpu.comm.peer import create_or_fetch
+
+    health_path = _tmpfile("slo")
+    port = free_port()
+    n = 1024
+    seed = jnp.zeros((n,), jnp.float32)
+
+    def cfg(root: bool) -> Config:
+        return Config(
+            native_engine=False,  # python tier: live-aged staleness
+            # pace frame production: a free-running python sender always
+            # has residual to halve, keeps the go-back-N window full, and
+            # the queueing delay (honestly reported by the live-aged
+            # staleness gauge) dwarfs any objective. Paced at 0.5 s the
+            # window drains between cuts and steady state sits near 1 s.
+            sync_interval_sec=0.5,
+            transport=TransportConfig(
+                peer_timeout_sec=30.0, ack_timeout_sec=0.4
+            ),
+            obs=ObsConfig(
+                digest_interval_sec=BEAT_S,
+                health_json_path=health_path if root else "",
+                staleness_slo_sec=3.0,
+                slo_budget=0.05,
+                slo_windows=(
+                    ("page", 6.0, 1.0, 2.0),
+                    ("ticket", 12.0, 2.0, 1.5),
+                ),
+            ),
+        )
+
+    hub = obs.hub()
+    hub.poll_native()
+    fire0 = hub.recorder.counts["slo_alert_fire"]
+    clear0 = hub.recorder.counts["slo_alert_clear"]
+    peers = [
+        create_or_fetch("127.0.0.1", port, seed, cfg(i == 0), timeout=60.0)
+        for i in range(7)
+    ]
+    stalled = threading.Event()
+    stop = threading.Event()
+    threads = []
+    try:
+        rng = np.random.default_rng(1)
+        ds = [
+            jnp.asarray(rng.uniform(-0.01, 0.01, n).astype(np.float32))
+            for _ in range(4)
+        ]
+
+        def writer(i: int):
+            j = 0
+            while not stop.is_set():
+                if not stalled.is_set():
+                    peers[i].add(ds[j % len(ds)])
+                    j += 1
+                stop.wait(0.25)
+
+        for i in range(7):
+            t = threading.Thread(target=writer, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+
+        def alert() -> int:
+            doc = _read_json(health_path)
+            return int((doc or {}).get("slo", {}).get("alert", -1))
+
+        _wait(lambda: alert() == 0, 30.0, "steady state under the objective")
+        time.sleep(2.0)  # a few green beats on the record
+        pre_stall_alert = alert()
+        stalled.set()
+        t_stall = time.monotonic()
+        fired = _wait(lambda: alert() == 2, 20.0, "page alert firing")
+        fire_latency = time.monotonic() - t_stall
+        fired_doc = _read_json(health_path)
+        stalled.clear()
+        t_resume = time.monotonic()
+        cleared = _wait(lambda: alert() == 0, 30.0, "alert clearing")
+        clear_latency = time.monotonic() - t_resume
+        return {
+            "pre_stall_alert": pre_stall_alert,
+            "fired": bool(fired),
+            "fire_latency_s": round(fire_latency, 2),
+            "fired_windows": (fired_doc or {}).get("slo", {}).get("windows"),
+            "cleared": bool(cleared),
+            "clear_latency_s": round(clear_latency, 2),
+            "fire_events": hub.recorder.counts["slo_alert_fire"] - fire0,
+            "clear_events": hub.recorder.counts["slo_alert_clear"] - clear0,
+            "pass": bool(
+                pre_stall_alert == 0
+                and fired
+                and cleared
+                and hub.recorder.counts["slo_alert_fire"] > fire0
+                and hub.recorder.counts["slo_alert_clear"] > clear0
+            ),
+        }
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        for p in reversed(peers):
+            p.close()
+
+
+# ---------------------------------------------------------------------------
+# phase 3: +/-50 ms simulated skew vs the offset estimator
+# ---------------------------------------------------------------------------
+
+
+def phase_skew() -> dict:
+    import jax.numpy as jnp
+
+    from shared_tensor_tpu.comm.peer import create_or_fetch
+
+    health_path = _tmpfile("skew")
+    port = free_port()
+    n = 2048
+    seed = jnp.zeros((n,), jnp.float32)
+    skews = [0.0, 0.05, -0.05]
+
+    def cfg(i: int) -> Config:
+        return Config(
+            native_engine=False,
+            transport=TransportConfig(peer_timeout_sec=30.0),
+            obs=ObsConfig(
+                digest_interval_sec=BEAT_S,
+                health_json_path=health_path if i == 0 else "",
+                clock_sync_interval_sec=0.2,
+                clock_skew_sim_sec=skews[i],
+            ),
+        )
+
+    peers = [
+        create_or_fetch("127.0.0.1", port, seed, cfg(i), timeout=60.0)
+        for i in range(3)
+    ]
+    stop = threading.Event()
+    try:
+        rng = np.random.default_rng(2)
+        d = jnp.asarray(rng.uniform(-0.01, 0.01, n).astype(np.float32))
+
+        def writer():
+            # the +50ms child writes, so the ROOT's staleness record for
+            # that link carries a skewed-origin generation stamp
+            while not stop.is_set():
+                peers[1].add(d)
+                stop.wait(0.05)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+
+        ids = [p.node.obs_id for p in peers]
+
+        def clock_ready() -> dict | None:
+            doc = _read_json(health_path)
+            if not doc:
+                return None
+            table = doc.get("clock", {})
+            if all(str(i) in table for i in ids[1:]):
+                return doc
+            return None
+
+        doc = _wait(clock_ready, 30.0, "clock estimates for both children")
+        time.sleep(2.0)  # let the min-RTT sample window fill
+        doc = _read_json(health_path) or doc
+        table = doc["clock"]
+        nodes = []
+        est_ok = True
+        for i, skew in enumerate(skews):
+            if i == 0:
+                continue  # the root pins (0, 0) by construction
+            ent = table[str(ids[i])]
+            err = abs(ent["off_sec"] - skew)
+            ok = err <= ent["unc_sec"] + 0.002
+            est_ok = est_ok and ok
+            nodes.append(
+                {
+                    "node": ids[i],
+                    "skew_sim_sec": skew,
+                    "off_est_sec": ent["off_sec"],
+                    "unc_sec": ent["unc_sec"],
+                    "abs_err_sec": err,
+                    "within_uncertainty": ok,
+                }
+            )
+        # corrected staleness at the root must shift by the writer's
+        # offset: corrected - raw = off_origin (applier = root, off 0)
+        def stale_rec() -> dict | None:
+            d2 = _read_json(health_path)
+            if not d2:
+                return None
+            rec = d2.get("staleness", {}).get("nodes", {}).get(str(ids[0]))
+            if rec and rec.get("origin") == ids[1] and rec["unc_sec"] is not None:
+                return rec
+            return None
+
+        rec = _wait(stale_rec, 30.0, "root's corrected staleness record")
+        shift = rec["corrected_sec"] - rec["raw_sec"]
+        shift_ok = abs(shift - skews[1]) <= rec["unc_sec"] + 0.005
+        # raw staleness here is skew-distorted DOWN (the origin's stamps
+        # run 50ms ahead) and may clamp at 0; corrected must restore the
+        # offset unless the clamp ate part of it — tolerate the clamped
+        # case by checking the shift only when raw > 0
+        if rec["raw_sec"] == 0.0:
+            shift_ok = shift <= skews[1] + rec["unc_sec"] + 0.005
+        return {
+            "nodes": nodes,
+            "estimator_within_uncertainty": est_ok,
+            "root_staleness_record": rec,
+            "corrected_minus_raw_sec": shift,
+            "shift_matches_skew": bool(shift_ok),
+            "pass": bool(est_ok and shift_ok),
+        }
+    finally:
+        stop.set()
+        for p in reversed(peers):
+            p.close()
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "CHAOS_r18.json"
+    if not os.path.isabs(out_path):
+        out_path = os.path.join(REPO, out_path)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    phases = {}
+    for name, fn in (
+        ("heat", phase_heat), ("slo", phase_slo), ("skew", phase_skew)
+    ):
+        t0 = time.monotonic()
+        try:
+            phases[name] = fn()
+        except Exception as e:  # a wedged phase fails loudly, not silently
+            phases[name] = {"pass": False, "error": f"{type(e).__name__}: {e}"}
+        phases[name]["wall_s"] = round(time.monotonic() - t0, 2)
+        print(
+            f"fleet_health/{name}: "
+            f"{'PASS' if phases[name]['pass'] else 'FAIL'} "
+            f"({phases[name]['wall_s']}s)",
+            file=sys.stderr,
+        )
+    doc = {
+        "bench": "fleet_health",
+        "beat_s": BEAT_S,
+        "phases": phases,
+        "pass": bool(all(p["pass"] for p in phases.values())),
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps(doc, indent=2))
+    return 0 if doc["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,7 +2,7 @@
 <2% on the hot path — INCLUDING r09's per-message trace stamping.
 
 Measures the r07 zero-copy engine loopback (the BENCH_r07 hot path) with
-the obs subsystem ON vs OFF. Three arms:
+the obs subsystem ON vs OFF. Four arms:
 
 - **engine arm (gate)** — ONE warm loopback pair built with the v1 (r08,
   untraced) wire framing, master streaming adds, with ``obs.set_enabled``
@@ -21,6 +21,12 @@ the obs subsystem ON vs OFF. Three arms:
   cost on a traced data plane. Same lower-90% discipline, same budget —
   the fresh-pair 5-10% noise never reaches the verdict because no
   cross-pair comparison is made.
+- **health arm (gate, r18)** — the same paired design on a traced pair
+  with fast digest beats (0.25 s) and the root-side fleet-health
+  analyzer live (time-series ingest, heat/SLO scoring, clock beats,
+  health.json writes). The runtime obs flag pauses the whole
+  housekeeping beat, so each (on, off) pair isolates digest+health cost
+  on top of the r08+r09 telemetry. Same lower-90% discipline and budget.
 - **python arm (informational)** — fresh pairs per arm on the fallback
   tier at 4 Ki, where the per-message histograms observe live.
 
@@ -61,17 +67,30 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _loopback_pair(n: int, engine: bool, trace: bool = True):
+def _loopback_pair(n: int, engine: bool, trace: bool = True,
+                   health: bool = False):
     import jax.numpy as jnp
     import numpy as np
 
     from shared_tensor_tpu.comm.peer import create_or_fetch
     from shared_tensor_tpu.config import Config, ObsConfig, TransportConfig
 
+    obs_kw = {}
+    if health:
+        # r18 health arm: fast digest beats + the root-side analyzer
+        # (time-series ingest, heat/SLO scoring, health.json writes) so
+        # the paired A/B isolates the full fleet-health housekeeping cost
+        obs_kw = dict(
+            digest_interval_sec=0.25,
+            health_json_path=os.path.join(
+                os.environ.get("TMPDIR", "/tmp"),
+                f"st_obs_bench_health_{os.getpid()}.json",
+            ),
+        )
     cfg = Config(
         transport=TransportConfig(peer_timeout_sec=30.0),
         native_engine=engine,
-        obs=ObsConfig(trace_wire=trace),
+        obs=ObsConfig(trace_wire=trace, **obs_kw),
     )
     port = _free_port()
     seed = jnp.zeros((n,), jnp.float32)
@@ -107,14 +126,17 @@ def _loopback_pair(n: int, engine: bool, trace: bool = True):
     return fps, close
 
 
-def engine_arm(trace: bool = False) -> dict:
+def engine_arm(trace: bool = False, health: bool = False) -> dict:
     """Paired within-run A/B: alternate the obs flag on one warm pair.
     ``trace=True`` builds the pair on the v2 (traced) framing — the obs
     flag then also gates the engine's per-message trace bookkeeping, so
-    the pairs measure the full r08+r09 toggleable cost."""
+    the pairs measure the full r08+r09 toggleable cost. ``health=True``
+    (r18) additionally runs fast digest beats with the root-side health
+    analyzer live; the runtime obs flag pauses the whole housekeeping
+    beat, so each pair isolates digest+health+clock cost too."""
     from shared_tensor_tpu import obs
 
-    fps, close = _loopback_pair(N, engine=True, trace=trace)
+    fps, close = _loopback_pair(N, engine=True, trace=trace, health=health)
     on, off = [], []
     try:
         time.sleep(2.0)  # warmup: links hot, pools warm, codec threads up
@@ -134,7 +156,7 @@ def engine_arm(trace: bool = False) -> dict:
         # diagnosable artifact instead of a ZeroDivision traceback
         return {
             "n": N, "pairs": PAIRS, "interval_s": INTERVAL_S,
-            "trace_wire": trace,
+            "trace_wire": trace, "health": health,
             "fps_obs_on": on, "fps_obs_off": off,
             "error": "all obs-off samples were 0 (loopback wedged)",
             "overhead_pct_mean": None, "overhead_pct_sem": None,
@@ -150,6 +172,7 @@ def engine_arm(trace: bool = False) -> dict:
         "pairs": PAIRS,
         "interval_s": INTERVAL_S,
         "trace_wire": trace,
+        "health": health,
         "fps_obs_on": on,
         "fps_obs_off": off,
         "overhead_pct_pairs": [round(o, 3) for o in overheads],
@@ -192,21 +215,24 @@ def main() -> int:
 
     eng = engine_arm(trace=False)
     trc = engine_arm(trace=True)
+    hlt = engine_arm(trace=True, health=True)
     py = python_arm()
     out = {
         "bench": "obs_overhead",
         "gate_pct": GATE_PCT,
         "gate_rule": (
-            "fail iff lower-90%-confidence overhead > gate_pct on EITHER "
-            "paired arm (untraced engine_arm, traced trace_arm); paired "
+            "fail iff lower-90%-confidence overhead > gate_pct on ANY "
+            "paired arm (untraced engine_arm, traced trace_arm, r18 "
+            "health_arm with digest+analyzer beats live); paired "
             "within-run A/B — the 5-10% fresh-pair loopback noise on this "
             "box never reaches the verdict. See the module docstring for "
             "the toggle scope."
         ),
         "engine_arm": eng,
         "trace_arm": trc,
+        "health_arm": hlt,
         "python_arm_informational": py,
-        "pass": bool(eng["pass"] and trc["pass"]),
+        "pass": bool(eng["pass"] and trc["pass"] and hlt["pass"]),
     }
     doc = json.dumps(out, indent=2)
     print(doc)
@@ -217,7 +243,9 @@ def main() -> int:
         )
     with open(art_path, "w") as f:
         f.write(doc + "\n")
-    for label, arm in (("obs gate", eng), ("trace gate", trc)):
+    for label, arm in (
+        ("obs gate", eng), ("trace gate", trc), ("health gate", hlt)
+    ):
         if arm["overhead_pct_mean"] is None:
             print(f"{label}: FAIL ({arm.get('error')})", file=sys.stderr)
         else:

@@ -148,17 +148,35 @@ if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_BENCH:-1}" = "1" ]; then
     python benchmarks/bench_gate.py "$BENCH_OUT" >>"$OUT" 2>&1 || FAILED=1
 fi
 
-# Obs-overhead gate (r08; r09 added the paired trace-stamping arm): the
-# unified telemetry — cross-hop trace stamping included — must stay <2%
-# on the engine hot path (paired within-run A/B; fails only when the
-# measured drop is statistically past the budget on either arm —
-# benchmarks/obs_overhead.py). The run is recorded as the round's OBS
-# artifact (ST_SUITE_OBS_OUT, default OBS_r09.json). ST_SUITE_OBS=0
+# Obs-overhead gate (r08; r09 added the paired trace-stamping arm; r18
+# adds the health arm — fast digest beats + the root-side fleet-health
+# analyzer live under the same paired A/B): the unified telemetry —
+# cross-hop trace stamping and digest+health housekeeping included —
+# must stay <2% on the engine hot path (paired within-run A/B; fails
+# only when the measured drop is statistically past the budget on any
+# arm — benchmarks/obs_overhead.py). The run is recorded as the round's
+# OBS artifact (ST_SUITE_OBS_OUT, default OBS_r18.json). ST_SUITE_OBS=0
 # skips (e.g. red-suite debugging).
 if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_OBS:-1}" = "1" ]; then
-  OBS_OUT="${ST_SUITE_OBS_OUT:-OBS_r09.json}"
+  OBS_OUT="${ST_SUITE_OBS_OUT:-OBS_r18.json}"
   JAX_PLATFORMS=cpu python benchmarks/obs_overhead.py "$OBS_OUT" \
     >/dev/null 2>>"$OUT" || FAILED=1
+fi
+
+# Fleet-health gate (r18): the observability acceptance arm — a sharded
+# fleet under zipf writes whose hot shard the root's health analyzer
+# must NAME within 3 digest beats, a peer tree whose staleness-SLO page
+# alert must FIRE during an injected writer stall and CLEAR after the
+# resume, and a +/-50 ms simulated-skew pair whose control-plane offset
+# estimates and offset-corrected staleness must agree with the injected
+# skew within their own reported uncertainty
+# (benchmarks/fleet_health.py -> the round's CHAOS_r18 artifact,
+# ST_SUITE_HEALTH_OUT). ST_SUITE_HEALTH=0 skips.
+if [ "$FAILED" -eq 0 ] && [ "${ST_SUITE_HEALTH:-1}" = "1" ]; then
+  HEALTH_OUT="${ST_SUITE_HEALTH_OUT:-CHAOS_r18.json}"
+  gate_run fleet_health sh -c \
+    "JAX_PLATFORMS=cpu python benchmarks/fleet_health.py '$HEALTH_OUT' \
+     >/dev/null"
 fi
 
 # Serving-tier gate (r10): under full write load, a read-only subscriber's

@@ -185,6 +185,21 @@ class _PeerObs:
             self.registry.start_jsonl_sink(
                 ocfg.jsonl_path, ocfg.jsonl_interval_sec
             )
+        # r18: engine-tier origin attribution. The C receiver's trace_apply
+        # ring events carry (origin << 8 | hop) in extra; a drain tap picks
+        # this peer's out of each batch so _stale_origin stays current on
+        # engine links too (the python tier writes it in _note_trace).
+        self._peer = peer
+        self._tap = self._on_native_batch if peer._engine is not None else None
+        if self._tap is not None:
+            self.hub.add_tap(self._tap)
+
+    def _on_native_batch(self, batch) -> None:
+        peer = self._peer
+        me = peer.node.obs_id
+        for e in batch:
+            if e.name == "trace_apply" and e.node == me:
+                peer._stale_origin[e.link] = e.extra >> 8
 
     def event(
         self, name: str, node: int = 0, link: int = 0, arg: int = 0,
@@ -196,6 +211,8 @@ class _PeerObs:
 
     def close(self) -> None:
         self.registry.stop_jsonl_sink()
+        if self._tap is not None:
+            self.hub.remove_tap(self._tap)
         self.hub.poll_native()  # final drain: close() must not strand events
         self.hub.unregister_registry(self.label)
 
@@ -262,9 +279,11 @@ class SharedTensorPeer:
         # send path when stamping outgoing messages. Tuple assignment —
         # atomic under the GIL, no lock on the hot path.
         self._trace_stamp: Optional[tuple[int, int, int]] = None
-        # per-link (staleness_seconds, hops) of the latest traced apply
-        # (python tier; the engine tier serves st_engine_link_obs instead)
-        self._staleness: dict[int, tuple[float, int]] = {}
+        # per-link (origin generation stamp ns, hops) of the latest traced
+        # apply (python tier; the engine tier serves st_engine_link_obs
+        # instead). r18: the GENERATION is stored, not a frozen age — the
+        # collector ages it live so stalls are visible to the SLO.
+        self._staleness: dict[int, tuple[int, int]] = {}
         self._traced_in = 0
         # r09 in-band digest aggregation: each child link's latest digest
         # (replaced wholesale per arrival; merged on demand)
@@ -279,6 +298,30 @@ class SharedTensorPeer:
             else self.config.obs.digest_interval_sec
         )
         self._digest_last = 0.0
+        # r18 fleet health plane. _skew_ns simulates a skewed host clock
+        # (tests/benches only — env ST_CLOCK_SKEW_SEC overrides config):
+        # applied via _now_ns() at every cross-node-comparable stamp site
+        # (trace stamps, clock probes, digest t_ns), so the offset
+        # estimator has a real skew to recover on a single host. _clock is
+        # the per-node offset estimator (obs/clock.py); a node probes its
+        # UPLINK every clock_sync_interval_sec with a wire.CLOCK message
+        # (chaos-exempt control plane) — master peers are roots (offset
+        # pinned 0). _stale_origin tracks the origin node of each link's
+        # freshest traced apply, feeding the health analyzer's
+        # offset-corrected staleness. _health exists only at a root with
+        # health_json_path set; it is beaten from _publish_digest.
+        skew_env = os.environ.get("ST_CLOCK_SKEW_SEC", "")
+        self._skew_ns = int(
+            float(skew_env if skew_env else self.config.obs.clock_skew_sim_sec)
+            * 1e9
+        )
+        self._clock_interval = (
+            0.0
+            if tcfg.wire_compat or self._wire_version < 2
+            else self.config.obs.clock_sync_interval_sec
+        )
+        self._clock_last = 0.0
+        self._stale_origin: dict[int, int] = {}
         from ..core import host_tier_active
 
         # Burst sizing (Config.frame_burst): host tier only — the device
@@ -374,6 +417,27 @@ class SharedTensorPeer:
             keepalive_sec=min(1.0, max(0.05, tcfg.peer_timeout_sec / 4)),
         )
         self.is_master = self.node.is_master
+        # r18 clock plane: master peers are tree roots (offset pinned to
+        # 0/0); everyone else converges by probing the uplink. The health
+        # analyzer exists only at a root with health_json_path set and is
+        # beaten from _publish_digest on the recv thread.
+        from ..obs.clock import ClockSync
+
+        self._clock = ClockSync(self._now_ns, is_root=self.is_master)
+        self._health = None
+        if self.is_master and self.config.obs.health_json_path:
+            from ..obs.health import HealthAnalyzer
+
+            ocfg = self.config.obs
+            self._health = HealthAnalyzer(
+                path=ocfg.health_json_path,
+                history=ocfg.health_history,
+                objective_sec=ocfg.staleness_slo_sec,
+                budget=ocfg.slo_budget,
+                windows=ocfg.slo_windows,
+                skew_ratio=ocfg.heat_skew_ratio,
+                emit=self._health_event,
+            )
         # Native engine (stengine.cpp): on the host tier the full
         # steady-state cycle — quantize, encode, send, receive, flood apply,
         # ACK ledger — runs in two C threads against the same stcodec.c
@@ -639,7 +703,7 @@ class SharedTensorPeer:
         if self._trace_wire and self._engine is None:
             # a local update is a fresh generation: re-seed the pending
             # trace stamp (the engine tier stamps inside st_engine_add)
-            self._trace_stamp = (self.node.obs_id, time.monotonic_ns(), 0)
+            self._trace_stamp = (self.node.obs_id, self._now_ns(), 0)
         self._wake.set()
 
     def wait_ready(self, timeout: float = 30.0) -> None:
@@ -1599,10 +1663,31 @@ class SharedTensorPeer:
                     out[_schema.link_key("st_staleness_seconds", link)] = lo[0]
                     out[_schema.link_key("st_update_hops_last", link)] = lo[1]
         else:
-            for link, (sec, hop) in list(self._staleness.items()):
-                out[_schema.link_key("st_staleness_seconds", link)] = sec
+            # r18: live aging — the stored value is the origin GENERATION
+            # stamp; its age is computed NOW, so a stalled link's gauge
+            # grows between applies (the SLO's staleness signal)
+            now_ns = self._now_ns()
+            for link, (gen, hop) in list(self._staleness.items()):
+                out[_schema.link_key("st_staleness_seconds", link)] = max(
+                    0.0, (now_ns - gen) / 1e9
+                )
                 out[_schema.link_key("st_update_hops_last", link)] = hop
             out["st_traced_msgs_in_total"] = self._traced_in
+        # r18 origin attribution + clock plane: the origin node of each
+        # link's freshest traced apply (python tier; the engine tier's
+        # arrives via the native-ring tap), and this node's estimated
+        # offset to the tree root — the health analyzer joins the two to
+        # widen staleness to offset-corrected +/- uncertainty.
+        for link, origin in list(self._stale_origin.items()):
+            out[_schema.link_key("st_staleness_origin", link)] = origin
+        if self._clock.known:
+            out["st_clock_offset_seconds"] = self._clock.offset_seconds
+            out["st_clock_uncertainty_seconds"] = (
+                self._clock.uncertainty_seconds
+            )
+        out["st_clock_probes_total"] = self._clock.probes
+        if self._health is not None:
+            out.update(self._health.metrics())
         for link in self.node.links:
             s = self.node.stats(link)
             if s is not None:
@@ -1973,7 +2058,7 @@ class SharedTensorPeer:
         # was either quantized+enqueued already (FIFO delivers it before
         # the FRESH) or left mass that made the determination non-empty.
         # The C tier gets the same guarantee by stamping under e->mu.
-        fresh_t = time.monotonic_ns()
+        fresh_t = self._now_ns()
         if self.st.host_tier:
             # serving links trade batch efficiency for pipeline LATENCY:
             # the subscriber's staleness floor is queue depth x per-message
@@ -1998,7 +2083,7 @@ class SharedTensorPeer:
         if self._trace_wire:
             trace = self._trace_stamp
             if trace is None:
-                trace = (self.node.obs_id, time.monotonic_ns(), 0)
+                trace = (self.node.obs_id, self._now_ns(), 0)
         nmsg = len(frames) if rng else 1
         with self._ack_mu:
             base = self._tx_seq.get(link, 0)
@@ -2059,7 +2144,7 @@ class SharedTensorPeer:
         mass gets no mark, so a subscriber read across the cut refuses
         (StalenessError) instead of falsely verifying. Stamp captured
         BEFORE the drained determination, same discipline as _send_sub."""
-        fresh_t = time.monotonic_ns()
+        fresh_t = self._now_ns()
         if self.st.residual_rms(link) > 0.0:
             return
         self._sub_fresh_mark(link, fresh_t)
@@ -2093,7 +2178,7 @@ class SharedTensorPeer:
         if self._trace_wire:
             trace = self._trace_stamp
             if trace is None:
-                trace = (self.node.obs_id, time.monotonic_ns(), 0)
+                trace = (self.node.obs_id, self._now_ns(), 0)
         slot = self._tx_pool.acquire()
         t0 = time.monotonic()
         n = encode_into(slot, txs, trace)
@@ -2324,21 +2409,29 @@ class SharedTensorPeer:
                 # the peer's own thread (never a background thread racing
                 # node teardown); rate-limited inside poll_native
                 self._obs.hub.poll_native(self._obs.drain_interval)
-            if self._digest_interval > 0 and self._obs is not None:
+            if (
+                self._digest_interval > 0
+                and self._obs is not None
+                and _obs.obs_enabled()
+            ):
                 # r09 in-band aggregation: piggyback this subtree's merged
                 # metrics digest up the tree (or, at the root, publish the
                 # whole-tree view) once per interval — control-plane
                 # traffic on the peer's own housekeeping thread. Gated on
                 # obs like everything else: ST_OBS=0 / ObsConfig.enabled
                 # =False means NO periodic snapshot/JSON/wire work (the
-                # explicit metrics(cluster=True) call still serves).
+                # explicit metrics(cluster=True) call still serves), and
+                # the RUNTIME flag (obs.set_enabled) pauses the beat too —
+                # that is what lets obs_overhead.py's health arm A/B the
+                # full digest+health+clock housekeeping cost.
                 now = time.monotonic()
                 if now - self._digest_last >= self._digest_interval and (
                     self._uplink is not None
                     or self.config.obs.cluster_json_path
+                    or self._health is not None
                 ):
-                    # a root with no JSON sink has nobody to publish TO —
-                    # its cluster view is built on demand
+                    # a root with no JSON/health sink has nobody to
+                    # publish TO — its cluster view is built on demand
                     # (metrics(cluster=True)); don't pay the snapshot per
                     # beat just to discard it
                     self._digest_last = now
@@ -2346,6 +2439,8 @@ class SharedTensorPeer:
                         self._publish_digest()
                     except Exception as e:
                         log.debug("digest publish failed: %s", e)
+                # r18 clock plane beat rides the same housekeeping pass
+                self._clock_beat(now)
             busy = self._handle_events()
             try:
                 # r12 lifecycle: drive any active barrier / operator
@@ -2548,6 +2643,41 @@ class SharedTensorPeer:
         if n_ack:
             self._ack_received(link, n_ack)
 
+    def _now_ns(self) -> int:
+        """Monotonic ns for cross-node-comparable stamps (trace
+        generations, clock probes, digest times), plus the simulated skew
+        when a test/bench configured one — so every stamp another node
+        compares against behaves like a genuinely skewed host clock."""
+        return time.monotonic_ns() + self._skew_ns
+
+    def _health_event(self, name: str, arg: int, detail: str) -> None:
+        """Analyzer event sink -> the flight recorder timeline."""
+        obs = self._obs
+        if obs is not None:
+            obs.event(name, self.node.obs_id, 0, arg, detail=detail)
+
+    def _clock_beat(self, now: float) -> None:
+        """r18 clock plane beat (housekeeping thread): probe the uplink
+        with a four-stamp offset sample every clock_sync_interval_sec.
+        The root never probes — it IS the reference. Lossy like the
+        digest beat: a bounced send just waits for the next interval."""
+        if (
+            self._clock_interval <= 0
+            or self.is_master
+            or now - self._clock_last < self._clock_interval
+        ):
+            return
+        up = self._uplink
+        if up is None:
+            return
+        self._clock_last = now
+        try:
+            self.node.send(
+                up, wire.encode_clock(self._clock.probe_payload()), timeout=0.05
+            )
+        except BrokenPipeError:
+            pass  # uplink died; re-graft re-targets the next probe
+
     def _note_trace(self, link: int, payload: bytes) -> None:
         """r09 trace bookkeeping for one ACCEPTED data message (python
         tier; the engine's receiver does the same in C): advance the
@@ -2568,11 +2698,13 @@ class SharedTensorPeer:
             self._trace_stamp = (origin, gen, hop)
         if obs is None:
             return
-        now_ns = time.monotonic_ns()
-        self._staleness[link] = (
-            (now_ns - gen) / 1e9 if now_ns > gen else 0.0,
-            hop,
-        )
+        # r18: store the origin GENERATION stamp, not a frozen age — the
+        # collector computes the live age at snapshot time, so a stalled
+        # link's staleness GROWS (what the SLO burn-rate alert watches)
+        # instead of freezing at its last-apply value. The origin node id
+        # feeds the health analyzer's cross-host offset correction.
+        self._staleness[link] = (gen, hop)
+        self._stale_origin[link] = origin
         self._traced_in += 1
         if obs.hops is not None:
             obs.hops.observe(hop)
@@ -2593,7 +2725,7 @@ class SharedTensorPeer:
         doc = aggregate.from_snapshot(
             self.node.obs_id,
             self.metrics(canonical=True),
-            time.monotonic_ns(),
+            self._now_ns(),
         )
         # r12: the lifecycle node name rides the per-node breakdown so the
         # operator surface (ctl drain/versions) can address nodes by name
@@ -2632,19 +2764,28 @@ class SharedTensorPeer:
                     self._obs.digest_out.inc()
             except BrokenPipeError:
                 pass  # uplink died; LINK_DOWN will re-route the next beat
-        elif self.config.obs.cluster_json_path:
-            import json as _json
-            import os as _os
+        else:
+            if self._health is not None:
+                # r18: the root's health analyzer samples every digest
+                # beat — time-series ingest, heat scoring, SLO burn rates,
+                # health.json (the analyzer writes it itself)
+                try:
+                    self._health.beat(doc, self._now_ns())
+                except Exception as e:
+                    log.debug("health beat failed: %s", e)
+            if self.config.obs.cluster_json_path:
+                import json as _json
+                import os as _os
 
-            path = self.config.obs.cluster_json_path
-            tmp = f"{path}.tmp.{_os.getpid()}"
-            try:
-                with open(tmp, "w") as f:
-                    _json.dump(doc, f)
-                    f.write("\n")
-                _os.replace(tmp, path)  # atomic: top never reads a torn file
-            except OSError as e:
-                log.debug("cluster digest write failed: %s", e)
+                path = self.config.obs.cluster_json_path
+                tmp = f"{path}.tmp.{_os.getpid()}"
+                try:
+                    with open(tmp, "w") as f:
+                        _json.dump(doc, f)
+                        f.write("\n")
+                    _os.replace(tmp, path)  # atomic: never a torn read
+                except OSError as e:
+                    log.debug("cluster digest write failed: %s", e)
         return doc
 
     def push_digest(self) -> dict:
@@ -2838,6 +2979,7 @@ class SharedTensorPeer:
             self._engine_links.discard(ev.link_id)
             self._rx_scratch.pop(ev.link_id, None)
             self._staleness.pop(ev.link_id, None)
+            self._stale_origin.pop(ev.link_id, None)
             self._child_digests.pop(ev.link_id, None)
             # a dead subscriber link carries NO residual forward: a
             # read-only leaf owes the tree nothing, and a re-joining
@@ -3033,7 +3175,7 @@ class SharedTensorPeer:
         # by the r06 rule (chaos exercises recovery, never wedges a
         # handshake), so a re-seed completes DETERMINISTICALLY and the
         # codec stream carries only steady-state deltas.
-        t_snap = time.monotonic_ns()
+        t_snap = self._now_ns()
         vals = np.asarray(self.st.snapshot_flat(), np.float32)
         self._send_blocking(link, bytes([wire.WELCOME]))
         sl = vals[wlo * 32 : (wlo + wcnt) * 32] if rng is not None else vals
@@ -3190,7 +3332,7 @@ class SharedTensorPeer:
                         f" ({mine.num_leaves}, {mine.total_n})"
                     ),
                 )
-                self.node.drop_link(link)
+                self.node.drop_link_flushed(link)
                 self._pending.pop(link, None)
                 self._pending_sub.pop(link, None)
             else:
@@ -3246,7 +3388,7 @@ class SharedTensorPeer:
                         f"{words}-word table"
                     ),
                 )
-                self.node.drop_link(link)
+                self.node.drop_link_flushed(link)
                 self._pending.pop(link, None)
                 self._pending_sub.pop(link, None)
             else:
@@ -3352,6 +3494,24 @@ class SharedTensorPeer:
             self._child_digests[link] = wire.decode_digest(payload)
             if self._obs is not None:
                 self._obs.digest_in.inc()
+        elif kind == wire.CLOCK:
+            # r18 clock plane: a child's four-stamp offset probe (answer
+            # synchronously down the SAME link — the turnaround time is
+            # inside the child's measured RTT either way), or our own
+            # uplink's reply (fold into the estimator). Chaos-exempt
+            # control traffic, the r06 rule.
+            doc = wire.decode_clock(payload)
+            if doc.get("op") == "probe":
+                try:
+                    self.node.send(
+                        link,
+                        wire.encode_clock(self._clock.reply_payload(doc)),
+                        timeout=0.05,
+                    )
+                except BrokenPipeError:
+                    pass  # prober died; nothing to answer
+            elif doc.get("op") == "reply" and link == self._uplink:
+                self._clock.on_reply(doc)
         elif kind == wire.SNAP:
             # r12 lifecycle barrier marker from our parent: per-link FIFO
             # means every pre-pause data message on this link was applied

@@ -14,6 +14,7 @@ import ctypes
 import dataclasses
 import enum
 import pathlib
+import time
 from typing import Optional
 
 from .. import _build
@@ -433,6 +434,25 @@ class TransportNode:
 
     def drop_link(self, link_id: int) -> None:
         self._lib.st_node_drop_link(self._h, link_id)
+
+    def drop_link_flushed(self, link_id: int, timeout: float = 0.5) -> None:
+        """Drop a link AFTER its userspace send queue has drained (bounded
+        wait). ``send`` only enqueues; ``drop_link`` kills the socket and
+        closes the queue in the same breath, so a reject-then-drop races
+        the sender thread still holding the REJECT — lose the race and the
+        refused peer sees a bare link death instead of the reason, retries
+        its join forever, and times out instead of failing loudly. Polling
+        the queue to empty (plus one scheduling grace for the in-flight
+        socket write) closes the race; the deadline keeps a wedged peer
+        from pinning the caller's control thread."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            s = self.stats(link_id)
+            if s is None or s.send_queue == 0:
+                break
+            time.sleep(0.005)
+        time.sleep(0.05)
+        self.drop_link(link_id)
 
     def close(self) -> None:
         if self._h:

@@ -135,6 +135,14 @@ CTL = 15  # parent -> child: routed operator command (JSON)
 # touching their data plane (the r12 tolerant-extension discipline).
 SHARD = 16  # shard-map control: claim/grant/own/map/handoff (JSON)
 FWD = 17  # owner-routed forwarded delta frame (binary, ledgered)
+# r18 clock plane (obs/clock.py): the NTP-style four-stamp offset probe
+# and its reply, bounded JSON bodies like the lifecycle kinds. Control
+# plane under the r06 rule — chaos classes never touch it (it is not in
+# is_data), so clock estimates keep converging through injected faults
+# and the corrected staleness the SLO alerts on stays honest. Python
+# tier only today: engine-lane links have no estimator, and pre-r18
+# peers drop the kind with the r12 "unknown message kind" tolerance.
+CLOCK = 18  # both ways on a parent link: offset probe / reply (JSON)
 
 #: r14 shm/r14-capability flag bit — MUST equal compat.SYNC_FLAG_SHM
 #: (compat asserts the tie at import; defined here too because compat
@@ -1208,6 +1216,30 @@ def decode_lifecycle(payload: bytes) -> dict:
     doc = json.loads(payload[1:].decode("utf-8"))
     if not isinstance(doc, dict):
         raise ValueError("lifecycle message body is not a JSON object")
+    return doc
+
+
+def encode_clock(doc: dict) -> bytes:
+    """One r18 clock-offset control message (probe or reply — obs/clock.py
+    owns the four-stamp payload shape): kind byte + bounded JSON body,
+    the lifecycle pattern. Tiny in practice (~100 bytes); the shared
+    DIGEST_MAX_BYTES cap keeps the receive bound uniform."""
+    import json
+
+    body = json.dumps(doc, separators=(",", ":")).encode()
+    if len(body) > DIGEST_MAX_BYTES:
+        raise ValueError(
+            f"clock message is {len(body)} bytes, cap {DIGEST_MAX_BYTES}"
+        )
+    return bytes([CLOCK]) + body
+
+
+def decode_clock(payload: bytes) -> dict:
+    import json
+
+    doc = json.loads(payload[1:].decode("utf-8"))
+    if not isinstance(doc, dict):
+        raise ValueError("clock message body is not a JSON object")
     return doc
 
 

@@ -335,6 +335,40 @@ class ObsConfig:
     #: digest interval — the file ``python -m shared_tensor_tpu.obs.top``
     #: tails for its terminal dashboard. "" = don't write.
     cluster_json_path: str = ""
+    #: r18 fleet health plane (root-side, obs/health.py): when set, the
+    #: tree root runs the health analyzer every digest beat — time-series
+    #: store, per-shard heat, staleness SLO burn-rate alerts — and writes
+    #: the machine-readable health document to this path (atomic replace,
+    #: same discipline as cluster_json_path). "" = analyzer off.
+    health_json_path: str = ""
+    #: Ring depth per time-series (beats kept). 256 beats at the default
+    #: 0.5s digest interval is ~2 minutes of history.
+    health_history: int = 256
+    #: Staleness SLO objective: a digest beat is "bad" when the fleet's
+    #: worst offset-corrected staleness exceeds this many seconds.
+    staleness_slo_sec: float = 1.0
+    #: SLO error budget: the tolerated bad-beat fraction (burn rate 1.0
+    #: means burning exactly the budget).
+    slo_budget: float = 0.01
+    #: Multi-window burn-rate severities: (name, long_sec, short_sec,
+    #: threshold). A severity fires when BOTH windows burn past the
+    #: threshold and clears when the short window recovers.
+    slo_windows: tuple = (
+        ("page", 60.0, 5.0, 14.4),
+        ("ticket", 300.0, 30.0, 6.0),
+    )
+    #: Zipf-skew naming bar: the hot shard must out-rate the mean of the
+    #: other shards by this factor before health.json names it.
+    heat_skew_ratio: float = 3.0
+    #: r18 clock plane: how often a non-root node probes its uplink with a
+    #: wire.CLOCK offset sample (obs/clock.py; chaos-exempt control op).
+    #: 0 = clock sync off (staleness stays raw).
+    clock_sync_interval_sec: float = 1.0
+    #: TEST/BENCH ONLY — simulated clock skew in seconds applied to this
+    #: node's cross-node-comparable stamps (trace stamps, clock probes).
+    #: Lets a single-host harness prove the offset estimator recovers a
+    #: known skew. Env ``ST_CLOCK_SKEW_SEC`` overrides. 0 = off.
+    clock_skew_sim_sec: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -15,6 +15,7 @@ Commands::
     python -m shared_tensor_tpu.ctl --ctl-dir /tmp/st_ctl restore  --dir D
     python -m shared_tensor_tpu.ctl --ctl-dir /tmp/st_ctl drain NODE
     python -m shared_tensor_tpu.ctl verify --dir D        # offline audit
+    python -m shared_tensor_tpu.ctl health --health-file /tmp/st_health.json
 
 ``status``/``versions`` read the digest; ``snapshot``/``restore``/``drain``
 write ``<ctl_dir>/cmd.json`` (atomically) and poll ``<ctl_dir>/result.json``
@@ -131,6 +132,47 @@ def cmd_drain(args) -> int:
     )
 
 
+def cmd_health(args) -> int:
+    """Fleet health verdict from the root's health.json (r18): exit 0 when
+    no SLO alert is firing, 1 while one is (severity printed), 2 when the
+    file is unreadable — scriptable as a readiness/paging probe."""
+    try:
+        with open(args.health_file) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read health file {args.health_file}: {e}",
+              file=sys.stderr)
+        return 2
+    slo = doc.get("slo") or {}
+    alert = int(slo.get("alert", 0))
+    badge = {0: "ok", 1: "TICKET", 2: "PAGE"}.get(alert, str(alert))
+    partial = " (PARTIAL: digest breakdowns truncated)" if doc.get(
+        "partial") else ""
+    print(f"health [{badge}] — beat {doc.get('beats', 0)}, "
+          f"{doc.get('nodes', 0)} node(s){partial}")
+    worst = (doc.get("staleness") or {}).get("worst")
+    if worst:
+        unc = worst.get("unc_sec")
+        bound = f" ±{unc:.4f}s" if unc is not None else " (uncorrected)"
+        print(f"  staleness worst {worst['corrected_sec']:.4f}s{bound} "
+              f"@ node {worst.get('node', '?')} "
+              f"(objective {(doc.get('staleness') or {}).get('objective_sec', 0):g}s)")
+    for name, w in sorted((slo.get("windows") or {}).items()):
+        state = "FIRING" if w.get("firing") else "ok"
+        print(f"  slo/{name}: {state} — burn {w.get('burn_long', 0.0):.1f}x "
+              f"long / {w.get('burn_short', 0.0):.1f}x short "
+              f"(threshold {w.get('threshold', 0.0):g}x)")
+    heat = doc.get("heat") or {}
+    hot = int(heat.get("hot_shard", -1))
+    shards = heat.get("shards") or {}
+    if shards:
+        hottest = max(shards.items(), key=lambda kv: kv[1].get("score", 0.0))
+        print(f"  heat: {len(shards)} shard(s), top s{hottest[0]} "
+              f"score {hottest[1].get('score', 0.0):.2f}"
+              + (f" — HOT shard {hot} (zipf skew)" if hot >= 0 else ""))
+    return 1 if alert else 0
+
+
 def cmd_verify(args) -> int:
     from .utils import checkpoint as ckpt
 
@@ -179,6 +221,13 @@ def main(argv=None) -> int:
     p.add_argument("node", help="target node name (LifecycleConfig.node_name)")
     p = sub.add_parser("verify", help="offline snapshot-manifest audit")
     p.add_argument("--dir", required=True, help="snapshot directory")
+    p = sub.add_parser(
+        "health", help="fleet health verdict from the root's health.json"
+    )
+    p.add_argument(
+        "--health-file", default="/tmp/st_health.json",
+        help="health JSON the root writes (ObsConfig.health_json_path)",
+    )
     args = ap.parse_args(argv)
     return {
         "status": cmd_status,
@@ -187,6 +236,7 @@ def main(argv=None) -> int:
         "restore": cmd_restore,
         "drain": cmd_drain,
         "verify": cmd_verify,
+        "health": cmd_health,
     }[args.cmd](args)
 
 

@@ -105,6 +105,19 @@ LIFECYCLE_EVENT_NAMES = frozenset(
     }
 )
 
+#: r18 fleet-health events (python tier only — the analyzer runs at the
+#: root, never in the C hot path, so these are names rather than ABI
+#: numbers; tools/lint_events.py pins the set). slo_alert_fire /
+#: slo_alert_clear carry the severity index in arg and the burn-rate
+#: numbers in detail; hot_shard carries the named shard id in arg.
+HEALTH_EVENT_NAMES = frozenset(
+    {
+        "slo_alert_fire",
+        "slo_alert_clear",
+        "hot_shard",
+    }
+)
+
 #: Names the flight recorder treats as fault-injection hits (timeline
 #: accounting in the chaos soak keys on these).
 FAULT_EVENT_NAMES = frozenset(

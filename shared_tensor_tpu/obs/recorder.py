@@ -90,6 +90,11 @@ class ObsHub:
         self._last_drain = 0.0
         self._last_dump: dict[str, float] = {}  # reason -> monotonic time
         self.dump_paths: list[str] = []
+        # r18 taps: callables fed every drained native batch (peers use
+        # one to read engine-tier trace_apply origins without a second
+        # drain of the ring — draining is destructive, so the recorder is
+        # the single drain point and taps fan the batch out).
+        self._taps: list = []
 
     # -- event ingestion ----------------------------------------------------
 
@@ -120,9 +125,27 @@ class ObsHub:
         if not batch:
             return 0
         self.recorder.record(batch)
+        for tap in list(self._taps):
+            try:
+                tap(batch)
+            except Exception:
+                pass  # a broken tap must not stop the drain
         if any(e.name == "blackhole_teardown" for e in batch):
             self.dump("native_blackhole_teardown")
         return len(batch)
+
+    def add_tap(self, fn) -> None:
+        """Register a callable fed every drained native event batch."""
+        with self._mu:
+            if fn not in self._taps:
+                self._taps.append(fn)
+
+    def remove_tap(self, fn) -> None:
+        with self._mu:
+            try:
+                self._taps.remove(fn)
+            except ValueError:
+                pass
 
     # -- registries ----------------------------------------------------------
 

@@ -58,7 +58,8 @@ SCHEMA: dict[str, tuple[str, str]] = {
     # native event ring health
     "st_obs_events_dropped_total": ("counter", "native ring events lost to overflow (undrained)"),
     # r09 convergence/staleness telemetry (trace context at apply)
-    "st_staleness_seconds": ("gauge", "origin-stamp age of the latest traced update applied on the link (per-link; CLOCK_MONOTONIC delta — valid within one host, needs synced clocks across hosts)"),
+    "st_staleness_seconds": ("gauge", "live age of the link's freshest traced update (per-link; raw CLOCK_MONOTONIC delta — the r18 health plane widens it to offset-corrected +/- uncertainty via st_clock_*)"),
+    "st_staleness_origin": ("gauge", "origin node id of the link's freshest traced update (per-link; feeds the r18 offset correction)"),
     "st_residual_norm": ("gauge", "L2 norm over every link's error-feedback residual (0 = quiesced)"),
     "st_update_hops": ("histogram", "tree hops traversed by applied traced updates (python tier buckets)"),
     "st_update_hops_sum": ("counter", "engine-tier hop-count aggregate (sum over applied traced msgs)"),
@@ -163,6 +164,26 @@ SCHEMA: dict[str, tuple[str, str]] = {
     "st_shard_fwd_retx_total": ("counter", "FWD messages re-sent byte-identical by the shard plane's go-back-N"),
     "st_shard_handoffs_total": ("counter", "shard ownership handoffs completed (counted at both endpoints)"),
     "st_shard_gather_staleness_seconds": ("histogram", "worst per-shard verified staleness per assembled gather view"),
+    # r18 fleet health plane. Clock gauges are per-NODE estimates against
+    # the tree root's CLOCK_MONOTONIC (obs/clock.py: NTP-style four-stamp
+    # exchange over wire.CLOCK, min-RTT selected; the root pins 0/0).
+    # Heat numerators are per-SHARD labeled gauges (shard_key) so they
+    # ride the digest's per-node breakdown — heat_applies is a monotone
+    # cumulative count served as a gauge (the health store derives the
+    # rate), heat_outbox is the node's pending backlog toward the shard.
+    # st_heat_*/st_slo_* are the ROOT's analyzer verdicts (obs/health.py).
+    "st_clock_offset_seconds": ("gauge", "estimated clock offset of this node vs the tree root (C_node - C_root; 0 at the root)"),
+    "st_clock_uncertainty_seconds": ("gauge", "error bound on st_clock_offset_seconds (accumulated min-RTT/2 down the tree)"),
+    "st_clock_probes_total": ("counter", "clock-offset probes sent up the uplink (wire.CLOCK round trips)"),
+    "st_shard_heat_applies": ("gauge", "cumulative FWD applies attributed to the shard at this node (per-shard; rate = shard heat numerator)"),
+    "st_shard_heat_outbox_bytes": ("gauge", "pending outbox bytes at this node destined to the shard (per-shard backlog)"),
+    "st_shard_outbox_bytes": ("gauge", "total pending outbox bytes across all shards at this node"),
+    "st_shard_outbox_limit_bytes": ("gauge", "configured outbox byte cap (ShardConfig.outbox_limit_bytes; 0 = unlimited)"),
+    "st_heat_score": ("gauge", "root analyzer: hottest shard's heat score (0.6*rate + 0.3*outbox + 0.1*alloc, each max-normalized)"),
+    "st_heat_hot_shard": ("gauge", "root analyzer: zipf-skew hot shard id (-1 = no shard dominates)"),
+    "st_slo_burn_rate": ("gauge", "root analyzer: staleness SLO burn rate over the severity's long window (per-window label)"),
+    "st_slo_alert": ("gauge", "root analyzer: staleness SLO alert severity (0=ok, 1=ticket, 2=page)"),
+    "st_slo_bad_beats_total": ("counter", "root analyzer: digest beats whose worst corrected staleness broke the objective"),
     # per-link series (rendered via link_key)
     "st_link_bytes_out_total": ("counter", "wire bytes sent on the link (incl. framing/keepalives)"),
     "st_link_bytes_in_total": ("counter", "wire bytes received on the link"),
@@ -184,6 +205,23 @@ PROCESS_GLOBAL = frozenset(
     }
 )
 
+def label_key(name: str, label: str, value) -> str:
+    """Canonical single-label series key: ``name{label="value"}``. The
+    ONLY sanctioned way to build a labeled variant of a schema name —
+    tools/lint_metrics.py bans ad-hoc dynamic construction of st_ names,
+    so every label site routes through here (or the typed wrappers).
+    Numeric values render as integers (link/shard ids); strings (the SLO
+    window names) pass through verbatim."""
+    if isinstance(value, (int, float)):
+        value = int(value)
+    return f'{name}{{{label}="{value}"}}'
+
+
 def link_key(name: str, link: int) -> str:
     """Canonical per-link series key: ``st_link_..._total{link="3"}``."""
-    return f'{name}{{link="{int(link)}"}}'
+    return label_key(name, "link", link)
+
+
+def shard_key(name: str, shard: int) -> str:
+    """Canonical per-shard series key: ``st_shard_...{shard="2"}``."""
+    return label_key(name, "shard", shard)

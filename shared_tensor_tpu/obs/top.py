@@ -8,8 +8,17 @@ breakdown — staleness, residual norm, frames, retransmits — one row per
 node. Stdlib-only and read-only: it never touches the peers, so it can run
 on a box that merely shares the file (NFS, kubectl cp loop, scp cron).
 
+v2 (r18): the viewer keeps a bounded :class:`~.timeseries.TimeSeriesStore`
+across refreshes, so the header grows throughput/staleness sparklines; when
+the root also publishes ``health.json`` (``ObsConfig.health_json_path``),
+``--health`` adds the SLO burn-rate row, a per-shard heat table naming the
+hot shard, and a per-node heat column. Truncation is honest: a truncated
+digest says how many node breakdowns were dropped and flags every total as
+exact-but-partial-breakdown rather than letting partial rows read as whole.
+
 Usage:
     python -m shared_tensor_tpu.obs.top --file /tmp/st_cluster.json
+    python -m shared_tensor_tpu.obs.top --file ... --health /tmp/st_health.json
     python -m shared_tensor_tpu.obs.top --file ... --once   # one frame (CI)
 """
 
@@ -18,8 +27,12 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import time
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+_SHARD_LABEL_RE = re.compile(r'\{shard="(\d+)"\}$')
 
 
 def _fmt(v, width=10) -> str:
@@ -28,6 +41,44 @@ def _fmt(v, width=10) -> str:
             return f"{v:>{width}.2e}"
         return f"{v:>{width}.3f}"
     return f"{v:>{width}}"
+
+
+def _spark(vals, width: int = 16) -> str:
+    """Unicode sparkline over the last ``width`` values (min..max scaled;
+    a flat series renders as all-low so spikes stay visually loud)."""
+    vals = [float(v) for v in vals][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_CHARS[0] * len(vals)
+    return "".join(
+        _SPARK_CHARS[
+            min(len(_SPARK_CHARS) - 1, int((v - lo) / span * len(_SPARK_CHARS)))
+        ]
+        for v in vals
+    )
+
+
+def _deltas(vals) -> list[float]:
+    """Positive first-differences of a counter series (reset -> 0 step)."""
+    out = []
+    for a, b in zip(vals, vals[1:]):
+        out.append(max(0.0, float(b) - float(a)))
+    return out
+
+
+def _node_heat(m: dict, health: dict) -> float:
+    """A node's heat: max health score over the shards it reports apply
+    telemetry for (the applier of a shard's FWDs is its owner)."""
+    shards = (health.get("heat") or {}).get("shards") or {}
+    best = 0.0
+    for k in m:
+        sm = _SHARD_LABEL_RE.search(k)
+        if sm is not None and k.startswith("st_shard_heat_applies{"):
+            best = max(best, float(shards.get(sm.group(1), {}).get("score", 0.0)))
+    return best
 
 
 def _node_val(m: dict, base: str) -> float:
@@ -40,19 +91,35 @@ def _node_val(m: dict, base: str) -> float:
     return best
 
 
-def render(doc: dict, prev: dict | None, dt: float) -> str:
+def render(
+    doc: dict,
+    prev: dict | None,
+    dt: float,
+    health: dict | None = None,
+    store=None,
+) -> str:
     nodes = doc.get("nodes", {})
     counters = doc.get("counters", {})
     pc = (prev or {}).get("counters", {})
+    truncated = int(doc.get("truncated", 0))
 
     def rate(name: str) -> float:
         if dt <= 0:
             return 0.0
         return max(0.0, (counters.get(name, 0) - pc.get(name, 0)) / dt)
 
+    # truncation honesty (r18): a bounded digest drops whole NODE
+    # breakdowns oldest-first but keeps exact totals — say both, loudly,
+    # instead of letting a partial node table read as the whole fleet.
+    if truncated:
+        trunc_note = (
+            f"{truncated} node breakdown(s) TRUNCATED — totals exact, "
+            f"per-node rows partial"
+        )
+    else:
+        trunc_note = "breakdown complete"
     lines = [
-        f"shared-tensor cluster digest — {len(nodes)} node(s), "
-        f"{doc.get('truncated', 0)} breakdown(s) truncated",
+        f"shared-tensor cluster digest — {len(nodes)} node(s), {trunc_note}",
         (
             f"  frames in {counters.get('st_frames_in_total', 0):.0f}"
             f" ({rate('st_frames_in_total'):.0f}/s)"
@@ -62,6 +129,16 @@ def render(doc: dict, prev: dict | None, dt: float) -> str:
             f"   dedup {counters.get('st_dedup_discards_total', 0):.0f}"
         ),
     ]
+    if store is not None and len(store):
+        spark_rows = []
+        fr = _deltas(store.values(("cluster", "st_frames_in_total")))
+        if fr:
+            spark_rows.append(f"frames/beat {_spark(fr)}")
+        st = store.values(("gmax", "st_staleness_seconds"))
+        if st:
+            spark_rows.append(f"worst stale {_spark(st)}")
+        if spark_rows:
+            lines.append("  " + "   ".join(spark_rows))
     gmax = doc.get("gmax", {})
     stale = gmax.get("st_staleness_seconds")
     resid = gmax.get("st_residual_norm")
@@ -76,6 +153,42 @@ def render(doc: dict, prev: dict | None, dt: float) -> str:
                 f"worst residual L2 {resid[0]:.4g} @ node {int(resid[1])}"
             )
         lines.append("  " + "   ".join(parts))
+    # r18 fleet health: SLO burn-rate row + per-shard heat table, fed by
+    # the root's health.json (absent -> layout falls back to pre-r18)
+    if health:
+        slo = health.get("slo") or {}
+        worst = (health.get("staleness") or {}).get("worst")
+        alert = int(slo.get("alert", 0))
+        badge = {0: "ok", 1: "TICKET", 2: "PAGE"}.get(alert, str(alert))
+        parts = [f"slo [{badge}]"]
+        if worst:
+            unc = worst.get("unc_sec")
+            parts.append(
+                f"worst corrected {worst['corrected_sec']:.4f}s"
+                + (f" ±{unc:.4f}s" if unc is not None else " (uncorrected)")
+                + f" @ node {worst.get('node', '?')}"
+            )
+        for name, w in sorted((slo.get("windows") or {}).items()):
+            flame = "*" if w.get("firing") else ""
+            parts.append(
+                f"{name}{flame} {w.get('burn_long', 0.0):.1f}x/"
+                f"{w.get('burn_short', 0.0):.1f}x"
+            )
+        lines.append("  " + "   ".join(parts))
+        heat = health.get("heat") or {}
+        shards = heat.get("shards") or {}
+        if shards:
+            hot = int(heat.get("hot_shard", -1))
+            cells = []
+            for k in sorted(shards, key=int):
+                s = shards[k]
+                mark = "!" if int(k) == hot else ""
+                cells.append(
+                    f"s{k}{mark}={s.get('score', 0.0):.2f}"
+                    f"({s.get('apply_rate', 0.0):.0f}/s)"
+                )
+            tail = f"   HOT shard {hot}" if hot >= 0 else ""
+            lines.append("  heat: " + " ".join(cells) + tail)
     # r12 lifecycle rows: only rendered while something is happening —
     # a snapshot barrier in progress (per-node paused/acked state), a
     # drain underway, or a version skew worth knowing about mid-upgrade
@@ -120,6 +233,9 @@ def render(doc: dict, prev: dict | None, dt: float) -> str:
     )
     if sharded:
         hdr += f" {'owned_w':>9} {'fwd_in':>8} {'fwd_out':>8}"
+    heatcol = bool(health and (health.get("heat") or {}).get("shards"))
+    if heatcol:
+        hdr += f" {'heat':>6}"
     lines.append(hdr)
     lines.append("-" * len(hdr))
     for nid in sorted(nodes, key=int):
@@ -141,7 +257,14 @@ def render(doc: dict, prev: dict | None, dt: float) -> str:
                 f" {int(m.get('st_shard_fwd_msgs_in_total', 0)):>8}"
                 f" {int(m.get('st_shard_fwd_msgs_out_total', 0)):>8}"
             )
+        if heatcol:
+            row += f" {_node_heat(m, health):>6.2f}"
         lines.append(row)
+    if truncated:
+        lines.append(
+            f"({truncated} more node(s) in totals but not shown: "
+            f"breakdown truncated at the digest bound)"
+        )
     return "\n".join(lines)
 
 
@@ -157,8 +280,16 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--once", action="store_true", help="render one frame and exit"
     )
+    ap.add_argument(
+        "--health", default="",
+        help="root health.json (ObsConfig.health_json_path) for the SLO "
+        "row, heat table and per-node heat column",
+    )
     args = ap.parse_args(argv)
-    prev, prev_t = None, 0.0
+    from .timeseries import TimeSeriesStore
+
+    store = TimeSeriesStore()
+    prev, prev_t, last_t = None, 0.0, -1
     while True:
         try:
             with open(args.file) as f:
@@ -169,8 +300,28 @@ def main(argv=None) -> int:
                 return 1
             time.sleep(args.interval)
             continue
+        health = None
+        if args.health:
+            try:
+                with open(args.health) as f:
+                    health = json.load(f)
+            except (OSError, ValueError):
+                health = None  # stale/missing health is not fatal to top
         now = time.monotonic()
-        frame = render(doc, prev, now - prev_t if prev is not None else 0.0)
+        # the viewer keeps its own series (sparklines): ingest each NEW
+        # digest once, keyed by its freshest node stamp (the digest has
+        # no top-level stamp of its own)
+        t_ns = max(
+            (int(n.get("t_ns", 0)) for n in doc.get("nodes", {}).values()),
+            default=time.monotonic_ns(),
+        )
+        if t_ns != last_t:
+            store.ingest(doc, t_ns)
+            last_t = t_ns
+        frame = render(
+            doc, prev, now - prev_t if prev is not None else 0.0,
+            health=health, store=store,
+        )
         if args.once:
             print(frame)
             return 0

@@ -18,10 +18,14 @@ recorder timeline into:
   (``s``/``t`` arrows) hopping across node tracks — the visual "which hop
   delayed this update" answer.
 
-Timestamps: the shared CLOCK_MONOTONIC timebase, converted to the trace
-format's microseconds. ``pid`` is the node obs id (process-unique), with
-metadata records naming them; ``tid`` separates the native ("c") and
-Python ("py") tiers.
+Timestamps: each node's CLOCK_MONOTONIC, converted to the trace format's
+microseconds. Same-process nodes share a timebase; across hosts (or the
+r18 skew simulator) they do NOT — pass ``offsets_ns`` (node obs id ->
+estimated offset from the root clock, i.e. ``st_clock_offset_seconds`` *
+1e9) and every event is re-timestamped onto the ROOT's clock, so
+cross-node flow arrows land in causal order instead of clock order.
+``pid`` is the node obs id (process-unique), with metadata records naming
+them; ``tid`` separates the native ("c") and Python ("py") tiers.
 """
 
 from __future__ import annotations
@@ -95,9 +99,22 @@ _TIER_TID = {"c": 1, "py": 2}
 
 
 def chrome_trace(
-    events: Iterable[ev.Event], flows: bool = True
+    events: Iterable[ev.Event],
+    flows: bool = True,
+    offsets_ns: Optional[dict] = None,
 ) -> dict:
-    """Chrome ``trace_event`` JSON document from a merged timeline."""
+    """Chrome ``trace_event`` JSON document from a merged timeline.
+
+    ``offsets_ns`` maps node obs id -> that node's clock offset from the
+    root in ns (``off = C_node - C_root``, the r18 clock plane's sign
+    convention); each event's ``ts`` becomes ``t_ns - off`` so every
+    track shares the root's timebase. Unlisted nodes keep raw stamps.
+    """
+    offs = offsets_ns or {}
+
+    def _ts(node: int, t_ns: int) -> float:
+        return (t_ns - int(offs.get(node, 0))) / 1000.0
+
     events = sorted(events, key=lambda e: e.t_ns)
     out: list[dict] = []
     nodes = sorted({e.node for e in events})
@@ -133,7 +150,7 @@ def chrome_trace(
                 "cat": "st",
                 "ph": "i",
                 "s": "t",  # thread-scoped instant
-                "ts": e.t_ns / 1000.0,
+                "ts": _ts(e.node, e.t_ns),
                 "pid": e.node,
                 "tid": _TIER_TID.get(e.tier, 3),
                 "args": args,
@@ -154,7 +171,7 @@ def chrome_trace(
                         "cat": "st_trace",
                         "ph": "s" if i == 0 else "t",
                         "id": flow_id,
-                        "ts": rec["t_ns"] / 1000.0,
+                        "ts": _ts(rec["node"], rec["t_ns"]),
                         "pid": rec["node"],
                         "tid": _TIER_TID.get(rec["tier"], 3),
                         "args": {"hop": rec["hop"], "origin": origin},
@@ -164,9 +181,12 @@ def chrome_trace(
 
 
 def export_file(
-    path: str, events: Iterable[ev.Event], flows: bool = True
+    path: str,
+    events: Iterable[ev.Event],
+    flows: bool = True,
+    offsets_ns: Optional[dict] = None,
 ) -> str:
-    doc = chrome_trace(events, flows=flows)
+    doc = chrome_trace(events, flows=flows, offsets_ns=offsets_ns)
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)
         f.write("\n")

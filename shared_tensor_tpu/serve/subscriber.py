@@ -570,7 +570,7 @@ class Subscriber:
                     "read-only subscriber accepts no children"
                 ),
             )
-            self.node.drop_link(link)
+            self.node.drop_link_flushed(link)
             return True
         return False  # ACK/DIGEST/...: not ours, ignore
 

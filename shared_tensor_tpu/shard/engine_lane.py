@@ -420,6 +420,22 @@ class ShardLane:
             self._lib.st_shard_counters(self._h, out)
         return out
 
+    def heat_applies_by_shard(self, fwd_in: int, owned) -> dict[int, int]:
+        """r18 heat numerator: attribute the plane's single fwd_msgs_in
+        total (``counters()[1]``) across the owned shards. The C plane
+        keeps one apply counter, so this is EXACT in the common
+        one-owned-shard topology and an even split otherwise (the python
+        tier attributes exactly per shard; the health analyzer's zipf
+        detector only needs owner-level resolution when a node owns one
+        shard — the bench topology)."""
+        owned = sorted(owned)
+        if not owned:
+            return {}
+        share, rem = divmod(int(fwd_in), len(owned))
+        return {
+            s: share + (1 if i < rem else 0) for i, s in enumerate(owned)
+        }
+
     def poll_ctrl(self) -> Optional[tuple[int, bytes]]:
         """One control-plane message the plane deferred to Python."""
         if not self._h:

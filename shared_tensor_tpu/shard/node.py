@@ -81,6 +81,8 @@ from typing import Any, Optional
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import schema as _schema
+from ..obs.clock import ClockSync
 from ..comm import wire
 from ..comm.transport import EventKind, TransportNode
 from ..compat import (
@@ -282,6 +284,17 @@ class ShardNode:
         self._announce_last = 0.0
         self._digest_last = 0.0
         self._child_digests: dict[int, dict] = {}
+        # r18 fleet health plane: per-shard apply counts (the heat-rate
+        # numerator — loop thread writes, collector reads; GIL-atomic dict
+        # ops), the simulated-skew knob, and the clock-probe beat state.
+        self._shard_applies: dict[int, int] = {}
+        skew_env = os.environ.get("ST_CLOCK_SKEW_SEC", "")
+        self._skew_ns = int(
+            float(skew_env if skew_env else self.config.obs.clock_skew_sim_sec)
+            * 1e9
+        )
+        self._clock_interval = self.config.obs.clock_sync_interval_sec
+        self._clock_last = 0.0
         self._stop = threading.Event()
         self._ready = threading.Event()
         self._wake = threading.Event()
@@ -313,6 +326,23 @@ class ShardNode:
         )
         self.is_master = self.node.is_master
         self.obs_id = int(self.node.obs_id)
+        # r18: master = tree root = the clock reference (offset pinned
+        # 0/0); the root with a health sink runs the analyzer per beat.
+        self._clock = ClockSync(self._now_ns, is_root=self.is_master)
+        self._health = None
+        if self.is_master and self.config.obs.health_json_path:
+            from ..obs.health import HealthAnalyzer
+
+            ocfg = self.config.obs
+            self._health = HealthAnalyzer(
+                path=ocfg.health_json_path,
+                history=ocfg.health_history,
+                objective_sec=ocfg.staleness_slo_sec,
+                budget=ocfg.slo_budget,
+                windows=ocfg.slo_windows,
+                skew_ratio=ocfg.heat_skew_ratio,
+                emit=self._health_event,
+            )
 
         self._obs_on = _obs.obs_enabled() and self.config.obs.enabled
         self._hub = _obs.hub() if self._obs_on else None
@@ -618,7 +648,7 @@ class ShardNode:
     def _collect(self) -> dict:
         if self._lane is not None:
             c = self._lane.counters()
-            return {
+            out = {
                 "st_shard_owned_words": self._lane.owned_words(),
                 "st_shard_alloc_bytes": self.alloc_bytes(),
                 "st_shard_routes": len(self._route),
@@ -635,19 +665,55 @@ class ShardNode:
                 "st_shard_fwd_frames_in_total": int(c[9]),
                 "st_shard_fwd_retx_total": int(c[6]),
                 "st_updates_total": int(c[7]),
+                "st_shard_outbox_bytes": self._lane.outbox_bytes(),
             }
-        return {
-            "st_shard_owned_words": self.state.owned_words(),
-            "st_shard_alloc_bytes": self.state.alloc_bytes(),
-            "st_shard_routes": len(self._route),
-            "st_shard_parked_msgs": len(self._parked),
-            "st_shard_fwd_frames_in_total": self.state.applies,
-            "st_shard_fwd_retx_total": self._retx_total,
-        }
+            # r18 heat numerator, lane mode: the counters ABI keeps one
+            # apply total; the lane attributes it across the owned shards
+            # (exact in the one-owned-shard topology)
+            for s, n in self._lane.heat_applies_by_shard(
+                int(c[1]), self.owned_shards()
+            ).items():
+                out[_schema.shard_key("st_shard_heat_applies", s)] = n
+        else:
+            out = {
+                "st_shard_owned_words": self.state.owned_words(),
+                "st_shard_alloc_bytes": self.state.alloc_bytes(),
+                "st_shard_routes": len(self._route),
+                "st_shard_parked_msgs": len(self._parked),
+                "st_shard_fwd_frames_in_total": self.state.applies,
+                "st_shard_fwd_retx_total": self._retx_total,
+                "st_shard_outbox_bytes": self.state.outbox_bytes(),
+            }
+            # r18 heat numerators, python tier: exact per-shard apply
+            # counts (tracked in _apply_fwd) and the live nonzero outbox
+            # backlog destined to each non-owned shard
+            for s, n in list(self._shard_applies.items()):
+                out[_schema.shard_key("st_shard_heat_applies", s)] = n
+            for s, b in self.state.outbox_backlog_by_shard().items():
+                out[_schema.shard_key("st_shard_heat_outbox_bytes", s)] = b
+        out["st_shard_outbox_limit_bytes"] = self.scfg.outbox_limit_bytes
+        if self._clock.known:
+            out["st_clock_offset_seconds"] = self._clock.offset_seconds
+            out["st_clock_uncertainty_seconds"] = (
+                self._clock.uncertainty_seconds
+            )
+        out["st_clock_probes_total"] = self._clock.probes
+        if self._health is not None:
+            out.update(self._health.metrics())
+        return out
 
     def _event(self, name: str, link: int = 0, arg: int = 0) -> None:
         if self._hub is not None:
             self._hub.emit(name, node=self.obs_id, link=link, arg=arg)
+
+    def _now_ns(self) -> int:
+        """Monotonic ns plus the simulated clock skew (r18; comm/peer.py
+        twin) — every cross-node-comparable stamp routes through here."""
+        return time.monotonic_ns() + self._skew_ns
+
+    def _health_event(self, name: str, arg: int, detail: str) -> None:
+        if self._hub is not None:
+            self._hub.emit(name, node=self.obs_id, arg=arg, detail=detail)
 
     # -- codec / slices ------------------------------------------------------
 
@@ -954,7 +1020,7 @@ class ShardNode:
         successor's announce supplies the route."""
         if self.state.owns(shard) and shard not in self._ho_sent:
             try:
-                self._apply_fwd(buf)
+                self._apply_fwd(buf, shard)
             except (ValueError, struct.error) as e:
                 # relays forward verbatim without decoding, so a frame a
                 # fault corrupted upstream is first DECODED here — at the
@@ -975,7 +1041,7 @@ class ShardNode:
             return True
         return False
 
-    def _apply_fwd(self, buf) -> None:
+    def _apply_fwd(self, buf, shard: int) -> None:
         """Owner-side apply with end-to-end dedup. Only the loop thread
         calls this (right after _dispatch_fwd's ownership check, with no
         release possible in between — one thread owns the protocol), so
@@ -1006,6 +1072,8 @@ class ShardNode:
                 applied |= self.state.apply_owned(scales, words, word_lo)
         if applied:
             self._m_fwd_in.inc()
+            # r18: exact per-shard attribution — the heat-rate numerator
+            self._shard_applies[shard] = self._shard_applies.get(shard, 0) + 1
 
     def _queue_room(self, link: int, keep: int = 3) -> bool:
         """True when the transport send queue has at least ``keep`` free
@@ -1103,7 +1171,7 @@ class ShardNode:
                 f"{e} (a sharded owner serves subscriptions only within "
                 f"its owned shards)"
             ))
-            self.node.drop_link(link)
+            self.node.drop_link_flushed(link)
             return
         self._subs[link] = sub = _Sub(wlo, wcnt)
         self._send_ctrl(link, wire.encode_welcome())
@@ -1111,7 +1179,7 @@ class ShardNode:
             self._send_ctrl(link, chunk)
         sub.last_fresh_t = time.monotonic()
         self._send_ctrl(
-            link, wire.encode_fresh(time.monotonic_ns(), sub.tx_seq)
+            link, wire.encode_fresh(self._now_ns(), sub.tx_seq)
         )
         self._event("sub_attach", link, wcnt)
 
@@ -1196,7 +1264,7 @@ class ShardNode:
                     0,
                     wcnt,
                     sub.tx_seq,
-                    trace=(self.obs_id, time.monotonic_ns(), 0),
+                    trace=(self.obs_id, self._now_ns(), 0),
                 )
                 # encode_rdata slices [word_lo:word_lo+cnt] out of the
                 # frame's words; our words ARE the slice already, so the
@@ -1219,7 +1287,7 @@ class ShardNode:
                 try:
                     self.node.send(
                         link,
-                        wire.encode_fresh(time.monotonic_ns(), sub.tx_seq),
+                        wire.encode_fresh(self._now_ns(), sub.tx_seq),
                         timeout=0.05,
                     )
                 except BrokenPipeError:
@@ -1675,7 +1743,7 @@ class ShardNode:
                         f"for this cluster's n_shards="
                         f"{self.map.n_shards}"
                     ))
-                    self.node.drop_link(link)
+                    self.node.drop_link_flushed(link)
                 else:
                     self._welcome_member(link)
         elif kind == wire.WELCOME:
@@ -1696,6 +1764,21 @@ class ShardNode:
             self._ready.set()
         elif kind == wire.DIGEST:
             self._child_digests[link] = wire.decode_digest(payload)
+        elif kind == wire.CLOCK:
+            # r18 clock plane (comm/peer.py twin): answer a child's probe
+            # down its own link; fold an uplink reply into the estimator
+            doc = wire.decode_clock(payload)
+            if doc.get("op") == "probe":
+                try:
+                    self.node.send(
+                        link,
+                        wire.encode_clock(self._clock.reply_payload(doc)),
+                        timeout=0.05,
+                    )
+                except BrokenPipeError:
+                    pass
+            elif doc.get("op") == "reply" and link == self._uplink:
+                self._clock.on_reply(doc)
         elif kind in (wire.CHUNK,):
             pass  # no snapshot uploads in the sharded handshake
         elif kind in (wire.DATA, wire.BURST, wire.RDATA, wire.FRESH):
@@ -1711,7 +1794,7 @@ class ShardNode:
                 f"is not byte-compatible with ours "
                 f"({self.spec.num_leaves}, {self.spec.total_n})"
             ))
-            self.node.drop_link(link)
+            self.node.drop_link_flushed(link)
             return
         flags = wire.sync_flags(payload)
         if flags & SYNC_FLAG_READ_ONLY:
@@ -1730,7 +1813,7 @@ class ShardNode:
                 "cluster with n_shards=0 / ST_SHARD=0 for the classic "
                 "protocol)"
             ))
-            self.node.drop_link(link)
+            self.node.drop_link_flushed(link)
             return
         self._pending[link] = {"sub": False, "claim": wire.sync_shard(payload)}
 
@@ -1816,7 +1899,7 @@ class ShardNode:
         from ..obs import aggregate
 
         doc = aggregate.from_snapshot(
-            self.obs_id, self._reg.snapshot(), time.monotonic_ns()
+            self.obs_id, self._reg.snapshot(), self._now_ns()
         )
         ent = doc["nodes"].get(str(self.obs_id))
         if ent is not None:
@@ -1830,18 +1913,25 @@ class ShardNode:
                 self.node.send(up, wire.encode_digest(doc), timeout=0.05)
             except BrokenPipeError:
                 pass
-        elif self.config.obs.cluster_json_path:
-            import json as _json
+        else:
+            if self._health is not None:
+                # r18: the root analyzer samples every digest beat
+                try:
+                    self._health.beat(doc, self._now_ns())
+                except Exception as e:
+                    log.debug("health beat failed: %s", e)
+            if self.config.obs.cluster_json_path:
+                import json as _json
 
-            path = self.config.obs.cluster_json_path
-            tmp = f"{path}.tmp.{os.getpid()}"
-            try:
-                with open(tmp, "w") as f:
-                    _json.dump(doc, f)
-                    f.write("\n")
-                os.replace(tmp, path)
-            except OSError as e:
-                log.debug("cluster digest write failed: %s", e)
+                path = self.config.obs.cluster_json_path
+                tmp = f"{path}.tmp.{os.getpid()}"
+                try:
+                    with open(tmp, "w") as f:
+                        _json.dump(doc, f)
+                        f.write("\n")
+                    os.replace(tmp, path)
+                except OSError as e:
+                    log.debug("cluster digest write failed: %s", e)
 
     # -- the loop ------------------------------------------------------------
 
@@ -1904,6 +1994,23 @@ class ShardNode:
                     self._publish_digest()
                 except Exception as e:
                     log.debug("digest failed: %s", e)
+            if (
+                self._clock_interval > 0
+                and not self.is_master
+                and self._uplink is not None
+                and now - self._clock_last >= self._clock_interval
+            ):
+                # r18 clock-probe beat (comm/peer.py twin): lossy — a
+                # bounced send waits for the next interval
+                self._clock_last = now
+                try:
+                    self.node.send(
+                        self._uplink,
+                        wire.encode_clock(self._clock.probe_payload()),
+                        timeout=0.05,
+                    )
+                except BrokenPipeError:
+                    pass
             if self._hub is not None:
                 self._hub.poll_native(
                     self.config.obs.native_drain_interval_sec

@@ -528,6 +528,17 @@ class ShardState:
         with self._lock:
             return sum(r.nbytes for _, r in self.outbox.values())
 
+    def outbox_backlog_by_shard(self) -> dict[int, int]:
+        """{shard: nonzero residual bytes} — the LIVE backlog destined to
+        each shard (drains to 0 at quiesce, unlike the resident-bytes
+        gauge above). The r18 per-shard heat numerator: one nonzero scan
+        per outbox per digest beat, off the hot path."""
+        with self._lock:
+            return {
+                k: int(np.count_nonzero(r)) * 4
+                for k, (_, r) in self.outbox.items()
+            }
+
     def outboxes_idle(self, tol: float = 0.0) -> bool:
         with self._lock:
             return all(

@@ -51,11 +51,24 @@ class RateMeter:
         self._samples: deque[tuple[float, dict[str, float]]] = deque()
 
     def update(self, **counters: float) -> None:
-        now = time.monotonic()
+        self.update_at(time.monotonic(), **counters)
+
+    def update_at(self, now: float, **counters: float) -> None:
+        """`update` with an explicit timestamp — the testable entry point
+        (r18 satellite), and the one for callers replaying recorded
+        counter trajectories."""
+        # Wall-clock-jump tolerance (r18 satellite): a sample stamped
+        # EARLIER than the previous one (suspend/resume replay, a caller
+        # switching time sources, test replays) would give a negative dt
+        # and an inverted window. Re-anchor exactly like a counter reset:
+        # the old timeline is unusable, the new one starts here.
+        if self._samples and now < self._samples[-1][0]:
+            self._samples.clear()
         # Counter-reset tolerance (r08 satellite): cumulative counters can
         # legitimately restart from ~0 — a link re-graft hands the stream
         # to a FRESH link id (new LinkStats), an engine peer is re-created
-        # after a crash-point kill, a compat peer reconnects. A window
+        # after a crash-point kill, a compat peer reconnects, a process
+        # restores from checkpoint with zeroed registries. A window
         # spanning the reset would then report a huge NEGATIVE rate (new
         # minus old counter). Detect any counter going backwards and drop
         # the pre-reset history: the meter re-anchors at the reset point
@@ -101,7 +114,12 @@ class RateMeter:
             }
             t0 = min(cutoff, tb)
         dt = max(t1 - t0, 1e-9)
-        return {k: (c1.get(k, 0.0) - c0.get(k, 0.0)) / dt for k in c1}
+        # Clamped at zero: resets/rewinds re-anchor the window above, so a
+        # negative delta here can only be float noise at the interpolated
+        # edge — and a rate is a non-negative quantity by definition.
+        return {
+            k: max(0.0, (c1.get(k, 0.0) - c0.get(k, 0.0)) / dt) for k in c1
+        }
 
 
 def effective_bits(rms_trajectory: Iterable[float]) -> float:

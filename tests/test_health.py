@@ -1,0 +1,442 @@
+"""r18 fleet-health plane: time-series store, analyzer, clock offsets.
+
+Pure-python unit coverage of the observability tentpole — no sockets, no
+fleets (the end-to-end arm is benchmarks/fleet_health.py): ring bounds
+and eviction honesty on the TimeSeriesStore, reset-tolerant rates against
+hand-computed deltas, zipf-heat naming on synthetic digests, SLO
+burn-rate alerts in BOTH directions (fire on stall, clear on recovery),
+the offset-corrected staleness arithmetic, ClockSync convergence on a
+simulated skew, the hardened RateMeter, the truncation-honest ``obs.top``
+renderer, and the re-timestamped Perfetto export.
+"""
+
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from shared_tensor_tpu.obs import top as top_mod  # noqa: E402
+from shared_tensor_tpu.obs import trace_export  # noqa: E402
+from shared_tensor_tpu.obs.clock import ClockSync  # noqa: E402
+from shared_tensor_tpu.obs.events import Event, HEALTH_EVENT_NAMES  # noqa: E402
+from shared_tensor_tpu.obs.health import HealthAnalyzer  # noqa: E402
+from shared_tensor_tpu.obs.timeseries import (  # noqa: E402
+    TimeSeriesStore, hist_quantile,
+)
+from shared_tensor_tpu.utils.profiling import RateMeter  # noqa: E402
+
+S = int(1e9)  # one second in ns
+
+
+def _doc(nodes: dict, counters: dict | None = None,
+         truncated: int = 0) -> dict:
+    """Minimal digest doc in the aggregate.py v1 shape."""
+    return {
+        "v": 1,
+        "nodes": {
+            str(nid): {"t_ns": 0, "m": dict(m)} for nid, m in nodes.items()
+        },
+        "counters": dict(counters or {}),
+        "hists": {},
+        "gmax": {},
+        "gmin": {},
+        "proc": {},
+        "truncated": truncated,
+    }
+
+
+# -- TimeSeriesStore ---------------------------------------------------------
+
+
+class TestTimeSeriesStore:
+    def test_ring_bounds(self):
+        st = TimeSeriesStore(max_points=8)
+        for i in range(50):
+            st.ingest(_doc({}, {"st_frames_in_total": i}), i * S)
+        vals = st.values(("cluster", "st_frames_in_total"))
+        assert len(vals) == 8          # ring capped
+        assert vals == list(range(42, 50))  # oldest evicted first
+
+    def test_series_eviction_is_counted(self):
+        st = TimeSeriesStore(max_series=3)
+        # 4 distinct node series with staggered last-update stamps
+        for i, name in enumerate(["a", "b", "c", "d"]):
+            st.ingest(_doc({7: {f"st_{name}": 1.0}}), i * S)
+        assert len(st) == 3
+        assert st.evicted == 1
+        # the least-recently-updated series ("a") is the one gone
+        assert st.series(("node", 7, "st_a")) is None
+        assert st.series(("node", 7, "st_d")) is not None
+
+    def test_rate_matches_hand_computed(self):
+        st = TimeSeriesStore()
+        # 100 frames/s for 4 beats at 1s spacing
+        for i in range(5):
+            st.ingest(_doc({}, {"st_frames_in_total": 100 * i}), i * S)
+        r = st.cluster_rate("st_frames_in_total", window_sec=10.0)
+        assert abs(r - 100.0) < 1e-9
+        # window narrower than the history: only the trailing span counts
+        r2 = st.cluster_rate("st_frames_in_total", window_sec=2.0)
+        assert abs(r2 - 100.0) < 1e-9
+
+    def test_rate_tolerates_counter_reset(self):
+        st = TimeSeriesStore()
+        # 0, 100, 200, then the node restarts: 5, 105 — the negative
+        # delta contributes ZERO, never a negative spike
+        for i, v in enumerate([0, 100, 200, 5, 105]):
+            st.ingest(_doc({}, {"st_frames_in_total": v}), i * S)
+        r = st.cluster_rate("st_frames_in_total", window_sec=10.0)
+        # gained = 100 + 100 + 0 + 100 over 4s
+        assert abs(r - 75.0) < 1e-9
+        assert r >= 0.0
+
+    def test_node_series_keeps_labeled_names_verbatim(self):
+        st = TimeSeriesStore()
+        st.ingest(_doc({3: {'st_shard_heat_applies{shard="2"}': 10.0}}), S)
+        st.ingest(_doc({3: {'st_shard_heat_applies{shard="2"}': 30.0}}), 2 * S)
+        assert st.node_rate(3, 'st_shard_heat_applies{shard="2"}', 10.0) == 20.0
+
+    def test_hist_quantile(self):
+        h = {"sum": 0, "count": 100,
+             "buckets": {"1": 50, "2": 90, "4": 100}}
+        assert hist_quantile(h, 0.5) == 1.0
+        # p99: target 99 between cum 90 (bound 2) and 100 (bound 4)
+        assert abs(hist_quantile(h, 0.99) - (2 + 2 * 9 / 10)) < 1e-9
+        assert hist_quantile({"count": 0, "buckets": {}}, 0.5) == 0.0
+
+
+# -- RateMeter hardening -----------------------------------------------------
+
+
+class TestRateMeter:
+    def test_rates_match_hand_computed(self):
+        m = RateMeter(window_sec=10.0)
+        m.update_at(0.0, frames=0)
+        m.update_at(2.0, frames=50)
+        assert abs(m.rates()["frames"] - 25.0) < 1e-9
+
+    def test_wall_clock_rewind_reanchors(self):
+        m = RateMeter(window_sec=10.0)
+        m.update_at(100.0, frames=1000)
+        m.update_at(50.0, frames=1010)   # clock jumped BACKWARDS
+        m.update_at(52.0, frames=1030)
+        r = m.rates()
+        assert r["frames"] >= 0.0
+        assert abs(r["frames"] - 10.0) < 1e-9  # only the new timeline
+
+    def test_counter_reset_reanchors(self):
+        m = RateMeter(window_sec=10.0)
+        m.update_at(0.0, frames=10_000)
+        m.update_at(1.0, frames=0)       # restart: counter rewound
+        m.update_at(2.0, frames=30)
+        r = m.rates()
+        assert abs(r["frames"] - 30.0) < 1e-9
+        assert r["frames"] >= 0.0
+
+    def test_rates_never_negative(self):
+        m = RateMeter(window_sec=10.0)
+        m.update_at(0.0, a=0.0)
+        m.update_at(1.0, a=1e-9)  # float-noise-scale positive delta
+        assert all(v >= 0.0 for v in m.rates().values())
+
+
+# -- ClockSync ---------------------------------------------------------------
+
+
+class TestClockSync:
+    def test_converges_on_simulated_skew(self):
+        skew_ns = 50_000_000  # child runs +50ms ahead of the root
+        t = {"now": 0}
+
+        def root_now():
+            return t["now"]
+
+        def child_now():
+            return t["now"] + skew_ns
+
+        root = ClockSync(root_now, is_root=True)
+        child = ClockSync(child_now)
+        assert root.known and root.offset_ns == 0
+        assert not child.known
+        for _ in range(8):
+            probe = child.probe_payload()
+            t["now"] += 200_000          # 0.2ms uplink transit
+            reply = root.reply_payload(probe)
+            t["now"] += 300_000          # 0.3ms downlink transit
+            assert child.on_reply(reply)
+        assert child.known
+        # min-RTT bound: |error| <= rtt/2 = 0.25ms
+        assert abs(child.offset_ns - skew_ns) <= child.uncertainty_ns
+        assert child.uncertainty_ns <= 250_000 + 1
+
+    def test_unconverged_parent_is_skipped(self):
+        t = {"now": 0}
+        parent = ClockSync(lambda: t["now"])       # NOT root: no estimate
+        child = ClockSync(lambda: t["now"])
+        reply = parent.reply_payload(child.probe_payload())
+        assert "off_ns" not in reply
+        assert not child.on_reply(reply)
+        assert not child.known
+
+
+# -- HealthAnalyzer: heat ----------------------------------------------------
+
+
+def _heat_doc(applies: dict, t_ns: int) -> dict:
+    nodes = {
+        nid: {f'st_shard_heat_applies{{shard="{k}"}}': float(v)}
+        for nid, (k, v) in applies.items()
+    }
+    return _doc(nodes)
+
+
+class TestHeat:
+    def test_names_hot_shard_on_zipf_writes(self):
+        events = []
+        a = HealthAnalyzer(skew_ratio=3.0, heat_window_sec=10.0,
+                           emit=lambda *e: events.append(e))
+        # shard 1 applies 100/s, shards 0 and 2 apply 10/s each
+        for i in range(4):
+            a.beat(_heat_doc({
+                10: (0, 10 * i), 11: (1, 100 * i), 12: (2, 10 * i),
+            }, i * S), i * S)
+        d = a.doc()
+        assert d["heat"]["hot_shard"] == 1
+        assert d["heat"]["skew_ratio"] >= 3.0
+        assert any(e[0] == "hot_shard" and e[1] == 1 for e in events)
+        assert "hot_shard" in HEALTH_EVENT_NAMES
+
+    def test_uniform_fleet_has_no_hot_shard(self):
+        a = HealthAnalyzer(skew_ratio=3.0)
+        for i in range(4):
+            a.beat(_heat_doc({
+                10: (0, 50 * i), 11: (1, 55 * i), 12: (2, 45 * i),
+            }, i * S), i * S)
+        assert a.doc()["heat"]["hot_shard"] == -1
+
+    def test_heat_metrics_render_labeled_gauges(self):
+        a = HealthAnalyzer()
+        for i in range(3):
+            a.beat(_heat_doc({10: (0, 10 * i), 11: (1, 90 * i)}, i * S),
+                   i * S)
+        m = a.metrics()
+        assert m["st_heat_hot_shard"] == float(a.doc()["heat"]["hot_shard"])
+        assert 'st_slo_burn_rate{window="page"}' in m
+
+
+# -- HealthAnalyzer: staleness correction ------------------------------------
+
+
+class TestStalenessCorrection:
+    def test_offset_corrected_with_error_bound(self):
+        a = HealthAnalyzer()
+        # applier 1 (offset -10ms), origin 2 (offset +50ms): the raw
+        # cross-clock age must widen by off_origin - off_applier = +60ms
+        doc = _doc({
+            1: {
+                'st_staleness_seconds{link="3"}': 0.200,
+                'st_staleness_origin{link="3"}': 2.0,
+                "st_clock_offset_seconds": -0.010,
+                "st_clock_uncertainty_seconds": 0.001,
+            },
+            2: {
+                "st_clock_offset_seconds": 0.050,
+                "st_clock_uncertainty_seconds": 0.002,
+            },
+        })
+        a.beat(doc, S)
+        rec = a.doc()["staleness"]["nodes"]["1"]
+        assert abs(rec["corrected_sec"] - 0.260) < 1e-9
+        assert abs(rec["unc_sec"] - 0.003) < 1e-9
+        assert rec["origin"] == 2
+
+    def test_missing_clock_keeps_raw_flagged(self):
+        a = HealthAnalyzer()
+        a.beat(_doc({1: {"st_staleness_seconds": 0.5}}), S)
+        rec = a.doc()["staleness"]["nodes"]["1"]
+        assert rec["corrected_sec"] == 0.5
+        assert rec["unc_sec"] is None   # flagged, never silently trusted
+
+    def test_corrected_clamps_at_zero(self):
+        a = HealthAnalyzer()
+        doc = _doc({
+            1: {
+                'st_staleness_seconds{link="3"}': 0.010,
+                'st_staleness_origin{link="3"}': 2.0,
+                "st_clock_offset_seconds": 0.0,
+                "st_clock_uncertainty_seconds": 0.001,
+            },
+            2: {
+                "st_clock_offset_seconds": -0.050,
+                "st_clock_uncertainty_seconds": 0.001,
+            },
+        })
+        a.beat(doc, S)
+        assert a.doc()["staleness"]["nodes"]["1"]["corrected_sec"] == 0.0
+
+
+# -- HealthAnalyzer: SLO both directions -------------------------------------
+
+
+class TestSlo:
+    def _analyzer(self, events):
+        return HealthAnalyzer(
+            objective_sec=1.0,
+            budget=0.05,
+            windows=(("page", 4.0, 1.0, 2.0),),
+            emit=lambda *e: events.append(e),
+        )
+
+    def _beat(self, a, stale_sec, t_ns):
+        a.beat(_doc({1: {"st_staleness_seconds": stale_sec}}), t_ns)
+
+    def test_fires_on_stall_and_clears_on_recovery(self):
+        events = []
+        a = self._analyzer(events)
+        t = 0
+        for _ in range(10):                 # healthy: 0.1s staleness
+            t += S // 5
+            self._beat(a, 0.1, t)
+        assert a.doc()["slo"]["alert"] == 0
+        for _ in range(10):                 # stall: objective blown
+            t += S // 5
+            self._beat(a, 5.0, t)
+        assert a.doc()["slo"]["alert"] == 2
+        assert a.doc()["slo"]["windows"]["page"]["firing"]
+        assert [e[0] for e in events].count("slo_alert_fire") == 1
+        for _ in range(10):                 # recovery
+            t += S // 5
+            self._beat(a, 0.1, t)
+        assert a.doc()["slo"]["alert"] == 0
+        assert not a.doc()["slo"]["windows"]["page"]["firing"]
+        assert [e[0] for e in events].count("slo_alert_clear") == 1
+        assert {"slo_alert_fire", "slo_alert_clear"} <= HEALTH_EVENT_NAMES
+
+    def test_short_blip_does_not_page(self):
+        events = []
+        a = self._analyzer(events)
+        t = 0
+        for _ in range(19):
+            t += S // 5
+            self._beat(a, 0.1, t)
+        t += S // 5
+        self._beat(a, 5.0, t)               # ONE bad beat
+        # long window 4s = 20 beats, 1 bad => burn 1/s window may spike
+        # but the LONG window (1/20/0.05 = 1.0x) stays under 2x: no page
+        assert a.doc()["slo"]["alert"] == 0
+        assert not any(e[0] == "slo_alert_fire" for e in events)
+
+    def test_bad_beats_counter_monotonic(self):
+        a = self._analyzer([])
+        t = 0
+        for i in range(6):
+            t += S // 5
+            self._beat(a, 5.0 if i % 2 else 0.1, t)
+        assert a.bad_beats == 3
+        assert a.metrics()["st_slo_bad_beats_total"] == 3
+
+
+# -- health.json write -------------------------------------------------------
+
+
+def test_health_json_written_atomically(tmp_path):
+    path = tmp_path / "health.json"
+    a = HealthAnalyzer(path=str(path))
+    a.beat(_doc({1: {"st_staleness_seconds": 0.2}}), S)
+    import json
+
+    doc = json.loads(path.read_text())
+    assert doc["v"] == 1
+    assert doc["beats"] == 1
+    assert "slo" in doc and "heat" in doc and "staleness" in doc
+    assert not list(tmp_path.glob("*.tmp.*"))  # no droppings
+
+
+# -- obs.top v2 --------------------------------------------------------------
+
+
+class TestTopRender:
+    def test_truncation_honesty(self):
+        doc = _doc({1: {"st_frames_in_total": 5.0}},
+                    {"st_frames_in_total": 10}, truncated=3)
+        out = top_mod.render(doc, None, 0.0)
+        assert "3 node breakdown(s) TRUNCATED" in out
+        assert "totals exact" in out
+        assert "breakdown truncated at the digest bound" in out
+
+    def test_complete_breakdown_says_so(self):
+        out = top_mod.render(_doc({1: {}}), None, 0.0)
+        assert "breakdown complete" in out
+        assert "TRUNCATED" not in out
+
+    def test_health_slo_row_and_heat_table(self):
+        doc = _doc({11: {'st_shard_heat_applies{shard="1"}': 50.0}})
+        health = {
+            "slo": {
+                "alert": 2,
+                "windows": {"page": {"burn_long": 20.0, "burn_short": 14.0,
+                                      "firing": True}},
+            },
+            "staleness": {
+                "worst": {"corrected_sec": 5.0, "unc_sec": 0.003,
+                          "raw_sec": 4.95, "node": 11, "origin": 12},
+            },
+            "heat": {
+                "hot_shard": 1,
+                "shards": {
+                    "0": {"score": 0.2, "apply_rate": 10.0},
+                    "1": {"score": 1.0, "apply_rate": 100.0},
+                },
+            },
+        }
+        out = top_mod.render(doc, None, 0.0, health=health)
+        assert "slo [PAGE]" in out
+        assert "worst corrected 5.0000s ±0.0030s" in out
+        assert "page* 20.0x/14.0x" in out
+        assert "HOT shard 1" in out
+        assert "s1!=1.00(100/s)" in out
+        assert "heat" in out  # per-node heat column header
+
+    def test_uncorrected_staleness_is_flagged(self):
+        health = {
+            "slo": {"alert": 0, "windows": {}},
+            "staleness": {"worst": {"corrected_sec": 0.4, "unc_sec": None,
+                                    "raw_sec": 0.4, "node": 1,
+                                    "origin": None}},
+            "heat": {"hot_shard": -1, "shards": {}},
+        }
+        out = top_mod.render(_doc({1: {}}), None, 0.0, health=health)
+        assert "(uncorrected)" in out
+
+    def test_sparkline_rows_from_store(self):
+        st = TimeSeriesStore()
+        for i in range(6):
+            st.ingest(_doc({}, {"st_frames_in_total": 100 * i}), i * S)
+        out = top_mod.render(_doc({}), None, 0.0, store=st)
+        assert "frames/beat" in out
+        assert any(ch in out for ch in top_mod._SPARK_CHARS)
+
+
+# -- Perfetto export re-timestamping -----------------------------------------
+
+
+def test_chrome_trace_offsets_rebase_onto_root_clock():
+    # node 7 runs +50ms ahead: its instant must land at t - off
+    events = [
+        Event(t_ns=1_050_000_000, tier="py", name="digest_publish", node=7),
+        Event(t_ns=1_000_000_000, tier="py", name="digest_publish", node=1),
+    ]
+    doc = trace_export.chrome_trace(
+        events, flows=False, offsets_ns={7: 50_000_000}
+    )
+    ts = {e["pid"]: e["ts"] for e in doc["traceEvents"] if e["ph"] == "i"}
+    assert ts[1] == 1_000_000_000 / 1000.0
+    assert ts[7] == 1_000_000_000 / 1000.0  # rebased onto the root clock
+
+
+def test_chrome_trace_unlisted_nodes_keep_raw_stamps():
+    events = [Event(t_ns=2_000_000, tier="c", name="link_up", node=4)]
+    doc = trace_export.chrome_trace(events, flows=False, offsets_ns={9: 99})
+    inst = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert inst[0]["ts"] == 2_000.0

@@ -42,6 +42,7 @@ _LINT_INPUTS = [
     "shared_tensor_tpu/obs/schema.py",
     "shared_tensor_tpu/shard/node.py",
     "shared_tensor_tpu/shard/engine_lane.py",
+    "shared_tensor_tpu/obs/health.py",
 ]
 
 
@@ -280,6 +281,28 @@ def test_event_lint_flags_renamed_shm_event(tmp_path):
           '34: "shm_lane_up"', '34: "shm_lane_went_up"')
     findings = lint_events.run(root)
     assert any("shm_lane_up" in f for f in findings), findings
+
+
+def test_event_lint_flags_renamed_health_event(tmp_path):
+    # r18: the fleet_health bench tallies key on the EXACT names in
+    # HEALTH_EVENT_NAMES — a rename on the declaring side must red
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/obs/events.py",
+          '"slo_alert_fire"', '"slo_alert_fired"')
+    findings = lint_events.run(root)
+    assert any("slo_alert_fire" in f for f in findings), findings
+
+
+def test_event_lint_flags_health_emit_outside_set(tmp_path):
+    # r18, the other direction: the analyzer emitting an event name the
+    # pinned set does not know means nothing downstream can tally it
+    root = _seed_tree(tmp_path)
+    _edit(root, "shared_tensor_tpu/obs/health.py",
+          'self._event(\n                "hot_shard"',
+          'self._event(\n                "hot_shard_named"')
+    findings = lint_events.run(root)
+    assert any("hot_shard_named" in f and "HEALTH_EVENT_NAMES" in f
+               for f in findings), findings
 
 
 def test_abi_lint_flags_dropped_shm_declaration(tmp_path):

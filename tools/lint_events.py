@@ -110,6 +110,40 @@ def run(repo: pathlib.Path) -> list[str]:
                 f"shm chaos tallies key on this exact name"
             )
 
+    # r18 health events: the analyzer's alert/heat events are python-tier
+    # names pinned by HEALTH_EVENT_NAMES, and every name the analyzer
+    # actually emits must be in that set — a rename on either side would
+    # silently zero the fleet_health bench's timeline tallies (which key
+    # on these exact names), with no red anywhere else
+    epy = L.read(repo, "shared_tensor_tpu/obs/events.py")
+    hm = re.search(
+        r"HEALTH_EVENT_NAMES\s*=\s*frozenset\(\s*\{(.*?)\}", epy, flags=re.S
+    )
+    if not hm:
+        findings.append(
+            "obs/events.py HEALTH_EVENT_NAMES parse failed (pattern rot?)"
+        )
+        health_names: set[str] = set()
+    else:
+        health_names = set(re.findall(r'"([a-z0-9_]+)"', hm.group(1)))
+        for want in ("slo_alert_fire", "slo_alert_clear", "hot_shard"):
+            if want not in health_names:
+                findings.append(
+                    f"obs/events.py HEALTH_EVENT_NAMES lost '{want}' — the "
+                    f"fleet_health bench tallies key on this exact name"
+                )
+    hpy = L.strip_py_comments(L.read(repo, "shared_tensor_tpu/obs/health.py"))
+    emitted = set(re.findall(r'self\._event\(\s*"([a-z0-9_]+)"', hpy))
+    if not emitted:
+        findings.append(
+            "obs/health.py emits no events (self._event parse rot?)"
+        )
+    for name in sorted(emitted - health_names):
+        findings.append(
+            f"obs/health.py emits '{name}' which is not in "
+            f"obs/events.py HEALTH_EVENT_NAMES"
+        )
+
     # membership kinds: transport.py's EventKind enum doubles as timeline
     # codes 1..4 (Node::emit feeds both surfaces with one number)
     tpy = L.strip_py_comments(
