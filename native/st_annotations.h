@@ -62,8 +62,19 @@
 
 #define ST_CAPABILITY(x) ST_TSA_(capability(x))
 #define ST_SCOPED_CAPABILITY ST_TSA_(scoped_lockable)
+// In C, clang does not late-parse thread-safety attribute arguments, so
+// a struct member cannot reference a sibling mutex member ("use of
+// undeclared identifier 'mu'") — which is exactly what stcodec.c's
+// g_pool fields need. The C TU keeps the capability/acquire/release
+// CONTRACTS (parameter references parse fine); its guarded-by
+// discipline is checked by the TSan arm instead.
+#if defined(__cplusplus)
 #define ST_GUARDED_BY(x) ST_TSA_(guarded_by(x))
 #define ST_PT_GUARDED_BY(x) ST_TSA_(pt_guarded_by(x))
+#else
+#define ST_GUARDED_BY(x)
+#define ST_PT_GUARDED_BY(x)
+#endif
 #define ST_ACQUIRED_BEFORE(...) ST_TSA_(acquired_before(__VA_ARGS__))
 #define ST_ACQUIRED_AFTER(...) ST_TSA_(acquired_after(__VA_ARGS__))
 #define ST_REQUIRES(...) ST_TSA_(requires_capability(__VA_ARGS__))

@@ -3138,9 +3138,15 @@ __attribute__((visibility("default"))) void* st_engine_create(
   // trace context is native-framing only (the reference compat protocol
   // has no header to extend)
   e->trace_wire = (trace_wire != 0 && compat_frame_bytes <= 0) ? 1 : 0;
-  e->values.assign((size_t)total, 0.0f);
-  if (init_values)
-    std::memcpy(e->values.data(), init_values, (size_t)total * 4);
+  {
+    // values is ST_GUARDED_BY(mu); the engine is not shared yet, but
+    // take the lock anyway — uncontended, and -Wthread-safety cannot
+    // see "not published yet"
+    StLockGuard lk(e->mu);
+    e->values.assign((size_t)total, 0.0f);
+    if (init_values)
+      std::memcpy(e->values.data(), init_values, (size_t)total * 4);
+  }
   // tx ring slot size: kBodyOff bytes of header room (body 8-aligned for
   // the codec kernels; headers pack flush against it) + the largest
   // message this engine can emit. The window (kSendWindow) bounds live

@@ -42,7 +42,10 @@
 #include <time.h>
 #include <unistd.h>
 
-#if defined(__x86_64__) && defined(__SSE2__)
+// ST_ANALYZE_NO_SIMD: the clang front-end analyzer (-Wthread-safety,
+// tools/analyze_clang.py) cannot parse gcc's intrinsics headers; it
+// analyzes the scalar reference paths instead. Never set by any build.
+#if defined(__x86_64__) && defined(__SSE2__) && !defined(ST_ANALYZE_NO_SIMD)
 #include <emmintrin.h>  // NT stores for the shm ring bulk copies
 #endif
 
@@ -198,12 +201,14 @@ struct RingHolder {
 
 // event codes (ABI; obs/events.py CODE_NAMES is the authoritative mirror).
 // 1..4 reuse the membership Event kinds verbatim.
-constexpr uint32_t kEvRetransmit = 10;
-constexpr uint32_t kEvBlackhole = 11;
-constexpr uint32_t kEvQuarantine = 12;
-constexpr uint32_t kEvWindowStall = 13;
-constexpr uint32_t kEvDedupDiscard = 14;
-constexpr uint32_t kEvSeal = 15;
+// maybe_unused: several are ABI documentation — the emit sites build the
+// code inline (clang's -Wunused-const-variable would flag them).
+[[maybe_unused]] constexpr uint32_t kEvRetransmit = 10;
+[[maybe_unused]] constexpr uint32_t kEvBlackhole = 11;
+[[maybe_unused]] constexpr uint32_t kEvQuarantine = 12;
+[[maybe_unused]] constexpr uint32_t kEvWindowStall = 13;
+[[maybe_unused]] constexpr uint32_t kEvDedupDiscard = 14;
+[[maybe_unused]] constexpr uint32_t kEvSeal = 15;
 constexpr uint32_t kEvFaultDrop = 20;
 constexpr uint32_t kEvFaultDup = 21;
 constexpr uint32_t kEvFaultCorrupt = 22;
@@ -474,7 +479,7 @@ struct Lane {
 // publish (shm_write_record does it); the scalar head/tail protocol is
 // untouched.
 inline void nt_copy(uint8_t* dst, const uint8_t* src, size_t n) {
-#if defined(__x86_64__) && defined(__SSE2__)
+#if defined(__x86_64__) && defined(__SSE2__) && !defined(ST_ANALYZE_NO_SIMD)
   if (n >= 256) {
     // align dst to 16 for the streaming stores
     size_t head = ((uintptr_t)dst & 15) ? 16 - ((uintptr_t)dst & 15) : 0;
@@ -1269,7 +1274,7 @@ bool shm_write_record(Node* node, const std::shared_ptr<Link>& link,
       head += c;
       src += c;
       n -= c;
-#if defined(__x86_64__) && defined(__SSE2__)
+#if defined(__x86_64__) && defined(__SSE2__) && !defined(ST_ANALYZE_NO_SIMD)
       _mm_sfence();  // NT stores must drain before the head publish
 #endif
       rc.head.store(head, std::memory_order_release);
@@ -1294,7 +1299,7 @@ bool shm_write_record(Node* node, const std::shared_ptr<Link>& link,
       if (len > 0)
         stshm::ring_put(base, rb, head + stshm::kRecHdr, payload, len);
       head += stshm::kRecHdr + len;
-#if defined(__x86_64__) && defined(__SSE2__)
+#if defined(__x86_64__) && defined(__SSE2__) && !defined(ST_ANALYZE_NO_SIMD)
       _mm_sfence();  // NT stores must drain before the head publish
 #endif
       rc.head.store(head, std::memory_order_release);

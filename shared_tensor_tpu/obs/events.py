@@ -118,6 +118,26 @@ HEALTH_EVENT_NAMES = frozenset(
     }
 )
 
+#: r19 elastic-resharding events (python tier, name-only — reserved by
+#: the protospec reshard models BEFORE the implementation lands, so the
+#: r20 implementation emits against conformance acceptors that already
+#: exist; tools/lint_events.py pins the set). *_begin/*_done bracket one
+#: staged transfer on the owning node (arg = shard / epoch);
+#: reshard_grant carries the minted epoch in arg with node = the minter
+#: (tools/protospec/spec_reshard.py's MasterAuthorityAcceptor checks the
+#: epochs mint monotonically and only from the current authority).
+RESHARD_EVENT_NAMES = frozenset(
+    {
+        "reshard_split_begin",
+        "reshard_split_done",
+        "reshard_merge_begin",
+        "reshard_merge_done",
+        "reshard_master_begin",
+        "reshard_master_done",
+        "reshard_grant",
+    }
+)
+
 #: Names the flight recorder treats as fault-injection hits (timeline
 #: accounting in the chaos soak keys on these).
 FAULT_EVENT_NAMES = frozenset(

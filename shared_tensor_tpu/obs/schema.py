@@ -177,6 +177,8 @@ SCHEMA: dict[str, tuple[str, str]] = {
     "st_clock_probes_total": ("counter", "clock-offset probes sent up the uplink (wire.CLOCK round trips)"),
     "st_shard_heat_applies": ("gauge", "cumulative FWD applies attributed to the shard at this node (per-shard; rate = shard heat numerator)"),
     "st_shard_heat_outbox_bytes": ("gauge", "pending outbox bytes at this node destined to the shard (per-shard backlog)"),
+    "st_shard_heat_deposit_msgs": ("gauge", "cumulative pre-coalesce outbox deposits destined to the shard at this node (writer-side; its rate vs the st_shard_fwd_msgs_out_total drain rate is the coalescing ratio — diverging deposits with flat msgs_out = saturated writer)"),
+    "st_shard_heat_deposit_bytes": ("gauge", "cumulative pre-coalesce payload bytes deposited toward the shard at this node (writer-side byte twin of st_shard_heat_deposit_msgs)"),
     "st_shard_outbox_bytes": ("gauge", "total pending outbox bytes across all shards at this node"),
     "st_shard_outbox_limit_bytes": ("gauge", "configured outbox byte cap (ShardConfig.outbox_limit_bytes; 0 = unlimited)"),
     "st_heat_score": ("gauge", "root analyzer: hottest shard's heat score (0.6*rate + 0.3*outbox + 0.1*alloc, each max-normalized)"),

@@ -288,6 +288,13 @@ class ShardNode:
         # numerator — loop thread writes, collector reads; GIL-atomic dict
         # ops), the simulated-skew knob, and the clock-probe beat state.
         self._shard_applies: dict[int, int] = {}
+        # r19 writer-side heat twins: raw outbox deposits BEFORE residual
+        # coalescing (user threads write under _dep_mu, collector reads) —
+        # the post-coalesce st_shard_fwd_msgs_out_total rate saturates at
+        # the drain rate, so this is the only honest write-pressure signal
+        self._dep_mu = threading.Lock()
+        self._shard_deposits: dict[int, int] = {}
+        self._shard_deposit_bytes: dict[int, int] = {}
         skew_env = os.environ.get("ST_CLOCK_SKEW_SEC", "")
         self._skew_ns = int(
             float(skew_env if skew_env else self.config.obs.clock_skew_sim_sec)
@@ -408,6 +415,15 @@ class ShardNode:
         flat = flatten_np(delta, self.spec, copy=False)
         self._admit_add(flat)
         if self._lane is not None:
+            # deposit twins ride a python-side scan (the native plane
+            # coalesces inside add_flat); the owns() read is racy vs a
+            # concurrent adopt, which can only misattribute one beat's
+            # worth of deposits — fine for a gauge
+            for k in range(m.n_shards):
+                elo, ehi = m.element_range(k)
+                seg = flat[elo:ehi]
+                if np.any(seg) and not self._lane.owns(k):
+                    self._track_deposit(k, seg.size * 4)
             # engine lane: ONE native call splits in-shard (exact apply)
             # from out-of-shard (outbox deposit) under the plane's mutex
             self._lane.add_flat(
@@ -423,7 +439,8 @@ class ShardNode:
             # ONE lock acquisition decides owned-vs-outbox AND writes: a
             # separate owns() check here would race the loop thread's
             # adopt()/release() into a stranded outbox or a spurious raise
-            self.state.add_delta(k, lambda k=k: self._codec(k), elo, seg)
+            if self.state.add_delta(k, lambda k=k: self._codec(k), elo, seg):
+                self._track_deposit(k, seg.size * 4)
         self._m_updates.inc()
         self._wake.set()
 
@@ -645,6 +662,15 @@ class ShardNode:
 
     # -- observability -------------------------------------------------------
 
+    def _track_deposit(self, shard: int, nbytes: int) -> None:
+        with self._dep_mu:
+            self._shard_deposits[shard] = (
+                self._shard_deposits.get(shard, 0) + 1
+            )
+            self._shard_deposit_bytes[shard] = (
+                self._shard_deposit_bytes.get(shard, 0) + nbytes
+            )
+
     def _collect(self) -> dict:
         if self._lane is not None:
             c = self._lane.counters()
@@ -691,6 +717,17 @@ class ShardNode:
                 out[_schema.shard_key("st_shard_heat_applies", s)] = n
             for s, b in self.state.outbox_backlog_by_shard().items():
                 out[_schema.shard_key("st_shard_heat_outbox_bytes", s)] = b
+        # r19 pre-coalesce deposit twins (lane-blind, writer-side): the
+        # raw deposit rate vs the st_shard_fwd_msgs_out_total drain rate
+        # is the coalescing ratio — a saturated writer shows deposits
+        # racing ahead while msgs_out flatlines at the drain ceiling
+        with self._dep_mu:
+            deposits = dict(self._shard_deposits)
+            deposit_bytes = dict(self._shard_deposit_bytes)
+        for s, n in deposits.items():
+            out[_schema.shard_key("st_shard_heat_deposit_msgs", s)] = n
+        for s, b in deposit_bytes.items():
+            out[_schema.shard_key("st_shard_heat_deposit_bytes", s)] = b
         out["st_shard_outbox_limit_bytes"] = self.scfg.outbox_limit_bytes
         if self._clock.known:
             out["st_clock_offset_seconds"] = self._clock.offset_seconds

@@ -292,19 +292,22 @@ class ShardState:
 
     def add_delta(
         self, shard: int, codec_fn, elo: int, delta: np.ndarray
-    ) -> None:
+    ) -> bool:
         """Apply an in-shard delta exactly OR deposit it into the shard's
         outbox — decided and written under ONE lock acquisition, so a
         caller-thread ``add()`` cannot race the loop thread's ``adopt()``/
         ``release()`` into a stranded outbox (adopt folds outboxes under
         this same lock) or a spurious does-not-own raise. ``codec_fn``
         builds the outbox SliceCodec lazily (owned applies never need
-        one)."""
+        one). Returns True iff the delta landed in the outbox (the
+        caller's pre-coalesce deposit twins key on the decision this
+        lock made, not on a racy owns() re-check)."""
         with self._lock:
             if shard in self.owned:
                 self._add_in_shard_locked(shard, elo, delta)
-            else:
-                self._add_outbox_locked(shard, codec_fn(), elo, delta)
+                return False
+            self._add_outbox_locked(shard, codec_fn(), elo, delta)
+            return True
 
     def add_in_shard(self, shard: int, elo: int, delta: np.ndarray) -> None:
         """Apply an in-shard delta slice [elo, elo+len) — exact f32, no

@@ -1,20 +1,27 @@
-"""Protocol model checker + runtime trace conformance (r15 tentpole).
+"""Protocol model checker + runtime trace conformance (r15 tentpole,
+r19 reductions + liveness).
 
-Three layers, mirroring tools/protospec's own structure:
+Four layers, mirroring tools/protospec's own structure:
 
 1. the EXPLORER: every true spec explores clean (zero violations,
    quiescence reachable, graph exhausted — not truncated), twice with
    identical counts (the committed MODEL artifact pins exact numbers,
    so nondeterminism is a bug);
-2. the RED TEAM: each seeded mutation — the three historical r10/r11/
-   r12 protocol bugs plus the extra lane-switch ordering mutation — is
-   FOUND within the documented depth bound, and its counterexample
-   trace REPLAYS through the mutated spec to the violating state (a
+2. the RED TEAM: each seeded mutation — the historical hand-found
+   protocol bugs plus the per-subsystem signature bugs — is FOUND
+   within the documented depth bound, and its counterexample trace
+   REPLAYS through the mutated spec to the violating state (a
    counterexample that can't be replayed is a checker bug);
-3. CONFORMANCE: the monitor accepts the committed CHAOS_r12/CHAOS_r14
+3. the r19 REDUCTIONS are sound: symmetry + ample-set POR re-find
+   every mutation the pre-reduction r17 artifact pinned at the
+   same-or-smaller depth, agree with the unreduced explorer on every
+   verdict, and the fair-lasso liveness pass goes red on a toy
+   livelock (and is excused by a declared fairness assumption);
+4. CONFORMANCE: the monitor accepts the committed CHAOS_r12/CHAOS_r14
    fixture timelines (pinned from real cluster_chaos.py runs — spec
    edits can't silently diverge from shipped behavior) and rejects a
-   battery of synthetic forbidden orderings, one per acceptor rule.
+   battery of synthetic forbidden orderings, one per acceptor rule —
+   including the r19 reshard/global-scope acceptors.
 """
 
 import json
@@ -30,6 +37,7 @@ sys.path.insert(0, str(TOOLS))
 
 from protospec import all_specs, explore  # noqa: E402
 from protospec.conformance import check_timeline, load_timeline  # noqa: E402
+from protospec.core import Spec  # noqa: E402
 
 FIXTURES = REPO / "tests" / "fixtures"
 
@@ -39,6 +47,14 @@ HISTORICAL = {
     "sub.fresh_no_seq",  # r10: FRESH falsely verifying over a lost tail
     "lane_stripe.requeue_before_kill",  # r11: last-stripe requeue livelock
     "snap.async_pause",  # r12: pre-pause pass leaking mass across the cut
+}
+
+#: the r19 reshard red-team set (ISSUE r19 acceptance bar)
+RESHARD = {
+    "reshard_split.split_during_fwd",
+    "reshard_split.stale_grant_readopt",
+    "reshard_merge.merge_drops_inflight_outbox",
+    "master_handoff.two_minters_after_handoff",
 }
 
 
@@ -76,6 +92,7 @@ def test_exploration_is_deterministic():
 
 def test_historical_bugs_are_encoded():
     assert HISTORICAL <= _mutation_keys()
+    assert RESHARD <= _mutation_keys()
 
 
 @pytest.mark.parametrize(
@@ -117,10 +134,10 @@ def test_mutation_counterexamples_replay():
 
 
 def test_model_artifact_matches_checker():
-    """MODEL_r17.json pins the explored state/transition counts; a spec
-    edit that changes the graph must re-commit the artifact, not drift
-    silently."""
-    path = REPO / "MODEL_r17.json"
+    """MODEL_r19.json pins the explored state/transition counts AND the
+    liveness verdicts; a spec edit that changes the graph must re-commit
+    the artifact, not drift silently."""
+    path = REPO / "MODEL_r19.json"
     doc = json.loads(path.read_text())
     assert doc["pass"] is True
     for name, cls in all_specs().items():
@@ -129,9 +146,14 @@ def test_model_artifact_matches_checker():
         assert (pinned["states"], pinned["transitions"]) == (
             res.states,
             res.transitions,
-        ), f"{name}: MODEL_r17.json is stale — re-run run_check.py"
+        ), f"{name}: MODEL_r19.json is stale — re-run run_check.py"
         assert pinned["violations"] == []
         assert pinned["quiescent_reachable"] is True
+        assert pinned.get("liveness", {}) == res.liveness, name
+    # the reshard family carries real liveness verdicts, all proven
+    for name in ("reshard_split", "reshard_merge", "master_handoff"):
+        liv = doc["specs"][name]["liveness"]
+        assert liv and all(v is True for v in liv.values()), (name, liv)
     for key in _mutation_keys():
         assert doc["mutations"][key]["found"] is True, key
 
@@ -147,6 +169,119 @@ def test_run_check_cli(tmp_path):
     doc = json.loads(out.read_text())
     assert doc["pass"] is True
     assert HISTORICAL <= set(doc["mutations"])
+    assert RESHARD <= set(doc["mutations"])
+
+
+# ---- r19: the reductions are sound ----------------------------------------
+
+
+def test_reduced_explorer_refinds_pinned_mutations():
+    """Soundness regression for symmetry + POR: with the reductions ON
+    (the default), every mutation the PRE-reduction r17 artifact pinned
+    is re-found at the same-or-smaller depth — a reduction that hides a
+    committed counterexample is unsound, full stop."""
+    doc = json.loads((REPO / "MODEL_r17.json").read_text())
+    for key, pinned in sorted(doc["mutations"].items()):
+        name, mut = key.split(".")
+        res = explore(all_specs()[name](mutation=mut))
+        assert res.violations, f"{key}: reduction hid the counterexample"
+        depth = len(res.violations[0].trace)
+        assert depth <= pinned["first_violation"]["depth"], (
+            key, depth, pinned["first_violation"]["depth"]
+        )
+
+
+@pytest.mark.parametrize(
+    "name", ["reshard_split", "reshard_merge", "master_handoff"]
+)
+def test_reduced_and_unreduced_explorers_agree(name):
+    """The specs with REAL canon/ample hooks: reduced and unreduced
+    exploration reach the same verdicts (safety, quiescence, liveness)
+    and the reduction genuinely shrinks the graph."""
+    cls = all_specs()[name]
+    red = explore(cls())
+    full = explore(cls(), reduction=False)
+    for res in (red, full):
+        assert res.violations == [], name
+        assert res.quiescent_reachable and not res.truncated_by_depth
+    assert red.liveness == full.liveness, name
+    assert red.states < full.states, (name, red.states, full.states)
+    for mut in cls.mutations:
+        assert explore(cls(mutation=mut)).violations, (name, mut)
+        assert explore(cls(mutation=mut), reduction=False).violations, (
+            name, mut
+        )
+
+
+# ---- r19: liveness verdicts -----------------------------------------------
+
+
+class _Spin(Spec):
+    """Toy livelock for red-teaming the fair-lasso pass: `spin` cycles
+    forever in the not-done region while `finish` stays enabled. With no
+    fairness every cycle is fair -> the livelock is a violation; with
+    weak fairness on `finish` the spin cycle is excused only if finish
+    is taken — it never is, so the cycle is UNFAIR and the property
+    holds (the implementation really does retry finish unconditionally
+    in the scenario this models)."""
+
+    name = "toy_spin"
+    depth_bound = 8
+
+    def __init__(self, mutation=None, fair=False):
+        super().__init__(mutation)
+        self._fair = fair
+
+    def initial(self):
+        return (0, 0)  # (done, tick)
+
+    def enabled(self, s):
+        return [] if s[0] else [("spin",), ("finish",)]
+
+    def apply(self, s, act):
+        if act[0] == "spin":
+            return (s[0], 1 - s[1])
+        return (1, s[1])
+
+    def invariants(self, s):
+        return []
+
+    def quiescent(self, s):
+        return bool(s[0])
+
+    def liveness(self):
+        return {"eventually-done": lambda s: bool(s[0])}
+
+    def fairness(self):
+        if self._fair:
+            return [("finish", lambda a: a[0] == "finish")]
+        return []
+
+
+def test_liveness_checker_finds_livelock():
+    res = explore(_Spin())
+    assert res.liveness["eventually-done"] is False
+    assert not res.ok
+    lasso = [v for v in res.violations if v.kind == "liveness"]
+    assert lasso, [v.as_dict() for v in res.violations]
+    assert "spin" in lasso[0].detail
+
+
+def test_liveness_checker_respects_declared_fairness():
+    res = explore(_Spin(fair=True))
+    assert res.liveness["eventually-done"] is True
+    assert res.violations == [] and res.ok
+
+
+def test_liveness_verdict_is_unknown_when_truncated():
+    """A liveness check over a depth-truncated graph proves nothing —
+    the verdict must be None (unknown) and the result NOT ok, never a
+    silent green."""
+    res = explore(all_specs()["reshard_split"](), depth_bound=3)
+    assert res.truncated_by_depth
+    assert res.liveness
+    assert all(v is None for v in res.liveness.values())
+    assert not res.ok
 
 
 # ---- conformance: the pinned chaos fixtures -------------------------------
@@ -268,6 +403,89 @@ def test_conformance_rejects_dead_stripe_reattach():
 
 def test_conformance_rejects_drain_with_no_seal():
     _violates([_ev("drain_begin")], "no seal")
+
+
+def test_conformance_rejects_nested_split_begin():
+    _violates(
+        [_ev("reshard_split_begin"), _ev("reshard_split_begin")],
+        "nested reshard_split_begin",
+    )
+
+
+def test_conformance_rejects_overlapping_split_and_merge():
+    _violates(
+        [_ev("reshard_split_begin"), _ev("reshard_merge_begin")],
+        "must not overlap",
+    )
+
+
+def test_conformance_rejects_reshard_done_without_begin():
+    _violates([_ev("reshard_merge_done")], "without an open")
+
+
+def test_conformance_accepts_open_split_at_end_of_run():
+    # kill-restore chaos reuses node ids, so a killed node legitimately
+    # leaves a begin open — the reshard acceptors carry no end-of-run
+    # obligation (unlike pause/resume)
+    report = check_timeline(
+        [_ev("reshard_split_begin"), _ev("reshard_split_done"),
+         _ev("reshard_split_begin")]
+    )
+    assert report["violations"] == [], report["violations"]
+
+
+def test_conformance_rejects_grant_while_authority_in_flight():
+    _violates(
+        [_ev("reshard_master_begin"), _ev("reshard_grant", arg=1)],
+        "in flight",
+    )
+
+
+def test_conformance_rejects_stale_minter_grant():
+    _violates(
+        [
+            _ev("reshard_master_begin", node=1),
+            _ev("reshard_master_done", node=2),
+            _ev("reshard_grant", node=1, arg=1),
+        ],
+        "no-stale-minter",
+    )
+
+
+def test_conformance_rejects_nonmonotonic_grant_epoch():
+    _violates(
+        [_ev("reshard_grant", arg=3), _ev("reshard_grant", node=1, arg=3)],
+        "epoch monotonicity",
+    )
+
+
+def test_conformance_master_acceptor_scope_is_global():
+    # the authority acceptor must see the WHOLE timeline as one scope:
+    # node 3's stale grant is only wrong relative to node 2's done, and
+    # no single node observed both events
+    _violates(
+        [
+            _ev("reshard_master_begin", node=1),
+            _ev("reshard_master_done", node=2),
+            _ev("reshard_grant", node=3, arg=5),
+        ],
+        "no-stale-minter",
+    )
+
+
+def test_conformance_accepts_legal_reshard_timeline():
+    ok = [
+        _ev("reshard_master_begin", node=1),
+        _ev("reshard_master_done", node=2),
+        _ev("reshard_grant", node=2, arg=1),
+        _ev("reshard_split_begin", node=2),
+        _ev("reshard_split_done", node=2),
+        _ev("reshard_grant", node=2, arg=2),
+        _ev("reshard_merge_begin", node=3),
+        _ev("reshard_merge_done", node=3),
+    ]
+    report = check_timeline(ok)
+    assert report["violations"] == [], report["violations"]
 
 
 def test_conformance_accepts_legal_orderings():

@@ -471,3 +471,48 @@ def test_st_shard_0_pins_classic_protocol(monkeypatch):
         assert isinstance(h.peer, SharedTensorPeer)
     finally:
         h.close()
+
+
+def test_deposit_twins_expose_saturated_writer():
+    # r19 writer-side heat twins: a lone master owning shard 0 of 2 has
+    # no owner to drain shard 1's outbox toward, so every add() with
+    # shard-1 mass coalesces into ONE pending residual — the
+    # post-coalesce st_shard_fwd_msgs_out_total flatlines while the
+    # pre-coalesce st_shard_heat_deposit_* twins keep counting the true
+    # write pressure (the saturation signature the gauges exist for)
+    from shared_tensor_tpu.obs import schema as _sch
+
+    port = free_port()
+    h = create_or_fetch_sharded("127.0.0.1", port, TMPL, _cfg(0, n=2))
+    try:
+        assert h.sharded
+        node = h.node
+        elo, ehi = node.map.element_range(1)
+        seg_bytes = (ehi - elo) * 4
+        # leaves flatten alphabetically (b then w), so w's TAIL is what
+        # lands in shard 1's element range
+        d = {
+            "w": np.zeros(4096, np.float32),
+            "b": np.zeros(512, np.float32),
+        }
+        d["w"][-1] = 1.0
+        for _ in range(8):
+            h.add(d)
+        out = node._collect()
+        assert out[_sch.shard_key("st_shard_heat_deposit_msgs", 1)] == 8
+        assert (
+            out[_sch.shard_key("st_shard_heat_deposit_bytes", 1)]
+            == 8 * seg_bytes
+        )
+        # saturated: nothing drained, the coalesced residual is all there is
+        assert out.get("st_shard_fwd_msgs_out_total", 0) == 0
+        # owned in-shard applies never count as deposits (b flattens
+        # into shard 0's range, which this lone master owns)
+        h.add({
+            "w": np.zeros(4096, np.float32),
+            "b": np.ones(512, np.float32),
+        })
+        out = node._collect()
+        assert _sch.shard_key("st_shard_heat_deposit_msgs", 0) not in out
+    finally:
+        h.close()

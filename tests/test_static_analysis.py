@@ -24,10 +24,12 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 TOOLS = REPO / "tools"
 sys.path.insert(0, str(TOOLS))
 
+import analyze_clang  # noqa: E402
 import lint_abi  # noqa: E402
 import lint_events  # noqa: E402
 import lint_locks  # noqa: E402
 import lint_metrics  # noqa: E402
+import lint_spec  # noqa: E402
 import lint_wire  # noqa: E402
 
 #: every file any lint reads, relative to the repo root
@@ -459,6 +461,137 @@ def test_locks_lint_skips_closures_under_lock(tmp_path):
           "            mo = sum(self._acked.values())")
     findings = lint_locks.run(root)
     assert findings == [], findings
+
+
+# ---- spec/mutation registry drift lint (r19) ------------------------------
+
+
+def _seed_spec_tree(tmp_path: pathlib.Path) -> pathlib.Path:
+    """Everything lint_spec reads: the spec modules, the committed MODEL
+    artifacts, and README's mutation table."""
+    root = tmp_path / "repo"
+    (root / "tools" / "protospec").mkdir(parents=True)
+    for src in (REPO / "tools" / "protospec").glob("spec_*.py"):
+        shutil.copy(src, root / "tools" / "protospec" / src.name)
+    for src in REPO.glob("MODEL_r*.json"):
+        shutil.copy(src, root / src.name)
+    shutil.copy(REPO / "README.md", root / "README.md")
+    return root
+
+
+def test_spec_lint_green_on_tree():
+    assert lint_spec.run(REPO) == []
+    r = _cli("lint_spec.py", REPO)
+    assert r.returncode == 0 and "OK" in r.stdout, (r.stdout, r.stderr)
+
+
+def test_spec_lint_flags_phantom_mutation(tmp_path):
+    # a MODEL artifact citing a mutation the spec no longer codes: the
+    # committed red-team coverage claim would be a lie
+    import json
+    root = _seed_spec_tree(tmp_path)
+    p = root / "MODEL_r19.json"
+    doc = json.loads(p.read_text())
+    doc["mutations"]["reshard_split.ghost_never_coded"] = (
+        doc["mutations"]["reshard_split.split_during_fwd"]
+    )
+    p.write_text(json.dumps(doc))
+    findings = lint_spec.run(root)
+    assert any(
+        "phantom mutation" in f and "ghost_never_coded" in f
+        for f in findings
+    ), findings
+    r = _cli("lint_spec.py", root)
+    assert r.returncode == 1 and "ghost_never_coded" in r.stdout
+
+
+def test_spec_lint_flags_phantom_spec(tmp_path):
+    import json
+    root = _seed_spec_tree(tmp_path)
+    p = root / "MODEL_r19.json"
+    doc = json.loads(p.read_text())
+    doc["mutations"]["reshard_teleport.any_mutation"] = (
+        doc["mutations"]["reshard_split.split_during_fwd"]
+    )
+    p.write_text(json.dumps(doc))
+    findings = lint_spec.run(root)
+    assert any(
+        "phantom spec" in f and "reshard_teleport" in f for f in findings
+    ), findings
+
+
+def test_spec_lint_flags_undocumented_mutation(tmp_path):
+    # a coded mutation README never cites: invisible red-team coverage —
+    # seeded as a new Spec subclass so the dict-literal arm is exercised
+    root = _seed_spec_tree(tmp_path)
+    p = root / "tools" / "protospec" / "spec_reshard.py"
+    p.write_text(
+        p.read_text()
+        + "\n\nclass _SeededSpec(Spec):\n"
+        + '    name = "reshard_seeded"\n'
+        + '    mutations = {"sneaky_uncited_mutation": None}\n'
+    )
+    findings = lint_spec.run(root)
+    assert any(
+        "undocumented mutation" in f
+        and "reshard_seeded.sneaky_uncited_mutation" in f
+        for f in findings
+    ), findings
+
+
+def test_spec_lint_resolves_dict_extension_idiom():
+    # shard_engine extends shard's mutations via dict(Base.mutations,
+    # extra=...) — the static resolution must see through it (the tree
+    # being green already proves the base keys; pin the extension key)
+    registry, findings = lint_spec._coded_registry(REPO)
+    assert findings == []
+    assert "relay_restamp_identity" in registry["shard_engine"]
+    assert "no_dedup_transfer" in registry["shard_engine"]
+    assert "split_during_fwd" in registry["reshard_split"]
+
+
+# ---- libclang thread-safety gate (r19, probe-gated) -----------------------
+
+_LIBCLANG_REASON = analyze_clang.probe()
+
+
+@pytest.mark.skipif(
+    _LIBCLANG_REASON is not None, reason=str(_LIBCLANG_REASON)
+)
+def test_analyze_clang_green_on_tree():
+    """The r13 -Wthread-safety contract, actually executed: all three
+    native TUs parse clean under the libclang front-end."""
+    assert analyze_clang.run(REPO) == []
+
+
+@pytest.mark.skipif(
+    _LIBCLANG_REASON is not None, reason=str(_LIBCLANG_REASON)
+)
+def test_analyze_clang_flags_unguarded_access(tmp_path):
+    # drop the lock guard around a ST_GUARDED_BY(mu) field init — the
+    # gate must red on the exact class it exists for
+    root = _seed_tree(tmp_path, full_package=True)
+    _edit(root, "native/stengine.cpp",
+          "    StLockGuard lk(e->mu);\n    e->values.assign",
+          "    e->values.assign")
+    findings = analyze_clang.run(root)
+    assert any(
+        "values" in f and ("warning" in f or "error" in f)
+        for f in findings
+    ), findings
+
+
+def test_analyze_clang_probe_cli_is_honest():
+    r = subprocess.run(
+        [sys.executable, str(TOOLS / "analyze_clang.py"), "--probe"],
+        capture_output=True, text=True, timeout=60,
+    )
+    if _LIBCLANG_REASON is None:
+        assert r.returncode == 0 and "usable" in r.stdout
+    else:
+        # the SKIPPED path must print the provisioning command, not
+        # silently pass
+        assert r.returncode == 1 and "pip install libclang" in r.stdout
 
 
 # ---- clang analyze / clang-tidy smoke (skipped without clang) -------------

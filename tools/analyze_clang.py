@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Hermetic clang front-end for the native -Wthread-safety gate.
+
+The r13 lock-annotation work (native/st_annotations.h: ST_GUARDED_BY,
+StMutex/StLockGuard) targets clang's -Wthread-safety analysis, but the
+image ships gcc only — ``make -C native analyze`` had NEVER actually
+executed, so the annotations were written blind. This tool closes that
+debt without a clang driver binary: the pip-provisioned ``libclang``
+wheel (clang.cindex) is a full C/C++ front-end, and -Wthread-safety is
+a front-end analysis — parsing the TU is running the gate.
+
+Two impedance mismatches vs. a real clang driver, both handled here:
+
+- the wheel ships no builtin headers and no driver to locate the
+  system C++ ones, so the include search list is lifted verbatim from
+  the gcc driver (``g++ -E -v``) plus gcc's builtin include dir;
+- gcc's SIMD intrinsics headers (emmintrin/immintrin) use gcc-only
+  builtins clang cannot parse, so the TUs are parsed with
+  ``-DST_ANALYZE_NO_SIMD`` — the native sources gate their intrinsics
+  includes/bodies on it and the scalar reference paths get analyzed
+  (the thread-safety annotations the gate exists for are not in the
+  SIMD bodies).
+
+``run(repo)`` returns findings (any clang diagnostic of severity
+warning or above in repo sources — the gate is -Werror in spirit);
+``--probe`` exits 0/1 on whether the front-end is usable at all, so
+suite_load.sh can stay SKIPPED-no-clang honestly when it is not.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+
+import _lintlib
+
+#: TUs the Makefile's analyze target covers, with language mode.
+_UNITS = [
+    ("native/sttransport.cpp", "c++"),
+    ("native/stengine.cpp", "c++"),
+    ("native/stcodec.c", "c"),
+]
+
+_WARN_FLAGS = ["-Wall", "-Wextra", "-Wthread-safety"]
+
+
+def _driver_includes(lang: str) -> list[str]:
+    """The gcc driver's include search list for ``lang`` (c or c++) —
+    libclang has no driver, so borrow gcc's."""
+    driver = "g++" if lang == "c++" else "gcc"
+    try:
+        out = subprocess.run(
+            [driver, "-E", "-x", lang, "-", "-v"],
+            input="",
+            capture_output=True,
+            text=True,
+            timeout=30,
+        ).stderr
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    dirs: list[str] = []
+    grab = False
+    for line in out.splitlines():
+        if line.startswith("#include <...> search starts here"):
+            grab = True
+            continue
+        if line.startswith("End of search list"):
+            break
+        if grab:
+            d = line.strip().split(" ")[0]
+            if pathlib.Path(d).is_dir():
+                dirs.append(str(pathlib.Path(d).resolve()))
+    return dirs
+
+
+def _load_cindex():
+    try:
+        from clang import cindex  # pip "libclang" wheel
+    except ImportError:
+        return None
+    try:
+        cindex.Index.create()
+    except Exception:
+        return None
+    return cindex
+
+
+def probe() -> str | None:
+    """None if the front-end is usable, else the reason it is not."""
+    if _load_cindex() is None:
+        return (
+            "libclang front-end unavailable — provision with: "
+            "python -m pip install libclang"
+        )
+    return None
+
+
+def _parse_args(repo: pathlib.Path, lang: str) -> list[str]:
+    args = ["-x", lang, "-std=c++17" if lang == "c++" else "-std=c11",
+            "-pthread", "-fsyntax-only", "-DST_ANALYZE_NO_SIMD",
+            "-I", str(repo / "native")]
+    args += _WARN_FLAGS
+    # the shim stdatomic.h must shadow gcc's (clang rejects gcc's
+    # __atomic_* expansion on _Atomic lvalues)
+    shim = pathlib.Path(__file__).resolve().parent / "analyze_include"
+    if lang == "c" and shim.is_dir():
+        args += ["-isystem", str(shim)]
+    for d in _driver_includes(lang):
+        args += ["-isystem", d]
+    return args
+
+
+def run(repo: str | pathlib.Path = ".") -> list[str]:
+    repo = pathlib.Path(repo)
+    cindex = _load_cindex()
+    if cindex is None:
+        return [
+            "analyze_clang: libclang front-end unavailable "
+            "(python -m pip install libclang)"
+        ]
+    findings: list[str] = []
+    index = cindex.Index.create()
+    for rel, lang in _UNITS:
+        path = repo / rel
+        if not path.is_file():
+            findings.append(f"{rel}: missing translation unit")
+            continue
+        try:
+            tu = index.parse(str(path), args=_parse_args(repo, lang))
+        except cindex.TranslationUnitLoadError as exc:
+            findings.append(f"{rel}: front-end failed to parse ({exc})")
+            continue
+        for d in tu.diagnostics:
+            if d.severity < cindex.Diagnostic.Warning:
+                continue
+            loc = d.location
+            where = (
+                f"{loc.file.name}:{loc.line}:{loc.column}"
+                if loc.file
+                else rel
+            )
+            # system headers are the toolchain's business, not ours
+            if loc.file is not None:
+                f = str(pathlib.Path(loc.file.name).resolve())
+                if not f.startswith(str(repo.resolve()) + "/"):
+                    continue
+            sev = {2: "warning", 3: "error", 4: "fatal"}.get(
+                d.severity, "diag"
+            )
+            findings.append(f"{where}: {sev}: {d.spelling}")
+    return findings
+
+
+def main() -> int:
+    if "--probe" in sys.argv[1:]:
+        reason = probe()
+        if reason:
+            print(f"analyze_clang --probe: {reason}")
+            return 1
+        print("analyze_clang --probe: libclang front-end usable")
+        return 0
+    return _lintlib.main(run)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,72 @@
+/* Analyzer-only <stdatomic.h> for tools/analyze_clang.py.
+ *
+ * The pip libclang wheel ships NO builtin headers, so the front-end
+ * borrows gcc's include dirs — but gcc's stdatomic.h expands the C11
+ * atomic generics to __atomic_* builtins, which clang REJECTS on
+ * _Atomic-qualified lvalues (clang routes _Atomic through its
+ * __c11_atomic_* builtins instead). This shim is the clang spelling of
+ * the same header, covering exactly the operations the native C tier
+ * uses (stcodec.c). It is -isystem'd AHEAD of the gcc dirs by
+ * analyze_clang.py only — no build ever sees it.
+ */
+#ifndef ST_ANALYZE_STDATOMIC_H_
+#define ST_ANALYZE_STDATOMIC_H_
+
+#ifndef __clang__
+#error "analyzer shim: only the libclang front-end may include this"
+#endif
+
+typedef enum memory_order {
+  memory_order_relaxed = __ATOMIC_RELAXED,
+  memory_order_consume = __ATOMIC_CONSUME,
+  memory_order_acquire = __ATOMIC_ACQUIRE,
+  memory_order_release = __ATOMIC_RELEASE,
+  memory_order_acq_rel = __ATOMIC_ACQ_REL,
+  memory_order_seq_cst = __ATOMIC_SEQ_CST
+} memory_order;
+
+#define ATOMIC_VAR_INIT(value) (value)
+#define atomic_init __c11_atomic_init
+
+#define atomic_load_explicit __c11_atomic_load
+#define atomic_store_explicit __c11_atomic_store
+#define atomic_exchange_explicit __c11_atomic_exchange
+#define atomic_fetch_add_explicit __c11_atomic_fetch_add
+#define atomic_fetch_sub_explicit __c11_atomic_fetch_sub
+#define atomic_fetch_or_explicit __c11_atomic_fetch_or
+#define atomic_fetch_and_explicit __c11_atomic_fetch_and
+#define atomic_compare_exchange_weak_explicit(obj, exp, des, suc, fail) \
+  __c11_atomic_compare_exchange_weak(obj, exp, des, suc, fail)
+#define atomic_compare_exchange_strong_explicit(obj, exp, des, suc, fail) \
+  __c11_atomic_compare_exchange_strong(obj, exp, des, suc, fail)
+
+#define atomic_load(obj) atomic_load_explicit(obj, memory_order_seq_cst)
+#define atomic_store(obj, des) \
+  atomic_store_explicit(obj, des, memory_order_seq_cst)
+#define atomic_exchange(obj, des) \
+  atomic_exchange_explicit(obj, des, memory_order_seq_cst)
+#define atomic_fetch_add(obj, arg) \
+  atomic_fetch_add_explicit(obj, arg, memory_order_seq_cst)
+#define atomic_fetch_sub(obj, arg) \
+  atomic_fetch_sub_explicit(obj, arg, memory_order_seq_cst)
+#define atomic_compare_exchange_weak(obj, exp, des)                       \
+  atomic_compare_exchange_weak_explicit(obj, exp, des,                    \
+                                        memory_order_seq_cst,             \
+                                        memory_order_seq_cst)
+#define atomic_compare_exchange_strong(obj, exp, des)                     \
+  atomic_compare_exchange_strong_explicit(obj, exp, des,                  \
+                                          memory_order_seq_cst,          \
+                                          memory_order_seq_cst)
+
+#define atomic_thread_fence(order) __c11_atomic_thread_fence(order)
+#define atomic_signal_fence(order) __c11_atomic_signal_fence(order)
+
+typedef _Atomic _Bool atomic_bool;
+typedef _Atomic int atomic_int;
+typedef _Atomic unsigned int atomic_uint;
+typedef _Atomic long atomic_long;
+typedef _Atomic unsigned long atomic_ulong;
+typedef _Atomic long long atomic_llong;
+typedef _Atomic unsigned long long atomic_ullong;
+
+#endif /* ST_ANALYZE_STDATOMIC_H_ */

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""lint_spec: spec/mutation registry drift lint (the r09 schema-lint
+discipline applied to protospec).
+
+The protospec red-team story rests on two registries staying in sync:
+
+- the CODE registry: every ``Spec`` subclass in
+  ``tools/protospec/spec_*.py`` declares ``name`` and a ``mutations``
+  dict — the set of seeded historical bugs the checker must re-find;
+- the DOCUMENTED registry: the committed ``MODEL_r*.json`` artifacts
+  and README's "Protocol specs & model checking" table cite mutations
+  as ``spec.mutation`` tokens.
+
+Drift in either direction is a lie: a PHANTOM mutation (cited in the
+artifact/README but absent from code) claims red-team coverage that no
+longer exists; an UNDOCUMENTED mutation (coded but never cited in
+README) is invisible to the reader deciding whether a bug class is
+covered. Both are findings.
+
+Like every lint here, this PARSES source (ast) — it never imports the
+modules under test, so a broken spec file is a finding, not a crash
+somewhere else. ``dict(Base.mutations, extra=...)`` extension (the
+spec_shard idiom) is resolved statically through same-module bases.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+
+import _lintlib
+
+
+def _class_mutations(tree: ast.Module) -> dict[str, tuple]:
+    """class name -> (spec_name | None, own mutation names, base class
+    names) for every class in one spec module."""
+    out: dict[str, tuple] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        spec_name = None
+        muts: set[str] | None = None
+        mut_base: str | None = None
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1):
+                continue
+            tgt = stmt.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            if tgt.id == "name" and isinstance(stmt.value, ast.Constant):
+                if isinstance(stmt.value.value, str):
+                    spec_name = stmt.value.value
+            if tgt.id == "mutations":
+                v = stmt.value
+                if isinstance(v, ast.Dict):
+                    muts = {
+                        k.value
+                        for k in v.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    }
+                elif (
+                    isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Name)
+                    and v.func.id == "dict"
+                    and len(v.args) == 1
+                    and isinstance(v.args[0], ast.Attribute)
+                    and v.args[0].attr == "mutations"
+                    and isinstance(v.args[0].value, ast.Name)
+                ):
+                    # dict(Base.mutations, extra=..., ...) — the
+                    # extension idiom; base resolved after the pass
+                    mut_base = v.args[0].value.id
+                    muts = {kw.arg for kw in v.keywords if kw.arg}
+        bases = [b.id for b in node.bases if isinstance(b, ast.Name)]
+        if mut_base:
+            bases = [mut_base] + bases
+        out[node.name] = (spec_name, muts, bases)
+    return out
+
+
+def _coded_registry(repo: pathlib.Path) -> tuple[dict[str, set], list[str]]:
+    """spec name -> mutation names, from ast over spec_*.py."""
+    findings: list[str] = []
+    registry: dict[str, set] = {}
+    for path in sorted((repo / "tools" / "protospec").glob("spec_*.py")):
+        try:
+            tree = ast.parse(path.read_text(errors="replace"), filename=str(path))
+        except SyntaxError as exc:
+            findings.append(f"{path.name}: unparseable spec module ({exc})")
+            continue
+        classes = _class_mutations(tree)
+
+        def resolve(cls: str, seen: frozenset = frozenset()) -> set:
+            if cls not in classes or cls in seen:
+                return set()
+            spec_name, muts, bases = classes[cls]
+            inherited: set = set()
+            for b in bases:
+                inherited |= resolve(b, seen | {cls})
+            return inherited | (muts or set())
+
+        for cls, (spec_name, muts, bases) in classes.items():
+            if spec_name is None:
+                continue  # acceptor / helper class, not a spec
+            registry[spec_name] = resolve(cls)
+    return registry, findings
+
+
+_TOKEN = re.compile(r"`([a-z][a-z0-9_]*)\.([a-z][a-z0-9_]*)`")
+
+
+def _cited(repo: pathlib.Path, spec_names: set) -> dict[str, set]:
+    """``spec.mutation`` token -> the sources citing it, from every
+    committed MODEL_r*.json plus README's backticked tokens (filtered
+    to known spec names — `obs.recorder` is a module path, not a
+    mutation)."""
+    cites: dict[str, set] = {}
+    for path in sorted(repo.glob("MODEL_r*.json")):
+        try:
+            doc = json.loads(path.read_text(errors="replace"))
+        except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+            cites.setdefault(f"!{path.name}", set()).add(str(exc))
+            continue
+        for tok in doc.get("mutations", {}):
+            cites.setdefault(tok, set()).add(path.name)
+    readme = repo / "README.md"
+    if readme.is_file():
+        for m in _TOKEN.finditer(readme.read_text(errors="replace")):
+            if m.group(1) in spec_names:
+                cites.setdefault(f"{m.group(1)}.{m.group(2)}", set()).add(
+                    "README.md"
+                )
+    return cites
+
+
+def run(repo: str | pathlib.Path = ".") -> list[str]:
+    repo = pathlib.Path(repo)
+    registry, findings = _coded_registry(repo)
+    if not registry:
+        findings.append("no spec modules found under tools/protospec/")
+        return findings
+    coded = {
+        f"{spec}.{mut}" for spec, muts in registry.items() for mut in muts
+    }
+    cites = _cited(repo, set(registry))
+    for tok in sorted(cites):
+        if tok.startswith("!"):
+            findings.append(f"{tok[1:]}: unreadable MODEL artifact")
+            continue
+        spec, _, mut = tok.partition(".")
+        if spec not in registry:
+            findings.append(
+                f"phantom spec: {tok} cited in {sorted(cites[tok])} but "
+                f"no spec named {spec!r} exists in tools/protospec/"
+            )
+        elif tok not in coded:
+            findings.append(
+                f"phantom mutation: {tok} cited in {sorted(cites[tok])} "
+                f"but {spec!r} codes no such mutation "
+                f"(have {sorted(registry[spec])})"
+            )
+    documented = {t for t, srcs in cites.items() if "README.md" in srcs}
+    for tok in sorted(coded - documented):
+        findings.append(
+            f"undocumented mutation: {tok} is coded in tools/protospec/ "
+            f"but never cited in README.md's spec table — the red-team "
+            f"coverage a reader can see must match the code"
+        )
+    return findings
+
+
+if __name__ == "__main__":
+    _lintlib.main(run)

@@ -29,7 +29,7 @@ protocol class, three ways:
    reachable. Each spec also carries MUTATIONS encoding the three
    historical bugs; ``run_check.py`` asserts the explorer finds every
    mutation within the bound and none on the true specs, and commits
-   the state/transition counts as MODEL_r17.json.
+   the state/transition counts as MODEL_r19.json.
 
 3. **Conformance** (``conformance.py``): the same specs replayed as
    trace ACCEPTORS over real flight-recorder timelines (obs/recorder),
@@ -54,6 +54,7 @@ def all_specs():
         spec_gbn,
         spec_hello,
         spec_lane,
+        spec_reshard,
         spec_shard,
         spec_snap,
     )
@@ -61,6 +62,7 @@ def all_specs():
     out = {}
     for mod in (
         spec_hello, spec_gbn, spec_snap, spec_drain, spec_lane, spec_shard,
+        spec_reshard,
     ):
         for cls in mod.SPECS:
             out[cls.name] = cls

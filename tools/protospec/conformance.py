@@ -18,6 +18,16 @@ Scopes and their acceptors (each defined next to its spec):
 - per (node, link): spec_gbn.SubAcceptor       (attach-before-resync)
 - per (node, link): spec_lane.LaneAcceptor     (lane/stripe lifecycle)
 - per (node, link): spec_hello.HelloAcceptor   (one negotiation verdict)
+- per node:         spec_reshard.ReshardAcceptor (staged split/merge
+                    transfer ordering: begin/done pairing, no nesting,
+                    no split/merge overlap)
+- global:           spec_reshard.MasterAuthorityAcceptor (grant-epoch
+                    monotonicity, sealed-while-in-flight, only the new
+                    master mints after the authority lands)
+
+The "global" scope kind (r19) keys ONE acceptor for the whole timeline:
+master-authority discipline is a cross-node property — two nodes both
+minting is exactly what no per-node projection can see.
 
 Events the specs don't model pass through untouched — a timeline is a
 lossy projection (the native ring drops under overflow and the
@@ -37,11 +47,13 @@ from .spec_drain import DrainAcceptor
 from .spec_gbn import LinkAcceptor, SubAcceptor
 from .spec_hello import HelloAcceptor
 from .spec_lane import LaneAcceptor
+from .spec_reshard import MasterAuthorityAcceptor, ReshardAcceptor
 from .spec_snap import LifecycleAcceptor
 
 #: event name -> (acceptor class, scope kind). "node" scopes key on the
-#: node id; "link" scopes on (node, link). One event may drive several
-#: acceptors (link_down closes both the window and the lane).
+#: node id; "link" scopes on (node, link); "global" keys one acceptor
+#: for the whole timeline. One event may drive several acceptors
+#: (link_down closes both the window and the lane).
 _ROUTES: list = [
     (
         frozenset(
@@ -79,6 +91,25 @@ _ROUTES: list = [
         "link",
     ),
     (frozenset({"shm_lane_up", "shm_fallback"}), HelloAcceptor, "link"),
+    (
+        frozenset(
+            {
+                "reshard_split_begin",
+                "reshard_split_done",
+                "reshard_merge_begin",
+                "reshard_merge_done",
+            }
+        ),
+        ReshardAcceptor,
+        "node",
+    ),
+    (
+        frozenset(
+            {"reshard_master_begin", "reshard_master_done", "reshard_grant"}
+        ),
+        MasterAuthorityAcceptor,
+        "global",
+    ),
 ]
 
 
@@ -96,18 +127,17 @@ def check_timeline(timeline: Iterable[Any]) -> dict:
         for names, cls, kind in _ROUTES:
             if name not in names:
                 continue
-            key = (
-                (cls.__name__, e["node"])
-                if kind == "node"
-                else (cls.__name__, e["node"], e["link"])
-            )
+            if kind == "node":
+                key = (cls.__name__, e["node"])
+                scope = f"{cls.__name__} node={e['node']}"
+            elif kind == "link":
+                key = (cls.__name__, e["node"], e["link"])
+                scope = f"{cls.__name__} node={e['node']} link={e['link']}"
+            else:  # global: one acceptor for the whole timeline
+                key = (cls.__name__,)
+                scope = cls.__name__
             acc = acceptors.get(key)
             if acc is None:
-                scope = (
-                    f"{cls.__name__} node={e['node']}"
-                    if kind == "node"
-                    else f"{cls.__name__} node={e['node']} link={e['link']}"
-                )
                 acc = acceptors[key] = cls(scope)
             acc.step(e)
             hit = True

@@ -12,7 +12,7 @@ delivered yet" is always a reachable ordering).
 The explorer is deliberately plain: breadth-first over canonicalized
 states (``canon`` is the per-spec symmetry reduction — e.g. sorting
 interchangeable peers), a seen-set of state hashes, invariants checked
-at every state, and three verdict classes:
+at every state, and four verdict classes:
 
 - **invariant**: a reached state violates a named safety property;
 - **wedged**: a reached non-quiescent state has NO enabled action —
@@ -20,10 +20,39 @@ at every state, and three verdict classes:
   modeled as "the blocked action is not enabled", so the wedge is a
   missing successor, not an infinite path);
 - **no-quiescence**: the whole bounded graph contains no quiescent
-  state (the protocol cannot finish even with a cooperative adversary).
+  state (the protocol cannot finish even with a cooperative adversary);
+- **liveness** (r19): a fairness-bounded "always eventually" property
+  fails — the explored graph contains a reachable FAIR cycle no state
+  of which satisfies the property's good-set (a lasso the adversary can
+  drive forever without ever converging/resuming). See ``Spec.liveness``
+  / ``Spec.fairness`` and the SCC pass below.
+
+The r19 reshard models dwarf the r15–r17 state spaces, so the explorer
+carries two REDUCTIONS, both off-by-default per spec (identity hooks):
+
+- **symmetry** (``canon``, r15): states are deduplicated by their
+  canonical representative — a spec with interchangeable node/shard
+  identities maps each state to the least relabeling, and the explorer
+  never expands two states in the same orbit.
+- **partial-order** (``ample``, r19): at each state the spec may
+  nominate an AMPLE SUBSET of the enabled actions whose members commute
+  with (and neither disable nor are disabled by) every action left out
+  — pure-local steps like an in-flight delivery that touches one
+  channel. The explorer expands only the ample set, and enforces the
+  classic soundness provisos DYNAMICALLY rather than trusting the spec:
+  the reduction is dropped at any state where (C2-invisibility) an
+  ample action changes the invariant verdicts or the quiescence of its
+  successor, or (C3-cycle) an ample successor lands on an
+  already-seen state — the standard cycle proviso, conservatively
+  triggered by cross edges too, so an action can never be deferred
+  around a loop forever. Independence itself (C1) is the spec's
+  declared contract; the reduction-soundness regression in
+  tests/test_protospec.py re-finds every seeded mutation with the
+  reductions on.
 
 Counterexamples are reconstructed from a predecessor map and reported
-as the action path from the initial state.
+as the action path from the initial state; liveness counterexamples are
+lassos (stem trace + the cycle's actions in the detail).
 
 States are value objects (tuples of primitives / frozensets); specs
 never mutate them. Determinism matters: the committed MODEL artifact
@@ -43,7 +72,7 @@ class Spec:
 
     - ``name``: artifact/report key;
     - ``depth_bound``: BFS depth the checker explores to (committed in
-      MODEL_r17.json — "verified to depth D" is the honest claim);
+      MODEL_r19.json — "verified to depth D" is the honest claim);
     - ``mutations``: mutation name -> the historical bug it seeds
       (constructed via ``Spec(mutation=name)``).
     """
@@ -87,10 +116,44 @@ class Spec:
         representative (default: identity)."""
         return state
 
+    # -- r19 reduction / liveness hooks --------------------------------------
+
+    def ample(self, state, acts: list) -> list:
+        """Partial-order reduction: return a subset of ``acts`` whose
+        members are independent of every action left out (commute with
+        them and neither disable nor are disabled by them). Returning
+        ``acts`` unchanged (the default) disables the reduction at this
+        state. The explorer enforces the invisibility and cycle provisos
+        dynamically and falls back to full expansion when they fail, so
+        a spec only vouches for INDEPENDENCE, not for the global
+        soundness conditions."""
+        return acts
+
+    def liveness(self) -> dict:
+        """Fairness-bounded "always eventually" properties: name -> a
+        good-state predicate. A property FAILS iff the explored graph
+        contains a reachable fair cycle (see ``fairness``) none of whose
+        states satisfies the predicate — i.e. some infinite fair
+        adversary schedule avoids the good set forever. Default: no
+        liveness properties (the r15–r17 wedged/no-quiescence verdicts
+        still apply)."""
+        return {}
+
+    def fairness(self) -> list:
+        """Weak-fairness constraints: ``[(name, action_predicate)]``.
+        A cycle is FAIR iff for every constraint either (a) some edge of
+        the cycle takes a matching action, or (b) some state on the
+        cycle has no matching action enabled (so the constraint is not
+        continuously enabled and weak fairness demands nothing). Actions
+        left unmatched by every constraint — adversary drops, dup
+        redeliveries, stale replays — may be scheduled forever, which is
+        exactly the adversarial schedule liveness must survive."""
+        return []
+
 
 @dataclasses.dataclass
 class Violation:
-    kind: str  # "invariant" | "wedged" | "no-quiescence"
+    kind: str  # "invariant" | "wedged" | "no-quiescence" | "liveness"
     detail: str
     depth: int
     trace: tuple  # action path from the initial state
@@ -115,10 +178,19 @@ class ExploreResult:
     truncated_by_depth: bool
     quiescent_reachable: bool
     violations: list[Violation]
+    # r19: liveness verdicts, property name -> True (holds) / False
+    # (fair counterexample lasso found) / None (graph truncated by the
+    # depth bound, so cycles beyond the horizon are unknowable — an
+    # honest "not checked", never a silent pass).
+    liveness: dict = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
-        return not self.violations and self.quiescent_reachable
+        return (
+            not self.violations
+            and self.quiescent_reachable
+            and all(v is True for v in self.liveness.values())
+        )
 
     def as_dict(self) -> dict:
         return {
@@ -131,6 +203,7 @@ class ExploreResult:
             "truncated_by_depth": self.truncated_by_depth,
             "quiescent_reachable": self.quiescent_reachable,
             "violations": [v.as_dict() for v in self.violations],
+            "liveness": dict(self.liveness),
         }
 
 
@@ -150,6 +223,7 @@ def explore(
     depth_bound: Optional[int] = None,
     max_states: int = 2_000_000,
     max_violations: int = 4,
+    reduction: bool = True,
 ) -> ExploreResult:
     """Exhaustive BFS of ``spec`` to its depth bound.
 
@@ -158,10 +232,30 @@ def explore(
     honest via ``violations != []``). ``max_states`` is a hard memory
     backstop — hitting it raises, because a truncated-by-memory run
     must never masquerade as an exhaustive one.
+
+    ``reduction=False`` bypasses BOTH reductions (``canon`` becomes
+    identity, ``ample`` is ignored) so tests can A/B the reduced graph
+    against ground truth. Specs with identity hooks (all of r15–r17)
+    produce bit-identical results either way — the committed
+    MODEL artifacts stay reproducible.
+
+    When ``spec.liveness()`` declares properties, the explorer retains
+    the successor graph and — only after true frontier exhaustion —
+    runs an SCC pass per property: a strongly connected component of
+    the ¬good-induced subgraph that contains a cycle and admits a FAIR
+    schedule (see ``Spec.fairness``) is a lasso the adversary can drive
+    forever, reported as a ``liveness`` violation with the stem trace
+    and the cycle's actions.
     """
     bound = spec.depth_bound if depth_bound is None else depth_bound
+    if reduction:
+        canon = spec.canon
+    else:
+        def canon(s):
+            return s
+    live_props = spec.liveness()
     init = spec.initial()
-    ckey = spec.canon(init)
+    ckey = canon(init)
     seen: set = {ckey}
     parent: dict = {ckey: (None, None)}
     frontier: list = [(init, ckey)]
@@ -169,6 +263,11 @@ def explore(
     quiescent = spec.quiescent(init)
     states, transitions, depth = 1, 0, 0
     truncated = False
+    # Liveness needs the full edge set (including edges into
+    # already-seen states — exactly the ones that close cycles) and a
+    # concrete representative per canonical key.
+    succs: dict = {ckey: []} if live_props else {}
+    rep: dict = {ckey: init} if live_props else {}
 
     bad = spec.invariants(init)
     for b in bad[: max(0, max_violations - len(violations))]:
@@ -193,14 +292,44 @@ def explore(
                         )
                     )
                 continue
-            for act in acts:
+            expand = acts
+            if reduction:
+                cand = spec.ample(state, acts)
+                if cand and len(cand) < len(acts):
+                    # Dynamic provisos. C2 (invisibility): an ample
+                    # action must not change any verdict. C3 (cycle):
+                    # no ample successor may land on an already-seen
+                    # state, else an excluded action could be deferred
+                    # around a loop forever. Either failure → full
+                    # expansion at this state.
+                    s_inv = spec.invariants(state)
+                    s_qui = spec.quiescent(state)
+                    ok = True
+                    for act in cand:
+                        t = spec.apply(state, act)
+                        tkey = canon(t)
+                        if (
+                            tkey in seen
+                            or spec.invariants(t) != s_inv
+                            or spec.quiescent(t) != s_qui
+                        ):
+                            ok = False
+                            break
+                    if ok:
+                        expand = cand
+            for act in expand:
                 t = spec.apply(state, act)
                 transitions += 1
-                tkey = spec.canon(t)
+                tkey = canon(t)
+                if live_props:
+                    succs[key].append((act, tkey))
                 if tkey in seen:
                     continue
                 seen.add(tkey)
                 parent[tkey] = (key, act)
+                if live_props:
+                    succs[tkey] = []
+                    rep[tkey] = t
                 states += 1
                 if states > max_states:
                     raise RuntimeError(
@@ -232,6 +361,30 @@ def explore(
                 (),
             )
         )
+
+    live_verdicts: dict = {}
+    if live_props:
+        if truncated:
+            # Cycles beyond the depth horizon are unknowable; "not
+            # checked" must never read as "holds".
+            live_verdicts = {name: None for name in live_props}
+        else:
+            fair = spec.fairness()
+            for name, good in sorted(live_props.items()):
+                lasso = _fair_lasso(succs, rep, good, fair, spec)
+                live_verdicts[name] = lasso is None
+                if lasso is not None and len(violations) < max_violations:
+                    entry, cycle_acts = lasso
+                    violations.append(
+                        Violation(
+                            "liveness",
+                            f"{name}: fair cycle avoids good set forever; "
+                            f"cycle actions {[repr(a) for a in cycle_acts]}",
+                            len(_trace(parent, entry)),
+                            _trace(parent, entry),
+                        )
+                    )
+
     return ExploreResult(
         spec=spec.name,
         mutation=spec.mutation,
@@ -242,7 +395,119 @@ def explore(
         truncated_by_depth=truncated,
         quiescent_reachable=quiescent,
         violations=violations,
+        liveness=live_verdicts,
     )
+
+
+def _fair_lasso(succs: dict, rep: dict, good, fair: list, spec: Spec):
+    """Find a fair cycle in the ¬good-induced subgraph of the explored
+    state graph, or None if every ¬good cycle is unfair.
+
+    Iterative Tarjan over the subgraph of states whose representative
+    fails ``good``. A component with at least one internal edge (size >
+    1 or a self-loop) carries an infinite schedule; that schedule can be
+    made to traverse EVERY internal state and edge (strong
+    connectivity), so the component admits a fair cycle iff for every
+    weak-fairness constraint either some internal edge's action matches
+    it or some member state has no matching enabled action. Returns
+    ``(entry_key, cycle_actions)`` — the first-discovered member as the
+    stem target plus a concrete action cycle inside the component.
+    """
+    nodes = [k for k in succs if not good(rep[k])]
+    node_set = set(nodes)
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    counter = [0]
+
+    def strong(root):
+        # Iterative Tarjan; yields SCCs as lists of keys.
+        work = [(root, 0)]
+        path = []
+        out = []
+        while work:
+            v, pi = work.pop()
+            if pi == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on_stack.add(v)
+                path.append(v)
+            recurse = False
+            edges = succs[v]
+            for i in range(pi, len(edges)):
+                w = edges[i][1]
+                if w not in node_set:
+                    continue
+                if w not in index:
+                    work.append((v, i + 1))
+                    work.append((w, 0))
+                    recurse = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if recurse:
+                continue
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(comp)
+            path.pop()
+            if path:
+                low[path[-1]] = min(low[path[-1]], low[v])
+        return out
+
+    for start in nodes:
+        if start in index:
+            continue
+        for comp in strong(start):
+            comp_set = set(comp)
+            internal = [
+                (u, act, w)
+                for u in comp
+                for act, w in succs[u]
+                if w in comp_set
+            ]
+            if not internal:
+                continue  # trivial SCC, no cycle
+            fair_ok = True
+            for _, pred in fair:
+                if any(pred(act) for _, act, _ in internal):
+                    continue
+                if any(
+                    not any(pred(a) for a in spec.enabled(rep[u]))
+                    for u in comp
+                ):
+                    continue
+                fair_ok = False
+                break
+            if not fair_ok:
+                continue
+            return comp[0], _cycle_actions(succs, comp_set, comp[0])
+    return None
+
+
+def _cycle_actions(succs: dict, comp_set: set, entry) -> tuple:
+    """Walk intra-component successors from ``entry`` until a state
+    repeats; return the actions of the closed portion of the walk."""
+    seen_at: dict = {entry: 0}
+    acts: list = []
+    cur = entry
+    while True:
+        act, nxt = next(
+            (a, w) for a, w in succs[cur] if w in comp_set
+        )
+        acts.append(act)
+        if nxt in seen_at:
+            return tuple(acts[seen_at[nxt]:])
+        seen_at[nxt] = len(acts)
+        cur = nxt
 
 
 # -- trace-acceptor base -----------------------------------------------------

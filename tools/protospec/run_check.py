@@ -1,23 +1,35 @@
 #!/usr/bin/env python
 """Model-checker gate: exhaustively explore every protocol spec to its
 documented depth bound, then red-team the checker itself by asserting
-the three seeded historical-bug mutations (r10 fresh_no_seq, r11
-requeue_before_kill, r12 async_pause — plus the extra lane-switch
-ordering mutation) are each FOUND within the same bound.
+every seeded historical-bug mutation (r10 fresh_no_seq, r11
+requeue_before_kill, r12 async_pause, the r16/r17 handoff races, the
+r19 reshard quartet) is FOUND within the same bound.
 
 Exit 0 iff every TRUE spec explores clean (zero violations, quiescence
-reachable, not truncated by the state backstop) AND every mutation is
-caught. Writes the state/transition counts as the round's MODEL
-artifact (default MODEL_r17.json) — the committed artifact pins the
+reachable, liveness verdicts green, not truncated) AND every mutation
+is caught. Writes the state/transition counts as the round's MODEL
+artifact (default MODEL_r19.json) — the committed artifact pins the
 exact counts, so a spec edit that silently changes the explored space
 shows up as a diff, not a mystery.
 
-Usage: python tools/protospec/run_check.py [--out MODEL_r17.json]
+r19: specs run as parallel per-spec units (``--jobs N``, or the
+``ST_SUITE_MODEL_JOBS`` env knob; default min(4, nproc)) and each unit
+reports a suite-style wall-clock line::
+
+    gate model/<spec>: <sec>s rc=<rc>
+
+so suite_load.sh's budget accounting sees the enlarged model set
+per-spec, not as one opaque blob. Output order stays deterministic
+(sorted by spec) regardless of completion order.
+
+Usage: python tools/protospec/run_check.py [--out MODEL_r19.json]
+                                           [--jobs N]
 """
 
 from __future__ import annotations
 
 import json
+import os
 import pathlib
 import sys
 import time
@@ -29,51 +41,106 @@ else:
     from . import all_specs, explore
 
 
-def run(out_path: str | None) -> int:
-    doc: dict = {"artifact": "protospec model check", "specs": {},
-                 "mutations": {}}
-    ok = True
+def default_jobs() -> int:
+    env = os.environ.get("ST_SUITE_MODEL_JOBS", "").strip()
+    if env:
+        return max(1, int(env))
+    return min(4, os.cpu_count() or 1)
+
+
+def check_spec(name: str) -> dict:
+    """One gate unit: the true spec + every mutation. Returns a
+    picklable report (runs in a worker process under --jobs)."""
+    cls = all_specs()[name]
     t0 = time.monotonic()
-    for name, cls in sorted(all_specs().items()):
-        res = explore(cls())
-        doc["specs"][name] = res.as_dict()
-        status = "OK" if res.ok and not res.truncated_by_depth else "FAIL"
-        if status == "FAIL":
-            ok = False
-        print(
-            f"spec {name}: {res.states} states / {res.transitions} "
-            f"transitions to depth {res.max_depth_reached} "
-            f"(bound {res.depth_bound}) — "
-            f"{len(res.violations)} violation(s), quiescent="
-            f"{res.quiescent_reachable} [{status}]"
+    res = explore(cls())
+    unit = {
+        "name": name,
+        "spec": res.as_dict(),
+        "mutations": {},
+        "lines": [],
+        "ok": res.ok and not res.truncated_by_depth,
+    }
+    status = "OK" if unit["ok"] else "FAIL"
+    live = (
+        " liveness=" + ",".join(
+            f"{k}:{'ok' if v else ('?' if v is None else 'FAIL')}"
+            for k, v in sorted(res.liveness.items())
         )
-        for v in res.violations:
-            print(f"  {v.kind}: {v.detail}")
-            if v.trace:
-                print(f"    trace: {' -> '.join(repr(a) for a in v.trace)}")
-        for mut in sorted(cls.mutations):
-            mres = explore(cls(mutation=mut))
-            found = bool(mres.violations)
-            if not found:
-                ok = False
-            first = mres.violations[0] if found else None
-            doc["mutations"][f"{name}.{mut}"] = {
-                "seeds": cls.mutations[mut],
-                "found": found,
-                "states": mres.states,
-                "transitions": mres.transitions,
-                "first_violation": first.as_dict() if first else None,
-            }
-            print(
-                f"  mutation {name}.{mut}: "
-                + (
-                    f"FOUND at depth {first.depth} ({first.kind}: "
-                    f"{first.detail})"
-                    if found
-                    else "NOT FOUND — the checker cannot see this bug class"
-                )
+        if res.liveness
+        else ""
+    )
+    unit["lines"].append(
+        f"spec {name}: {res.states} states / {res.transitions} "
+        f"transitions to depth {res.max_depth_reached} "
+        f"(bound {res.depth_bound}) — "
+        f"{len(res.violations)} violation(s), quiescent="
+        f"{res.quiescent_reachable}{live} [{status}]"
+    )
+    for v in res.violations:
+        unit["lines"].append(f"  {v.kind}: {v.detail}")
+        if v.trace:
+            unit["lines"].append(
+                f"    trace: {' -> '.join(repr(a) for a in v.trace)}"
             )
+    for mut in sorted(cls.mutations):
+        mres = explore(cls(mutation=mut))
+        found = bool(mres.violations)
+        if not found:
+            unit["ok"] = False
+        first = mres.violations[0] if found else None
+        unit["mutations"][f"{name}.{mut}"] = {
+            "seeds": cls.mutations[mut],
+            "found": found,
+            "states": mres.states,
+            "transitions": mres.transitions,
+            "first_violation": first.as_dict() if first else None,
+        }
+        unit["lines"].append(
+            f"  mutation {name}.{mut}: "
+            + (
+                f"FOUND at depth {first.depth} ({first.kind}: "
+                f"{first.detail})"
+                if found
+                else "NOT FOUND — the checker cannot see this bug class"
+            )
+        )
+    unit["duration_sec"] = round(time.monotonic() - t0, 3)
+    return unit
+
+
+def run(out_path: str | None, jobs: int | None = None) -> int:
+    jobs = default_jobs() if jobs is None else max(1, jobs)
+    names = sorted(all_specs())
+    doc: dict = {"artifact": "protospec model check", "specs": {},
+                 "mutations": {}, "gate": {}}
+    t0 = time.monotonic()
+    if jobs > 1 and len(names) > 1:
+        import concurrent.futures
+
+        with concurrent.futures.ProcessPoolExecutor(
+            max_workers=min(jobs, len(names))
+        ) as pool:
+            units = {u["name"]: u for u in pool.map(check_spec, names)}
+    else:
+        units = {n: check_spec(n) for n in names}
+    ok = True
+    for name in names:
+        u = units[name]
+        ok = ok and u["ok"]
+        doc["specs"][name] = u["spec"]
+        doc["mutations"].update(u["mutations"])
+        doc["gate"][name] = {
+            "duration_sec": u["duration_sec"], "rc": 0 if u["ok"] else 1,
+        }
+        for line in u["lines"]:
+            print(line)
+        print(
+            f"gate model/{name}: {u['duration_sec']}s "
+            f"rc={0 if u['ok'] else 1}"
+        )
     doc["duration_sec"] = round(time.monotonic() - t0, 3)
+    doc["jobs"] = jobs
     doc["pass"] = ok
     if out_path:
         with open(out_path, "w") as f:
@@ -86,12 +153,20 @@ def run(out_path: str | None) -> int:
 
 def main() -> int:
     out = None
+    jobs = None
     args = sys.argv[1:]
-    if args and args[0] == "--out":
-        out = args[1]
-    elif args:
-        out = args[0]
-    return run(out)
+    i = 0
+    while i < len(args):
+        if args[i] == "--out":
+            out = args[i + 1]
+            i += 2
+        elif args[i] == "--jobs":
+            jobs = int(args[i + 1])
+            i += 2
+        else:
+            out = args[i]
+            i += 1
+    return run(out, jobs)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,821 @@
+"""Elastic-resharding specs: live split, merge, and master-authority
+handoff (ROADMAP item 1, modeled BEFORE implementation — r20 lands
+against these invariants and the conformance acceptors below).
+
+Three specs over the r16 migration primitive (ho_meta/ho_state/ho_ack
+staging + epoch-minted grants + dedup-window transfer):
+
+- ``reshard_split``: owner C hands HALF its word-range to successor B
+  while writer A keeps producing FWD mass into the moving half. The
+  staging is two-phase like the real protocol: ``split_meta`` captures
+  the end-to-end dedup window (and mints the grant epoch),
+  ``split_ship`` captures the slice mass, ``split_complete`` adopts.
+  From meta onward the TRUE owner parks arriving frames for onward
+  routing. A split may ABORT (drain cancelled) — the minted grant
+  stays in flight as replayable residue, which is exactly the r16
+  stale-grant split-brain surface:
+
+  * ``split_during_fwd`` seeds the double-apply: the owner keeps
+    applying between meta and ship — the unit lands in the shipped
+    mass but NOT in the shipped dedup window, so the at-least-once
+    last hop re-applies it at the successor;
+  * ``stale_grant_readopt`` seeds the split-brain: the successor
+    adopts on a REPLAYED grant instead of consulting the directory's
+    current entry — two simultaneous authorities.
+
+- ``reshard_merge``: the symmetric fold — B's half folds back into C
+  while FWD mass is in flight to BOTH halves. In-flight mass toward
+  the folding half parks at B from merge_meta onward and must be
+  relayed onward at merge_complete;
+
+  * ``merge_drops_inflight_outbox`` clears the parked in-flight mass
+    at the fold instead of relaying it — silent cluster-mass loss.
+
+- ``master_handoff``: grant-minting + map-epoch authority moves to a
+  successor under the same staged epoch discipline (so ``drain_node``
+  works on the master). The TRUE spec SEALS the old minter at
+  ma_meta; the successor adopts the mint cursor shipped at ma_ship.
+
+  * ``two_minters_after_handoff`` skips the seal — both nodes mint,
+    and the collided epoch (or the two live minter flags) trips
+    no-stale-minter.
+
+Invariants across the family: exactly-one-owner-at-every-epoch,
+debited-mass conservation across the split/merge boundary,
+exactly-once application, no-stale-minter, epoch monotonicity.
+
+Liveness (r19 explorer): fairness-bounded "always eventually"
+verdicts — ``eventually-converges`` (no fair adversary schedule avoids
+quiescence forever), ``eventually-resumes`` (parked mass cannot stay
+parked forever), ``eventually-exactly-one-minter``. Adversary actions
+(redelivery, stale-grant replay) carry NO fairness constraint — the
+verdicts must survive an adversary that schedules them forever.
+
+Symmetry: units of the same target half are interchangeable —
+``canon`` relabels them by membership signature so the explorer never
+expands two states in the same orbit. POR: ``produce``/``request``
+commute with every other action (fresh-identity, nothing disabled), so
+the ample set defers all interleavings until production is done.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from .core import Spec, TraceAcceptor
+
+P = 2  # units produced into the moving half (split spec)
+M = 2  # grant mints the split spec allows (bounds the abort/re-split loop)
+R = 2  # grant requests the master spec serves
+REPLAYS = 2  # stale-replay budget (bounded adversary keeps the graph finite)
+
+
+def _relabel(state, set_fields: tuple, n: int, cls_of=None):
+    """Symmetry canon: units with the same target class and the same
+    membership signature across every set field are interchangeable —
+    relabel each class's units in signature order and rebuild the
+    state. Equivalent-up-to-relabeling states collapse to one key."""
+    sig = {}
+    for u in range(1, n + 1):
+        sig[u] = (
+            (0 if cls_of is None else cls_of(u)),
+            tuple(u in getattr(state, f) for f in set_fields),
+        )
+    order = sorted(range(1, n + 1), key=lambda u: (sig[u][0], sig[u][1], 0))
+    # canonical id for each old id: same class keeps its class's id pool
+    pools: dict = {}
+    for u in range(1, n + 1):
+        pools.setdefault(0 if cls_of is None else cls_of(u), []).append(u)
+    newid = {}
+    taken: dict = {k: 0 for k in pools}
+    for u in order:
+        c = 0 if cls_of is None else cls_of(u)
+        newid[u] = pools[c][taken[c]]
+        taken[c] += 1
+    repl = {
+        f: frozenset(newid[u] for u in getattr(state, f)) for f in set_fields
+    }
+    return state._replace(**repl)
+
+
+# ---------------------------------------------------------------------------
+# reshard_split
+# ---------------------------------------------------------------------------
+
+
+class SplitState(NamedTuple):
+    prod: int
+    chan_ab: frozenset  # A->B in flight (per-link layer: exactly-once)
+    led_bc: frozenset  # B->C ledgered, unacked (the at-least-once hop)
+    chan_bc: frozenset  # B->C in flight
+    applied_c: frozenset  # C's moving-half slice (while C is authority)
+    dedup_c: frozenset  # C's end-to-end seen set
+    parked_c: frozenset  # frames C holds from split_meta onward
+    chan_cb: frozenset  # C->B relays (post-release forwarding)
+    applied_b: frozenset  # B's adopted half
+    dedup_b: frozenset  # B's end-to-end seen set (post-adopt)
+    auth_c: int  # C believes it owns the moving half
+    auth_b: int  # B believes it owns the moving half
+    phase: int  # 0 idle / 1 meta (dedup captured) / 2 shipped / 3 done
+    sp_dedup: frozenset  # dedup window captured at split_meta
+    sp_mass: frozenset  # slice mass captured at split_ship
+    sp_epoch: int  # epoch the live split minted (0 = none)
+    grants: frozenset  # minted grant epochs in flight (replayable residue)
+    dir_epoch: int  # directory's current epoch for the half
+    minted: int  # total grants minted (bounded by M)
+    double: int  # ghost: double-applies observed
+    lost: frozenset  # ghost: units destroyed
+
+
+_SPLIT_SETS = (
+    "chan_ab", "led_bc", "chan_bc", "applied_c", "dedup_c", "parked_c",
+    "chan_cb", "applied_b", "dedup_b", "sp_dedup", "sp_mass", "lost",
+)
+
+
+class ReshardSplitSpec(Spec):
+    name = "reshard_split"
+    depth_bound = 26
+    mutations = {
+        "split_during_fwd": (
+            "the owner keeps applying FWDs between split_meta and "
+            "split_ship — the unit rides the shipped mass but not the "
+            "meta-captured dedup window, and the at-least-once last hop "
+            "re-applies it at the successor (double-apply)"
+        ),
+        "stale_grant_readopt": (
+            "the successor adopts on a REPLAYED grant instead of the "
+            "directory's current entry — a grant left over from an "
+            "aborted split re-creates the authority: two simultaneous "
+            "owners (the r16 split-brain class)"
+        ),
+    }
+
+    def initial(self):
+        e = frozenset()
+        return SplitState(
+            0, e, e, e, e, e, e, e, e, e, 1, 0, 0, e, e, 0, e, 0, 0, 0, e
+        )
+
+    def enabled(self, s: SplitState):
+        acts = []
+        if s.prod < P:
+            acts.append(("produce",))
+        for u in sorted(s.chan_ab):
+            acts.append(("deliver_ab", u))
+        for u in sorted(s.chan_bc):
+            acts.append(("deliver_bc", u))
+        for u in sorted(s.led_bc - s.chan_bc):
+            acts.append(("redeliver_bc", u))
+        for u in sorted(s.led_bc):
+            if u in s.dedup_c or u in s.parked_c or u in s.dedup_b:
+                acts.append(("ack_bc", u))
+        for u in sorted(s.chan_cb):
+            acts.append(("deliver_cb", u))
+        if s.auth_c and s.phase == 0 and s.minted < M:
+            acts.append(("split_meta",))
+        if s.phase == 1:
+            acts.append(("split_ship",))
+        if s.phase == 2:
+            acts.append(("split_complete",))
+        if s.phase in (1, 2):
+            acts.append(("split_abort",))
+        for g in sorted(s.grants):
+            acts.append(("grant_stale", g))
+        return acts
+
+    def apply(self, s: SplitState, a):
+        kind = a[0]
+        if kind == "produce":
+            u = s.prod + 1
+            return s._replace(prod=u, chan_ab=s.chan_ab | {u})
+        if kind == "deliver_ab":
+            u = a[1]
+            s = s._replace(chan_ab=s.chan_ab - {u})
+            if s.auth_b and not s.auth_c:
+                return self._apply_at_b(s, u)
+            return s._replace(led_bc=s.led_bc | {u}, chan_bc=s.chan_bc | {u})
+        if kind in ("deliver_bc", "redeliver_bc"):
+            u = a[1]
+            s = s._replace(chan_bc=s.chan_bc - {u})
+            if not s.auth_c:
+                # C released: relay onward to the new owner, identity
+                # unchanged (the verbatim discipline)
+                return s._replace(chan_cb=s.chan_cb | {u})
+            if s.phase in (1, 2) and not (
+                self.mutation == "split_during_fwd" and s.phase == 1
+            ):
+                # TRUE spec: from meta onward, arriving frames park for
+                # onward routing — the dying slice never grows
+                return s._replace(parked_c=s.parked_c | {u})
+            if u in s.dedup_c:
+                return s  # end-to-end duplicate: discarded
+            dbl = s.double + (1 if u in s.applied_c else 0)
+            return s._replace(
+                applied_c=s.applied_c | {u},
+                dedup_c=s.dedup_c | {u},
+                double=dbl,
+            )
+        if kind == "ack_bc":
+            u = a[1]
+            return s._replace(led_bc=s.led_bc - {u})
+        if kind == "deliver_cb":
+            u = a[1]
+            s = s._replace(chan_cb=s.chan_cb - {u})
+            return self._apply_at_b(s, u)
+        if kind == "split_meta":
+            e = s.minted + 1
+            return s._replace(
+                phase=1,
+                sp_dedup=s.dedup_c,
+                sp_epoch=e,
+                grants=s.grants | {e},
+                minted=e,
+            )
+        if kind == "split_ship":
+            return s._replace(phase=2, sp_mass=s.applied_c)
+        if kind == "split_complete":
+            # B adopts the shipped mass + the META-captured dedup
+            # window; C releases; parked frames route onward; the live
+            # grant is consumed and the directory epoch advances
+            return s._replace(
+                phase=3,
+                auth_c=0,
+                auth_b=1,
+                applied_b=s.sp_mass,
+                dedup_b=s.sp_dedup,
+                applied_c=frozenset(),
+                dedup_c=frozenset(),
+                chan_cb=s.chan_cb | s.parked_c,
+                parked_c=frozenset(),
+                grants=s.grants - {s.sp_epoch},
+                dir_epoch=s.sp_epoch,
+                sp_epoch=0,
+            )
+        if kind == "split_abort":
+            # drain cancelled: C stays the authority and applies what
+            # it parked; the minted grant stays IN FLIGHT — the stale
+            # residue the true spec must be immune to
+            applied, dedup = s.applied_c, s.dedup_c
+            for u in sorted(s.parked_c):
+                if u not in dedup:
+                    applied, dedup = applied | {u}, dedup | {u}
+            return s._replace(
+                phase=0,
+                sp_dedup=frozenset(),
+                sp_mass=frozenset(),
+                sp_epoch=0,
+                applied_c=applied,
+                dedup_c=dedup,
+                parked_c=frozenset(),
+            )
+        if kind == "grant_stale":
+            g = a[1]
+            if self.mutation == "stale_grant_readopt" and g != s.sp_epoch:
+                # the buggy successor trusts the grant message instead
+                # of the directory's current entry
+                return s._replace(grants=s.grants - {g}, auth_b=1)
+            # TRUE spec: the grant is acted on via the directory's
+            # CURRENT entry — a grant that is not the live split's is
+            # stale and discarded
+            return s._replace(grants=s.grants - {g})
+        raise AssertionError(a)
+
+    def _apply_at_b(self, s: SplitState, u):
+        if u in s.dedup_b:
+            return s
+        dbl = s.double + (1 if u in s.applied_b else 0)
+        return s._replace(
+            applied_b=s.applied_b | {u},
+            dedup_b=s.dedup_b | {u},
+            double=dbl,
+        )
+
+    def invariants(self, s: SplitState):
+        bad = []
+        if s.auth_c and s.auth_b:
+            bad.append(
+                "exactly-one-owner: two simultaneous authorities for "
+                "the moving half (stale-grant split-brain)"
+            )
+        if s.double:
+            bad.append(
+                "exactly-once: a unit was applied twice at an owner "
+                "authority (end-to-end dedup window breached)"
+            )
+        if s.lost:
+            bad.append(
+                f"conservation: units {sorted(s.lost)} destroyed across "
+                f"the split boundary"
+            )
+        applied = s.applied_b if s.auth_b else s.applied_c
+        held = (
+            applied
+            | s.chan_ab
+            | s.led_bc
+            | s.chan_bc
+            | s.chan_cb
+            | s.parked_c
+            | (s.sp_mass if s.phase == 2 else frozenset())
+            | (s.applied_c if s.auth_b else frozenset())
+            | s.lost
+        )
+        missing = frozenset(range(1, s.prod + 1)) - held
+        if missing:
+            bad.append(
+                f"conservation: units {sorted(missing)} vanished with "
+                f"no channel, ledger, park, or staged mass holding them"
+            )
+        return bad
+
+    def quiescent(self, s: SplitState):
+        applied = s.applied_b if s.auth_b else s.applied_c
+        return (
+            s.prod == P
+            and applied == frozenset(range(1, P + 1))
+            and not s.chan_ab
+            and not s.led_bc
+            and not s.chan_bc
+            and not s.chan_cb
+            and not s.parked_c
+            and s.phase in (0, 3)
+        )
+
+    def canon(self, s: SplitState):
+        return _relabel(s, _SPLIT_SETS, s.prod)
+
+    def ample(self, s: SplitState, acts):
+        # produce mints a FRESH identity: it commutes with every other
+        # action and neither disables nor is disabled by any — the
+        # classic safe ample singleton
+        prod = [a for a in acts if a[0] == "produce"]
+        return prod if prod else acts
+
+    def liveness(self):
+        return {
+            "eventually-converges": self.quiescent,
+            "eventually-resumes": lambda s: (
+                not s.parked_c and s.phase not in (1, 2)
+            ),
+        }
+
+    def fairness(self):
+        return [
+            (k, (lambda a, _k=k: a[0] == _k))
+            for k in (
+                "produce", "deliver_ab", "deliver_bc", "ack_bc",
+                "deliver_cb", "split_ship", "split_complete",
+            )
+        ]
+
+
+# ---------------------------------------------------------------------------
+# reshard_merge
+# ---------------------------------------------------------------------------
+
+MP = 3  # merge spec units: odd -> folding half (B), even -> staying half (C)
+
+
+def _hi(u: int) -> bool:
+    return u % 2 == 1
+
+
+class MergeState(NamedTuple):
+    prod: int
+    chan_ab: frozenset  # A->B in flight
+    led_bc: frozenset  # B->C ledgered, unacked (at-least-once hop)
+    chan_bc: frozenset  # B->C in flight
+    applied_b: frozenset  # folding half's slice at B
+    dedup_b: frozenset
+    parked_b: frozenset  # folding-half frames arriving from merge_meta on
+    applied_c: frozenset  # staying half (and post-fold: everything)
+    dedup_c: frozenset
+    phase: int  # 0 idle / 1 meta / 2 shipped / 3 folded
+    mg_dedup: frozenset
+    mg_mass: frozenset
+    double: int
+    lost: frozenset
+
+
+_MERGE_SETS = (
+    "chan_ab", "led_bc", "chan_bc", "applied_b", "dedup_b", "parked_b",
+    "applied_c", "dedup_c", "mg_dedup", "mg_mass", "lost",
+)
+
+
+class ReshardMergeSpec(Spec):
+    name = "reshard_merge"
+    depth_bound = 24
+    mutations = {
+        "merge_drops_inflight_outbox": (
+            "merge_complete CLEARS the mass parked in flight toward the "
+            "folding half instead of relaying it to the surviving owner "
+            "— the sender's ledger was already debited: silent "
+            "cluster-mass loss"
+        ),
+    }
+
+    def initial(self):
+        e = frozenset()
+        return MergeState(0, e, e, e, e, e, e, e, e, 0, e, e, 0, e)
+
+    def enabled(self, s: MergeState):
+        acts = []
+        if s.prod < MP:
+            acts.append(("produce",))
+        for u in sorted(s.chan_ab):
+            acts.append(("deliver_ab", u))
+        for u in sorted(s.chan_bc):
+            acts.append(("deliver_bc", u))
+        for u in sorted(s.led_bc - s.chan_bc):
+            acts.append(("redeliver_bc", u))
+        for u in sorted(s.led_bc):
+            if u in s.dedup_c:
+                acts.append(("ack_bc", u))
+        if s.phase == 0:
+            acts.append(("merge_meta",))
+        if s.phase == 1:
+            acts.append(("merge_ship",))
+        if s.phase == 2:
+            acts.append(("merge_complete",))
+        return acts
+
+    def apply(self, s: MergeState, a):
+        kind = a[0]
+        if kind == "produce":
+            u = s.prod + 1
+            return s._replace(prod=u, chan_ab=s.chan_ab | {u})
+        if kind == "deliver_ab":
+            u = a[1]
+            s = s._replace(chan_ab=s.chan_ab - {u})
+            if not _hi(u):
+                # staying-half mass forwards to C over the lossy hop
+                return s._replace(
+                    led_bc=s.led_bc | {u}, chan_bc=s.chan_bc | {u}
+                )
+            if s.phase in (1, 2):
+                # folding half mid-fold: park for onward routing
+                return s._replace(parked_b=s.parked_b | {u})
+            if s.phase == 3:
+                # B released its half: relay onward to C
+                return s._replace(
+                    led_bc=s.led_bc | {u}, chan_bc=s.chan_bc | {u}
+                )
+            if u in s.dedup_b:
+                return s
+            dbl = s.double + (1 if u in s.applied_b else 0)
+            return s._replace(
+                applied_b=s.applied_b | {u},
+                dedup_b=s.dedup_b | {u},
+                double=dbl,
+            )
+        if kind in ("deliver_bc", "redeliver_bc"):
+            u = a[1]
+            s = s._replace(chan_bc=s.chan_bc - {u})
+            if u in s.dedup_c:
+                return s
+            dbl = s.double + (1 if u in s.applied_c else 0)
+            return s._replace(
+                applied_c=s.applied_c | {u},
+                dedup_c=s.dedup_c | {u},
+                double=dbl,
+            )
+        if kind == "ack_bc":
+            u = a[1]
+            return s._replace(led_bc=s.led_bc - {u})
+        if kind == "merge_meta":
+            return s._replace(phase=1, mg_dedup=s.dedup_b)
+        if kind == "merge_ship":
+            return s._replace(phase=2, mg_mass=s.applied_b)
+        if kind == "merge_complete":
+            s = s._replace(
+                phase=3,
+                applied_c=s.applied_c | s.mg_mass,
+                dedup_c=s.dedup_c | s.mg_dedup,
+                applied_b=frozenset(),
+                dedup_b=frozenset(),
+            )
+            if self.mutation == "merge_drops_inflight_outbox":
+                return s._replace(
+                    parked_b=frozenset(), lost=s.lost | s.parked_b
+                )
+            # TRUE spec: parked in-flight mass relays onward to the
+            # surviving owner under its unchanged identity
+            return s._replace(
+                led_bc=s.led_bc | s.parked_b,
+                chan_bc=s.chan_bc | s.parked_b,
+                parked_b=frozenset(),
+            )
+        raise AssertionError(a)
+
+    def invariants(self, s: MergeState):
+        bad = []
+        if s.double:
+            bad.append(
+                "exactly-once: a unit was applied twice at an owner "
+                "authority (dedup window not transferred at the fold)"
+            )
+        if s.lost:
+            bad.append(
+                f"conservation: in-flight mass {sorted(s.lost)} toward "
+                f"the folding half dropped at merge-complete"
+            )
+        held = (
+            s.applied_b
+            | s.applied_c
+            | s.chan_ab
+            | s.led_bc
+            | s.chan_bc
+            | s.parked_b
+            | (s.mg_mass if s.phase == 2 else frozenset())
+            | s.lost
+        )
+        missing = frozenset(range(1, s.prod + 1)) - held
+        if missing:
+            bad.append(
+                f"conservation: units {sorted(missing)} vanished with "
+                f"no channel, ledger, park, or staged mass holding them"
+            )
+        if s.phase == 3 and s.applied_b:
+            bad.append(
+                "exactly-one-owner: the folded half still holds slice "
+                "content after the surviving owner adopted"
+            )
+        return bad
+
+    def quiescent(self, s: MergeState):
+        if s.prod != MP or s.chan_ab or s.led_bc or s.chan_bc or s.parked_b:
+            return False
+        all_u = frozenset(range(1, MP + 1))
+        if s.phase == 0:
+            return (
+                s.applied_b == frozenset(u for u in all_u if _hi(u))
+                and s.applied_c == frozenset(u for u in all_u if not _hi(u))
+            )
+        return s.phase == 3 and s.applied_c == all_u
+
+    def canon(self, s: MergeState):
+        return _relabel(s, _MERGE_SETS, s.prod, cls_of=lambda u: u % 2)
+
+    def ample(self, s: MergeState, acts):
+        prod = [a for a in acts if a[0] == "produce"]
+        return prod if prod else acts
+
+    def liveness(self):
+        return {
+            "eventually-converges": self.quiescent,
+            "eventually-resumes": lambda s: (
+                not s.parked_b and s.phase not in (1, 2)
+            ),
+        }
+
+    def fairness(self):
+        return [
+            (k, (lambda a, _k=k: a[0] == _k))
+            for k in (
+                "produce", "deliver_ab", "deliver_bc", "ack_bc",
+                "merge_ship", "merge_complete",
+            )
+        ]
+
+
+# ---------------------------------------------------------------------------
+# master_handoff
+# ---------------------------------------------------------------------------
+
+
+class MasterState(NamedTuple):
+    reqs: int  # grant requests arrived (bounded by R)
+    pending: int  # unserved requests
+    minter0: int  # old master believes it mints
+    minter1: int  # successor believes it mints
+    minted0: int  # old master's mint cursor (max epoch it minted)
+    minted1: int  # successor's mint cursor
+    ever: frozenset  # every epoch ever minted (collision ghost source)
+    flight: frozenset  # grant epochs in flight toward the directory
+    dir_epoch: int  # directory's applied epoch (monotone)
+    phase: int  # 0 idle / 1 meta (sealed) / 2 shipped / 3 handed off
+    ma_cursor: int  # mint cursor snapshot shipped at ma_ship
+    replays: int  # stale-replay budget used (bounded adversary)
+    collide: int  # ghost: an epoch was minted twice
+    regress: int  # ghost: the directory applied a non-monotone epoch
+
+
+class MasterHandoffSpec(Spec):
+    name = "master_handoff"
+    depth_bound = 18
+    mutations = {
+        "two_minters_after_handoff": (
+            "ma_meta does not SEAL the old master — after the authority "
+            "ships, both nodes believe they mint: the successor reuses "
+            "epochs the old master already minted (no-stale-minter)"
+        ),
+    }
+
+    def initial(self):
+        return MasterState(
+            0, 0, 1, 0, 0, 0, frozenset(), frozenset(), 0, 0, 0, 0, 0, 0
+        )
+
+    def enabled(self, s: MasterState):
+        acts = []
+        if s.reqs < R:
+            acts.append(("request",))
+        if s.pending and s.minter0:
+            acts.append(("mint0",))
+        if s.pending and s.minter1:
+            acts.append(("mint1",))
+        for g in sorted(s.flight):
+            acts.append(("grant_deliver", g))
+        if s.replays < REPLAYS:
+            for g in sorted(s.ever - s.flight):
+                acts.append(("replay", g))
+        if s.phase == 0 and s.minter0:
+            acts.append(("ma_meta",))
+        if s.phase == 1:
+            acts.append(("ma_ship",))
+        if s.phase == 2:
+            acts.append(("ma_complete",))
+        return acts
+
+    def apply(self, s: MasterState, a):
+        kind = a[0]
+        if kind == "request":
+            return s._replace(reqs=s.reqs + 1, pending=s.pending + 1)
+        if kind in ("mint0", "mint1"):
+            cur = s.minted0 if kind == "mint0" else s.minted1
+            e = cur + 1
+            s = s._replace(
+                pending=s.pending - 1,
+                flight=s.flight | {e},
+                collide=s.collide + (1 if e in s.ever else 0),
+                ever=s.ever | {e},
+            )
+            if kind == "mint0":
+                return s._replace(minted0=e)
+            return s._replace(minted1=e)
+        if kind == "grant_deliver":
+            g = a[1]
+            s = s._replace(flight=s.flight - {g})
+            if g > s.dir_epoch:
+                return s._replace(dir_epoch=g)
+            # stale (replayed or collided) grant: the directory's epoch
+            # check discards it — applying it would be the regress
+            return s
+        if kind == "replay":
+            return s._replace(
+                flight=s.flight | {a[1]}, replays=s.replays + 1
+            )
+        if kind == "ma_meta":
+            if self.mutation == "two_minters_after_handoff":
+                return s._replace(phase=1)  # no seal: keeps minting
+            return s._replace(phase=1, minter0=0)  # SEAL the old minter
+        if kind == "ma_ship":
+            return s._replace(phase=2, ma_cursor=s.minted0)
+        if kind == "ma_complete":
+            return s._replace(
+                phase=3, minter1=1, minted1=s.ma_cursor
+            )
+        raise AssertionError(a)
+
+    def invariants(self, s: MasterState):
+        bad = []
+        if s.minter0 and s.minter1:
+            bad.append(
+                "no-stale-minter: two nodes simultaneously believe they "
+                "mint grants"
+            )
+        if s.collide:
+            bad.append(
+                "no-stale-minter: an epoch was minted twice (the old "
+                "master kept minting past the shipped cursor)"
+            )
+        if s.regress:
+            bad.append(
+                "epoch-monotonic: the directory applied a non-monotone "
+                "epoch"
+            )
+        return bad
+
+    def quiescent(self, s: MasterState):
+        return (
+            s.reqs == R
+            and s.pending == 0
+            and not s.flight
+            and s.phase in (0, 3)
+        )
+
+    def ample(self, s: MasterState, acts):
+        req = [a for a in acts if a[0] == "request"]
+        return req if req else acts
+
+    def liveness(self):
+        return {
+            "eventually-converges": self.quiescent,
+            "eventually-exactly-one-minter": lambda s: (
+                bool(s.minter0) != bool(s.minter1)
+            ),
+        }
+
+    def fairness(self):
+        return [
+            (k, (lambda a, _k=k: a[0] == _k))
+            for k in (
+                "request", "mint0", "mint1", "grant_deliver",
+                "ma_ship", "ma_complete",
+            )
+        ]
+
+
+# ---------------------------------------------------------------------------
+# conformance acceptors (the r20 implementation lands against these)
+# ---------------------------------------------------------------------------
+
+
+class ReshardAcceptor(TraceAcceptor):
+    """Per-node staged-transfer ordering for split/merge timelines:
+    a ``*_done`` must close a matching open ``*_begin``, staged
+    transfers never nest, and split and merge never overlap on one
+    node. PERMISSIVE about everything else — a killed node legitimately
+    leaves a begin open (kill-restore chaos reuses node ids), so there
+    is no end-of-run obligation."""
+
+    def __init__(self, scope: str = ""):
+        super().__init__(scope)
+        self.open: str = ""  # "", "split", "merge"
+
+    def step(self, event: dict) -> None:
+        name = event.get("name", "")
+        if name not in (
+            "reshard_split_begin", "reshard_split_done",
+            "reshard_merge_begin", "reshard_merge_done",
+        ):
+            return
+        kind = "split" if "split" in name else "merge"
+        if name.endswith("_begin"):
+            if self.open == kind:
+                self._flag(f"nested reshard_{kind}_begin with one open")
+            elif self.open:
+                self._flag(
+                    f"reshard_{kind}_begin while a {self.open} transfer "
+                    f"is open (staged transfers must not overlap)"
+                )
+            self.open = kind
+        else:
+            if self.open != kind:
+                self._flag(
+                    f"reshard_{kind}_done without an open "
+                    f"reshard_{kind}_begin"
+                )
+            self.open = ""
+
+
+class MasterAuthorityAcceptor(TraceAcceptor):
+    """Global epoch discipline for master-authority timelines: grant
+    epochs mint strictly monotonically, a master ``done`` closes an
+    open ``begin``, no grant mints while the authority is in flight,
+    and after the authority lands only the NEW master mints."""
+
+    def __init__(self, scope: str = ""):
+        super().__init__(scope)
+        self.max_epoch = 0
+        self.in_flight = False
+        self.master = None  # node id of the current minting authority
+
+    def step(self, event: dict) -> None:
+        name = event.get("name", "")
+        node = event.get("node", 0)
+        arg = event.get("arg", 0)
+        if name == "reshard_grant":
+            if self.in_flight:
+                self._flag(
+                    "reshard_grant minted while the master authority "
+                    "is in flight (the old minter must be sealed)"
+                )
+            if self.master is not None and node != self.master:
+                self._flag(
+                    f"reshard_grant from node {node} after the "
+                    f"authority moved to node {self.master} "
+                    f"(no-stale-minter)"
+                )
+            if arg <= self.max_epoch:
+                self._flag(
+                    f"reshard_grant epoch {arg} <= already-minted "
+                    f"{self.max_epoch} (epoch monotonicity)"
+                )
+            self.max_epoch = max(self.max_epoch, arg)
+        elif name == "reshard_master_begin":
+            if self.in_flight:
+                self._flag("nested reshard_master_begin")
+            self.in_flight = True
+        elif name == "reshard_master_done":
+            if not self.in_flight:
+                self._flag(
+                    "reshard_master_done without an open "
+                    "reshard_master_begin"
+                )
+            self.in_flight = False
+            self.master = node
+
+
+SPECS = [ReshardSplitSpec, ReshardMergeSpec, MasterHandoffSpec]
